@@ -1,0 +1,26 @@
+"""risingwave_tpu — a TPU-native streaming-SQL framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of RisingWave
+(reference: /root/reference, a Rust streaming-SQL database): Postgres-style SQL
+in, incrementally-maintained materialized views out, exactly-once barrier
+checkpoints, epoch-MVCC state persistence, vnode-based data parallelism.
+
+Architecture (not a port — see SURVEY.md §7):
+  * columnar ``StreamChunk`` deltas are fixed-capacity device buffers with
+    visibility masks (static shapes for XLA),
+  * stateful operators (hash agg / hash join / top-n / dynamic filter) keep
+    their state device-resident and update it inside jitted step functions,
+  * data parallelism is vnode→mesh-shard via ``shard_map``; the hash shuffle
+    is an in-step ICI all-to-all instead of the reference's gRPC exchange,
+  * the control plane (barrier conductor, catalog, SQL frontend) stays host-side.
+"""
+
+import jax
+
+# The framework traffics in int64 row ids / timestamps / keys (the reference's
+# arrays are i64-heavy, e.g. src/common/src/array/mod.rs:334-376). JAX defaults
+# to 32-bit; enable x64 once at import. Floats stay f32 unless a column's
+# logical type says otherwise.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

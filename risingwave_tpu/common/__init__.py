@@ -1,0 +1,11 @@
+from .types import (  # noqa: F401
+    BOOL, BYTEA, DATE, FLOAT32, FLOAT64, INT16, INT32, INT64, INTERVAL,
+    SERIAL, TIME, TIMESTAMP, VARCHAR, DataType, Field, Schema, StringDict,
+    TypeKind, decimal, GLOBAL_STRING_DICT,
+)
+from .chunk import (  # noqa: F401
+    DEFAULT_CHUNK_CAPACITY, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, Column, StreamChunk, chunk_to_rows, compact_chunk_host,
+    concat_rows, empty_chunk, make_chunk,
+)
+from .hashing import VNODE_COUNT, hash_columns, vnode_of, vnode_to_shard  # noqa: F401

@@ -1,0 +1,167 @@
+"""Columnar chunk format — the unit of dataflow.
+
+TPU-first re-design of the reference's ``DataChunk``/``StreamChunk``
+(reference: src/common/src/array/data_chunk.rs:59,
+src/common/src/array/stream_chunk.rs:37-76): a chunk is a struct-of-arrays of
+**fixed-capacity** device buffers plus a visibility mask, so every operator
+step compiles once per (schema, capacity) and never again, regardless of how
+many rows actually arrived (SURVEY.md §7 "Dynamic shapes vs XLA static
+shapes").
+
+Layout per chunk of capacity C:
+  * ``ops``  int8[C]   — Insert / Delete / UpdateDelete / UpdateInsert
+  * ``vis``  bool[C]   — row visibility (capacity padding ⇒ False)
+  * per column: ``data`` dtype[C] and ``mask`` bool[C] (True = non-null)
+
+UpdateDelete/UpdateInsert adjacency carries the same meaning as the
+reference's stream-chunk op pairs (array/stream_chunk.rs:37-45): an update is
+two adjacent rows with the same key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .types import DataType, Schema
+
+# Op codes (match the reference's Op enum order, array/stream_chunk.rs:37).
+OP_INSERT = 0
+OP_DELETE = 1
+OP_UPDATE_DELETE = 2
+OP_UPDATE_INSERT = 3
+
+DEFAULT_CHUNK_CAPACITY = 1024
+
+
+@struct.dataclass
+class Column:
+    data: jax.Array  # dtype[C]
+    mask: jax.Array  # bool[C]; True = non-null
+
+
+@struct.dataclass
+class StreamChunk:
+    """A batch of row-level change events (+ visibility padding)."""
+
+    ops: jax.Array  # int8[C]
+    vis: jax.Array  # bool[C]
+    columns: tuple[Column, ...]
+
+    # -- static views ---------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self.ops.shape[0]
+
+    def cardinality(self) -> jax.Array:
+        """Number of visible rows (traced value)."""
+        return jnp.sum(self.vis)
+
+    # -- functional updates ---------------------------------------------------
+
+    def with_vis(self, vis: jax.Array) -> "StreamChunk":
+        return self.replace(vis=vis)
+
+    def mask_vis(self, keep: jax.Array) -> "StreamChunk":
+        return self.replace(vis=self.vis & keep)
+
+    def project(self, indices: Sequence[int]) -> "StreamChunk":
+        return self.replace(columns=tuple(self.columns[i] for i in indices))
+
+    def with_columns(self, columns: Sequence[Column]) -> "StreamChunk":
+        return self.replace(columns=tuple(columns))
+
+    def append_columns(self, columns: Sequence[Column]) -> "StreamChunk":
+        return self.replace(columns=self.columns + tuple(columns))
+
+    # Insert/delete sign per row: +1 for Insert/UpdateInsert, -1 for
+    # Delete/UpdateDelete, 0 for invisible. The universal "delta weight" used
+    # by aggregation and materialization.
+    def signs(self) -> jax.Array:
+        pos = (self.ops == OP_INSERT) | (self.ops == OP_UPDATE_INSERT)
+        return jnp.where(self.vis, jnp.where(pos, 1, -1).astype(jnp.int32), 0)
+
+
+def make_chunk(
+    schema: Schema,
+    rows: Sequence[Sequence[Any]],
+    ops: Optional[Sequence[int]] = None,
+    capacity: int = DEFAULT_CHUNK_CAPACITY,
+) -> StreamChunk:
+    """Host constructor: python rows → padded device chunk."""
+    n = len(rows)
+    if n > capacity:
+        raise ValueError(f"{n} rows > capacity {capacity}")
+    if ops is None:
+        ops = [OP_INSERT] * n
+    ops_arr = np.zeros(capacity, np.int8)
+    ops_arr[:n] = np.asarray(list(ops), np.int8)
+    vis = np.zeros(capacity, bool)
+    vis[:n] = True
+    cols = []
+    for ci, field in enumerate(schema):
+        t = field.type
+        data = np.full(capacity, t.null_sentinel(), t.np_dtype)
+        mask = np.zeros(capacity, bool)
+        for ri, row in enumerate(rows):
+            v = row[ci]
+            if v is not None:
+                data[ri] = t.to_physical(v)
+                mask[ri] = True
+        cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+    return StreamChunk(jnp.asarray(ops_arr), jnp.asarray(vis), tuple(cols))
+
+
+def empty_chunk(schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY) -> StreamChunk:
+    return make_chunk(schema, [], capacity=capacity)
+
+
+def chunk_to_rows(
+    chunk: StreamChunk, schema: Schema, with_ops: bool = False
+) -> list:
+    """Device chunk → visible python rows (host sync; tests & egress only)."""
+    ops = np.asarray(chunk.ops)
+    vis = np.asarray(chunk.vis)
+    datas = [np.asarray(c.data) for c in chunk.columns]
+    masks = [np.asarray(c.mask) for c in chunk.columns]
+    out = []
+    for i in range(chunk.capacity):
+        if not vis[i]:
+            continue
+        row = tuple(
+            schema[ci].type.to_python(datas[ci][i]) if masks[ci][i] else None
+            for ci in range(len(schema))
+        )
+        out.append((int(ops[i]), row) if with_ops else row)
+    return out
+
+
+def compact_chunk_host(chunk: StreamChunk) -> StreamChunk:
+    """Pack visible rows to the front (host-side; not for jitted paths)."""
+    vis = np.asarray(chunk.vis)
+    idx = np.nonzero(vis)[0]
+    cap = chunk.capacity
+    pad = np.zeros(cap - len(idx), np.int64)
+    sel = np.concatenate([idx, pad]).astype(np.int64)
+    new_vis = np.zeros(cap, bool)
+    new_vis[: len(idx)] = True
+    return StreamChunk(
+        jnp.asarray(np.asarray(chunk.ops)[sel]),
+        jnp.asarray(new_vis),
+        tuple(
+            Column(jnp.asarray(np.asarray(c.data)[sel]), jnp.asarray(np.asarray(c.mask)[sel]))
+            for c in chunk.columns
+        ),
+    )
+
+
+def concat_rows(chunks: Iterable[StreamChunk], schema: Schema) -> list:
+    rows = []
+    for c in chunks:
+        rows.extend(chunk_to_rows(c, schema))
+    return rows

@@ -1,0 +1,73 @@
+"""Device-side hashing and vnode partitioning.
+
+The reference partitions rows by ``Crc32(dist_key) % 256`` virtual nodes
+(reference: src/common/src/hash/consistent_hash/vnode.rs:34,54-56) and builds
+vectorized hash keys for group-by/join (src/common/src/hash/key.rs:293). Here
+both are pure jnp functions over column arrays so they fuse into the operator
+step: a 64-bit mix (splitmix64 finalizer) combined across key columns, then
+reduced to a vnode index. Exact CRC32 compatibility is not needed — vnode
+assignment only has to be deterministic within this system.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .chunk import Column
+
+VNODE_COUNT = 256  # reference: vnode.rs:54 (2^8 vnodes)
+
+_U64 = jnp.uint64
+
+
+def _splitmix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer — cheap, high-quality 64-bit mixer (public domain)."""
+    x = x.astype(_U64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15)) & jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return x
+
+
+def hash_column(data: jax.Array, mask: jax.Array) -> jax.Array:
+    """uint64 hash of one column; nulls hash to a fixed tag."""
+    if data.dtype == jnp.bool_:
+        raw = data.astype(jnp.uint64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        # Hash the bit pattern; normalize -0.0 to 0.0 first so they collide.
+        f = jnp.where(data == 0, jnp.zeros_like(data), data)
+        bits = jax.lax.bitcast_convert_type(
+            f.astype(jnp.float32), jnp.uint32
+        ).astype(jnp.uint64)
+        raw = bits
+    else:
+        raw = data.astype(jnp.int64).astype(jnp.uint64)
+    h = _splitmix64(raw)
+    null_h = jnp.uint64(0xA5A5A5A55A5A5A5A)
+    return jnp.where(mask, h, null_h)
+
+
+def hash_columns(cols: Sequence[Column]) -> jax.Array:
+    """Combine per-column hashes into one uint64 key hash per row."""
+    h = jnp.uint64(0x243F6A8885A308D3)  # pi fraction seed
+    for c in cols:
+        hc = hash_column(c.data, c.mask)
+        h = _splitmix64(h ^ hc)
+    return h
+
+
+def vnode_of(cols: Sequence[Column]) -> jax.Array:
+    """Per-row vnode in [0, VNODE_COUNT) from the distribution-key columns."""
+    return (hash_columns(cols) % jnp.uint64(VNODE_COUNT)).astype(jnp.int32)
+
+
+def vnode_to_shard(vnode: jax.Array, num_shards: int) -> jax.Array:
+    """vnode → parallel shard. Contiguous range mapping, the same scheme the
+    reference's meta scheduler uses to hand vnode ranges to parallel units
+    (docs/consistent-hash.md)."""
+    per = VNODE_COUNT // num_shards
+    return jnp.minimum(vnode // per, num_shards - 1).astype(jnp.int32)

@@ -1,0 +1,243 @@
+"""Logical type system for the data plane.
+
+Mirrors the capability surface of the reference's 18-variant ``ArrayImpl``
+(reference: src/common/src/array/mod.rs:334-376) but with a TPU-first physical
+mapping: every logical type lowers to a fixed-width device dtype. Varlen types
+(VARCHAR / BYTEA / JSONB) are dictionary-encoded at the ingest boundary — the
+device sees int32 dictionary ids; the host keeps the dictionary (SURVEY.md §7
+"Varlen strings on device").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    BOOL = "boolean"
+    INT16 = "smallint"
+    INT32 = "int"
+    INT64 = "bigint"
+    FLOAT32 = "real"
+    FLOAT64 = "double"
+    DECIMAL = "decimal"        # scaled int64 (fixed point)
+    DATE = "date"              # int32 days since epoch
+    TIME = "time"              # int64 microseconds since midnight
+    TIMESTAMP = "timestamp"    # int64 microseconds since epoch
+    INTERVAL = "interval"      # int64 microseconds (v1: no month component)
+    VARCHAR = "varchar"        # int32 dictionary id
+    BYTEA = "bytea"            # int32 dictionary id
+    SERIAL = "serial"          # int64 row id (vnode-prefixed)
+
+
+_PHYSICAL: dict[TypeKind, Any] = {
+    TypeKind.BOOL: jnp.bool_,
+    TypeKind.INT16: jnp.int16,
+    TypeKind.INT32: jnp.int32,
+    TypeKind.INT64: jnp.int64,
+    TypeKind.FLOAT32: jnp.float32,
+    TypeKind.FLOAT64: jnp.float64,
+    TypeKind.DECIMAL: jnp.int64,
+    TypeKind.DATE: jnp.int32,
+    TypeKind.TIME: jnp.int64,
+    TypeKind.TIMESTAMP: jnp.int64,
+    TypeKind.INTERVAL: jnp.int64,
+    TypeKind.VARCHAR: jnp.int32,
+    TypeKind.BYTEA: jnp.int32,
+    TypeKind.SERIAL: jnp.int64,
+}
+
+_INTEGRAL = {
+    TypeKind.INT16,
+    TypeKind.INT32,
+    TypeKind.INT64,
+    TypeKind.SERIAL,
+    TypeKind.DATE,
+    TypeKind.TIME,
+    TypeKind.TIMESTAMP,
+    TypeKind.INTERVAL,
+    TypeKind.DECIMAL,
+}
+
+
+class StringDict:
+    """Host-side dictionary for a VARCHAR/BYTEA column family.
+
+    Interning happens on the ingest path (source parsers) and decoding on the
+    egress path (materialize / pgwire). Device code only ever compares,
+    hashes, or shuffles the int32 ids. Id 0 is reserved for the empty string
+    so zero-initialised buffers decode cleanly.
+    """
+
+    __slots__ = ("_to_id", "_to_str")
+
+    def __init__(self) -> None:
+        self._to_id: dict[str, int] = {"": 0}
+        self._to_str: list[str] = [""]
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, i: int) -> str:
+        return self._to_str[i]
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+
+# A single process-wide dictionary keeps VARCHAR ids comparable across
+# operators and fragments without a coordination protocol. Sources intern,
+# sinks look up. (A per-column dictionary would shrink ids but require id
+# translation at every join on strings.)
+GLOBAL_STRING_DICT = StringDict()
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """A logical column type. ``scale`` is only meaningful for DECIMAL."""
+
+    kind: TypeKind
+    scale: int = 0
+
+    @property
+    def dtype(self):
+        return _PHYSICAL[self.kind]
+
+    @property
+    def np_dtype(self):
+        return np.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def is_integral(self) -> bool:
+        return self.kind in _INTEGRAL
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64)
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind in (TypeKind.VARCHAR, TypeKind.BYTEA)
+
+    # -- host <-> device value conversion -------------------------------------
+
+    def to_physical(self, v: Any) -> Any:
+        """Python value → physical scalar for device buffers."""
+        if v is None:
+            return self.null_sentinel()
+        if self.kind == TypeKind.DECIMAL:
+            return int(round(float(v) * 10**self.scale))
+        if self.is_string:
+            return GLOBAL_STRING_DICT.intern(v if isinstance(v, str) else v.decode())
+        if self.kind == TypeKind.BOOL:
+            return bool(v)
+        if self.is_float:
+            return float(v)
+        return int(v)
+
+    def to_python(self, v: Any) -> Any:
+        """Physical scalar → Python value (for result rows / tests)."""
+        if self.kind == TypeKind.DECIMAL:
+            return int(v) / 10**self.scale if self.scale else int(v)
+        if self.is_string:
+            return GLOBAL_STRING_DICT.lookup(int(v))
+        if self.kind == TypeKind.BOOL:
+            return bool(v)
+        if self.is_float:
+            return float(v)
+        return int(v)
+
+    def null_sentinel(self) -> Any:
+        """Filler for null/invisible slots. The validity mask is authoritative;
+        the sentinel only needs to be a valid value of the physical dtype."""
+        if self.kind == TypeKind.BOOL:
+            return False
+        if self.is_float:
+            return 0.0
+        return 0
+
+
+# Convenience singletons.
+BOOL = DataType(TypeKind.BOOL)
+INT16 = DataType(TypeKind.INT16)
+INT32 = DataType(TypeKind.INT32)
+INT64 = DataType(TypeKind.INT64)
+FLOAT32 = DataType(TypeKind.FLOAT32)
+FLOAT64 = DataType(TypeKind.FLOAT64)
+DATE = DataType(TypeKind.DATE)
+TIME = DataType(TypeKind.TIME)
+TIMESTAMP = DataType(TypeKind.TIMESTAMP)
+INTERVAL = DataType(TypeKind.INTERVAL)
+VARCHAR = DataType(TypeKind.VARCHAR)
+BYTEA = DataType(TypeKind.BYTEA)
+SERIAL = DataType(TypeKind.SERIAL)
+
+
+def decimal(scale: int = 2) -> DataType:
+    return DataType(TypeKind.DECIMAL, scale=scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """A named, typed column in a schema."""
+
+    name: str
+    type: DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    """Ordered column metadata for a chunk/table.
+
+    Static (hashable) so it can live in jit-static args and plan nodes.
+    Counterpart of the reference's ``Schema`` (src/common/src/catalog/schema.rs).
+    """
+
+    fields: tuple[Field, ...]
+
+    def __post_init__(self):
+        if not isinstance(self.fields, tuple):
+            object.__setattr__(self, "fields", tuple(self.fields))
+
+    @staticmethod
+    def of(*cols: tuple[str, DataType]) -> "Schema":
+        return Schema(tuple(Field(n, t) for n, t in cols))
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    @property
+    def types(self) -> tuple[DataType, ...]:
+        return tuple(f.type for f in self.fields)
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
+
+    def index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def select(self, indices) -> "Schema":
+        return Schema(tuple(self.fields[i] for i in indices))
+
+    def concat(self, other: "Schema") -> "Schema":
+        return Schema(self.fields + other.fields)
