@@ -1,0 +1,87 @@
+"""Benchmark: NEXmark q5-core hash aggregation throughput on one chip.
+
+Runs the hot path of NEXmark q5 (tumble-window projection + per-(window,
+auction) COUNT(*) incremental aggregation — reference workload
+src/tests/simulation/src/nexmark/q5.sql) through the streaming executor stack
+on the real device and reports sustained source rows/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against the reference harness's fixed simulation
+source rate of 5_000 events/s (src/tests/simulation/src/nexmark.rs:24) — the
+repo publishes no absolute numbers (BASELINE.md), so that rate is the only
+in-tree reference point.
+"""
+
+import asyncio
+import json
+import time
+
+import jax
+
+from risingwave_tpu.common import INT64, TIMESTAMP
+from risingwave_tpu.connector import BID_SCHEMA, NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.expr import Literal, call, col
+from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.stream import (
+    Barrier, HashAggExecutor, MockSource, ProjectExecutor,
+)
+
+CHUNK = 4096
+WINDOW_US = 10_000_000  # 10s tumble as the q5 core window
+N_CHUNKS = 200
+WARMUP_CHUNKS = 8
+CHUNKS_PER_EPOCH = 16
+
+
+def build_messages(gen, n_chunks, first_epoch):
+    msgs = [Barrier.new(first_epoch)]
+    epoch = first_epoch
+    for i in range(n_chunks):
+        msgs.append(gen.next_bid_chunk())
+        if (i + 1) % CHUNKS_PER_EPOCH == 0:
+            epoch += 1
+            msgs.append(Barrier.new(epoch))
+    epoch += 1
+    msgs.append(Barrier.new(epoch))
+    return msgs, epoch
+
+
+def main():
+    gen = NexmarkGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    warm_msgs, last_epoch = build_messages(gen, WARMUP_CHUNKS, 1)
+    main_msgs, _ = build_messages(gen, N_CHUNKS, last_epoch + 1)
+
+    # ONE pipeline instance: the warmup messages compile every jitted step the
+    # measured messages reuse (jit caches are per-instance closures).
+    src = MockSource(BID_SCHEMA, warm_msgs)
+    proj = ProjectExecutor(src, [
+        call("tumble_start", col(5, TIMESTAMP), Literal(WINDOW_US, INT64)),
+        col(0, INT64),
+    ], names=("window_start", "auction"))
+    agg = HashAggExecutor(proj, [0, 1], [count_star()],
+                          table_capacity=1 << 18, out_capacity=CHUNK)
+
+    async def drive() -> float:
+        async for _ in agg.execute():  # warmup pass
+            pass
+        jax.block_until_ready(agg.state.lanes)
+        src._messages = main_msgs   # same executors, fresh message script
+        t0 = time.perf_counter()
+        async for _ in agg.execute():
+            pass
+        jax.block_until_ready(agg.state.lanes)
+        return time.perf_counter() - t0
+
+    elapsed = asyncio.run(drive())
+    rows = N_CHUNKS * CHUNK
+    rps = rows / elapsed
+    print(json.dumps({
+        "metric": "nexmark_q5_core_throughput",
+        "value": round(rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rps / 5000.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -122,9 +122,14 @@ def empty_chunk(schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY) -> Strea
 
 
 def chunk_to_rows(
-    chunk: StreamChunk, schema: Schema, with_ops: bool = False
+    chunk: StreamChunk, schema: Schema, with_ops: bool = False,
+    physical: bool = False,
 ) -> list:
-    """Device chunk → visible python rows (host sync; tests & egress only)."""
+    """Device chunk → visible python rows (host sync; tests & egress only).
+
+    ``physical=True`` skips logical decoding (dictionary lookups, decimal
+    descaling) and returns raw physical scalars — the fast path for writing
+    into state tables, which store physical values."""
     ops = np.asarray(chunk.ops)
     vis = np.asarray(chunk.vis)
     datas = [np.asarray(c.data) for c in chunk.columns]
@@ -133,10 +138,16 @@ def chunk_to_rows(
     for i in range(chunk.capacity):
         if not vis[i]:
             continue
-        row = tuple(
-            schema[ci].type.to_python(datas[ci][i]) if masks[ci][i] else None
-            for ci in range(len(schema))
-        )
+        if physical:
+            row = tuple(
+                datas[ci][i].item() if masks[ci][i] else None
+                for ci in range(len(schema))
+            )
+        else:
+            row = tuple(
+                schema[ci].type.to_python(datas[ci][i]) if masks[ci][i] else None
+                for ci in range(len(schema))
+            )
         out.append((int(ops[i]), row) if with_ops else row)
     return out
 

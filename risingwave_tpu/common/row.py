@@ -1,0 +1,85 @@
+"""Row representation and memcomparable key encoding (host tier).
+
+Counterpart of the reference's row serde + memcomparable encoding
+(reference: src/common/src/row/, src/common/src/util/memcmp_encoding.rs):
+encoded keys compare bytewise in the same order as the logical values, which
+is what gives the state store sorted iteration (TopN, range scans, prefix
+scans by group key). Only physical scalars appear here — VARCHAR arrives as
+dictionary ids but is encoded via its string bytes so lexicographic order is
+preserved.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional, Sequence
+
+from .types import DataType, Schema, TypeKind, GLOBAL_STRING_DICT
+
+_NULL_TAG = b"\x00"   # nulls sort first (reference: memcmp_encoding nulls-first default)
+_VAL_TAG = b"\x01"
+
+
+def _enc_int(v: int, bits: int) -> bytes:
+    # flip sign bit => unsigned bytewise order matches signed order
+    off = 1 << (bits - 1)
+    return int(v + off).to_bytes(bits // 8, "big")
+
+
+def _dec_int(b: bytes, bits: int) -> int:
+    off = 1 << (bits - 1)
+    return int.from_bytes(b, "big") - off
+
+
+def _enc_float(v: float) -> bytes:
+    bits = struct.unpack(">Q", struct.pack(">d", float(v)))[0]
+    if bits & (1 << 63):
+        bits = ~bits & 0xFFFFFFFFFFFFFFFF   # negative: flip all
+    else:
+        bits |= 1 << 63                      # positive: flip sign
+    return bits.to_bytes(8, "big")
+
+
+def _dec_float(b: bytes) -> float:
+    bits = int.from_bytes(b, "big")
+    if bits & (1 << 63):
+        bits &= ~(1 << 63) & 0xFFFFFFFFFFFFFFFF
+    else:
+        bits = ~bits & 0xFFFFFFFFFFFFFFFF
+    return struct.unpack(">d", struct.pack(">Q", bits))[0]
+
+
+def _enc_str(s: str) -> bytes:
+    # escape embedded zero bytes, terminate with 0x00 0x00 so prefixes sort first
+    raw = s.encode("utf-8").replace(b"\x00", b"\x00\xff")
+    return raw + b"\x00\x00"
+
+
+def encode_value(v: Optional[Any], t: DataType) -> bytes:
+    """Physical scalar -> memcomparable bytes (nulls first)."""
+    if v is None:
+        return _NULL_TAG
+    k = t.kind
+    if k == TypeKind.BOOL:
+        return _VAL_TAG + (b"\x01" if v else b"\x00")
+    if t.is_string:
+        return _VAL_TAG + _enc_str(GLOBAL_STRING_DICT.lookup(int(v)))
+    if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        return _VAL_TAG + _enc_float(float(v))
+    if k in (TypeKind.INT16,):
+        return _VAL_TAG + _enc_int(int(v), 16)
+    if k in (TypeKind.INT32, TypeKind.DATE):
+        return _VAL_TAG + _enc_int(int(v), 32)
+    return _VAL_TAG + _enc_int(int(v), 64)
+
+
+def encode_key(values: Sequence[Optional[Any]], types: Sequence[DataType]) -> bytes:
+    """Physical row (already via DataType.to_physical) -> sortable key bytes."""
+    return b"".join(encode_value(v, t) for v, t in zip(values, types))
+
+
+def encode_vnode_key(vnode: int, values: Sequence, types: Sequence[DataType]) -> bytes:
+    """vnode-prefixed key — the reference's table key layout
+    ``table_id | vnode | key`` (docs/state-store-overview.md:96); table_id is
+    the store-level namespace here."""
+    return vnode.to_bytes(2, "big") + encode_key(values, types)

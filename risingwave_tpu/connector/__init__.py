@@ -1,0 +1,3 @@
+from .nexmark import (  # noqa: F401
+    AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig, NexmarkGenerator,
+)

@@ -1,0 +1,182 @@
+"""NEXmark event generator — the benchmark source.
+
+Counterpart of the reference's NEXmark connector
+(reference: src/connector/src/source/nexmark/source/reader.rs:41; schemas
+from src/tests/simulation/src/nexmark/create_source.sql). Generation is
+vectorized numpy on the host (a whole chunk per call — there is no per-event
+loop), producing device chunks directly. Distributions follow the NEXmark
+spec shape: event ratio person:auction:bid = 1:3:46, hot-auction/hot-bidder
+skew, price ~ geometric, monotonically advancing event time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..common.chunk import StreamChunk, make_chunk, Column
+from ..common.types import (
+    GLOBAL_STRING_DICT, INT64, Schema, TIMESTAMP, VARCHAR,
+)
+import jax.numpy as jnp
+
+BID_SCHEMA = Schema.of(
+    ("auction", INT64), ("bidder", INT64), ("price", INT64),
+    ("channel", VARCHAR), ("url", VARCHAR), ("date_time", TIMESTAMP),
+    ("extra", VARCHAR),
+)
+
+AUCTION_SCHEMA = Schema.of(
+    ("id", INT64), ("item_name", VARCHAR), ("description", VARCHAR),
+    ("initial_bid", INT64), ("reserve", INT64), ("date_time", TIMESTAMP),
+    ("expires", TIMESTAMP), ("seller", INT64), ("category", INT64),
+    ("extra", VARCHAR),
+)
+
+PERSON_SCHEMA = Schema.of(
+    ("id", INT64), ("name", VARCHAR), ("email_address", VARCHAR),
+    ("credit_card", VARCHAR), ("city", VARCHAR), ("state", VARCHAR),
+    ("date_time", TIMESTAMP), ("extra", VARCHAR),
+)
+
+# NEXmark spec constants (mirroring the generator config semantics in
+# src/connector/src/source/nexmark/mod.rs)
+PERSON_PROPORTION = 1
+AUCTION_PROPORTION = 3
+BID_PROPORTION = 46
+TOTAL_PROPORTION = PERSON_PROPORTION + AUCTION_PROPORTION + BID_PROPORTION
+FIRST_PERSON_ID = 1000
+FIRST_AUCTION_ID = 1000
+FIRST_CATEGORY_ID = 10
+HOT_AUCTION_RATIO = 100
+HOT_BIDDER_RATIO = 100
+NUM_CATEGORIES = 5
+
+_CHANNELS = ["Google", "Facebook", "Baidu", "Apple"]
+_US_STATES = ["AZ", "CA", "ID", "OR", "WY"]
+_CITIES = ["Phoenix", "Los Angeles", "San Francisco", "Boise", "Portland"]
+
+
+@dataclasses.dataclass
+class NexmarkConfig:
+    chunk_capacity: int = 1024
+    events_per_second: int = 10_000   # drives event-time spacing
+    active_people: int = 1000
+    in_flight_auctions: int = 100
+    start_time_us: int = 1_600_000_000_000_000
+
+
+class NexmarkGenerator:
+    """Generates Bid / Auction / Person chunks with a shared event clock."""
+
+    def __init__(self, config: NexmarkConfig = NexmarkConfig(), seed: int = 42):
+        self.cfg = config
+        self.rng = np.random.default_rng(seed)
+        self.events_so_far = 0
+        # pre-intern the small string vocabularies
+        self._channel_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(c) for c in _CHANNELS], np.int32)
+        self._url_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(f"https://www.nexmark.com/item{i}")
+             for i in range(64)], np.int32)
+        self._city_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(c) for c in _CITIES], np.int32)
+        self._state_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(s) for s in _US_STATES], np.int32)
+        self._name_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(f"person-{i}") for i in range(997)],
+            np.int32)
+        self._item_ids = np.array(
+            [GLOBAL_STRING_DICT.intern(f"item-{i}") for i in range(499)],
+            np.int32)
+        self._empty = GLOBAL_STRING_DICT.intern("")
+
+    # -- event-time / id helpers ---------------------------------------------
+
+    def _advance(self, n: int) -> np.ndarray:
+        """Event timestamps (us) for the next n events of this stream's clock."""
+        ids = np.arange(self.events_so_far, self.events_so_far + n, dtype=np.int64)
+        self.events_so_far += n
+        us_per_event = 1_000_000 // max(self.cfg.events_per_second, 1)
+        return self.cfg.start_time_us + ids * max(us_per_event, 1), ids
+
+    def _last_auction_id(self, event_ids: np.ndarray) -> np.ndarray:
+        epoch = event_ids // TOTAL_PROPORTION
+        return FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION
+
+    def _last_person_id(self, event_ids: np.ndarray) -> np.ndarray:
+        epoch = event_ids // TOTAL_PROPORTION
+        return FIRST_PERSON_ID + epoch * PERSON_PROPORTION
+
+    def _mk_col(self, data: np.ndarray, dtype) -> Column:
+        return Column(jnp.asarray(data.astype(dtype)),
+                      jnp.ones(len(data), jnp.bool_))
+
+    def _chunk(self, schema: Schema, arrays: list[np.ndarray], n: int) -> StreamChunk:
+        cap = self.cfg.chunk_capacity
+        cols = []
+        for arr, field in zip(arrays, schema):
+            buf = np.zeros(cap, field.type.np_dtype)
+            buf[:n] = arr.astype(field.type.np_dtype)
+            cols.append(Column(jnp.asarray(buf), jnp.asarray(np.arange(cap) < n)))
+        ops = jnp.zeros(cap, jnp.int8)  # all Insert (append-only source)
+        vis = jnp.asarray(np.arange(cap) < n)
+        return StreamChunk(ops, vis, tuple(cols))
+
+    # -- streams --------------------------------------------------------------
+
+    def next_bid_chunk(self, n: Optional[int] = None) -> StreamChunk:
+        n = n or self.cfg.chunk_capacity
+        ts, eids = self._advance(n)
+        last_auction = self._last_auction_id(eids)
+        last_person = self._last_person_id(eids)
+        hot = self.rng.random(n) < 0.9  # hot auctions get ~90% of bids (spec ratio)
+        hot_auction = (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO
+        cold_auction = last_auction - self.rng.integers(
+            0, self.cfg.in_flight_auctions, n)
+        auction = np.where(hot, hot_auction, cold_auction)
+        hot_b = self.rng.random(n) < 0.9
+        hot_bidder = (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1
+        cold_bidder = np.maximum(
+            last_person - self.rng.integers(0, self.cfg.active_people, n),
+            FIRST_PERSON_ID)
+        bidder = np.where(hot_b, hot_bidder, cold_bidder)
+        price = (100 * np.exp(self.rng.random(n) * np.log(1000.0))).astype(np.int64)
+        channel = self._channel_ids[self.rng.integers(0, len(self._channel_ids), n)]
+        url = self._url_ids[self.rng.integers(0, len(self._url_ids), n)]
+        extra = np.full(n, self._empty, np.int32)
+        return self._chunk(
+            BID_SCHEMA, [auction, bidder, price, channel, url, ts, extra], n)
+
+    def next_auction_chunk(self, n: Optional[int] = None) -> StreamChunk:
+        n = n or self.cfg.chunk_capacity
+        ts, eids = self._advance(n)
+        ids = FIRST_AUCTION_ID + np.arange(n, dtype=np.int64) + (
+            self._last_auction_id(eids[:1])[0] - FIRST_AUCTION_ID)
+        item = self._item_ids[self.rng.integers(0, len(self._item_ids), n)]
+        desc = np.full(n, self._empty, np.int32)
+        initial = self.rng.integers(1, 1000, n).astype(np.int64)
+        reserve = initial + self.rng.integers(0, 1000, n)
+        expires = ts + self.rng.integers(1_000_000, 60_000_000, n)
+        seller = self._last_person_id(eids)
+        category = FIRST_CATEGORY_ID + self.rng.integers(0, NUM_CATEGORIES, n)
+        extra = np.full(n, self._empty, np.int32)
+        return self._chunk(
+            AUCTION_SCHEMA,
+            [ids, item, desc, initial, reserve, ts, expires, seller, category, extra],
+            n)
+
+    def next_person_chunk(self, n: Optional[int] = None) -> StreamChunk:
+        n = n or self.cfg.chunk_capacity
+        ts, eids = self._advance(n)
+        ids = self._last_person_id(eids)
+        name = self._name_ids[self.rng.integers(0, len(self._name_ids), n)]
+        email = np.full(n, self._empty, np.int32)
+        card = np.full(n, self._empty, np.int32)
+        city = self._city_ids[self.rng.integers(0, len(self._city_ids), n)]
+        state = self._state_ids[self.rng.integers(0, len(self._state_ids), n)]
+        extra = np.full(n, self._empty, np.int32)
+        return self._chunk(
+            PERSON_SCHEMA, [ids, name, email, card, city, state, ts, extra], n)

@@ -1,0 +1,5 @@
+from .expr import (  # noqa: F401
+    Cast, Expr, FunctionCall, InputRef, Literal, call, cast, col, eval_many,
+    input_refs, register,
+)
+from .agg import AggCall, agg, count_star  # noqa: F401

@@ -1,0 +1,167 @@
+"""Aggregate function specifications.
+
+Counterpart of the reference's agg impls + registry
+(reference: src/expr/src/agg/general.rs, src/expr/src/agg/def.rs). An
+``AggSpec`` describes, for one aggregate call, how its device-resident state
+is initialised, updated from a signed delta batch, merged, and projected to an
+output value. The hash-agg executor scatters these updates into its
+device-resident group table (SURVEY.md §7 kernel plan) — the spec itself is
+pure jnp and shape-free, so it works for the global (simple agg) case and the
+per-group scatter case alike.
+
+Retraction: count/sum handle Delete deltas exactly (subtract). min/max are
+exact for append-only inputs; under retraction they keep a best-effort bound
+and set ``needs_append_only`` so the planner can insert the reference's
+equivalent of MaterializedInput state (src/expr/src/agg — AggStateStorage::
+MaterializedInput, stream/src/executor/aggregation/agg_state.rs:34,65) once
+that path lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..common.types import DataType, FLOAT64, INT64
+from ..common.chunk import Column
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    """A planned aggregate: kind + input column index (-1 for count(*))."""
+
+    kind: str                      # count / sum / min / max / avg
+    arg: int = -1                  # input column index; -1 => count(*)
+    arg_type: Optional[DataType] = None
+    distinct: bool = False
+
+    @property
+    def output_type(self) -> DataType:
+        if self.kind == "count":
+            return INT64
+        if self.kind == "avg":
+            return FLOAT64
+        assert self.arg_type is not None
+        return self.arg_type
+
+    @property
+    def needs_append_only(self) -> bool:
+        return self.kind in ("min", "max")
+
+    # ---- state layout -------------------------------------------------------
+    # Every agg state is a fixed number of float64/int64 lanes so the group
+    # table can hold all aggs in one [groups, total_lanes] array per dtype.
+    # Layout per kind:
+    #   count -> 1 int lane (running count)
+    #   sum   -> 1 num lane (running sum; int64 for integral, f64 for float)
+    #   avg   -> 2 lanes (sum, count)
+    #   min   -> 1 num lane (+append-only)
+    #   max   -> 1 num lane (+append-only)
+
+    @property
+    def num_lanes(self) -> int:
+        return 2 if self.kind == "avg" else 1
+
+    def init_lanes(self):
+        """Initial per-lane values (python scalars, cast by the table)."""
+        if self.kind in ("min", "max"):
+            return [self._minmax_sentinel()]
+        return [0.0] * self.num_lanes
+
+    def update(self, lanes, value, vmask, signs):
+        """Combine a batch of rows into state lanes via a reduction.
+
+        ``lanes``: current state, list of [G]-or-scalar arrays (one per lane).
+        ``value``: the arg column data for the batch rows ([N]).
+        ``vmask``: arg non-null & row visible ([N] bool).
+        ``signs``: +1 insert / -1 delete / 0 invisible ([N] int32).
+
+        Returns per-row *contributions* (list of [N] arrays) plus a reduce op
+        name per lane ('add' | 'min' | 'max') — the caller performs the
+        scatter/segment reduction, which is where grouped vs global differ.
+        """
+        raise NotImplementedError("use contributions() + reduce_ops()")
+
+    def _minmax_sentinel(self):
+        """Identity element for min/max lanes; int64 extrema for integral
+        args (exact for full-range ids/timestamps), ±inf for floats."""
+        if self._integral_arg():
+            big = jnp.iinfo(jnp.int64).max
+            return big if self.kind == "min" else -big
+        return jnp.inf if self.kind == "min" else -jnp.inf
+
+    def _integral_arg(self) -> bool:
+        return self.arg_type is not None and self.arg_type.is_integral
+
+    def contributions(self, value, vmask, signs):
+        """Per-row contribution arrays, one per lane ([N] each)."""
+        s = signs
+        if self.kind == "count":
+            if self.arg < 0:
+                return [s.astype(jnp.int64)]
+            return [jnp.where(vmask, s, 0).astype(jnp.int64)]
+        if self.kind == "sum":
+            v = jnp.where(vmask, value, 0)
+            return [(v * s).astype(self.state_dtypes()[0])]
+        if self.kind == "avg":
+            v = jnp.where(vmask, value, 0).astype(jnp.float64)
+            if self.arg_type is not None and self.arg_type.kind.name == "DECIMAL":
+                v = v / 10 ** self.arg_type.scale
+            return [v * s, jnp.where(vmask, s, 0).astype(jnp.int64)]
+        if self.kind in ("min", "max"):
+            dt = self.state_dtypes()[0]
+            v = jnp.where(vmask & (s > 0), value, self._minmax_sentinel())
+            return [v.astype(dt)]
+        raise ValueError(self.kind)
+
+    def reduce_ops(self) -> list[str]:
+        if self.kind == "min":
+            return ["min"]
+        if self.kind == "max":
+            return ["max"]
+        return ["add"] * self.num_lanes
+
+    def state_dtypes(self):
+        if self.kind == "count":
+            return [jnp.int64]
+        if self.kind == "sum":
+            if self.arg_type is not None and self.arg_type.is_float:
+                return [jnp.float64]
+            return [jnp.int64]
+        if self.kind == "avg":
+            return [jnp.float64, jnp.int64]
+        # min/max: exact int64 lanes for integral args, f64 otherwise
+        return [jnp.int64 if self._integral_arg() else jnp.float64]
+
+    def output(self, lanes, count_nonzero):
+        """Project state lanes ([G] arrays) to (data, mask) output columns.
+
+        ``count_nonzero``: [G] bool — group has any live rows (drives group
+        liveness, computed by the executor from its row-count lane)."""
+        if self.kind == "count":
+            return lanes[0], jnp.ones_like(count_nonzero)
+        if self.kind == "sum":
+            return lanes[0], count_nonzero
+        if self.kind == "avg":
+            cnt = lanes[1]
+            safe = jnp.where(cnt == 0, 1, cnt)
+            return lanes[0] / safe, cnt != 0
+        if self.kind in ("min", "max"):
+            sent = self._minmax_sentinel()
+            if self._integral_arg():
+                valid = lanes[0] != sent
+            else:
+                valid = jnp.isfinite(lanes[0])
+            out = jnp.where(valid, lanes[0], 0)
+            return out.astype(self.output_type.dtype), valid
+        raise ValueError(self.kind)
+
+
+def count_star() -> AggCall:
+    return AggCall("count", -1)
+
+
+def agg(kind: str, arg: int, arg_type: DataType, distinct: bool = False) -> AggCall:
+    return AggCall(kind, arg, arg_type, distinct)

@@ -1,0 +1,3 @@
+from .hash_table import (  # noqa: F401
+    DeviceHashTable, ht_lookup, ht_lookup_or_insert, ht_new, scatter_reduce,
+)

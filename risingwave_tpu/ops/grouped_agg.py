@@ -1,0 +1,152 @@
+"""Pure device-side grouped-aggregation core.
+
+The functional heart shared by HashAggExecutor (single shard) and the
+sharded/multi-chip path (parallel/sharded_agg.py): all logic is pure
+(state, chunk) -> state / chunk, so it runs unchanged inside ``jit`` on one
+chip or inside ``shard_map`` per mesh shard. See stream/hash_agg.py for the
+semantics discussion and reference citations.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, Column,
+    StreamChunk,
+)
+from ..expr.agg import AggCall
+from .hash_table import DeviceHashTable, ht_lookup_or_insert, ht_new, scatter_reduce
+
+
+@struct.dataclass
+class AggState:
+    table: DeviceHashTable
+    lanes: tuple[jax.Array, ...]       # [cap] per lane; lane 0 = row count
+    prev_lanes: tuple[jax.Array, ...]  # values as of last emitted flush
+    dirty: jax.Array                   # bool[cap] since last barrier flush
+    ckpt_dirty: jax.Array              # bool[cap] since last checkpoint
+    overflow: jax.Array                # bool scalar, sticky
+
+
+class AggCore:
+    """Static config + pure methods for one grouped-agg operator."""
+
+    def __init__(self, key_types: Sequence, group_keys: Sequence[int],
+                 agg_calls: Sequence[AggCall], table_capacity: int,
+                 out_capacity: int):
+        self.key_types = tuple(key_types)
+        self.group_keys = tuple(group_keys)
+        self.agg_calls = tuple(agg_calls)
+        self.capacity = table_capacity
+        self.out_capacity = out_capacity
+        self.groups_per_chunk = out_capacity // 2
+        self.lane_dtypes = [jnp.int64]
+        self.call_lane_ofs = []
+        for c in self.agg_calls:
+            self.call_lane_ofs.append(len(self.lane_dtypes))
+            self.lane_dtypes.extend(c.state_dtypes())
+
+    def init_state(self) -> AggState:
+        cap = self.capacity
+        init_lanes = [jnp.zeros(cap, jnp.int64)]
+        for c in self.agg_calls:
+            for v, dt in zip(c.init_lanes(), c.state_dtypes()):
+                init_lanes.append(jnp.full(cap, v, dt))
+        return AggState(
+            table=ht_new(self.key_types, cap),
+            lanes=tuple(init_lanes),
+            prev_lanes=tuple(init_lanes),
+            dirty=jnp.zeros(cap, jnp.bool_),
+            ckpt_dirty=jnp.zeros(cap, jnp.bool_),
+            overflow=jnp.zeros((), jnp.bool_),
+        )
+
+    # -- pure steps -----------------------------------------------------------
+
+    def apply_chunk(self, state: AggState, chunk: StreamChunk) -> AggState:
+        key_cols = [chunk.columns[i] for i in self.group_keys]
+        table, slots, _is_new, ovf = ht_lookup_or_insert(
+            state.table, key_cols, chunk.vis
+        )
+        signs = chunk.signs()
+        lanes = list(state.lanes)
+        lanes[0] = scatter_reduce(lanes[0], slots, signs, "add")
+        for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
+            if call.arg >= 0:
+                col = chunk.columns[call.arg]
+                value, vmask = col.data, col.mask & chunk.vis
+            else:
+                value = jnp.zeros_like(signs)
+                vmask = chunk.vis
+            contribs = call.contributions(value, vmask, signs)
+            for j, (contrib, op) in enumerate(zip(contribs, call.reduce_ops())):
+                lanes[ofs + j] = scatter_reduce(lanes[ofs + j], slots, contrib, op)
+        mark = jnp.where(chunk.vis, slots, self.capacity)
+        dirty = state.dirty.at[mark].set(True, mode="drop")
+        ckpt_dirty = state.ckpt_dirty.at[mark].set(True, mode="drop")
+        return state.replace(
+            table=table, lanes=tuple(lanes), dirty=dirty,
+            ckpt_dirty=ckpt_dirty, overflow=state.overflow | ovf,
+        )
+
+    def outputs(self, lanes) -> list[tuple[jax.Array, jax.Array]]:
+        live = lanes[0] > 0
+        outs = []
+        for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
+            call_lanes = [lanes[ofs + j] for j in range(call.num_lanes)]
+            data, mask = call.output(call_lanes, live)
+            outs.append((data.astype(call.output_type.dtype), mask))
+        return outs
+
+    def gather_flush_chunk(self, state: AggState, lo: jax.Array) -> StreamChunk:
+        """One output chunk for dirty groups with rank in [lo, lo+G)."""
+        G = self.groups_per_chunk
+        C = self.out_capacity
+        rank = jnp.cumsum(state.dirty) - state.dirty.astype(jnp.int64)
+        in_win = state.dirty & (rank >= lo) & (rank < lo + G)
+        pos = (rank - lo).astype(jnp.int32)
+        idx0 = jnp.where(in_win, 2 * pos, C)      # row for prev value
+        idx1 = jnp.where(in_win, 2 * pos + 1, C)  # row for current value
+
+        prev_live = state.prev_lanes[0] > 0
+        cur_live = state.lanes[0] > 0
+
+        ops = jnp.zeros(C, jnp.int8)
+        ops = ops.at[idx0].set(
+            jnp.where(cur_live, OP_UPDATE_DELETE, OP_DELETE).astype(jnp.int8),
+            mode="drop")
+        ops = ops.at[idx1].set(
+            jnp.where(prev_live, OP_UPDATE_INSERT, OP_INSERT).astype(jnp.int8),
+            mode="drop")
+        vis = jnp.zeros(C, jnp.bool_)
+        vis = vis.at[idx0].set(prev_live, mode="drop")
+        vis = vis.at[idx1].set(cur_live, mode="drop")
+
+        cols = []
+        for kd, km in zip(state.table.key_data, state.table.key_mask):
+            data = jnp.zeros(C, kd.dtype).at[idx0].set(kd, mode="drop")
+            data = data.at[idx1].set(kd, mode="drop")
+            mask = jnp.zeros(C, jnp.bool_).at[idx0].set(km, mode="drop")
+            mask = mask.at[idx1].set(km, mode="drop")
+            cols.append(Column(data, mask))
+        prev_outs = self.outputs(state.prev_lanes)
+        cur_outs = self.outputs(state.lanes)
+        for (pd, pm), (cd, cm) in zip(prev_outs, cur_outs):
+            data = jnp.zeros(C, cd.dtype).at[idx0].set(pd.astype(cd.dtype), mode="drop")
+            data = data.at[idx1].set(cd, mode="drop")
+            mask = jnp.zeros(C, jnp.bool_).at[idx0].set(pm, mode="drop")
+            mask = mask.at[idx1].set(cm, mode="drop")
+            cols.append(Column(data, mask))
+        return StreamChunk(ops, vis, tuple(cols))
+
+    def finish_flush(self, state: AggState) -> AggState:
+        prev = tuple(
+            jnp.where(state.dirty, cur, prev)
+            for cur, prev in zip(state.lanes, state.prev_lanes)
+        )
+        return state.replace(prev_lanes=prev, dirty=jnp.zeros_like(state.dirty))

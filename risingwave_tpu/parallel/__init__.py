@@ -1,0 +1,4 @@
+from .sharded_agg import (  # noqa: F401
+    SHARD_AXIS, ShardedHashAgg, build_sharded_q5_step, make_mesh,
+    shuffle_chunk_local,
+)

@@ -1,0 +1,91 @@
+"""Epoch-versioned state store (host tier).
+
+Counterpart of the reference's ``StateStore`` trait family
+(reference: src/storage/src/store.rs:87-110,163-180,215,264) with the
+Memory backend (src/storage/src/memory.rs) as the first implementation. In
+the TPU design the store is the *truth tier under the device state*: executor
+state lives in HBM and is flushed here on checkpoint barriers; recovery
+reloads it (SURVEY.md §7 "JoinHashMap / AggGroup LRU over Hummock" row).
+
+Semantics kept from the reference:
+  * writes are buffered per epoch and become visible atomically at
+    ``commit(epoch)`` (MemTable → shared-buffer semantics),
+  * reads see the latest committed epoch,
+  * ``checkpoint(epoch)`` materialises a named durable snapshot; the
+    checkpoint manager persists it (storage/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterator, Optional
+
+
+class MemoryStateStore:
+    """Process-local multi-table KV store with epoch commit.
+
+    Keys are ``(table_id, key_bytes)``; values are opaque python tuples
+    (physical row values). Not thread-safe; the single-process runtime drives
+    it from one event loop, matching the per-CN LocalStateStore usage.
+    """
+
+    def __init__(self) -> None:
+        self._committed: dict[int, dict[bytes, tuple]] = {}
+        self._pending: dict[int, dict[int, dict[bytes, Optional[tuple]]]] = {}
+        self.committed_epoch: int = 0
+
+    # -- write path -----------------------------------------------------------
+
+    def ingest(self, table_id: int, epoch: int,
+               puts: dict[bytes, tuple], deletes: set[bytes]) -> None:
+        buf = self._pending.setdefault(epoch, {}).setdefault(table_id, {})
+        for k in deletes:
+            buf[k] = None
+        buf.update(puts)
+
+    def commit(self, epoch: int) -> None:
+        """Atomically apply all writes buffered for epochs ≤ ``epoch``.
+
+        A checkpoint epoch commits every earlier non-checkpoint epoch's
+        buffer too, in epoch order — mirroring the reference where
+        non-checkpoint barriers stage state that the next checkpoint's
+        ``commit_epoch`` makes durable (docs/checkpoint.md:26-44)."""
+        assert epoch > self.committed_epoch, (epoch, self.committed_epoch)
+        for e in sorted(k for k in self._pending if k <= epoch):
+            for table_id, buf in self._pending.pop(e).items():
+                tbl = self._committed.setdefault(table_id, {})
+                for k, v in buf.items():
+                    if v is None:
+                        tbl.pop(k, None)
+                    else:
+                        tbl[k] = v
+        self.committed_epoch = epoch
+
+    # -- read path ------------------------------------------------------------
+
+    def get(self, table_id: int, key: bytes) -> Optional[tuple]:
+        return self._committed.get(table_id, {}).get(key)
+
+    def iter_table(self, table_id: int) -> Iterator[tuple[bytes, tuple]]:
+        yield from sorted(self._committed.get(table_id, {}).items())
+
+    def iter_prefix(self, table_id: int, prefix: bytes) -> Iterator[tuple[bytes, tuple]]:
+        for k, v in self.iter_table(table_id):
+            if k.startswith(prefix):
+                yield k, v
+
+    def table_len(self, table_id: int) -> int:
+        return len(self._committed.get(table_id, {}))
+
+    # -- snapshot (checkpoint/restore hooks) ----------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "committed_epoch": self.committed_epoch,
+            "tables": copy.deepcopy(self._committed),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.committed_epoch = snap["committed_epoch"]
+        self._committed = copy.deepcopy(snap["tables"])
+        self._pending.clear()

@@ -1,0 +1,105 @@
+"""StateTable — the relational view over the state store.
+
+Counterpart of the reference's ``StateTable``
+(reference: src/stream/src/common/table/state_table.rs:62,520,667-686,783):
+pk-addressed row storage with buffered writes that become visible at
+``commit(epoch)``. In the TPU design executors keep *hot* state on device and
+use the StateTable as the durable tier: they write dirty deltas here on
+barriers, and reload on recovery (`scan_all` → device bulk-insert).
+
+Rows are stored as physical-value tuples; pk columns are memcomparable-
+encoded so iteration order == pk order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence
+
+from ..common.row import encode_key
+from ..common.types import Schema
+from .state_store import MemoryStateStore
+
+
+class StateTable:
+    def __init__(
+        self,
+        store: MemoryStateStore,
+        table_id: int,
+        schema: Schema,
+        pk_indices: Sequence[int],
+    ) -> None:
+        self.store = store
+        self.table_id = table_id
+        self.schema = schema
+        self.pk_indices = tuple(pk_indices)
+        self._pk_types = tuple(schema[i].type for i in self.pk_indices)
+        self._puts: dict[bytes, tuple] = {}
+        self._dels: set[bytes] = set()
+
+    # -- key helpers ----------------------------------------------------------
+
+    def key_of(self, row: Sequence[Any]) -> bytes:
+        return encode_key([row[i] for i in self.pk_indices], self._pk_types)
+
+    # -- buffered writes (MemTable semantics) ---------------------------------
+
+    def insert(self, row: Sequence[Any]) -> None:
+        k = self.key_of(row)
+        self._dels.discard(k)
+        self._puts[k] = tuple(row)
+
+    def delete(self, row: Sequence[Any]) -> None:
+        k = self.key_of(row)
+        self._puts.pop(k, None)
+        self._dels.add(k)
+
+    def update(self, old_row: Sequence[Any], new_row: Sequence[Any]) -> None:
+        ko, kn = self.key_of(old_row), self.key_of(new_row)
+        if ko != kn:
+            self.delete(old_row)
+        self.insert(new_row)
+
+    def commit(self, epoch: int) -> None:
+        """Hand the buffered epoch delta to the store (visible after the
+        store-level commit of this epoch)."""
+        if self._puts or self._dels:
+            self.store.ingest(self.table_id, epoch, self._puts, self._dels)
+            self._puts, self._dels = {}, set()
+
+    def is_dirty(self) -> bool:
+        return bool(self._puts or self._dels)
+
+    # -- reads (committed + own uncommitted buffer) ---------------------------
+
+    def get_row(self, pk_values: Sequence[Any]) -> Optional[tuple]:
+        k = encode_key(list(pk_values), self._pk_types)
+        if k in self._dels:
+            return None
+        if k in self._puts:
+            return self._puts[k]
+        return self.store.get(self.table_id, k)
+
+    def scan_all(self) -> Iterator[tuple]:
+        """Committed rows merged with the uncommitted buffer, pk order."""
+        merged: dict[bytes, Optional[tuple]] = {
+            k: v for k, v in self.store.iter_table(self.table_id)
+        }
+        for k in self._dels:
+            merged.pop(k, None)
+        merged.update(self._puts)
+        for k in sorted(merged):
+            v = merged[k]
+            if v is not None:
+                yield v
+
+    def scan_prefix(self, prefix_values: Sequence[Any], n_cols: int) -> Iterator[tuple]:
+        prefix = encode_key(list(prefix_values), self._pk_types[:n_cols])
+        for row in self.scan_all():
+            if self.key_of(row).startswith(prefix):
+                yield row
+
+    def __len__(self) -> int:
+        n = self.store.table_len(self.table_id)
+        new_puts = sum(1 for k in self._puts if self.store.get(self.table_id, k) is None)
+        dead = sum(1 for k in self._dels if self.store.get(self.table_id, k) is not None)
+        return n + new_puts - dead

@@ -1,0 +1,11 @@
+from .message import (  # noqa: F401
+    Barrier, EpochPair, Message, Mutation, MutationKind, Watermark, is_chunk,
+)
+from .executor import (  # noqa: F401
+    EpochCheckExecutor, Executor, SingleInputExecutor, UpdateCheckExecutor,
+    collect_until_barrier, wrap_debug,
+)
+from .source import MockSource, ScheduledSource  # noqa: F401
+from .project import FilterExecutor, ProjectExecutor  # noqa: F401
+from .hash_agg import HashAggExecutor, agg_state_schema  # noqa: F401
+from .materialize import MaterializeExecutor  # noqa: F401

@@ -1,0 +1,139 @@
+"""Executor protocol: async stream transformers over Messages.
+
+Counterpart of the reference's ``Executor`` trait
+(reference: src/stream/src/executor/mod.rs:170-206): every operator is an
+async generator of ``Message`` (chunk / barrier / watermark). Barriers flow
+through every executor and *must* be yielded after the executor has applied
+all chunks of the closing epoch to its state — that ordering is what makes
+the barrier a consistent cut (Chandy-Lamport, docs/checkpoint.md).
+
+The TPU twist: an executor's per-chunk work is a jitted, functionally-pure
+step over (device_state, chunk) — the async generator is only the host
+control loop. Invariant-checking wrappers mirror the reference's
+executor/wrapper/{schema_check,epoch_check,update_check}.rs and are enabled
+in tests/sim runs.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional, Sequence
+
+from ..common.chunk import (
+    OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk, chunk_to_rows,
+)
+from ..common.types import Schema
+from .message import Barrier, Message, Watermark
+
+
+class Executor:
+    """Base class. ``schema`` describes the output chunks."""
+
+    schema: Schema
+    identity: str = "Executor"
+
+    def execute(self) -> AsyncIterator[Message]:
+        raise NotImplementedError
+
+
+class SingleInputExecutor(Executor):
+    """Common shape: transform one upstream, pass barriers/watermarks through.
+
+    Subclasses override ``map_chunk`` (1→0..n chunks) and optionally
+    ``on_barrier`` (flush state, emit pending output *before* the barrier)."""
+
+    def __init__(self, input: Executor):
+        self.input = input
+
+    async def map_chunk(self, chunk: StreamChunk):
+        yield chunk
+
+    async def on_barrier(self, barrier: Barrier):
+        if False:  # pragma: no cover - async generator shape
+            yield
+
+    async def on_watermark(self, watermark: Watermark):
+        yield watermark
+
+    async def execute(self) -> AsyncIterator[Message]:
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                async for out in self.map_chunk(msg):
+                    yield out
+            elif isinstance(msg, Barrier):
+                async for out in self.on_barrier(msg):
+                    yield out
+                yield msg
+                if msg.is_stop():
+                    return
+            elif isinstance(msg, Watermark):
+                async for out in self.on_watermark(msg):
+                    yield out
+
+
+# ---------------------------------------------------------------------------
+# Invariant wrappers (reference: src/stream/src/executor/wrapper/)
+# ---------------------------------------------------------------------------
+
+
+class EpochCheckExecutor(SingleInputExecutor):
+    """Barrier epochs must strictly increase (wrapper/epoch_check.rs)."""
+
+    def __init__(self, input: Executor):
+        super().__init__(input)
+        self.schema = input.schema
+        self.identity = input.identity
+        self._last_epoch: Optional[int] = None
+
+    async def on_barrier(self, barrier: Barrier):
+        if self._last_epoch is not None and barrier.epoch.curr <= self._last_epoch:
+            raise AssertionError(
+                f"epoch regression: {barrier.epoch.curr} after {self._last_epoch} "
+                f"at {self.identity}"
+            )
+        self._last_epoch = barrier.epoch.curr
+        if False:
+            yield
+
+
+class UpdateCheckExecutor(SingleInputExecutor):
+    """UpdateDelete must be immediately followed by UpdateInsert within a
+    chunk (wrapper/update_check.rs)."""
+
+    def __init__(self, input: Executor):
+        super().__init__(input)
+        self.schema = input.schema
+        self.identity = input.identity
+
+    async def map_chunk(self, chunk: StreamChunk):
+        rows = chunk_to_rows(chunk, self.schema, with_ops=True)
+        pending_ud = False
+        for op, _ in rows:
+            if pending_ud and op != OP_UPDATE_INSERT:
+                raise AssertionError(f"U- not followed by U+ at {self.identity}")
+            pending_ud = op == OP_UPDATE_DELETE
+        if pending_ud:
+            raise AssertionError(f"chunk ends with dangling U- at {self.identity}")
+        yield chunk
+
+
+def wrap_debug(executor: Executor) -> Executor:
+    """Compose the sanity wrappers (debug/sim runs)."""
+    return EpochCheckExecutor(UpdateCheckExecutor(executor))
+
+
+async def collect_until_barrier(stream, n_barriers: int = 1):
+    """Test helper: drain messages until the n-th barrier; returns (chunks,
+    barriers, watermarks)."""
+    chunks: list[StreamChunk] = []
+    barriers: list[Barrier] = []
+    watermarks: list[Watermark] = []
+    async for msg in stream:
+        if isinstance(msg, StreamChunk):
+            chunks.append(msg)
+        elif isinstance(msg, Barrier):
+            barriers.append(msg)
+            if len(barriers) >= n_barriers:
+                break
+        else:
+            watermarks.append(msg)
+    return chunks, barriers, watermarks

@@ -1,0 +1,75 @@
+"""Streaming message protocol: Chunk / Barrier / Watermark.
+
+Counterpart of the reference's ``Message`` enum and ``Barrier``/``Mutation``
+(reference: src/stream/src/executor/mod.rs:170-206,220-251,591,677-681). In
+this design messages are host-level control objects flowing between async
+executor generators; the chunks they carry are device-resident pytrees. A
+barrier is purely host-side — device work is fenced by the host awaiting the
+step results for the epoch before forwarding the barrier (SURVEY.md §7
+"Exactly-once barrier semantics").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Union
+
+from ..common.chunk import StreamChunk
+
+
+class MutationKind(enum.Enum):
+    """Config-change commands carried by barriers (reference: Mutation enum,
+    src/stream/src/executor/mod.rs:220-238)."""
+
+    STOP = "stop"
+    ADD = "add"
+    UPDATE = "update"
+    PAUSE = "pause"
+    RESUME = "resume"
+    SOURCE_CHANGE_SPLIT = "source_change_split"
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    kind: MutationKind
+    payload: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochPair:
+    """curr = epoch the barrier opens; prev = epoch it closes
+    (reference: src/common/src/util/epoch.rs)."""
+
+    curr: int
+    prev: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Barrier:
+    epoch: EpochPair
+    checkpoint: bool = False
+    mutation: Optional[Mutation] = None
+
+    @staticmethod
+    def new(curr: int, checkpoint: bool = False, mutation: Optional[Mutation] = None) -> "Barrier":
+        return Barrier(EpochPair(curr, curr - 1), checkpoint, mutation)
+
+    def is_stop(self) -> bool:
+        return self.mutation is not None and self.mutation.kind == MutationKind.STOP
+
+
+@dataclasses.dataclass(frozen=True)
+class Watermark:
+    """Event-time progress on one column (reference: executor/mod.rs:591);
+    downstream state with keys below the watermark can be cleaned/emitted."""
+
+    col_idx: int
+    value: Any
+
+
+Message = Union[StreamChunk, Barrier, Watermark]
+
+
+def is_chunk(m: Message) -> bool:
+    return isinstance(m, StreamChunk)

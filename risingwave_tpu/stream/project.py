@@ -1,0 +1,76 @@
+"""Project and Filter executors (stateless).
+
+Counterparts of the reference's ProjectExecutor / FilterExecutor
+(reference: src/stream/src/executor/project.rs, executor/filter.rs). Both are
+single jitted device steps; Filter keeps ops consistent for Update pairs the
+same way the reference does — if a filter flips visibility across a U-/U+
+pair, the pair degrades to a plain Delete/Insert (filter.rs apply logic).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, Column,
+    StreamChunk,
+)
+from ..common.types import Field, Schema
+from ..expr import Expr
+from .executor import Executor, SingleInputExecutor
+
+
+class ProjectExecutor(SingleInputExecutor):
+    identity = "Project"
+
+    def __init__(self, input: Executor, exprs: Sequence[Expr],
+                 names: Sequence[str] = ()):
+        super().__init__(input)
+        self.exprs = tuple(exprs)
+        names = tuple(names) or tuple(f"expr{i}" for i in range(len(exprs)))
+        self.schema = Schema(tuple(Field(n, e.type) for n, e in zip(names, self.exprs)))
+
+        @jax.jit
+        def _step(chunk: StreamChunk) -> StreamChunk:
+            cols = tuple(e.eval(chunk) for e in self.exprs)
+            return chunk.with_columns(cols)
+
+        self._step = _step
+
+    async def map_chunk(self, chunk: StreamChunk):
+        yield self._step(chunk)
+
+
+class FilterExecutor(SingleInputExecutor):
+    identity = "Filter"
+
+    def __init__(self, input: Executor, predicate: Expr):
+        super().__init__(input)
+        self.schema = input.schema
+        self.predicate = predicate
+
+        @jax.jit
+        def _step(chunk: StreamChunk) -> StreamChunk:
+            cond = predicate.eval(chunk)
+            keep = cond.data & cond.mask  # NULL -> filtered out (SQL WHERE)
+            # Degrade broken update pairs to Insert/Delete: a U- whose U+ was
+            # filtered (or vice versa) must not dangle
+            # (reference: filter.rs / dispatch.rs:635-650 pairing rules).
+            ops = chunk.ops
+            is_ud = ops == OP_UPDATE_DELETE
+            is_ui = ops == OP_UPDATE_INSERT
+            partner_kept = jnp.roll(keep, -1)  # for U- rows: their U+ follows
+            partner_kept_prev = jnp.roll(keep, 1)  # for U+ rows: their U- precedes
+            new_ops = jnp.where(
+                is_ud & ~partner_kept, OP_DELETE,
+                jnp.where(is_ui & ~partner_kept_prev, OP_INSERT, ops),
+            ).astype(ops.dtype)
+            return chunk.replace(ops=new_ops, vis=chunk.vis & keep)
+
+        self._step = _step
+
+    async def map_chunk(self, chunk: StreamChunk):
+        yield self._step(chunk)

@@ -1,0 +1,84 @@
+"""Source executors and test sources.
+
+``MockSource`` mirrors the reference's test utility of the same name
+(reference: src/stream/src/executor/test_utils.rs) — a scripted sequence of
+messages. ``ScheduledSource`` drives a pull-based generator with periodic
+barrier injection, standing in for SourceExecutor + the meta barrier tick
+until the barrier manager lands (reference:
+src/stream/src/executor/source/source_executor.rs:39).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Callable, Iterable, Optional, Sequence
+
+from ..common.chunk import StreamChunk
+from ..common.types import Schema
+from .executor import Executor
+from .message import Barrier, Message, Mutation, MutationKind, Watermark
+
+
+class MockSource(Executor):
+    identity = "MockSource"
+
+    def __init__(self, schema: Schema, messages: Iterable[Message]):
+        self.schema = schema
+        self._messages = list(messages)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        for m in self._messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+class ScheduledSource(Executor):
+    """Pulls chunks from ``generator`` (a callable returning StreamChunk or
+    None when exhausted) and injects a barrier every ``chunks_per_epoch``
+    chunks; every ``checkpoint_frequency``-th barrier is a checkpoint
+    (reference defaults: system_param/mod.rs:39-40)."""
+
+    identity = "ScheduledSource"
+
+    def __init__(
+        self,
+        schema: Schema,
+        generator: Callable[[], Optional[StreamChunk]],
+        chunks_per_epoch: int = 8,
+        checkpoint_frequency: int = 10,
+        first_epoch: int = 1,
+        stop_after_epochs: Optional[int] = None,
+    ):
+        self.schema = schema
+        self._gen = generator
+        self._chunks_per_epoch = chunks_per_epoch
+        self._checkpoint_frequency = checkpoint_frequency
+        self._epoch = first_epoch
+        self._stop_after = stop_after_epochs
+
+    async def execute(self) -> AsyncIterator[Message]:
+        n_barriers = 0
+        # initial barrier opens the first epoch (reference: recovery injects an
+        # init barrier before any data, barrier/recovery.rs:154-173)
+        yield Barrier.new(self._epoch, checkpoint=False)
+        while True:
+            for _ in range(self._chunks_per_epoch):
+                chunk = self._gen()
+                if chunk is None:
+                    yield Barrier.new(
+                        self._epoch + 1, checkpoint=True,
+                        mutation=Mutation(MutationKind.STOP),
+                    )
+                    return
+                yield chunk
+                await asyncio.sleep(0)
+            self._epoch += 1
+            n_barriers += 1
+            ckpt = n_barriers % self._checkpoint_frequency == 0
+            yield Barrier.new(self._epoch, checkpoint=ckpt)
+            if self._stop_after is not None and n_barriers >= self._stop_after:
+                yield Barrier.new(
+                    self._epoch + 1, checkpoint=True,
+                    mutation=Mutation(MutationKind.STOP),
+                )
+                return

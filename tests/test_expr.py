@@ -1,0 +1,83 @@
+"""Expression-engine tests (counterpart of reference vector_op unit tests)."""
+
+import jax
+import numpy as np
+
+from risingwave_tpu.common import (
+    BOOL, FLOAT64, INT64, TIMESTAMP, Schema, chunk_to_rows, make_chunk,
+)
+from risingwave_tpu.expr import call, cast, col, Literal
+from risingwave_tpu.common.chunk import Column
+
+SCHEMA = Schema.of(("a", INT64), ("b", INT64), ("f", FLOAT64), ("flag", BOOL))
+
+
+def rows_of(column, type_, chunk):
+    out = []
+    data = np.asarray(column.data)
+    mask = np.asarray(column.mask)
+    vis = np.asarray(chunk.vis)
+    for i in range(len(data)):
+        if vis[i]:
+            out.append(type_.to_python(data[i]) if mask[i] else None)
+    return out
+
+
+def test_arith_and_nulls():
+    chunk = make_chunk(SCHEMA, [(1, 10, 1.5, True), (2, None, 2.0, False), (3, 30, None, None)], capacity=4)
+    e = col(0, INT64) + col(1, INT64)
+    out = e.eval(chunk)
+    assert rows_of(out, INT64, chunk) == [11, None, 33]
+    prod = col(2, FLOAT64) * 2.0
+    assert rows_of(prod.eval(chunk), FLOAT64, chunk) == [3.0, 4.0, None]
+
+
+def test_divide_by_zero_is_null():
+    chunk = make_chunk(SCHEMA, [(10, 2, 0.0, True), (10, 0, 0.0, True)], capacity=2)
+    out = (col(0, INT64) / col(1, INT64)).eval(chunk)
+    assert rows_of(out, INT64, chunk) == [5, None]
+
+
+def test_comparison_and_kleene_logic():
+    chunk = make_chunk(SCHEMA, [(1, 2, 0.0, True), (2, 2, 0.0, None), (3, None, 0.0, False)], capacity=4)
+    lt = col(0, INT64) < col(1, INT64)
+    assert rows_of(lt.eval(chunk), BOOL, chunk) == [True, False, None]
+    # Kleene: NULL AND FALSE = FALSE, NULL OR TRUE = TRUE
+    e_and = call("and", col(3, BOOL), Literal(False, BOOL))
+    assert rows_of(e_and.eval(chunk), BOOL, chunk) == [False, False, False]
+    e_or = call("or", col(3, BOOL), Literal(True, BOOL))
+    assert rows_of(e_or.eval(chunk), BOOL, chunk) == [True, True, True]
+    e_and2 = call("and", col(3, BOOL), Literal(True, BOOL))
+    assert rows_of(e_and2.eval(chunk), BOOL, chunk) == [True, None, False]
+
+
+def test_case_coalesce_isnull():
+    chunk = make_chunk(SCHEMA, [(1, None, 1.0, True), (2, 20, 2.0, False)], capacity=2)
+    coal = call("coalesce", col(1, INT64), col(0, INT64))
+    assert rows_of(coal.eval(chunk), INT64, chunk) == [1, 20]
+    isn = call("is_null", col(1, INT64))
+    assert rows_of(isn.eval(chunk), BOOL, chunk) == [True, False]
+    case = call("case", call("is_null", col(1, INT64)), Literal(-1, INT64), col(1, INT64))
+    assert rows_of(case.eval(chunk), INT64, chunk) == [-1, 20]
+
+
+def test_cast_and_tumble():
+    sch = Schema.of(("ts", TIMESTAMP),)
+    chunk = make_chunk(sch, [(10_500_000,), (19_999_999,), (20_000_000,)], capacity=4)
+    win = call("tumble_start", col(0, TIMESTAMP), Literal(10_000_000, INT64))
+    assert rows_of(win.eval(chunk), TIMESTAMP, chunk) == [10_000_000, 10_000_000, 20_000_000]
+    f = cast(col(0, TIMESTAMP), FLOAT64)
+    assert rows_of(f.eval(chunk), FLOAT64, chunk)[0] == 10_500_000.0
+
+
+def test_exprs_fuse_under_jit():
+    chunk = make_chunk(SCHEMA, [(i, i * 2, float(i), True) for i in range(4)], capacity=4)
+    e = (col(0, INT64) + col(1, INT64)) * 3
+
+    @jax.jit
+    def step(c):
+        out = e.eval(c)
+        return c.with_columns([Column(out.data, out.mask)])
+
+    got = step(chunk)
+    assert rows_of(got.columns[0], INT64, got) == [0, 9, 18, 27]

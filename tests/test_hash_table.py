@@ -1,0 +1,112 @@
+"""Device hash-table semantics tests: batch find-or-insert, duplicate keys,
+null grouping keys, collision resolution, overflow, read-only lookup."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common import INT64, VARCHAR, Schema, make_chunk
+from risingwave_tpu.ops import ht_lookup, ht_lookup_or_insert, ht_new, scatter_reduce
+
+SCHEMA = Schema.of(("k", INT64),)
+
+
+def _chunk(keys, capacity=16):
+    return make_chunk(SCHEMA, [(k,) for k in keys], capacity=capacity)
+
+
+def _insert(table, keys, capacity=16):
+    chunk = _chunk(keys, capacity)
+    return ht_lookup_or_insert(table, [chunk.columns[0]], chunk.vis)
+
+
+def test_insert_then_find():
+    t = ht_new([INT64], 64)
+    t, slots1, new1, ovf = _insert(t, [10, 20, 30])
+    assert not bool(ovf)
+    assert list(np.asarray(new1)[:3]) == [True, True, True]
+    t, slots2, new2, _ = _insert(t, [20, 30, 40])
+    s1, s2 = np.asarray(slots1), np.asarray(slots2)
+    assert s2[0] == s1[1] and s2[1] == s1[2]  # existing keys hit same slots
+    assert list(np.asarray(new2)[:3]) == [False, False, True]
+    assert int(t.num_occupied()) == 4
+
+
+def test_intra_batch_duplicates_share_slot():
+    t = ht_new([INT64], 64)
+    t, slots, is_new, _ = _insert(t, [7, 7, 7, 8, 8])
+    s = np.asarray(slots)[:5]
+    assert s[0] == s[1] == s[2]
+    assert s[3] == s[4] != s[0]
+    assert int(np.asarray(is_new)[:5].sum()) == 2  # one winner per distinct key
+
+
+def test_null_keys_group_together():
+    t = ht_new([INT64], 64)
+    chunk = make_chunk(SCHEMA, [(None,), (None,), (5,)], capacity=8)
+    t, slots, is_new, _ = ht_lookup_or_insert(t, [chunk.columns[0]], chunk.vis)
+    s = np.asarray(slots)
+    assert s[0] == s[1] != s[2]
+
+
+def test_collisions_resolve_in_tiny_table():
+    # 8-slot table, 6 distinct keys -> guaranteed probing collisions
+    t = ht_new([INT64], 8)
+    t, slots, _, ovf = _insert(t, [1, 9, 17, 2, 10, 3])
+    assert not bool(ovf)
+    s = np.asarray(slots)[:6]
+    assert len(set(s.tolist())) == 6  # distinct keys -> distinct slots
+
+
+def test_overflow_reported():
+    t = ht_new([INT64], 8)
+    t, slots, _, ovf = _insert(t, list(range(1, 10)))  # 9 keys > 8 slots
+    assert bool(ovf)
+
+
+def test_invalid_rows_ignored():
+    t = ht_new([INT64], 64)
+    chunk = _chunk([1, 2, 3], capacity=8)
+    vis = jnp.asarray([True, False, True, False, False, False, False, False])
+    t, slots, is_new, _ = ht_lookup_or_insert(t, [chunk.columns[0]], vis)
+    s = np.asarray(slots)
+    assert s[1] == 64  # capacity sentinel for masked row
+    assert int(t.num_occupied()) == 2
+
+
+def test_lookup_without_insert():
+    t = ht_new([INT64], 64)
+    t, _, _, _ = _insert(t, [100, 200])
+    chunk = _chunk([200, 300], capacity=8)
+    slots, found = ht_lookup(t, [chunk.columns[0]], chunk.vis)
+    f = np.asarray(found)
+    assert f[0] and not f[1]
+    assert int(t.num_occupied()) == 2  # lookup does not insert
+
+
+def test_scatter_reduce_grouped_sum():
+    t = ht_new([INT64], 64)
+    chunk = _chunk([5, 6, 5, 5, 6], capacity=8)
+    t, slots, _, _ = ht_lookup_or_insert(t, [chunk.columns[0]], chunk.vis)
+    sums = jnp.zeros(64, jnp.int64)
+    contrib = jnp.asarray([1, 10, 2, 3, 20, 999, 999, 999], jnp.int64)
+    sums = scatter_reduce(sums, slots, contrib, "add")
+    s = np.asarray(slots)
+    assert int(sums[s[0]]) == 6   # 1+2+3 for key 5
+    assert int(sums[s[1]]) == 30  # 10+20 for key 6
+    assert int(np.asarray(sums).sum()) == 36  # masked rows dropped
+
+
+def test_compound_string_key_and_jit():
+    schema = Schema.of(("a", INT64), ("s", VARCHAR))
+    t = ht_new([INT64, VARCHAR], 64)
+    chunk = make_chunk(schema, [(1, "x"), (1, "y"), (1, "x")], capacity=8)
+
+    @jax.jit
+    def step(t, c):
+        return ht_lookup_or_insert(t, [c.columns[0], c.columns[1]], c.vis)
+
+    t, slots, is_new, ovf = step(t, chunk)
+    s = np.asarray(slots)
+    assert s[0] == s[2] != s[1]
+    assert not bool(ovf)

@@ -1,0 +1,110 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import asyncio
+
+import numpy as np
+
+from risingwave_tpu.common import (
+    FLOAT64, INT64, TIMESTAMP, Schema, chunk_to_rows, make_chunk, decimal,
+)
+from risingwave_tpu.expr import Literal, call, col
+from risingwave_tpu.expr.agg import agg, count_star
+from risingwave_tpu.storage import MemoryStateStore, StateTable
+from risingwave_tpu.stream import (
+    Barrier, HashAggExecutor, MaterializeExecutor, MockSource,
+)
+
+S = Schema.of(("k", INT64), ("v", INT64))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def drain(ex):
+    async for _ in ex.execute():
+        pass
+
+
+def test_non_checkpoint_epochs_survive_to_next_checkpoint():
+    """Finding 1: epoch-2 (non-checkpoint) MV writes must be committed by the
+    epoch-3 checkpoint, not stranded."""
+    store = MemoryStateStore()
+    src = MockSource(S, [
+        Barrier.new(1),
+        make_chunk(S, [(1, 10)]),
+        Barrier.new(2),                      # non-checkpoint
+        make_chunk(S, [(2, 20)]),
+        Barrier.new(3, checkpoint=True),     # checkpoint commits epoch 2 + 3
+    ])
+    mv = MaterializeExecutor(src, StateTable(store, 7, S, [0]))
+    run(drain(mv))
+    assert sorted(mv.rows()) == [(1, 10), (2, 20)]
+    assert store.committed_epoch == 3
+
+
+def test_avg_decimal_descaled():
+    """Finding 2: avg over DECIMAL must descale."""
+    sch = Schema.of(("k", INT64), ("d", decimal(2)))
+    c = make_chunk(sch, [(1, 1.00), (1, 3.00)])
+    src = MockSource(sch, [Barrier.new(1), c, Barrier.new(2)])
+    ex = HashAggExecutor(src, [0], [agg("avg", 1, decimal(2))])
+    chunks = []
+
+    async def d():
+        async for m in ex.execute():
+            from risingwave_tpu.common import StreamChunk
+            if isinstance(m, StreamChunk):
+                chunks.append(m)
+    run(d())
+    rows = [r for ch in chunks for r in chunk_to_rows(ch, ex.schema)]
+    assert rows == [(1, 2.0)]
+
+
+def test_minmax_int64_exact_above_2_53():
+    """Finding 3: min/max on int64 must be exact beyond 2^53."""
+    big = 9007199254740993  # 2^53 + 1
+    c = make_chunk(S, [(1, big), (1, big - 1)])
+    src = MockSource(S, [Barrier.new(1), c, Barrier.new(2)])
+    ex = HashAggExecutor(src, [0], [agg("max", 1, INT64), agg("min", 1, INT64)])
+    chunks = []
+
+    async def d():
+        async for m in ex.execute():
+            from risingwave_tpu.common import StreamChunk
+            if isinstance(m, StreamChunk):
+                chunks.append(m)
+    run(d())
+    rows = [r for ch in chunks for r in chunk_to_rows(ch, ex.schema)]
+    assert rows == [(1, big, big - 1)]
+
+
+def test_mixed_operand_order_timestamp_plus_int():
+    """Finding 5: int + timestamp must type-infer regardless of order."""
+    sch = Schema.of(("ts", TIMESTAMP),)
+    c = make_chunk(sch, [(100,)])
+    e1 = col(0, TIMESTAMP) + 5
+    e2 = Literal(5, INT64) + col(0, TIMESTAMP)
+    assert e1.type.kind == e2.type.kind == TIMESTAMP.kind
+    assert int(e2.eval(c).data[0]) == 105
+
+
+def test_sql_truncating_division_and_modulus():
+    """Finding 6: -5/2 == -2 and -5%2 == -1 (SQL), not floor semantics."""
+    c = make_chunk(S, [(-5, 2)])
+    q = (col(0, INT64) / col(1, INT64)).eval(c)
+    r = (col(0, INT64) % col(1, INT64)).eval(c)
+    assert int(q.data[0]) == -2
+    assert int(r.data[0]) == -1
+
+
+def test_state_table_len_no_double_count():
+    """Finding 7: overwriting a committed pk must not inflate len()."""
+    store = MemoryStateStore()
+    t = StateTable(store, 1, S, [0])
+    t.insert((1, 10))
+    t.commit(1)
+    store.commit(1)
+    t.insert((1, 99))  # overwrite, uncommitted
+    assert len(t) == 1
+    assert len(list(t.scan_all())) == 1

@@ -1,0 +1,75 @@
+"""Project/Filter executor tests incl. update-pair consistency through
+filters (reference: filter.rs op-fixup; dispatch.rs:635-650 pairing rules)."""
+
+import asyncio
+
+from risingwave_tpu.common import (
+    BOOL, INT64, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+    Schema, chunk_to_rows, make_chunk,
+)
+from risingwave_tpu.expr import col
+from risingwave_tpu.stream import (
+    Barrier, FilterExecutor, MockSource, ProjectExecutor, wrap_debug,
+)
+
+SCHEMA = Schema.of(("a", INT64), ("b", INT64))
+
+
+async def drain_rows(ex):
+    out = []
+    async for msg in ex.execute():
+        from risingwave_tpu.common import StreamChunk
+        if isinstance(msg, StreamChunk):
+            out.extend(chunk_to_rows(msg, ex.schema, with_ops=True))
+    return out
+
+
+def test_project():
+    src = MockSource(SCHEMA, [
+        Barrier.new(1),
+        make_chunk(SCHEMA, [(1, 2), (3, 4)]),
+        Barrier.new(2),
+    ])
+    ex = ProjectExecutor(src, [col(0, INT64) + col(1, INT64), col(0, INT64) * 10])
+    rows = asyncio.run(drain_rows(wrap_debug(ex)))
+    assert rows == [(OP_INSERT, (3, 10)), (OP_INSERT, (7, 30))]
+
+
+def test_filter_simple():
+    src = MockSource(SCHEMA, [
+        Barrier.new(1),
+        make_chunk(SCHEMA, [(1, 2), (5, 4), (9, 1)]),
+        Barrier.new(2),
+    ])
+    ex = FilterExecutor(src, col(0, INT64) > 3)
+    rows = asyncio.run(drain_rows(wrap_debug(ex)))
+    assert [r for _, r in rows] == [(5, 4), (9, 1)]
+
+
+def test_filter_degrades_broken_update_pairs():
+    # update moves a=2->a=8; filter a>3 keeps only the U+ side -> must become Insert
+    chunk = make_chunk(
+        SCHEMA,
+        [(2, 1), (8, 1), (5, 2), (6, 2)],
+        ops=[OP_UPDATE_DELETE, OP_UPDATE_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT],
+    )
+    src = MockSource(SCHEMA, [Barrier.new(1), chunk, Barrier.new(2)])
+    ex = FilterExecutor(src, col(0, INT64) > 3)
+    rows = asyncio.run(drain_rows(wrap_debug(ex)))
+    assert rows == [
+        (OP_INSERT, (8, 1)),          # degraded: its U- was filtered
+        (OP_UPDATE_DELETE, (5, 2)),   # intact pair passes through
+        (OP_UPDATE_INSERT, (6, 2)),
+    ]
+
+
+def test_filter_null_predicate_drops_row():
+    sch = Schema.of(("a", INT64), ("flag", BOOL))
+    src = MockSource(sch, [
+        Barrier.new(1),
+        make_chunk(sch, [(1, True), (2, None), (3, False)]),
+        Barrier.new(2),
+    ])
+    ex = FilterExecutor(src, col(1, BOOL))
+    rows = asyncio.run(drain_rows(ex))
+    assert [r for _, r in rows] == [(1, True)]
