@@ -171,6 +171,54 @@ def compact_chunk_host(chunk: StreamChunk) -> StreamChunk:
     )
 
 
+def _update_units(chunk: StreamChunk):
+    """Rows grouped into emission units: a visible U- immediately followed by
+    a visible U+ forms one 2-row unit (the reference's chunk builder reserves
+    two slots so update pairs never split across chunks,
+    src/common/src/array/stream_chunk.rs:37-45); every other visible row is a
+    1-row unit. Returns (unit_index int64[C], attached bool[C], unit_start)."""
+    ops, vis = chunk.ops, chunk.vis
+    prev_ud = jnp.concatenate([
+        jnp.zeros(1, jnp.bool_),
+        (ops[:-1] == OP_UPDATE_DELETE) & vis[:-1],
+    ])
+    attached = vis & (ops == OP_UPDATE_INSERT) & prev_ud
+    unit_start = vis & ~attached
+    unit_index = jnp.cumsum(unit_start) - 1  # valid where vis
+    return unit_index, attached, unit_start
+
+
+def count_units(chunk: StreamChunk) -> jax.Array:
+    """Number of emission units in the chunk (jit-friendly scalar)."""
+    _, _, unit_start = _update_units(chunk)
+    return jnp.sum(unit_start)
+
+
+def gather_units_window(chunk: StreamChunk, lo: jax.Array, out_capacity: int) -> StreamChunk:
+    """Pack the units with index in [lo, lo + out_capacity//2) into a fresh
+    chunk of ``out_capacity`` rows (2 slots per unit; vis masks the gaps).
+
+    Pure and shape-static: drive from the host as
+    ``for lo in range(0, int(count_units(c)), out_capacity//2)``."""
+    G = out_capacity // 2
+    C = out_capacity
+    unit_index, attached, _ = _update_units(chunk)
+    in_win = chunk.vis & (unit_index >= lo) & (unit_index < lo + G)
+    pos = jnp.where(
+        in_win, 2 * (unit_index - lo) + attached.astype(jnp.int64), C
+    ).astype(jnp.int32)
+    ops = jnp.zeros(C, jnp.int8).at[pos].set(chunk.ops, mode="drop")
+    vis = jnp.zeros(C, jnp.bool_).at[pos].set(True, mode="drop")
+    cols = tuple(
+        Column(
+            jnp.zeros(C, c.data.dtype).at[pos].set(c.data, mode="drop"),
+            jnp.zeros(C, jnp.bool_).at[pos].set(c.mask, mode="drop"),
+        )
+        for c in chunk.columns
+    )
+    return StreamChunk(ops, vis, cols)
+
+
 def concat_rows(chunks: Iterable[StreamChunk], schema: Schema) -> list:
     rows = []
     for c in chunks:

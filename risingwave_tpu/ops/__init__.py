@@ -1,3 +1,4 @@
 from .hash_table import (  # noqa: F401
     DeviceHashTable, ht_lookup, ht_lookup_or_insert, ht_new, scatter_reduce,
 )
+from .join_state import JoinCore, JoinState, JoinType  # noqa: F401

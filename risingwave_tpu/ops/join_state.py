@@ -1,0 +1,440 @@
+"""Device-resident streaming hash-join state + pure join step.
+
+TPU-native counterpart of the reference's HashJoinExecutor state machinery
+(reference: src/stream/src/executor/hash_join.rs:227-270, probe/build
+``eq_join_oneside`` :972; JoinHashMap = row + degree StateTables,
+src/stream/src/executor/managed_state/join/mod.rs:228-258). Deliberately NOT
+an LRU row-cache probed row-by-row: each side keeps ALL its rows
+device-resident in a bucketed arena —
+
+  * a DeviceHashTable maps join key -> bucket (ops/hash_table.py),
+  * each bucket holds up to W rows (static bucket width) in struct-of-arrays
+    ``[capacity, W]`` buffers, with per-row occupancy, tombstones, and a
+    **degree** = number of condition-passing matches on the opposite side
+    (the reference's degree table) driving outer/semi/anti emission with no
+    re-probing.
+
+One input chunk is joined in ONE jitted step: the opposite side is probed for
+all rows at once (vectorized gathers), the serial-order effects the reference
+gets from row-at-a-time processing (degree transitions when several same-key
+rows arrive in one chunk) are recovered with rank/total **matmuls** over the
+key-equality matrix — MXU work instead of scalar loops — and outputs land in
+a fixed-capacity ``[N, 2W+1]`` lane grid (lanes 2w/2w+1 = match w's primary /
+update-pair row; lane 2W = the null-padded or self row) that flattens into a
+single visibility-masked chunk for downstream compaction
+(common/chunk.py:gather_units_window).
+
+A chunk is processed as two vectorized sub-passes — deletes first, then
+inserts — preserving the one ordering streaming SQL relies on inside a chunk
+(U- before U+ of the same key). Insert-then-delete of the same row inside one
+chunk would be mis-ordered; that pattern trips the ``inconsistent`` flag
+(checked on barriers) instead of silently corrupting state.
+
+Join-key NULLs never match (SQL semantics), unlike GROUP BY: rows with a null
+key are stored (for deletes / outer emission) but masked out of probing.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, Column,
+    StreamChunk,
+)
+from ..common.types import Schema
+from .hash_table import DeviceHashTable, ht_lookup, ht_lookup_or_insert, ht_new
+
+
+class JoinType(enum.Enum):
+    """reference: JoinTypePrimitive consts, src/stream/src/executor/hash_join.rs:83-100."""
+
+    INNER = "inner"
+    LEFT_OUTER = "left_outer"
+    RIGHT_OUTER = "right_outer"
+    FULL_OUTER = "full_outer"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    RIGHT_SEMI = "right_semi"
+    RIGHT_ANTI = "right_anti"
+
+    @property
+    def preserves_left(self) -> bool:
+        return self in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+
+    @property
+    def preserves_right(self) -> bool:
+        return self in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)
+
+    @property
+    def semi_anti_side(self) -> Optional[str]:
+        if self in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return "left"
+        if self in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return "right"
+        return None
+
+    @property
+    def is_anti(self) -> bool:
+        return self in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI)
+
+
+@struct.dataclass
+class JoinSideState:
+    ht: DeviceHashTable                 # join key -> bucket
+    row_data: tuple[jax.Array, ...]     # per column: dtype[cap, W]
+    row_mask: tuple[jax.Array, ...]     # per column: bool[cap, W]
+    occupied: jax.Array                 # bool[cap, W]
+    tomb: jax.Array                     # bool[cap, W] — deleted since last ckpt
+    degree: jax.Array                   # int32[cap, W] — opposite-side matches
+    ckpt_dirty: jax.Array               # bool[cap, W] — changed since last ckpt
+    overflow: jax.Array                 # bool scalar, sticky
+    inconsistent: jax.Array             # bool scalar, sticky
+
+
+@struct.dataclass
+class JoinState:
+    left: JoinSideState
+    right: JoinSideState
+
+
+class JoinCore:
+    """Static config + pure (state, chunk) -> (state, out) step for one
+    streaming hash join. Shardable: runs unchanged under shard_map with
+    vnode-partitioned inputs (both sides shuffled by join key)."""
+
+    def __init__(
+        self,
+        left_schema: Schema,
+        right_schema: Schema,
+        left_keys: Sequence[int],
+        right_keys: Sequence[int],
+        join_type: JoinType,
+        condition=None,
+        key_capacity: int = 1 << 13,
+        bucket_width: int = 16,
+    ):
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        self.capacity = key_capacity
+        self.W = bucket_width
+        lkt = tuple(left_schema[i].type for i in self.left_keys)
+        rkt = tuple(right_schema[i].type for i in self.right_keys)
+        assert tuple(t.dtype for t in lkt) == tuple(t.dtype for t in rkt), (
+            "equi-join key physical types must match (planner inserts casts)")
+        self.key_types = lkt
+        sa = join_type.semi_anti_side
+        if sa == "left":
+            self.out_schema = left_schema
+        elif sa == "right":
+            self.out_schema = right_schema
+        else:
+            self.out_schema = left_schema.concat(right_schema)
+
+    # -- state ----------------------------------------------------------------
+
+    def _new_side(self, schema: Schema, key_idx: Sequence[int]) -> JoinSideState:
+        cap, W = self.capacity, self.W
+        key_types = tuple(schema[i].type for i in key_idx)
+        return JoinSideState(
+            ht=ht_new(key_types, cap),
+            row_data=tuple(jnp.zeros((cap, W), f.type.dtype) for f in schema),
+            row_mask=tuple(jnp.zeros((cap, W), jnp.bool_) for _ in schema),
+            occupied=jnp.zeros((cap, W), jnp.bool_),
+            tomb=jnp.zeros((cap, W), jnp.bool_),
+            degree=jnp.zeros((cap, W), jnp.int32),
+            ckpt_dirty=jnp.zeros((cap, W), jnp.bool_),
+            overflow=jnp.zeros((), jnp.bool_),
+            inconsistent=jnp.zeros((), jnp.bool_),
+        )
+
+    def init_state(self) -> JoinState:
+        return JoinState(
+            left=self._new_side(self.left_schema, self.left_keys),
+            right=self._new_side(self.right_schema, self.right_keys),
+        )
+
+    # -- the step --------------------------------------------------------------
+
+    def apply_chunk(self, state: JoinState, chunk: StreamChunk, *, side: str):
+        """Join one chunk arriving on ``side``; returns (state, big_chunk).
+
+        ``big_chunk`` has capacity 2*N*(2W+1) and is mostly invisible; compact
+        it with gather_units_window before sending downstream."""
+        is_del = chunk.vis & (
+            (chunk.ops == OP_DELETE) | (chunk.ops == OP_UPDATE_DELETE))
+        is_ins = chunk.vis & (
+            (chunk.ops == OP_INSERT) | (chunk.ops == OP_UPDATE_INSERT))
+
+        def run_del(st):
+            return self._pass(st, chunk, is_del, False, side)
+
+        def run_ins(st):
+            return self._pass(st, chunk, is_ins, True, side)
+
+        def skip(st):
+            return st, self._empty_out(chunk.capacity)
+
+        state, out_d = jax.lax.cond(jnp.any(is_del), run_del, skip, state)
+        state, out_i = jax.lax.cond(jnp.any(is_ins), run_ins, skip, state)
+        ops = jnp.concatenate([out_d[0].reshape(-1), out_i[0].reshape(-1)])
+        vis = jnp.concatenate([out_d[1].reshape(-1), out_i[1].reshape(-1)])
+        cols = tuple(
+            Column(jnp.concatenate([d0.reshape(-1), d1.reshape(-1)]),
+                   jnp.concatenate([m0.reshape(-1), m1.reshape(-1)]))
+            for (d0, m0), (d1, m1) in zip(out_d[2], out_i[2])
+        )
+        return state, StreamChunk(ops, vis, cols)
+
+    # -- internals -------------------------------------------------------------
+
+    def _empty_out(self, N: int):
+        L = 2 * self.W + 1
+        return (
+            jnp.zeros((N, L), jnp.int8),
+            jnp.zeros((N, L), jnp.bool_),
+            tuple(
+                (jnp.zeros((N, L), f.type.dtype), jnp.zeros((N, L), jnp.bool_))
+                for f in self.out_schema
+            ),
+        )
+
+    def _eval_condition(self, chunk, b_datas, b_masks, side: str):
+        """Evaluate the non-equi condition on all candidate pairs -> bool[N, W]."""
+        N, W = chunk.capacity, self.W
+        a_cols = [
+            Column(jnp.repeat(c.data, W), jnp.repeat(c.mask, W))
+            for c in chunk.columns
+        ]
+        b_cols = [
+            Column(d.reshape(-1), m.reshape(-1))
+            for d, m in zip(b_datas, b_masks)
+        ]
+        pair = a_cols + b_cols if side == "left" else b_cols + a_cols
+        pseudo = StreamChunk(
+            jnp.zeros(N * W, jnp.int8), jnp.ones(N * W, jnp.bool_), tuple(pair)
+        )
+        res = self.condition.eval(pseudo)
+        return (res.data & res.mask).reshape(N, W)
+
+    def _pass(self, state: JoinState, chunk: StreamChunk, sel: jax.Array,
+              is_insert: bool, side: str):
+        cap, W = self.capacity, self.W
+        N = chunk.capacity
+        A = state.left if side == "left" else state.right
+        B = state.right if side == "left" else state.left
+        a_key_idx = self.left_keys if side == "left" else self.right_keys
+        a_key_cols = [chunk.columns[i] for i in a_key_idx]
+        idx = jnp.arange(N)
+
+        has_null_key = jnp.zeros(N, jnp.bool_)
+        for c in a_key_cols:
+            has_null_key = has_null_key | ~c.mask
+        match_ok = sel & ~has_null_key
+
+        # ---- probe the opposite side (all rows at once)
+        b_slot, b_found = ht_lookup(B.ht, a_key_cols, match_ok)
+        bs = jnp.where(b_found, b_slot, 0)
+        occ_b = B.occupied[bs] & b_found[:, None]                      # [N, W]
+        b_datas = [rd[bs] for rd in B.row_data]                        # [N, W]
+        b_masks = [rm[bs] & occ_b for rm in B.row_mask]
+        matches = occ_b
+        if self.condition is not None:
+            matches = matches & self._eval_condition(chunk, b_datas, b_masks, side)
+        c_cnt = jnp.sum(matches, axis=1).astype(jnp.int32)             # [N]
+
+        # ---- rank/total of same-key rows within this pass (MXU matmuls):
+        # r[i,w] = |{j<i: key_j == key_i, (j,w) matches}|, t = same over all j.
+        ident = jnp.where(b_found, b_slot, -1)
+        eqf = (ident[:, None] == ident[None, :]) & (ident >= 0)[:, None]
+        lower = eqf & (idx[None, :] < idx[:, None])
+        mf = matches.astype(jnp.float32)
+        r = jnp.round(lower.astype(jnp.float32) @ mf).astype(jnp.int32)
+        t = jnp.round(eqf.astype(jnp.float32) @ mf).astype(jnp.int32)
+        d0 = B.degree[bs]                                              # [N, W]
+
+        # ---- opposite-side degree maintenance (reference join/mod.rs degrees)
+        lane_w = jnp.arange(W, dtype=jnp.int32)[None, :]
+        g = jnp.where(matches, bs[:, None] * W + lane_w, cap * W).reshape(-1)
+        delta = jnp.where(matches, 1 if is_insert else -1, 0).astype(jnp.int32)
+        # degrees are rebuilt on recovery, not persisted — no ckpt_dirty here
+        B = B.replace(
+            degree=B.degree.reshape(-1).at[g].add(delta.reshape(-1), mode="drop")
+                    .reshape(cap, W),
+        )
+
+        # ---- own-side arena update
+        if is_insert:
+            a_ht, a_slot, _, ht_ovf = ht_lookup_or_insert(A.ht, a_key_cols, sel)
+            a_ok = sel & (a_slot < cap)
+            as_ = jnp.where(a_ok, a_slot, 0)
+            aident = jnp.where(a_ok, a_slot, -1)
+            alower = ((aident[:, None] == aident[None, :])
+                      & (aident >= 0)[:, None] & (idx[None, :] < idx[:, None]))
+            a_rank = jnp.sum(alower, axis=1).astype(jnp.int32)
+            free = ~(A.occupied | A.tomb)[as_]                         # [N, W]
+            cs = jnp.cumsum(free, axis=1)
+            hit = (cs == (a_rank + 1)[:, None]) & free
+            lane = jnp.argmax(hit, axis=1).astype(jnp.int32)
+            lane_ok = jnp.any(hit, axis=1) & a_ok
+            f = jnp.where(lane_ok, as_ * W + lane, cap * W)
+            A = A.replace(
+                ht=a_ht,
+                occupied=A.occupied.reshape(-1).at[f].set(True, mode="drop")
+                          .reshape(cap, W),
+                row_data=tuple(
+                    rd.reshape(-1).at[f].set(c.data, mode="drop").reshape(cap, W)
+                    for rd, c in zip(A.row_data, chunk.columns)),
+                row_mask=tuple(
+                    rm.reshape(-1).at[f].set(c.mask, mode="drop").reshape(cap, W)
+                    for rm, c in zip(A.row_mask, chunk.columns)),
+                degree=A.degree.reshape(-1).at[f].set(c_cnt, mode="drop")
+                        .reshape(cap, W),
+                ckpt_dirty=A.ckpt_dirty.reshape(-1).at[f].set(True, mode="drop")
+                            .reshape(cap, W),
+                overflow=A.overflow | ht_ovf | jnp.any(a_ok & ~lane_ok)
+                         | jnp.any(sel & (a_slot >= cap)),
+            )
+        else:
+            a_slot, a_found = ht_lookup(A.ht, a_key_cols, sel)
+            as_ = jnp.where(a_found, a_slot, 0)
+            delmatch = A.occupied[as_] & a_found[:, None]
+            for rd, rm, c in zip(A.row_data, A.row_mask, chunk.columns):
+                srd, srm = rd[as_], rm[as_]
+                delmatch = delmatch & (
+                    (srm & c.mask[:, None] & (srd == c.data[:, None]))
+                    | (~srm & ~c.mask[:, None]))
+            # rank among value-identical delete rows -> distinct lanes
+            roweq = sel[:, None] & sel[None, :]
+            for c in chunk.columns:
+                roweq = roweq & (
+                    (c.mask[:, None] & c.mask[None, :]
+                     & (c.data[:, None] == c.data[None, :]))
+                    | (~c.mask[:, None] & ~c.mask[None, :]))
+            drank = jnp.sum(roweq & (idx[None, :] < idx[:, None]), axis=1)
+            cs = jnp.cumsum(delmatch, axis=1)
+            hit = (cs == (drank + 1)[:, None]) & delmatch
+            lane = jnp.argmax(hit, axis=1).astype(jnp.int32)
+            lane_ok = jnp.any(hit, axis=1)
+            f = jnp.where(lane_ok, as_ * W + lane, cap * W)
+            # values stay in row_data for the durable-tier delete at checkpoint
+            A = A.replace(
+                occupied=A.occupied.reshape(-1).at[f].set(False, mode="drop")
+                          .reshape(cap, W),
+                tomb=A.tomb.reshape(-1).at[f].set(True, mode="drop")
+                      .reshape(cap, W),
+                ckpt_dirty=A.ckpt_dirty.reshape(-1).at[f].set(True, mode="drop")
+                            .reshape(cap, W),
+                inconsistent=A.inconsistent | jnp.any(sel & ~lane_ok),
+            )
+
+        state = (state.replace(left=A, right=B) if side == "left"
+                 else state.replace(left=B, right=A))
+
+        out = self._emit(chunk, sel, is_insert, side, matches, c_cnt, r, t, d0,
+                         b_datas, b_masks)
+        return state, out
+
+    def _emit(self, chunk, sel, is_insert: bool, side: str, matches, c_cnt,
+              r, t, d0, b_datas, b_masks):
+        """Build the [N, 2W+1] emission grid for one pass."""
+        N, W = chunk.capacity, self.W
+        jt = self.join_type
+        sa = jt.semi_anti_side
+        op_plain = OP_INSERT if is_insert else OP_DELETE
+
+        a_outer = (jt.preserves_left if side == "left" else jt.preserves_right)
+        b_outer = (jt.preserves_right if side == "left" else jt.preserves_left)
+
+        p0 = jnp.zeros((N, W), jnp.bool_)   # lane 2w visible
+        p1 = jnp.zeros((N, W), jnp.bool_)   # lane 2w+1 visible
+        op0 = jnp.full((N, W), op_plain, jnp.int8)
+        op1 = jnp.full((N, W), OP_UPDATE_INSERT, jnp.int8)
+        pself = jnp.zeros(N, jnp.bool_)     # lane 2W visible
+        # per-lane "A columns are non-null" (B cols are non-null in any pair lane)
+        a0 = jnp.ones((N, W), jnp.bool_)
+        a1 = jnp.ones((N, W), jnp.bool_)
+
+        if is_insert:
+            trans = matches & (d0 + r == 0)
+        else:
+            trans = matches & (d0 - t == 0) & (r == t - 1)
+
+        if sa is None:
+            if b_outer:
+                # transition lanes emit an adjacent update pair replacing /
+                # restoring the opposite side's null-padded row
+                p0 = matches
+                p1 = trans
+                op0 = jnp.where(trans, OP_UPDATE_DELETE, op_plain).astype(jnp.int8)
+                if is_insert:
+                    a0 = ~trans   # U- row is (B row, A-null)
+                else:
+                    a1 = jnp.zeros((N, W), jnp.bool_)  # U+ row is (B row, A-null)
+            else:
+                p0 = matches
+            if a_outer:
+                pself = sel & (c_cnt == 0)
+        elif sa == side:
+            # input on the preserved side: emit/retract own row only
+            want = (c_cnt == 0) if jt.is_anti else (c_cnt > 0)
+            pself = sel & want
+        else:
+            # input on the non-preserved side: emit/retract opposite rows on
+            # degree transitions
+            p0 = trans
+            if jt.is_anti:
+                op0 = jnp.full((N, W), OP_DELETE if is_insert else OP_INSERT,
+                               jnp.int8)
+            else:
+                op0 = jnp.full((N, W), OP_INSERT if is_insert else OP_DELETE,
+                               jnp.int8)
+
+        # ---- assemble ops/vis  [N, 2W+1]
+        L = 2 * W + 1
+        ops = jnp.zeros((N, L), jnp.int8)
+        vis = jnp.zeros((N, L), jnp.bool_)
+        ops = ops.at[:, 0:2 * W:2].set(op0).at[:, 1:2 * W:2].set(op1)
+        ops = ops.at[:, 2 * W].set(jnp.full(N, op_plain, jnp.int8))
+        vis = vis.at[:, 0:2 * W:2].set(p0).at[:, 1:2 * W:2].set(p1)
+        vis = vis.at[:, 2 * W].set(pself)
+
+        # ---- assemble output columns
+        def lanes(w0_d, w0_m, w1_d, w1_m, self_d, self_m, dtype):
+            d = jnp.zeros((N, L), dtype)
+            m = jnp.zeros((N, L), jnp.bool_)
+            d = d.at[:, 0:2 * W:2].set(w0_d).at[:, 1:2 * W:2].set(w1_d)
+            d = d.at[:, 2 * W].set(self_d)
+            m = m.at[:, 0:2 * W:2].set(w0_m).at[:, 1:2 * W:2].set(w1_m)
+            m = m.at[:, 2 * W].set(self_m)
+            return d, m
+
+        a_col_list = []   # input side's columns in output
+        for c in chunk.columns:
+            bd = jnp.broadcast_to(c.data[:, None], (N, W))
+            bm = jnp.broadcast_to(c.mask[:, None], (N, W))
+            a_col_list.append(lanes(
+                bd, bm & a0, bd, bm & a1, c.data, c.mask, c.data.dtype))
+        b_col_list = []   # opposite side's columns in output (null in self lane)
+        for d, m in zip(b_datas, b_masks):
+            zeros_self = jnp.zeros(N, d.dtype)
+            b_col_list.append(lanes(
+                d, m, d, m, zeros_self, jnp.zeros(N, jnp.bool_), d.dtype))
+
+        if sa is None:
+            cols = (a_col_list + b_col_list if side == "left"
+                    else b_col_list + a_col_list)
+        elif sa == side:
+            cols = a_col_list
+        else:
+            cols = b_col_list
+        return ops, vis, tuple(cols)

@@ -9,3 +9,5 @@ from .source import MockSource, ScheduledSource  # noqa: F401
 from .project import FilterExecutor, ProjectExecutor  # noqa: F401
 from .hash_agg import HashAggExecutor, agg_state_schema  # noqa: F401
 from .materialize import MaterializeExecutor  # noqa: F401
+from .hash_join import HashJoinExecutor  # noqa: F401
+from .barrier_align import barrier_align  # noqa: F401

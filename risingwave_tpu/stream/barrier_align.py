@@ -1,0 +1,76 @@
+"""Two-input barrier alignment.
+
+Counterpart of the reference's ``barrier_align`` stream combinator
+(reference: src/stream/src/executor/barrier_align.rs:43): read both inputs
+concurrently; once a barrier arrives on one side, stop polling that side
+until the other side's barrier for the same epoch arrives, then emit one
+aligned barrier. This is what makes a barrier a consistent cut across a
+binary operator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from ..common.chunk import StreamChunk
+from .executor import Executor
+from .message import Barrier, Watermark
+
+
+async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]:
+    """Yields ("chunk", side, chunk) / ("watermark", side, wm) /
+    ("barrier", barrier) events; terminates after a stop barrier or when both
+    inputs are exhausted."""
+    its = {"left": left.execute().__aiter__(),
+           "right": right.execute().__aiter__()}
+    pending: dict[str, asyncio.Task] = {}
+    held_barrier: dict[str, Barrier] = {}
+    finished: set[str] = set()
+
+    try:
+        while len(finished) < 2:
+            for s in ("left", "right"):
+                if s not in pending and s not in held_barrier and s not in finished:
+                    pending[s] = asyncio.ensure_future(its[s].__anext__())
+            if not pending:
+                break
+            done, _ = await asyncio.wait(
+                pending.values(), return_when=asyncio.FIRST_COMPLETED)
+            for s in list(pending):
+                task = pending[s]
+                if task not in done:
+                    continue
+                del pending[s]
+                try:
+                    msg = task.result()
+                except StopAsyncIteration:
+                    finished.add(s)
+                    continue
+                if isinstance(msg, Barrier):
+                    held_barrier[s] = msg
+                elif isinstance(msg, StreamChunk):
+                    yield ("chunk", s, msg)
+                elif isinstance(msg, Watermark):
+                    yield ("watermark", s, msg)
+            if len(held_barrier) == 2:
+                bl, br = held_barrier["left"], held_barrier["right"]
+                if bl.epoch.curr != br.epoch.curr:
+                    raise AssertionError(
+                        f"barrier misalignment: left epoch {bl.epoch.curr} "
+                        f"!= right epoch {br.epoch.curr}")
+                held_barrier.clear()
+                yield ("barrier", bl)
+                if bl.is_stop():
+                    return
+            elif held_barrier and finished - held_barrier.keys():
+                # one side ended without a stop barrier; flush the other's
+                # barrier so the operator can still make progress
+                (s, b), = held_barrier.items()
+                held_barrier.clear()
+                yield ("barrier", b)
+                if b.is_stop():
+                    return
+    finally:
+        for task in pending.values():
+            task.cancel()

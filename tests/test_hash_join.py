@@ -1,0 +1,287 @@
+"""HashJoin semantics — golden cases mirroring the reference's join unit
+tests (reference: src/stream/src/executor/hash_join.rs:1552-3398): insert /
+delete / update flows for inner, outer, semi, anti; degree transitions with
+duplicate keys in one chunk; non-equi conditions; null join keys."""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.common import INT64, Schema, chunk_to_rows, make_chunk
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+)
+from risingwave_tpu.expr import col
+from risingwave_tpu.ops import JoinType
+from risingwave_tpu.storage import MemoryStateStore, StateTable
+from risingwave_tpu.stream import Barrier, HashJoinExecutor, MockSource
+
+L_SCHEMA = Schema.of(("k", INT64), ("a", INT64))
+R_SCHEMA = Schema.of(("k", INT64), ("b", INT64))
+
+CAP = 64  # small chunks keep CPU-sim compiles fast
+
+
+def lchunk(rows, ops=None):
+    return make_chunk(L_SCHEMA, rows, ops=ops, capacity=CAP)
+
+
+def rchunk(rows, ops=None):
+    return make_chunk(R_SCHEMA, rows, ops=ops, capacity=CAP)
+
+
+def run_join(left_msgs, right_msgs, join_type=JoinType.INNER, **kw):
+    """Drive a join over scripted epochs; returns [(op, row), ...]."""
+    kw.setdefault("key_capacity", 64)
+    kw.setdefault("bucket_width", 4)
+    kw.setdefault("out_capacity", 32)
+    ex = HashJoinExecutor(
+        MockSource(L_SCHEMA, left_msgs), MockSource(R_SCHEMA, right_msgs),
+        [0], [0], join_type, **kw)
+
+    async def drain():
+        out = []
+        async for m in ex.execute():
+            from risingwave_tpu.common import StreamChunk
+            if isinstance(m, StreamChunk):
+                out.extend(chunk_to_rows(m, ex.schema, with_ops=True))
+        return out
+
+    return asyncio.run(drain()), ex
+
+
+def epochs(*sides_per_epoch):
+    """Build aligned (left_msgs, right_msgs): each arg is (left_chunks,
+    right_chunks) for one epoch."""
+    left, right = [], []
+    e = 1
+    left.append(Barrier.new(e)); right.append(Barrier.new(e))
+    for lcs, rcs in sides_per_epoch:
+        left.extend(lcs); right.extend(rcs)
+        e += 1
+        left.append(Barrier.new(e)); right.append(Barrier.new(e))
+    return left, right
+
+
+def test_inner_insert_then_match():
+    l, r = epochs(
+        ([lchunk([(1, 100), (2, 200)])], []),
+        ([], [rchunk([(1, 10), (3, 30)])]),
+    )
+    rows, _ = run_join(l, r, JoinType.INNER)
+    assert rows == [(OP_INSERT, (1, 100, 1, 10))]
+
+
+def test_inner_multi_match_and_delete():
+    l, r = epochs(
+        ([lchunk([(1, 100), (1, 101)])], []),
+        ([], [rchunk([(1, 10)])]),
+        ([], [rchunk([(1, 10)], ops=[OP_DELETE])]),
+    )
+    rows, _ = run_join(l, r, JoinType.INNER)
+    inserts = [x for x in rows if x[0] == OP_INSERT]
+    deletes = [x for x in rows if x[0] == OP_DELETE]
+    assert sorted(x[1] for x in inserts) == [(1, 100, 1, 10), (1, 101, 1, 10)]
+    assert sorted(x[1] for x in deletes) == [(1, 100, 1, 10), (1, 101, 1, 10)]
+
+
+def test_left_outer_null_pad_then_retract():
+    l, r = epochs(
+        ([lchunk([(1, 100)])], []),
+        ([], [rchunk([(1, 10)])]),
+        ([], [rchunk([(1, 10)], ops=[OP_DELETE])]),
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_OUTER)
+    assert rows == [
+        (OP_INSERT, (1, 100, None, None)),
+        (OP_UPDATE_DELETE, (1, 100, None, None)),
+        (OP_UPDATE_INSERT, (1, 100, 1, 10)),
+        (OP_UPDATE_DELETE, (1, 100, 1, 10)),
+        (OP_UPDATE_INSERT, (1, 100, None, None)),
+    ]
+
+
+def test_left_outer_second_match_plain_insert():
+    """Second right row with the same key emits a plain Insert, not U-/U+
+    (degree transition only fires on 0 -> 1)."""
+    l, r = epochs(
+        ([lchunk([(1, 100)])], []),
+        ([], [rchunk([(1, 10), (1, 11)])]),
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_OUTER)
+    assert rows[0] == (OP_INSERT, (1, 100, None, None))
+    assert (OP_UPDATE_DELETE, (1, 100, None, None)) in rows
+    pair_ops = [op for op, row in rows[1:]]
+    assert pair_ops.count(OP_UPDATE_DELETE) == 1
+    assert pair_ops.count(OP_UPDATE_INSERT) == 1
+    assert pair_ops.count(OP_INSERT) == 1
+    assert (OP_INSERT, (1, 100, 1, 11)) in rows or (OP_INSERT, (1, 100, 1, 10)) in rows
+
+
+def test_right_outer_mirrors_left():
+    l, r = epochs(
+        ([], [rchunk([(7, 70)])]),
+        ([lchunk([(7, 700)])], []),
+    )
+    rows, _ = run_join(l, r, JoinType.RIGHT_OUTER)
+    assert rows == [
+        (OP_INSERT, (None, None, 7, 70)),
+        (OP_UPDATE_DELETE, (None, None, 7, 70)),
+        (OP_UPDATE_INSERT, (7, 700, 7, 70)),
+    ]
+
+
+def test_full_outer_both_sides_pad():
+    l, r = epochs(
+        ([lchunk([(1, 100)])], [rchunk([(2, 20)])]),
+        ([], [rchunk([(1, 10)])]),
+    )
+    rows, _ = run_join(l, r, JoinType.FULL_OUTER)
+    first_epoch = set(x for x in rows[:2])
+    assert (OP_INSERT, (1, 100, None, None)) in first_epoch
+    assert (OP_INSERT, (None, None, 2, 20)) in first_epoch
+    assert rows[2:] == [
+        (OP_UPDATE_DELETE, (1, 100, None, None)),
+        (OP_UPDATE_INSERT, (1, 100, 1, 10)),
+    ]
+
+
+def test_left_semi():
+    l, r = epochs(
+        ([lchunk([(1, 100), (2, 200)])], []),
+        ([], [rchunk([(1, 10)])]),
+        ([], [rchunk([(1, 11)])]),          # second match: no re-emit
+        ([], [rchunk([(1, 10)], ops=[OP_DELETE])]),  # still matched by (1,11)
+        ([], [rchunk([(1, 11)], ops=[OP_DELETE])]),  # now unmatched
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_SEMI)
+    assert rows == [
+        (OP_INSERT, (1, 100)),
+        (OP_DELETE, (1, 100)),
+    ]
+
+
+def test_left_semi_insert_on_matched_side():
+    l, r = epochs(
+        ([], [rchunk([(1, 10)])]),
+        ([lchunk([(1, 100)])], []),
+        ([lchunk([(1, 100)], ops=[OP_DELETE])], []),
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_SEMI)
+    assert rows == [(OP_INSERT, (1, 100)), (OP_DELETE, (1, 100))]
+
+
+def test_left_anti():
+    l, r = epochs(
+        ([lchunk([(1, 100), (2, 200)])], []),
+        ([], [rchunk([(1, 10)])]),
+        ([], [rchunk([(1, 10)], ops=[OP_DELETE])]),
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_ANTI)
+    assert rows == [
+        (OP_INSERT, (1, 100)),
+        (OP_INSERT, (2, 200)),
+        (OP_DELETE, (1, 100)),
+        (OP_INSERT, (1, 100)),
+    ]
+
+
+def test_duplicate_key_batch_degree_transitions():
+    """Two same-key right rows in ONE chunk against a degree-0 left row:
+    exactly one U-/U+ transition + one plain insert (rank logic)."""
+    l, r = epochs(
+        ([lchunk([(1, 100)])], []),
+        ([], [rchunk([(1, 10), (1, 11)])]),
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_OUTER)
+    ops = [op for op, _ in rows]
+    assert ops == [OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, OP_INSERT]
+
+
+def test_update_pair_flows_through():
+    l, r = epochs(
+        ([lchunk([(1, 100)])], [rchunk([(1, 10)])]),
+        ([lchunk([(1, 100), (1, 150)],
+                 ops=[OP_UPDATE_DELETE, OP_UPDATE_INSERT])], []),
+    )
+    rows, _ = run_join(l, r, JoinType.INNER)
+    assert (OP_INSERT, (1, 100, 1, 10)) in rows
+    assert (OP_DELETE, (1, 100, 1, 10)) in rows
+    assert (OP_INSERT, (1, 150, 1, 10)) in rows
+    # delete emitted before the replacement insert
+    assert rows.index((OP_DELETE, (1, 100, 1, 10))) < rows.index(
+        (OP_INSERT, (1, 150, 1, 10)))
+
+
+def test_non_equi_condition():
+    # ON l.k = r.k AND l.a < r.b
+    cond = col(1, INT64) < col(3, INT64)
+    l, r = epochs(
+        ([lchunk([(1, 5), (1, 50)])], []),
+        ([], [rchunk([(1, 10)])]),
+    )
+    rows, _ = run_join(l, r, JoinType.INNER, condition=cond)
+    assert rows == [(OP_INSERT, (1, 5, 1, 10))]
+
+
+def test_condition_affects_outer_degrees():
+    cond = col(1, INT64) < col(3, INT64)
+    l, r = epochs(
+        ([lchunk([(1, 50)])], []),
+        ([], [rchunk([(1, 10)])]),   # fails condition -> left stays padded
+        ([], [rchunk([(1, 99)])]),   # passes -> transition
+    )
+    rows, _ = run_join(l, r, JoinType.LEFT_OUTER, condition=cond)
+    assert rows == [
+        (OP_INSERT, (1, 50, None, None)),
+        (OP_UPDATE_DELETE, (1, 50, None, None)),
+        (OP_UPDATE_INSERT, (1, 50, 1, 99)),
+    ]
+
+
+def test_null_keys_never_match():
+    l, r = epochs(
+        ([lchunk([(None, 100)])], [rchunk([(None, 10)])]),
+    )
+    rows_inner, _ = run_join(l, r, JoinType.INNER)
+    assert rows_inner == []
+    l, r = epochs(
+        ([lchunk([(None, 100)])], [rchunk([(None, 10)])]),
+    )
+    rows_outer, _ = run_join(l, r, JoinType.LEFT_OUTER)
+    assert rows_outer == [(OP_INSERT, (None, 100, None, None))]
+
+
+def test_checkpoint_and_recovery_rebuild_degrees():
+    store = MemoryStateStore()
+    lt = StateTable(store, 1, L_SCHEMA, [0, 1])
+    rt = StateTable(store, 2, R_SCHEMA, [0, 1])
+    l, r = epochs(
+        ([lchunk([(1, 100)])], [rchunk([(1, 10)])]),
+    )
+    # run with checkpoint on the closing stop barrier
+    l[-1] = Barrier.new(2, checkpoint=True, mutation=l[-1].mutation)
+    r[-1] = Barrier.new(2, checkpoint=True, mutation=r[-1].mutation)
+    from risingwave_tpu.stream.message import Mutation, MutationKind
+    stop = Mutation(MutationKind.STOP)
+    l.append(Barrier.new(3, checkpoint=True, mutation=stop))
+    r.append(Barrier.new(3, checkpoint=True, mutation=stop))
+    rows1, _ = run_join(l, r, JoinType.LEFT_OUTER,
+                        left_state_table=lt, right_state_table=rt)
+    store.commit(3)
+    assert len(list(lt.scan_all())) == 1
+    assert len(list(rt.scan_all())) == 1
+
+    # recover into a fresh executor; delete the right row -> retraction,
+    # proving degrees were rebuilt
+    lt2 = StateTable(store, 1, L_SCHEMA, [0, 1])
+    rt2 = StateTable(store, 2, R_SCHEMA, [0, 1])
+    l2, r2 = epochs(
+        ([], [rchunk([(1, 10)], ops=[OP_DELETE])]),
+    )
+    rows2, _ = run_join(l2, r2, JoinType.LEFT_OUTER,
+                        left_state_table=lt2, right_state_table=rt2)
+    assert rows2 == [
+        (OP_UPDATE_DELETE, (1, 100, 1, 10)),
+        (OP_UPDATE_INSERT, (1, 100, None, None)),
+    ]
