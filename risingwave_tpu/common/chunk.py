@@ -92,8 +92,12 @@ def make_chunk(
     rows: Sequence[Sequence[Any]],
     ops: Optional[Sequence[int]] = None,
     capacity: int = DEFAULT_CHUNK_CAPACITY,
+    physical: bool = False,
 ) -> StreamChunk:
-    """Host constructor: python rows → padded device chunk."""
+    """Host constructor: python rows → padded device chunk.
+
+    ``physical=True`` takes raw physical values (state-table storage form)
+    and skips logical encoding — the recovery-reload fast path."""
     n = len(rows)
     if n > capacity:
         raise ValueError(f"{n} rows > capacity {capacity}")
@@ -111,7 +115,7 @@ def make_chunk(
         for ri, row in enumerate(rows):
             v = row[ci]
             if v is not None:
-                data[ri] = t.to_physical(v)
+                data[ri] = v if physical else t.to_physical(v)
                 mask[ri] = True
         cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
     return StreamChunk(jnp.asarray(ops_arr), jnp.asarray(vis), tuple(cols))
@@ -119,6 +123,12 @@ def make_chunk(
 
 def empty_chunk(schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY) -> StreamChunk:
     return make_chunk(schema, [], capacity=capacity)
+
+
+def physical_chunk(schema: Schema, rows: Sequence[Sequence[Any]],
+                   capacity: int) -> StreamChunk:
+    """Rows of raw *physical* values → chunk (see make_chunk(physical=True))."""
+    return make_chunk(schema, rows, capacity=capacity, physical=True)
 
 
 def chunk_to_rows(

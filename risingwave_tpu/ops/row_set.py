@@ -1,0 +1,184 @@
+"""Device-resident row-set state with diff-based delta emission.
+
+Shared functional core for executors whose state is "a set of rows keyed by
+pk, from which a *derived subset* is emitted downstream" — TopN (subset = the
+rank window; reference: src/stream/src/executor/top_n/top_n_cache.rs:43) and
+DynamicFilter (subset = rows passing the dynamic bound; reference:
+src/stream/src/executor/dynamic_filter.rs:46-64). Instead of the reference's
+per-row cache walks, the whole chunk upserts in one scatter round and the
+emitted-subset diff is computed over all slots at flush time:
+
+  * rows live in slot-indexed column arrays behind a pk hash table
+    (ops/hash_table.py); ``live`` marks deletions (slots are reused on pk
+    re-insertion, never compacted — same policy as the agg table);
+  * within-chunk ordering (Delete then Insert of one pk in the same chunk)
+    resolves by last-writer-wins via a scatter-max of row indices — scatter
+    application order is undefined in XLA, so the winner is picked explicitly;
+  * at flush the executor supplies ``in_set`` (bool per slot, any derived
+    membership rule); the core diffs it against what was last emitted
+    (membership flag + value copy) and gathers Insert / Delete / U-,U+ delta
+    chunks exactly like the agg flush (ops/grouped_agg.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, Column,
+    StreamChunk,
+)
+from .hash_table import DeviceHashTable, ht_lookup_or_insert, ht_new
+
+
+@struct.dataclass
+class RowSetState:
+    table: DeviceHashTable            # keyed by pk columns
+    live: jax.Array                   # bool[cap] — row currently exists
+    cols: tuple[Column, ...]          # stored rows, [cap] per column
+    emitted: jax.Array                # bool[cap] — in emitted subset at last flush
+    emitted_cols: tuple[Column, ...]  # values as of last emission
+    ckpt_dirty: jax.Array             # bool[cap] — touched since last checkpoint
+    overflow: jax.Array               # bool scalar, sticky
+    saw_delete: jax.Array             # bool scalar, sticky (append-only check)
+
+
+def rs_new(pk_types: Sequence, col_types: Sequence, capacity: int) -> RowSetState:
+    cols = tuple(
+        Column(jnp.zeros(capacity, t.dtype), jnp.zeros(capacity, jnp.bool_))
+        for t in col_types
+    )
+    return RowSetState(
+        table=ht_new(pk_types, capacity),
+        live=jnp.zeros(capacity, jnp.bool_),
+        cols=cols,
+        emitted=jnp.zeros(capacity, jnp.bool_),
+        emitted_cols=cols,
+        ckpt_dirty=jnp.zeros(capacity, jnp.bool_),
+        overflow=jnp.zeros((), jnp.bool_),
+        saw_delete=jnp.zeros((), jnp.bool_),
+    )
+
+
+def rs_apply_chunk(
+    state: RowSetState, chunk: StreamChunk, pk_indices: Sequence[int]
+):
+    """Upsert/delete a chunk of rows. Returns ``(state, slots, applied)``:
+    ``slots`` int32[N] per input row (capacity sentinel when invisible/
+    overflowed), ``applied`` bool[N] — the winning writer rows whose values
+    landed in the table (callers extend state keyed by these)."""
+    cap = state.table.capacity
+    pk_cols = [chunk.columns[i] for i in pk_indices]
+    table, slots, _is_new, ovf = ht_lookup_or_insert(state.table, pk_cols, chunk.vis)
+    n = chunk.capacity
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    # last-writer-wins: the highest row index targeting each slot applies
+    last = jnp.full(cap, -1, jnp.int32).at[slots].max(
+        jnp.where(chunk.vis, row_ids, -1), mode="drop")
+    in_range = slots < cap
+    applied = chunk.vis & in_range & (
+        last[jnp.clip(slots, 0, cap - 1)] == row_ids)
+    idx = jnp.where(applied, slots, cap)
+    is_insert = (chunk.ops == OP_INSERT) | (chunk.ops == OP_UPDATE_INSERT)
+    live = state.live.at[idx].set(is_insert, mode="drop")
+    cols = tuple(
+        Column(
+            c.data.at[idx].set(src.data, mode="drop"),
+            c.mask.at[idx].set(src.mask, mode="drop"),
+        )
+        for c, src in zip(state.cols, chunk.columns)
+    )
+    is_delete = (chunk.ops == OP_DELETE) | (chunk.ops == OP_UPDATE_DELETE)
+    state = state.replace(
+        table=table, live=live, cols=cols,
+        ckpt_dirty=state.ckpt_dirty.at[idx].set(True, mode="drop"),
+        overflow=state.overflow | ovf,
+        saw_delete=state.saw_delete | jnp.any(chunk.vis & is_delete),
+    )
+    return state, slots, applied
+
+
+def rs_changed(state: RowSetState, in_set: jax.Array) -> jax.Array:
+    """Slots whose downstream-visible row changes: membership flips, or stays
+    in-set with different values."""
+    val_changed = jnp.zeros_like(state.live)
+    for cur, old in zip(state.cols, state.emitted_cols):
+        col_diff = (cur.mask != old.mask) | (
+            cur.mask & old.mask & (cur.data != old.data))
+        val_changed = val_changed | col_diff
+    return (state.emitted != in_set) | (state.emitted & in_set & val_changed)
+
+
+def rs_gather_delta(
+    state: RowSetState, in_set: jax.Array, changed: jax.Array,
+    lo: jax.Array, out_capacity: int,
+) -> StreamChunk:
+    """One delta chunk for changed slots with rank in [lo, lo+G), G =
+    out_capacity//2 (2 slots per slot: old row / new row, vis-masked)."""
+    G = out_capacity // 2
+    C = out_capacity
+    rank = jnp.cumsum(changed) - changed.astype(jnp.int64)
+    in_win = changed & (rank >= lo) & (rank < lo + G)
+    pos = (rank - lo).astype(jnp.int32)
+    idx0 = jnp.where(in_win, 2 * pos, C)      # old (emitted) row
+    idx1 = jnp.where(in_win, 2 * pos + 1, C)  # new (current) row
+
+    ops = jnp.zeros(C, jnp.int8)
+    ops = ops.at[idx0].set(
+        jnp.where(in_set, OP_UPDATE_DELETE, OP_DELETE).astype(jnp.int8),
+        mode="drop")
+    ops = ops.at[idx1].set(
+        jnp.where(state.emitted, OP_UPDATE_INSERT, OP_INSERT).astype(jnp.int8),
+        mode="drop")
+    vis = jnp.zeros(C, jnp.bool_)
+    vis = vis.at[idx0].set(state.emitted, mode="drop")
+    vis = vis.at[idx1].set(in_set, mode="drop")
+
+    cols = []
+    for cur, old in zip(state.cols, state.emitted_cols):
+        data = jnp.zeros(C, cur.data.dtype).at[idx0].set(old.data, mode="drop")
+        data = data.at[idx1].set(cur.data, mode="drop")
+        mask = jnp.zeros(C, jnp.bool_).at[idx0].set(old.mask, mode="drop")
+        mask = mask.at[idx1].set(cur.mask, mode="drop")
+        cols.append(Column(data, mask))
+    return StreamChunk(ops, vis, tuple(cols))
+
+
+def rs_finish_flush(state: RowSetState, in_set: jax.Array) -> RowSetState:
+    emitted_cols = tuple(
+        Column(
+            jnp.where(in_set, cur.data, old.data),
+            jnp.where(in_set, cur.mask, old.mask),
+        )
+        for cur, old in zip(state.cols, state.emitted_cols)
+    )
+    return state.replace(emitted=in_set, emitted_cols=emitted_cols)
+
+
+def rs_checkpoint(rows: RowSetState, state_table,
+                       epoch: int) -> RowSetState:
+    """Incremental row-set checkpoint: flush only slots touched since the
+    last checkpoint (upsert live rows, delete tombstoned ones), mirroring
+    the reference's dirty-delta StateTable.commit (state_table.rs:783).
+    Returns the state with ckpt_dirty cleared."""
+    import numpy as np
+    dirty = np.asarray(rows.ckpt_dirty)
+    idx = np.nonzero(dirty)[0]
+    if len(idx):
+        live = np.asarray(rows.live)[idx]
+        datas = [np.asarray(c.data)[idx] for c in rows.cols]
+        masks = [np.asarray(c.mask)[idx] for c in rows.cols]
+        for r in range(len(idx)):
+            row = tuple(
+                datas[c][r].item() if masks[c][r] else None
+                for c in range(len(datas)))
+            if live[r]:
+                state_table.insert(row)
+            else:
+                state_table.delete(row)
+        state_table.commit(epoch)
+    return rows.replace(ckpt_dirty=jnp.zeros_like(rows.ckpt_dirty))

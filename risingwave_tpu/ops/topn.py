@@ -1,0 +1,112 @@
+"""TopN ranking over a device row set: full-sort membership computation.
+
+The reference maintains TopN incrementally through a 3-segment cache over a
+state table (src/stream/src/executor/top_n/top_n_cache.rs:43 — low/middle/
+high segments, per-row cache walks). The TPU-native design instead recomputes
+the rank window *wholesale* at flush time: one lexicographic sort of all
+slots (XLA sorts are fast and fusible; there is no pointer-chasing win on a
+vector machine), then a vectorized per-group rank and a membership mask.
+Correct under arbitrary insert/delete churn because membership is derived
+from the full row set every flush, not patched incrementally.
+
+``OrderSpec``: (column index, desc, nulls_last) per sort key — the order-by
+clause (reference: PG ORDER BY semantics, defaults nulls last for ASC,
+nulls first for DESC).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column
+from .row_set import RowSetState
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderSpec:
+    col: int
+    desc: bool = False
+    nulls_last: bool = True
+
+
+def _sort_key(c: Column, spec: OrderSpec) -> jax.Array:
+    """Column → ascending-sortable f64/i64 key honoring desc/nulls order.
+
+    int64 keys stay int64 (exact); everything else lowers to float64
+    (float32/bool/int32 fit exactly)."""
+    d = c.data
+    if d.dtype == jnp.int64:
+        k = d
+        big = jnp.iinfo(jnp.int64).max
+        small = jnp.iinfo(jnp.int64).min
+    else:
+        k = d.astype(jnp.float64)
+        big = jnp.inf
+        small = -jnp.inf
+    if spec.desc:
+        k = -k
+    # nulls position is relative to the *output* order; after desc negation
+    # the key is ascending, so nulls_last => +big, nulls_first => small
+    null_sent = big if spec.nulls_last else small
+    return jnp.where(c.mask, k, null_sent)
+
+
+def topn_order(state: RowSetState, gid: jax.Array,
+               order: Sequence[OrderSpec]) -> jax.Array:
+    """Stable lexicographic permutation: (live-first is NOT applied here;
+    dead slots are routed to the end via gid), gid, then order keys, then
+    slot index (total order tiebreak via stable sort)."""
+    cap = state.live.shape[0]
+    dead_gid = jnp.iinfo(jnp.int64).max
+    gid_eff = jnp.where(state.live, gid.astype(jnp.int64), dead_gid)
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for spec in reversed(list(order)):
+        key = _sort_key(state.cols[spec.col], spec)
+        perm = perm[jnp.argsort(key[perm], stable=True)]
+    perm = perm[jnp.argsort(gid_eff[perm], stable=True)]
+    return perm
+
+
+def topn_in_set(
+    state: RowSetState,
+    gid: jax.Array,
+    order: Sequence[OrderSpec],
+    offset: int,
+    limit: int,
+    with_ties: bool = False,
+    n_tie_keys: int | None = None,
+) -> jax.Array:
+    """bool[cap]: slot is in its group's [offset, offset+limit) rank window
+    (plus ties with the window's last row when ``with_ties``).
+
+    ``n_tie_keys``: how many leading order keys define a WITH TIES tie —
+    callers append pk tiebreak keys to ``order`` for deterministic totality,
+    and those must NOT participate in tie equality (default: all keys)."""
+    cap = state.live.shape[0]
+    perm = topn_order(state, gid, order)
+    dead_gid = jnp.iinfo(jnp.int64).max
+    gid_eff = jnp.where(state.live, gid.astype(jnp.int64), dead_gid)
+    sgid = gid_eff[perm]
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    is_start = jnp.concatenate([
+        jnp.ones(1, jnp.bool_), sgid[1:] != sgid[:-1]])
+    start = jax.lax.cummax(jnp.where(is_start, pos, 0))
+    rank = pos - start
+    slive = state.live[perm]
+    in_win = slive & (rank >= offset) & (rank < offset + limit)
+    if with_ties:
+        # rows past the window tie-in if their sort key equals the key of the
+        # window's last row (rank offset+limit-1) in the same group
+        bpos = jnp.clip(start + offset + limit - 1, 0, cap - 1)
+        tie = slive & (rank >= offset + limit) & (sgid == sgid[bpos])
+        tie_specs = list(order)[: (len(order) if n_tie_keys is None
+                                   else n_tie_keys)]
+        for spec in tie_specs:
+            key = _sort_key(state.cols[spec.col], spec)[perm]
+            tie = tie & (key == key[bpos])
+        in_win = in_win | tie
+    return jnp.zeros(cap, jnp.bool_).at[perm].set(in_win)

@@ -49,8 +49,13 @@ class MemoryStateStore:
         A checkpoint epoch commits every earlier non-checkpoint epoch's
         buffer too, in epoch order — mirroring the reference where
         non-checkpoint barriers stage state that the next checkpoint's
-        ``commit_epoch`` makes durable (docs/checkpoint.md:26-44)."""
-        assert epoch > self.committed_epoch, (epoch, self.committed_epoch)
+        ``commit_epoch`` makes durable (docs/checkpoint.md:26-44).
+
+        Idempotent per epoch: every executor of an epoch may trigger the
+        commit; the first wins (the reference's HummockManager.commit_epoch
+        is likewise a single logical commit per epoch)."""
+        if epoch <= self.committed_epoch:
+            return
         for e in sorted(k for k in self._pending if k <= epoch):
             for table_id, buf in self._pending.pop(e).items():
                 tbl = self._committed.setdefault(table_id, {})
@@ -63,11 +68,29 @@ class MemoryStateStore:
 
     # -- read path ------------------------------------------------------------
 
+    def _merged_view(self, table_id: int) -> dict:
+        """Read-your-writes view: committed state overlaid with every staged
+        (sealed-but-uncommitted) epoch in order — the reference's shared
+        buffer makes sealed epochs readable before the checkpoint commits
+        them (docs/checkpoint.md:36-44, state visibility vs durability)."""
+        view = dict(self._committed.get(table_id, {}))
+        for e in sorted(self._pending):
+            for k, v in self._pending[e].get(table_id, {}).items():
+                if v is None:
+                    view.pop(k, None)
+                else:
+                    view[k] = v
+        return view
+
     def get(self, table_id: int, key: bytes) -> Optional[tuple]:
+        for e in sorted(self._pending, reverse=True):
+            buf = self._pending[e].get(table_id, {})
+            if key in buf:
+                return buf[key]
         return self._committed.get(table_id, {}).get(key)
 
     def iter_table(self, table_id: int) -> Iterator[tuple[bytes, tuple]]:
-        yield from sorted(self._committed.get(table_id, {}).items())
+        yield from sorted(self._merged_view(table_id).items())
 
     def iter_prefix(self, table_id: int, prefix: bytes) -> Iterator[tuple[bytes, tuple]]:
         for k, v in self.iter_table(table_id):
@@ -75,7 +98,7 @@ class MemoryStateStore:
                 yield k, v
 
     def table_len(self, table_id: int) -> int:
-        return len(self._committed.get(table_id, {}))
+        return len(self._merged_view(table_id))
 
     # -- snapshot (checkpoint/restore hooks) ----------------------------------
 

@@ -11,3 +11,17 @@ from .hash_agg import HashAggExecutor, agg_state_schema  # noqa: F401
 from .materialize import MaterializeExecutor  # noqa: F401
 from .hash_join import HashJoinExecutor  # noqa: F401
 from .barrier_align import barrier_align  # noqa: F401
+from .simple_agg import (  # noqa: F401
+    SimpleAggExecutor, StatelessSimpleAggExecutor,
+)
+from .top_n import TopNExecutor  # noqa: F401
+from .dynamic_filter import DynamicFilterExecutor  # noqa: F401
+from .barrier_align import align_streams  # noqa: F401
+from .hop_window import HopWindowExecutor  # noqa: F401
+from .union import UnionExecutor, ValuesExecutor  # noqa: F401
+from .dedup import AppendOnlyDedupExecutor  # noqa: F401
+from .row_id_gen import RowIdGenExecutor  # noqa: F401
+from .expand import ExpandExecutor  # noqa: F401
+from .eowc import (  # noqa: F401
+    NowExecutor, SortExecutor, WatermarkFilterExecutor,
+)

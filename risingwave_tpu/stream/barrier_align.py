@@ -1,36 +1,41 @@
-"""Two-input barrier alignment.
+"""N-input barrier alignment.
 
-Counterpart of the reference's ``barrier_align`` stream combinator
-(reference: src/stream/src/executor/barrier_align.rs:43): read both inputs
-concurrently; once a barrier arrives on one side, stop polling that side
-until the other side's barrier for the same epoch arrives, then emit one
-aligned barrier. This is what makes a barrier a consistent cut across a
-binary operator.
+Counterpart of the reference's ``barrier_align`` stream combinator and the
+MergeExecutor's SelectReceivers fan-in
+(reference: src/stream/src/executor/barrier_align.rs:43,
+src/stream/src/executor/merge.rs:36,114-172): read all inputs concurrently;
+once a barrier arrives on one input, stop polling that input until every
+other input's barrier for the same epoch arrives, then emit one aligned
+barrier. This is what makes a barrier a consistent cut across a multi-input
+operator.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import AsyncIterator
+from typing import AsyncIterator, Hashable, Mapping
 
 from ..common.chunk import StreamChunk
 from .executor import Executor
 from .message import Barrier, Watermark
 
 
-async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]:
-    """Yields ("chunk", side, chunk) / ("watermark", side, wm) /
-    ("barrier", barrier) events; terminates after a stop barrier or when both
-    inputs are exhausted."""
-    its = {"left": left.execute().__aiter__(),
-           "right": right.execute().__aiter__()}
-    pending: dict[str, asyncio.Task] = {}
-    held_barrier: dict[str, Barrier] = {}
-    finished: set[str] = set()
+async def align_streams(inputs: Mapping[Hashable, Executor]) -> AsyncIterator[tuple]:
+    """Align barriers across named inputs.
+
+    Yields ("chunk", name, chunk) / ("watermark", name, wm) /
+    ("barrier", barrier) events; terminates after a stop barrier or when all
+    inputs are exhausted. An input holding a barrier is not polled again
+    until the barrier is resolved (the alignment backpressure)."""
+    names = list(inputs)
+    its = {s: inputs[s].execute().__aiter__() for s in names}
+    pending: dict = {}
+    held_barrier: dict = {}
+    finished: set = set()
 
     try:
-        while len(finished) < 2:
-            for s in ("left", "right"):
+        while len(finished) < len(names):
+            for s in names:
                 if s not in pending and s not in held_barrier and s not in finished:
                     pending[s] = asyncio.ensure_future(its[s].__anext__())
             if not pending:
@@ -53,24 +58,23 @@ async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]
                     yield ("chunk", s, msg)
                 elif isinstance(msg, Watermark):
                     yield ("watermark", s, msg)
-            if len(held_barrier) == 2:
-                bl, br = held_barrier["left"], held_barrier["right"]
-                if bl.epoch.curr != br.epoch.curr:
+            live = [s for s in names if s not in finished]
+            if live and all(s in held_barrier for s in live):
+                barriers = [held_barrier[s] for s in live]
+                epochs = {b.epoch.curr for b in barriers}
+                if len(epochs) != 1:
                     raise AssertionError(
-                        f"barrier misalignment: left epoch {bl.epoch.curr} "
-                        f"!= right epoch {br.epoch.curr}")
+                        f"barrier misalignment: epochs {sorted(epochs)}")
                 held_barrier.clear()
-                yield ("barrier", bl)
-                if bl.is_stop():
-                    return
-            elif held_barrier and finished - held_barrier.keys():
-                # one side ended without a stop barrier; flush the other's
-                # barrier so the operator can still make progress
-                (s, b), = held_barrier.items()
-                held_barrier.clear()
-                yield ("barrier", b)
-                if b.is_stop():
+                yield ("barrier", barriers[0])
+                if barriers[0].is_stop():
                     return
     finally:
         for task in pending.values():
             task.cancel()
+
+
+async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]:
+    """Two-input alignment with "left"/"right" naming (join-style callers)."""
+    async for ev in align_streams({"left": left, "right": right}):
+        yield ev

@@ -1,0 +1,104 @@
+"""AppendOnlyDedupExecutor — drop duplicate pks from an append-only stream.
+
+Counterpart of the reference's AppendOnlyDedupExecutor
+(reference: src/stream/src/executor/dedup/append_only_dedup.rs). The seen-key
+set is a device hash table; a whole chunk dedups in one step — the scatter-min
+claim in ht_lookup_or_insert already makes the FIRST row of each new key the
+winner (`is_new`), which is exactly SQL's keep-first semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import OP_INSERT, StreamChunk, physical_chunk
+from ..ops.hash_table import ht_lookup_or_insert, ht_new
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+from .message import Barrier
+
+
+class AppendOnlyDedupExecutor(SingleInputExecutor):
+    identity = "AppendOnlyDedup"
+
+    def __init__(self, input: Executor, pk_indices: Sequence[int],
+                 state_table: Optional[StateTable] = None,
+                 table_capacity: int = 1 << 16):
+        super().__init__(input)
+        self.schema = input.schema
+        self.pk_indices = tuple(pk_indices)
+        self.capacity = table_capacity
+        self.state_table = state_table
+        pk_types = [input.schema[i].type for i in self.pk_indices]
+        self.table = ht_new(pk_types, table_capacity)
+        self.ckpt_dirty = jnp.zeros(table_capacity, jnp.bool_)
+        self.overflow = jnp.zeros((), jnp.bool_)
+        self.saw_delete = jnp.zeros((), jnp.bool_)
+
+        @jax.jit
+        def _step(table, ckpt_dirty, chunk: StreamChunk):
+            keys = [chunk.columns[i] for i in self.pk_indices]
+            table, slots, is_new, ovf = ht_lookup_or_insert(
+                table, keys, chunk.vis)
+            mark = jnp.where(is_new, slots, self.capacity)
+            ckpt_dirty = ckpt_dirty.at[mark].set(True, mode="drop")
+            bad = jnp.any(chunk.vis & (chunk.ops != OP_INSERT))
+            return table, ckpt_dirty, chunk.mask_vis(is_new), ovf, bad
+
+        self._step = _step
+        if state_table is not None:
+            self._load_from_state_table()
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self.table, self.ckpt_dirty, out, ovf, bad = self._step(
+            self.table, self.ckpt_dirty, chunk)
+        self.overflow = self.overflow | ovf
+        self.saw_delete = self.saw_delete | bad
+        if bool(jnp.any(out.vis)):
+            yield out
+
+    async def on_barrier(self, barrier: Barrier):
+        if bool(self.overflow):
+            raise RuntimeError(
+                f"{self.identity}: key table overflow (capacity "
+                f"{self.capacity})")
+        if bool(self.saw_delete):
+            raise RuntimeError(
+                f"{self.identity}: non-insert op on append-only input")
+        if barrier.checkpoint and self.state_table is not None:
+            self._checkpoint(barrier.epoch.curr)
+        if False:
+            yield
+
+    # -- persistence (durable row = pk values only) ---------------------------
+
+    def _checkpoint(self, epoch: int) -> None:
+        idx = np.nonzero(np.asarray(self.ckpt_dirty))[0]
+        if len(idx):
+            datas = [np.asarray(d)[idx] for d in self.table.key_data]
+            masks = [np.asarray(m)[idx] for m in self.table.key_mask]
+            for r in range(len(idx)):
+                self.state_table.insert(tuple(
+                    datas[c][r].item() if masks[c][r] else None
+                    for c in range(len(datas))))
+            self.state_table.commit(epoch)
+        self.ckpt_dirty = jnp.zeros_like(self.ckpt_dirty)
+
+    def _load_from_state_table(self) -> None:
+        pk_schema = type(self.schema)(tuple(
+            self.schema[i] for i in self.pk_indices))
+        rows = list(self.state_table.scan_all())
+        bs = 1024
+        ident = list(range(len(self.pk_indices)))
+        for i in range(0, len(rows), bs):
+            chunk = physical_chunk(pk_schema, rows[i:i + bs], bs)
+            keys = [chunk.columns[j] for j in ident]
+            self.table, _, _, ovf = ht_lookup_or_insert(
+                self.table, keys, chunk.vis)
+            if bool(ovf):
+                raise RuntimeError("dedup table overflow during recovery")
+        self.ckpt_dirty = jnp.zeros_like(self.ckpt_dirty)

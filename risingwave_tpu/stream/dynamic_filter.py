@@ -1,0 +1,197 @@
+"""DynamicFilterExecutor — filter LHS rows by a moving 1-row RHS bound.
+
+Counterpart of the reference's DynamicFilterExecutor
+(reference: src/stream/src/executor/dynamic_filter.rs:46-64, apply_batch :94,
+loop :256): the pattern behind ``WHERE v > (SELECT max(...) ...)``. The LHS
+row set lives on device (ops/row_set.py); the RHS is a single scalar fed by
+a 1-row aggregate stream. When the bound moves, the rows whose predicate
+outcome flips are emitted retroactively as Inserts/Deletes — here that is
+one vectorized membership diff at each barrier instead of the reference's
+range scan between the old and new bound (a sort-free full-compare is the
+natural vector-machine form; the row set is already resident in HBM).
+
+Barrier alignment across the two inputs follows the same combinator as the
+join. Within an epoch the RHS update is applied *at the barrier*, so chunk
+emission is consistent with the epoch's closing bound on both sides — this
+matches the reference, which buffers the RHS update and applies it on
+barrier (dynamic_filter.rs loop :256).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import (
+    DEFAULT_CHUNK_CAPACITY, OP_INSERT, OP_UPDATE_INSERT, StreamChunk,
+    chunk_to_rows, physical_chunk,
+)
+from ..ops.row_set import (
+    rs_apply_chunk, rs_changed, rs_checkpoint, rs_finish_flush,
+    rs_gather_delta, rs_new,
+)
+
+from ..storage.state_table import StateTable
+from .barrier_align import barrier_align
+from .executor import Executor
+from .message import Barrier
+
+_CMP_FNS = {
+    "greater_than": lambda v, b: v > b,
+    "greater_than_or_equal": lambda v, b: v >= b,
+    "less_than": lambda v, b: v < b,
+    "less_than_or_equal": lambda v, b: v <= b,
+}
+
+
+class DynamicFilterExecutor(Executor):
+    """``key_col``: LHS column compared against the RHS scalar (column 0 of
+    the RHS input). ``cmp``: one of greater_than / greater_than_or_equal /
+    less_than / less_than_or_equal. ``pk_indices``: LHS stream pk."""
+
+    identity = "DynamicFilter"
+
+    def __init__(
+        self,
+        left: Executor,
+        right: Executor,
+        key_col: int,
+        cmp: str,
+        pk_indices,
+        state_table: Optional[StateTable] = None,
+        bound_table: Optional[StateTable] = None,
+        table_capacity: int = 1 << 16,
+        out_capacity: int = DEFAULT_CHUNK_CAPACITY,
+    ):
+        if cmp not in _CMP_FNS:
+            raise ValueError(f"unsupported comparator {cmp}")
+        if state_table is not None and bound_table is None:
+            raise ValueError(
+                "state_table requires bound_table: recovery must restore the "
+                "committed bound to rebuild the emitted snapshot")
+        self.left, self.right = left, right
+        self.schema = left.schema
+        self.key_col = key_col
+        self.cmp = cmp
+        self.pk_indices = tuple(pk_indices)
+        self.capacity = table_capacity
+        self.out_capacity = out_capacity
+        self.state_table = state_table
+        self.bound_table = bound_table
+
+        pk_types = [left.schema[i].type for i in self.pk_indices]
+        col_types = [f.type for f in left.schema]
+        self.rows = rs_new(pk_types, col_types, table_capacity)
+        # committed bound: (value, valid). Invalid (no RHS row yet / NULL)
+        # means nothing passes — comparison with NULL is unknown.
+        key_dtype = left.schema[key_col].type.dtype
+        self.bound = jnp.zeros((), key_dtype)
+        self.bound_valid = jnp.zeros((), jnp.bool_)
+        self._staged_bound: tuple = ()  # () = no update; (v,) = set to v (may be None)
+
+        self._apply = jax.jit(
+            lambda st, ch: rs_apply_chunk(st, ch, self.pk_indices))
+        self._compute_flush = jax.jit(self._compute_flush_impl)
+        self._gather = jax.jit(rs_gather_delta, static_argnames=("out_capacity",))
+        self._finish = jax.jit(rs_finish_flush)
+        if state_table is not None:
+            self._load_from_state_table()
+
+    def _compute_flush_impl(self, rows, bound, bound_valid):
+        col = rows.cols[self.key_col]
+        passes = _CMP_FNS[self.cmp](col.data, bound)
+        in_set = rows.live & col.mask & passes & bound_valid
+        changed = rs_changed(rows, in_set)
+        return in_set, changed, jnp.sum(changed)
+
+    async def execute(self):
+        async for ev in barrier_align(self.left, self.right):
+            kind = ev[0]
+            if kind == "chunk":
+                _, side, chunk = ev
+                if side == "left":
+                    self.rows, _, _ = self._apply(self.rows, chunk)
+                else:
+                    # RHS is a 1-row changelog; the last visible insert wins.
+                    # Tiny by construction (a global agg output) — host read.
+                    for op, row in chunk_to_rows(
+                            chunk, self.right.schema, with_ops=True,
+                            physical=True):
+                        if op in (OP_INSERT, OP_UPDATE_INSERT):
+                            self._staged_bound = (row[0],)  # None = NULL bound
+                        else:
+                            # bound row deleted with no replacement (yet):
+                            # bound becomes invalid — nothing passes until a
+                            # new RHS row arrives (a following U+ in the same
+                            # chunk overwrites this)
+                            self._staged_bound = (None,)
+            elif kind == "barrier":
+                barrier = ev[1]
+                for out in self._flush(barrier):
+                    yield out
+                yield barrier
+                if barrier.is_stop():
+                    return
+            elif kind == "watermark":
+                _, side, wm = ev
+                if side == "left":
+                    yield wm
+
+    def _flush(self, barrier: Barrier):
+        if bool(self.rows.overflow):
+            raise RuntimeError(
+                f"{self.identity}: row table overflow (capacity "
+                f"{self.capacity}); increase table_capacity")
+        if self._staged_bound:
+            (v,) = self._staged_bound
+            if v is None:
+                self.bound_valid = jnp.zeros((), jnp.bool_)
+            else:
+                self.bound = jnp.asarray(v, self.bound.dtype)
+                self.bound_valid = jnp.ones((), jnp.bool_)
+            self._staged_bound = ()
+        in_set, changed, n_changed = self._compute_flush(
+            self.rows, self.bound, self.bound_valid)
+        lo, n = 0, int(n_changed)
+        while lo < n:
+            chunk = self._gather(self.rows, in_set, changed, jnp.int64(lo),
+                                 out_capacity=self.out_capacity)
+            if bool(jnp.any(chunk.vis)):
+                yield chunk
+            lo += self.out_capacity // 2
+        if barrier.checkpoint and self.state_table is not None:
+            self._checkpoint(barrier.epoch.curr)
+        self.rows = self._finish(self.rows, in_set)
+
+    # -- persistence ----------------------------------------------------------
+    # Durable state = the LHS rows plus the committed bound in a 1-row side
+    # table (schema: id, value; the reference keeps the RHS in its own state
+    # table the same way, dynamic_filter.rs right_table).
+
+    def _checkpoint(self, epoch: int) -> None:
+        self.rows = rs_checkpoint(self.rows, self.state_table, epoch)
+        if self.bound_table is not None:
+            v = self.bound.item() if bool(self.bound_valid) else None
+            self.bound_table.insert((0, v))
+            self.bound_table.commit(epoch)
+
+    def _load_from_state_table(self) -> None:
+        rows = list(self.state_table.scan_all())
+        bs = 1024
+        for i in range(0, len(rows), bs):
+            chunk = physical_chunk(self.schema, rows[i:i + bs], bs)
+            self.rows, _, _ = self._apply(self.rows, chunk)
+        if self.bound_table is not None:
+            brows = list(self.bound_table.scan_all())
+            if brows and brows[0][1] is not None:
+                self.bound = jnp.asarray(brows[0][1], self.bound.dtype)
+                self.bound_valid = jnp.ones((), jnp.bool_)
+        # rebuild the emitted snapshot at the recovered bound so the first
+        # post-recovery flush emits only genuine deltas (downstream restored
+        # from the same checkpoint and already holds the old passing set)
+        in_set, _, _ = self._compute_flush(self.rows, self.bound,
+                                           self.bound_valid)
+        self.rows = self._finish(self.rows, in_set).replace(
+            ckpt_dirty=jnp.zeros_like(self.rows.ckpt_dirty))

@@ -1,0 +1,227 @@
+"""Watermark machinery: WatermarkFilter, Sort (emit-on-window-close), Now.
+
+Counterparts of the reference's watermark/EOWC pipeline
+(reference: src/stream/src/executor/watermark_filter.rs, executor/sort.rs +
+executor/sort_buffer.rs, executor/now.rs; Watermark message semantics
+executor/mod.rs:591). Watermarks are the unbounded-stream analogue of
+sequence-length handling (SURVEY.md §5 long-context note): they bound how
+much state an EOWC operator must keep and let it emit+clean closed windows.
+
+  * WatermarkFilter: tracks max(event_time) on device, emits
+    ``Watermark(col, max - delay)``, and drops late rows (ts < watermark).
+  * SortExecutor: buffers rows on device (ops/row_set.py) and, at each
+    barrier, emits rows with ts <= watermark in (ts, pk) order, then frees
+    them — the EOWC sort that makes downstream appends ordered by time.
+  * NowExecutor: 1-column ``now()`` changelog + watermark per barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import (
+    Column, DEFAULT_CHUNK_CAPACITY, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, StreamChunk, physical_chunk,
+)
+from ..common.types import TIMESTAMP, Field, Schema
+from ..ops.row_set import rs_apply_chunk, rs_checkpoint, rs_new
+from ..ops.topn import OrderSpec, topn_order
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+from .message import Barrier, Watermark
+
+
+class WatermarkFilterExecutor(SingleInputExecutor):
+    """``delay``: watermark lag behind the max observed event time (the
+    out-of-orderness bound). Late rows (ts < current watermark) are dropped
+    — insert-only semantics, so this belongs right after sources."""
+
+    identity = "WatermarkFilter"
+
+    def __init__(self, input: Executor, time_col: int, delay: int,
+                 state_table: Optional[StateTable] = None):
+        super().__init__(input)
+        self.schema = input.schema
+        self.time_col = time_col
+        self.delay = delay
+        self.state_table = state_table
+        self.current_wm = jnp.asarray(jnp.iinfo(jnp.int64).min, jnp.int64)
+
+        @jax.jit
+        def _step(wm, chunk: StreamChunk):
+            col = chunk.columns[self.time_col]
+            ts = col.data.astype(jnp.int64)
+            valid = chunk.vis & col.mask
+            # filter against the watermark already ANNOUNCED downstream (a row
+            # below an emitted watermark would violate the watermark contract);
+            # rows of this chunk never violate the watermark they themselves
+            # advance
+            keep = valid & (ts >= wm)
+            chunk_max = jnp.max(jnp.where(valid, ts, jnp.iinfo(jnp.int64).min))
+            new_wm = jnp.maximum(wm, chunk_max - self.delay)
+            return new_wm, chunk.mask_vis(keep)
+
+        self._step = _step
+        if state_table is not None:
+            rows = list(state_table.scan_all())
+            if rows and rows[0][1] is not None:
+                self.current_wm = jnp.asarray(rows[0][1], jnp.int64)
+
+    async def map_chunk(self, chunk: StreamChunk):
+        old = self.current_wm
+        self.current_wm, out = self._step(self.current_wm, chunk)
+        if bool(jnp.any(out.vis)):
+            yield out
+        if bool(self.current_wm > old):
+            yield Watermark(self.time_col, int(self.current_wm))
+
+    async def on_barrier(self, barrier: Barrier):
+        if barrier.checkpoint and self.state_table is not None:
+            wm = int(self.current_wm)
+            self.state_table.insert(
+                (0, None if wm == jnp.iinfo(jnp.int64).min else wm))
+            self.state_table.commit(barrier.epoch.curr)
+        if False:
+            yield
+
+
+class SortExecutor(SingleInputExecutor):
+    """EOWC sort: emit buffered rows in (time, pk) order once the watermark
+    passes them. Input must be append-only (EOWC contract)."""
+
+    identity = "Sort"
+
+    def __init__(self, input: Executor, time_col: int,
+                 pk_indices: Sequence[int],
+                 state_table: Optional[StateTable] = None,
+                 table_capacity: int = 1 << 16,
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+        super().__init__(input)
+        self.schema = input.schema
+        self.time_col = time_col
+        self.pk_indices = tuple(pk_indices)
+        self.capacity = table_capacity
+        self.out_capacity = out_capacity
+        self.state_table = state_table
+        pk_types = [input.schema[i].type for i in self.pk_indices]
+        col_types = [f.type for f in input.schema]
+        self.rows = rs_new(pk_types, col_types, table_capacity)
+        self.order = (OrderSpec(time_col),) + tuple(
+            OrderSpec(i) for i in self.pk_indices if i != time_col)
+        self._pending_wm: Optional[int] = None
+
+        self._apply = jax.jit(
+            lambda st, ch: rs_apply_chunk(st, ch, self.pk_indices))
+
+        @jax.jit
+        def _close(rows, wm):
+            col = rows.cols[self.time_col]
+            ts = col.data.astype(jnp.int64)
+            ripe = rows.live & col.mask & (ts <= wm)
+            perm = topn_order(rows, jnp.zeros(self.capacity, jnp.int32),
+                              self.order)
+            ripe_sorted = ripe[perm]
+            rank_sorted = jnp.cumsum(ripe_sorted) - ripe_sorted.astype(jnp.int64)
+            # rank per slot (capacity sentinel for non-ripe)
+            rank = jnp.zeros(self.capacity, jnp.int64).at[perm].set(rank_sorted)
+            return ripe, rank, jnp.sum(ripe)
+
+        @jax.jit
+        def _gather(rows, ripe, rank, lo):
+            C = self.out_capacity
+            in_win = ripe & (rank >= lo) & (rank < lo + C)
+            pos = jnp.where(in_win, rank - lo, C).astype(jnp.int32)
+            ops = jnp.zeros(C, jnp.int8)
+            vis = jnp.zeros(C, jnp.bool_).at[pos].set(True, mode="drop")
+            cols = tuple(
+                Column(
+                    jnp.zeros(C, c.data.dtype).at[pos].set(c.data, mode="drop"),
+                    jnp.zeros(C, jnp.bool_).at[pos].set(c.mask, mode="drop"),
+                )
+                for c in rows.cols
+            )
+            return StreamChunk(ops, vis, cols)
+
+        @jax.jit
+        def _free(rows, ripe):
+            return rows.replace(live=rows.live & ~ripe,
+                                ckpt_dirty=rows.ckpt_dirty | ripe)
+
+        self._close, self._gather_ripe, self._free = _close, _gather, _free
+        if state_table is not None:
+            self._load_from_state_table()
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self.rows, _, _ = self._apply(self.rows, chunk)
+        if False:
+            yield
+
+    async def on_watermark(self, watermark: Watermark):
+        if watermark.col_idx == self.time_col:
+            self._pending_wm = watermark.value
+        yield watermark
+
+    async def on_barrier(self, barrier: Barrier):
+        if bool(self.rows.overflow):
+            raise RuntimeError(
+                f"{self.identity}: sort buffer overflow (capacity "
+                f"{self.capacity})")
+        if self._pending_wm is not None:
+            wm = jnp.asarray(self._pending_wm, jnp.int64)
+            self._pending_wm = None
+            ripe, rank, n_ripe = self._close(self.rows, wm)
+            lo, n = 0, int(n_ripe)
+            while lo < n:
+                chunk = self._gather_ripe(self.rows, ripe, rank, jnp.int64(lo))
+                yield chunk
+                lo += self.out_capacity
+            self.rows = self._free(self.rows, ripe)
+        if barrier.checkpoint and self.state_table is not None:
+            self.rows = rs_checkpoint(self.rows, self.state_table,
+                                      barrier.epoch.curr)
+
+    def _load_from_state_table(self) -> None:
+        rows = list(self.state_table.scan_all())
+        bs = 1024
+        for i in range(0, len(rows), bs):
+            chunk = physical_chunk(self.schema, rows[i:i + bs], bs)
+            self.rows, _, _ = self._apply(self.rows, chunk)
+        self.rows = self.rows.replace(
+            ckpt_dirty=jnp.zeros_like(self.rows.ckpt_dirty))
+
+
+class NowExecutor(Executor):
+    """Emits the wall-clock of each barrier as a 1-row changelog + watermark
+    (reference: executor/now.rs — the ``now()`` lower bound for temporal
+    filters). ``clock``: epoch -> microseconds; default derives a synthetic
+    monotone clock from the epoch number so tests are deterministic."""
+
+    identity = "Now"
+
+    def __init__(self, barrier_source: Executor,
+                 clock: Optional[Callable[[int], int]] = None):
+        self._barriers = barrier_source
+        self.schema = Schema.of(("now", TIMESTAMP))
+        self._clock = clock or (lambda epoch: epoch * 1_000_000)
+        self._last: Optional[int] = None
+
+    async def execute(self):
+        async for msg in self._barriers.execute():
+            if not isinstance(msg, Barrier):
+                continue
+            now = self._clock(msg.epoch.curr)
+            if self._last is None:
+                chunk = physical_chunk(self.schema, [(now,)], 2)
+            else:
+                chunk = physical_chunk(self.schema, [(self._last,), (now,)], 2)
+                chunk = chunk.replace(ops=jnp.array(
+                    [OP_UPDATE_DELETE, OP_UPDATE_INSERT], jnp.int8))
+            self._last = now
+            yield chunk
+            yield Watermark(0, now)
+            yield msg
+            if msg.is_stop():
+                return

@@ -1,0 +1,51 @@
+"""ExpandExecutor — row expansion for grouping sets / distinct aggregates.
+
+Counterpart of the reference's ExpandExecutor
+(reference: src/stream/src/executor/expand.rs; used by the distinct-agg and
+grouping-sets rewrites in the optimizer). Each input row is replicated once
+per column subset, with columns outside the subset nulled and a ``flag``
+column identifying the subset — emitted as one statically-shaped chunk per
+subset, same capacity as the input.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import INT64, Field, Schema
+from .executor import Executor, SingleInputExecutor
+
+
+class ExpandExecutor(SingleInputExecutor):
+    identity = "Expand"
+
+    def __init__(self, input: Executor, column_subsets: Sequence[Sequence[int]]):
+        super().__init__(input)
+        self.subsets = [tuple(s) for s in column_subsets]
+        self.schema = Schema(tuple(input.schema) + (Field("flag", INT64),))
+
+        @jax.jit
+        def _expand(chunk: StreamChunk):
+            outs = []
+            for flag, subset in enumerate(self.subsets):
+                cols = []
+                for ci, c in enumerate(chunk.columns):
+                    if ci in subset:
+                        cols.append(c)
+                    else:
+                        cols.append(Column(c.data, jnp.zeros_like(c.mask)))
+                cols.append(Column(
+                    jnp.full(chunk.capacity, flag, jnp.int64),
+                    jnp.ones(chunk.capacity, jnp.bool_)))
+                outs.append(chunk.with_columns(cols))
+            return tuple(outs)
+
+        self._expand = _expand
+
+    async def map_chunk(self, chunk: StreamChunk):
+        for out in self._expand(chunk):
+            yield out

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.chunk import (
+    physical_chunk,
     DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units, gather_units_window,
     make_chunk,
 )
@@ -168,7 +169,7 @@ class HashJoinExecutor(Executor):
             rows = list(table.scan_all())
             bs = 1024
             for i in range(0, len(rows), bs):
-                chunk = _physical_chunk(schema, rows[i: i + bs], bs)
+                chunk = physical_chunk(schema, rows[i: i + bs], bs)
                 self.state, _ = self._apply[side](self.state, chunk)
         self.state = self._clear_ckpt(self.state)
 
@@ -182,22 +183,3 @@ def _clear_ckpt_marks(state: JoinState) -> JoinState:
     return state.replace(left=clear(state.left), right=clear(state.right))
 
 
-def _physical_chunk(schema, rows, capacity: int) -> StreamChunk:
-    """Rows of raw *physical* values (state-table storage form) → chunk."""
-    import numpy as _np
-    from ..common.chunk import Column
-    n = len(rows)
-    ops = _np.zeros(capacity, _np.int8)
-    vis = _np.zeros(capacity, bool)
-    vis[:n] = True
-    cols = []
-    for ci, field in enumerate(schema):
-        data = _np.full(capacity, field.type.null_sentinel(), field.type.np_dtype)
-        mask = _np.zeros(capacity, bool)
-        for ri in range(n):
-            v = rows[ri][ci]
-            if v is not None:
-                data[ri] = v
-                mask[ri] = True
-        cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
-    return StreamChunk(jnp.asarray(ops), jnp.asarray(vis), tuple(cols))

@@ -1,0 +1,67 @@
+"""HopWindowExecutor — HOP (sliding) window expansion.
+
+Counterpart of the reference's HopWindowExecutor
+(reference: src/stream/src/executor/hop_window.rs; TUMBLE needs no executor —
+it is a plain projection, which the planner lowers to Project with
+``tumble_start``). Each row falls into ``n = window_size / window_slide``
+hop windows; the executor emits n output chunks per input chunk — one per
+hop offset, same static capacity, visibility-masked — so shapes stay static
+and XLA compiles the expansion once (SURVEY.md §7 static-shape rule).
+
+Output schema: input columns + window_start + window_end (both TIMESTAMP),
+matching the reference's output layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import TIMESTAMP, Field, Schema
+from .executor import Executor, SingleInputExecutor
+
+
+class HopWindowExecutor(SingleInputExecutor):
+    identity = "HopWindow"
+
+    def __init__(self, input: Executor, time_col: int, window_slide: int,
+                 window_size: int):
+        super().__init__(input)
+        if window_size % window_slide != 0:
+            raise ValueError(
+                f"window_size {window_size} must be a multiple of "
+                f"window_slide {window_slide} (reference parity: hop_window.rs "
+                "requires units == size/slide)")
+        self.time_col = time_col
+        self.slide = window_slide
+        self.size = window_size
+        self.n_windows = window_size // window_slide
+        self.schema = Schema(tuple(input.schema) + (
+            Field("window_start", TIMESTAMP), Field("window_end", TIMESTAMP)))
+
+        @jax.jit
+        def _expand(chunk: StreamChunk):
+            col = chunk.columns[self.time_col]
+            ts = col.data.astype(jnp.int64)
+            # first (earliest) hop window containing ts starts at
+            # tumble(ts, slide) - (n-1)*slide; the i-th candidate start is
+            # tumble(ts, slide) - i*slide, valid while ts < start + size
+            base = (ts // self.slide) * self.slide
+            outs = []
+            for i in range(self.n_windows):
+                ws = base - (self.n_windows - 1 - i) * self.slide
+                we = ws + self.size
+                valid = col.mask & (ts < we) & (ts >= ws)
+                cols = chunk.columns + (
+                    Column(ws, valid), Column(we, valid))
+                outs.append(chunk.replace(
+                    vis=chunk.vis & valid, columns=cols))
+            return tuple(outs)
+
+        self._expand = _expand
+
+    async def map_chunk(self, chunk: StreamChunk):
+        for out in self._expand(chunk):
+            if bool(jnp.any(out.vis)):
+                yield out

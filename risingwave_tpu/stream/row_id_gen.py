@@ -1,0 +1,42 @@
+"""RowIdGenExecutor — assign serial row ids to source rows.
+
+Counterpart of the reference's RowIdGenExecutor
+(reference: src/stream/src/executor/row_id_gen.rs; RowId layout
+src/common/src/util/row_id.rs — vnode-prefixed monotone ids so ids generated
+by parallel source actors never collide). Here: id = shard_id << 48 | seq,
+seq a device counter bumped per visible row — one fused step, no host sync.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from .executor import Executor, SingleInputExecutor
+
+
+class RowIdGenExecutor(SingleInputExecutor):
+    identity = "RowIdGen"
+
+    def __init__(self, input: Executor, row_id_index: int, shard_id: int = 0):
+        super().__init__(input)
+        self.schema = input.schema
+        self.row_id_index = row_id_index
+        self.seq = jnp.zeros((), jnp.int64)
+        base = jnp.int64(shard_id) << 48
+
+        @jax.jit
+        def _step(seq, chunk: StreamChunk):
+            vis = chunk.vis
+            offset = jnp.cumsum(vis) - vis.astype(jnp.int64)
+            ids = base | (seq + offset)
+            cols = list(chunk.columns)
+            cols[row_id_index] = Column(ids, jnp.ones_like(vis))
+            return seq + jnp.sum(vis), chunk.with_columns(cols)
+
+        self._step = _step
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self.seq, out = self._step(self.seq, chunk)
+        yield out

@@ -1,0 +1,237 @@
+"""SimpleAgg / StatelessSimpleAgg — global (single-group) aggregation.
+
+Counterparts of the reference's SimpleAggExecutor and
+StatelessSimpleAggExecutor (reference: src/stream/src/executor/simple_agg.rs,
+src/stream/src/executor/stateless_simple_agg.rs). SimpleAgg keeps one group's
+lanes as device scalars and emits its first row on the first barrier (the MV
+of ``SELECT count(*) …`` shows 0 before any input — reference
+simple_agg.rs's AggGroup with prev_outputs=None). StatelessSimpleAgg is the
+shuffle-free local phase of 2-phase aggregation: one partial-delta row per
+chunk, always op Insert (downstream global agg combines via signed sums).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, Column, StreamChunk,
+)
+from ..common.types import Field, Schema
+from ..expr.agg import AggCall
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+from .message import Barrier
+
+
+@struct.dataclass
+class SimpleAggState:
+    lanes: tuple[jax.Array, ...]       # scalars; lane 0 = row count
+    prev_lanes: tuple[jax.Array, ...]
+    dirty: jax.Array                   # bool scalar
+    ever_emitted: jax.Array            # bool scalar
+
+
+class _AggLanes:
+    """Shared lane layout/update logic for the two global-agg executors."""
+
+    def __init__(self, agg_calls: Sequence[AggCall]):
+        self.agg_calls = tuple(agg_calls)
+        self.lane_dtypes = [jnp.int64]
+        self.call_lane_ofs = []
+        for c in self.agg_calls:
+            self.call_lane_ofs.append(len(self.lane_dtypes))
+            self.lane_dtypes.extend(c.state_dtypes())
+
+    def init_lanes(self) -> tuple[jax.Array, ...]:
+        lanes = [jnp.zeros((), jnp.int64)]
+        for c in self.agg_calls:
+            for v, dt in zip(c.init_lanes(), c.state_dtypes()):
+                lanes.append(jnp.asarray(v, dt))
+        return tuple(lanes)
+
+    def chunk_deltas(self, chunk: StreamChunk) -> tuple[jax.Array, ...]:
+        """Per-chunk reduction of contributions → one delta per lane."""
+        signs = chunk.signs()
+        deltas = [jnp.sum(signs).astype(jnp.int64)]
+        for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
+            if call.arg >= 0:
+                col = chunk.columns[call.arg]
+                value, vmask = col.data, col.mask & chunk.vis
+            else:
+                value = jnp.zeros_like(signs)
+                vmask = chunk.vis
+            for contrib, op in zip(call.contributions(value, vmask, signs),
+                                   call.reduce_ops()):
+                if op == "add":
+                    deltas.append(jnp.sum(contrib))
+                elif op == "min":
+                    deltas.append(jnp.min(contrib))
+                else:
+                    deltas.append(jnp.max(contrib))
+        return tuple(deltas)
+
+    def merge(self, lanes, deltas) -> tuple[jax.Array, ...]:
+        out = [lanes[0] + deltas[0]]
+        i = 1
+        for call in self.agg_calls:
+            for op in call.reduce_ops():
+                if op == "add":
+                    out.append(lanes[i] + deltas[i])
+                elif op == "min":
+                    out.append(jnp.minimum(lanes[i], deltas[i]))
+                else:
+                    out.append(jnp.maximum(lanes[i], deltas[i]))
+                i += 1
+        return tuple(out)
+
+    def outputs(self, lanes) -> list[tuple[jax.Array, jax.Array]]:
+        live = lanes[0] > 0
+        outs = []
+        for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
+            call_lanes = [lanes[ofs + j] for j in range(call.num_lanes)]
+            data, mask = call.output(call_lanes, live)
+            outs.append((data.astype(call.output_type.dtype), mask))
+        return outs
+
+    def out_schema(self) -> Schema:
+        return Schema(tuple(
+            Field(f"agg{i}", c.output_type) for i, c in enumerate(self.agg_calls)
+        ))
+
+
+class SimpleAggExecutor(SingleInputExecutor):
+    """Global aggregation: output is always exactly one logical row."""
+
+    identity = "SimpleAgg"
+
+    def __init__(self, input: Executor, agg_calls: Sequence[AggCall],
+                 state_table: Optional[StateTable] = None):
+        super().__init__(input)
+        self.lanes_def = _AggLanes(agg_calls)
+        self.agg_calls = self.lanes_def.agg_calls
+        self.schema = self.lanes_def.out_schema()
+        self.state_table = state_table
+        self.state = SimpleAggState(
+            lanes=self.lanes_def.init_lanes(),
+            prev_lanes=self.lanes_def.init_lanes(),
+            dirty=jnp.zeros((), jnp.bool_),
+            ever_emitted=jnp.zeros((), jnp.bool_),
+        )
+        self._apply = jax.jit(self._apply_impl)
+        self._flush = jax.jit(self._flush_impl)
+        if state_table is not None:
+            self._load_from_state_table()
+
+    def _apply_impl(self, state: SimpleAggState, chunk: StreamChunk):
+        deltas = self.lanes_def.chunk_deltas(chunk)
+        any_row = chunk.cardinality() > 0
+        return state.replace(
+            lanes=self.lanes_def.merge(state.lanes, deltas),
+            dirty=state.dirty | any_row,
+        )
+
+    def _flush_impl(self, state: SimpleAggState):
+        """Returns (new_state, chunk-of-2-rows): row 0 = U- of prev values
+        (vis only if previously emitted), row 1 = U+/Insert of current."""
+        emit = state.dirty | ~state.ever_emitted
+        prev_outs = self.lanes_def.outputs(state.prev_lanes)
+        cur_outs = self.lanes_def.outputs(state.lanes)
+        ops = jnp.array([OP_UPDATE_DELETE, OP_UPDATE_INSERT], jnp.int8)
+        ops = jnp.where(
+            state.ever_emitted, ops,
+            jnp.array([OP_UPDATE_DELETE, OP_INSERT], jnp.int8))
+        vis = jnp.stack([state.ever_emitted & emit, emit])
+        cols = tuple(
+            Column(jnp.stack([pd, cd]), jnp.stack([pm, cm]))
+            for (pd, pm), (cd, cm) in zip(prev_outs, cur_outs)
+        )
+        chunk = StreamChunk(ops, vis, cols)
+        new_state = state.replace(
+            prev_lanes=state.lanes,
+            dirty=jnp.zeros((), jnp.bool_),
+            ever_emitted=state.ever_emitted | emit,
+        )
+        return new_state, chunk
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self.state = self._apply(self.state, chunk)
+        if False:
+            yield
+
+    async def on_barrier(self, barrier: Barrier):
+        self.state, chunk = self._flush(self.state)
+        if bool(jnp.any(chunk.vis)):
+            yield chunk
+        if barrier.checkpoint and self.state_table is not None:
+            self._checkpoint(barrier.epoch.curr)
+
+    # -- persistence ----------------------------------------------------------
+
+    def _checkpoint(self, epoch: int) -> None:
+        row = tuple(l.item() for l in self.state.lanes) + (
+            bool(self.state.ever_emitted),)
+        self.state_table.insert((0,) + row)
+        self.state_table.commit(epoch)
+
+    def _load_from_state_table(self) -> None:
+        rows = list(self.state_table.scan_all())
+        if not rows:
+            return
+        row = rows[0]
+        lanes = tuple(
+            jnp.asarray(v, dt) for v, dt in zip(row[1:], self.lanes_def.lane_dtypes)
+        )
+        self.state = self.state.replace(
+            lanes=lanes, prev_lanes=lanes,
+            ever_emitted=jnp.asarray(bool(row[1 + len(lanes)]), jnp.bool_),
+        )
+
+
+class StatelessSimpleAggExecutor(SingleInputExecutor):
+    """Local (pre-shuffle) agg phase: one partial-delta Insert row per chunk
+    (reference: stateless_simple_agg.rs — StatelessSimpleAgg has no state and
+    emits chunk-local partials; only sum/count shapes are retraction-safe)."""
+
+    identity = "StatelessSimpleAgg"
+
+    def __init__(self, input: Executor, agg_calls: Sequence[AggCall]):
+        super().__init__(input)
+        for c in agg_calls:
+            if c.needs_append_only or c.kind == "avg":
+                raise ValueError(
+                    f"stateless agg cannot emit {c.kind} partials; the "
+                    "planner must split it (avg -> sum+count)")
+        self.lanes_def = _AggLanes(agg_calls)
+        self.agg_calls = self.lanes_def.agg_calls
+        self.schema = self.lanes_def.out_schema()
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, chunk: StreamChunk):
+        """Emit RAW partial deltas (count deltas may be negative, sums are
+        signed) — the downstream global agg combines them; applying the SQL
+        output projection here would lose retraction information."""
+        deltas = self.lanes_def.chunk_deltas(chunk)
+        outs = []
+        for call, ofs in zip(self.agg_calls, self.lanes_def.call_lane_ofs):
+            data = deltas[ofs].astype(call.output_type.dtype)
+            if call.arg >= 0:
+                col = chunk.columns[call.arg]
+                mask = jnp.any(col.mask & chunk.vis)
+            else:
+                mask = jnp.ones((), jnp.bool_)
+            outs.append((data, mask))
+        any_row = chunk.cardinality() > 0
+        ops = jnp.zeros(1, jnp.int8)
+        vis = jnp.stack([any_row])
+        cols = tuple(Column(d[None], m[None]) for d, m in outs)
+        return StreamChunk(ops, vis, cols)
+
+    async def map_chunk(self, chunk: StreamChunk):
+        out = self._step(chunk)
+        if bool(jnp.any(out.vis)):
+            yield out
