@@ -1,0 +1,12 @@
+"""SQL frontend: parser → binder → planner → optimizer → stream/batch plans.
+
+Counterpart of the reference's frontend stack
+(reference: src/sqlparser/ (parser), src/frontend/src/binder/,
+planner/, optimizer/, stream_fragmenter/ — SURVEY.md §2.6). Python is the
+right tool here: the frontend is control-plane, runs once per DDL, and emits
+plans whose *runtime* is the jitted executor graph.
+"""
+
+from .parser import parse_sql  # noqa: F401
+from .catalog import Catalog, SourceDef, TableDef  # noqa: F401
+from .session import Session  # noqa: F401

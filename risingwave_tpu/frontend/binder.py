@@ -1,0 +1,254 @@
+"""Binder: name resolution of AST expressions against a column scope.
+
+Counterpart of the reference's Binder (reference: src/frontend/src/binder/
+mod.rs:78,269). One deliberate simplification vs the reference: bound
+expressions ARE the runtime expression objects (risingwave_tpu.expr) — there
+is no separate frontend IR to re-lower, because the runtime exprs are
+already pure plan-time trees that inline into jitted steps (expr/expr.py).
+Aggregate calls are extracted (not evaluable row-wise) and replaced by
+references into the agg operator's output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ..common.types import (
+    BOOL, FLOAT64, INT32, INT64, INTERVAL, TIMESTAMP, VARCHAR, DataType,
+    Field, Schema, TypeKind,
+)
+from ..expr.agg import AggCall
+from ..expr.expr import Cast as RCast, Expr, InputRef, Literal, call, cast
+from . import sqlast as A
+from .catalog import type_from_name
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class ScopeColumn:
+    name: str
+    table: Optional[str]
+    index: int
+    type: DataType
+
+
+class Scope:
+    """Visible columns during binding, with table-alias qualification."""
+
+    def __init__(self, columns: Sequence[ScopeColumn]):
+        self.columns = list(columns)
+
+    @staticmethod
+    def of_schema(schema: Schema, table: Optional[str] = None,
+                  offset: int = 0) -> "Scope":
+        return Scope([
+            ScopeColumn(f.name, table, offset + i, f.type)
+            for i, f in enumerate(schema)
+        ])
+
+    def concat(self, other: "Scope", offset: int) -> "Scope":
+        """``offset``: width of the left relation's SCHEMA (not scope — a
+        scope may hide internal pk columns, but indices address the schema)."""
+        return Scope(self.columns + [
+            dataclasses.replace(c, index=c.index + offset)
+            for c in other.columns
+        ])
+
+    def resolve(self, name: str, table: Optional[str]) -> ScopeColumn:
+        matches = [
+            c for c in self.columns
+            if c.name == name and (table is None or c.table == table)
+        ]
+        if not matches:
+            raise BindError(f"column {table + '.' if table else ''}{name} not found")
+        if len(matches) > 1:
+            raise BindError(f"column reference {name!r} is ambiguous")
+        return matches[0]
+
+
+_BINOP_FN = {
+    "+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+    "%": "modulus", "=": "equal", "<>": "not_equal", "<": "less_than",
+    "<=": "less_than_or_equal", ">": "greater_than",
+    ">=": "greater_than_or_equal", "AND": "and", "OR": "or",
+}
+
+AGG_KINDS = {"count", "sum", "min", "max", "avg"}
+
+
+@dataclasses.dataclass
+class BoundAgg:
+    """An aggregate call found during binding + where its output will land."""
+
+    call: AggCall
+    output_index: int     # index in the agg operator's output (after keys)
+
+
+class ExprBinder:
+    """Binds one expression tree. ``agg_ctx`` non-None => aggregate calls are
+    allowed and collected (SELECT/HAVING position in a GROUP BY query)."""
+
+    def __init__(self, scope: Scope, agg_ctx: Optional[list] = None,
+                 subquery_sink: Optional[list] = None):
+        self.scope = scope
+        self.agg_ctx = agg_ctx
+        self.subquery_sink = subquery_sink
+
+    def bind(self, node) -> Expr:
+        if isinstance(node, A.ColumnRef):
+            c = self.scope.resolve(node.name, node.table)
+            return InputRef(c.index, c.type)
+        if isinstance(node, A.Lit):
+            return self._literal(node)
+        if isinstance(node, A.BinaryOp):
+            return self._binop(node)
+        if isinstance(node, A.UnaryOp):
+            if node.op == "NOT":
+                return call("not", self.bind(node.operand))
+            if node.op == "-":
+                return call("neg", self.bind(node.operand))
+            raise BindError(f"unsupported unary op {node.op}")
+        if isinstance(node, A.FuncCall):
+            return self._func(node)
+        if isinstance(node, A.Case):
+            args = []
+            for cond, res in node.branches:
+                args.append(self.bind(cond))
+                args.append(self.bind(res))
+            if node.else_result is not None:
+                args.append(self.bind(node.else_result))
+            return call("case", *args)
+        if isinstance(node, A.InList):
+            e = self.bind(node.expr)
+            cmps = [call("equal", e, self.bind(i)) for i in node.items]
+            out = cmps[0]
+            for c in cmps[1:]:
+                out = call("or", out, c)
+            return call("not", out) if node.negated else out
+        if isinstance(node, A.Between):
+            e = self.bind(node.expr)
+            lo = call("greater_than_or_equal", e, self.bind(node.low))
+            hi = call("less_than_or_equal", e, self.bind(node.high))
+            rng = call("and", lo, hi)
+            return call("not", rng) if node.negated else rng
+        if isinstance(node, A.IsNull):
+            fn = "is_not_null" if node.negated else "is_null"
+            return call(fn, self.bind(node.expr))
+        if isinstance(node, A.Cast):
+            return cast(self.bind(node.expr), type_from_name(node.type_name))
+        if isinstance(node, A.ScalarSubquery):
+            if self.subquery_sink is None:
+                raise BindError("scalar subquery not supported here")
+            self.subquery_sink.append(node.query)
+            # placeholder: planner rewrites the comparison into DynamicFilter
+            return _SubqueryPlaceholder(len(self.subquery_sink) - 1)
+        raise BindError(f"cannot bind {type(node).__name__}")
+
+    def _literal(self, node: A.Lit) -> Literal:
+        v = node.value
+        if v is None:
+            return Literal(None, INT64)
+        if node.type_hint == "interval":
+            return Literal(v, INTERVAL)
+        if node.type_hint == "varchar":
+            return Literal(v, VARCHAR)
+        if isinstance(v, bool):
+            return Literal(v, BOOL)
+        if isinstance(v, int):
+            return Literal(v, INT64 if abs(v) > 2**31 - 1 else INT32)
+        if isinstance(v, float):
+            return Literal(v, FLOAT64)
+        raise BindError(f"cannot bind literal {v!r}")
+
+    def _binop(self, node: A.BinaryOp) -> Expr:
+        fn = _BINOP_FN.get(node.op)
+        if fn is None:
+            raise BindError(f"unsupported operator {node.op}")
+        return call(fn, self.bind(node.left), self.bind(node.right))
+
+    def _func(self, node: A.FuncCall) -> Expr:
+        name = node.name.lower()
+        if name in AGG_KINDS:
+            if self.agg_ctx is None:
+                raise BindError(f"aggregate {name}() not allowed here")
+            return self._bind_agg(name, node)
+        args = [self.bind(a) for a in node.args]
+        return call(name, *args)
+
+    def _bind_agg(self, kind: str, node: A.FuncCall) -> Expr:
+        if len(node.args) > 1:
+            raise BindError(f"{kind}() takes at most one argument")
+        if not node.args or isinstance(node.args[0], A.Star):
+            if kind != "count":
+                raise BindError(f"{kind}(*) is not valid")
+            acall = AggCall("count", -1, distinct=node.distinct)
+        else:
+            arg = ExprBinder(self.scope).bind(node.args[0])
+            if not isinstance(arg, InputRef):
+                # non-trivial agg args get a pre-projection by the planner;
+                # record the expression itself
+                acall = AggCall(kind, -2, arg.type, distinct=node.distinct)
+                bound = BoundAgg(acall, -1)
+                bound.arg_expr = arg  # type: ignore[attr-defined]
+                self.agg_ctx.append(bound)
+                return _AggPlaceholder(len(self.agg_ctx) - 1, acall.output_type)
+            acall = AggCall(kind, arg.index, arg.type, distinct=node.distinct)
+        # dedup identical agg calls
+        for i, b in enumerate(self.agg_ctx):
+            if b.call == acall and not hasattr(b, "arg_expr"):
+                return _AggPlaceholder(i, acall.output_type)
+        self.agg_ctx.append(BoundAgg(acall, -1))
+        return _AggPlaceholder(len(self.agg_ctx) - 1, acall.output_type)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class _AggPlaceholder(Expr):
+    """Stands for 'output of agg call #i'; the planner rewrites it to an
+    InputRef over the agg operator's output schema."""
+
+    agg_index: int
+    type: DataType
+
+    def eval(self, chunk):  # pragma: no cover
+        raise RuntimeError("unresolved aggregate placeholder")
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class _SubqueryPlaceholder(Expr):
+    """Stands for 'the scalar value of subquery #i' inside WHERE — only
+    allowed as one side of a comparison, which the planner turns into a
+    DynamicFilter (reference: dynamic_filter.rs pattern)."""
+
+    subquery_index: int
+    type: DataType = INT64
+
+    def eval(self, chunk):  # pragma: no cover
+        raise RuntimeError("unresolved subquery placeholder")
+
+
+def rewrite_placeholders(e: Expr, mapping) -> Expr:
+    """Replace _AggPlaceholder nodes via ``mapping(agg_index) -> Expr``."""
+    from ..expr.expr import FunctionCall
+    if isinstance(e, _AggPlaceholder):
+        return mapping(e.agg_index)
+    if isinstance(e, FunctionCall):
+        new_args = tuple(rewrite_placeholders(a, mapping) for a in e.args)
+        return dataclasses.replace(e, args=new_args)
+    if isinstance(e, RCast):
+        return dataclasses.replace(e, arg=rewrite_placeholders(e.arg, mapping))
+    return e
+
+
+def contains_placeholder(e: Expr, kind) -> bool:
+    from ..expr.expr import FunctionCall
+    if isinstance(e, kind):
+        return True
+    if isinstance(e, FunctionCall):
+        return any(contains_placeholder(a, kind) for a in e.args)
+    if isinstance(e, RCast):
+        return contains_placeholder(e.arg, kind)
+    return False

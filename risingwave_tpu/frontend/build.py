@@ -1,0 +1,172 @@
+"""Executor-graph builder: plan tree → wired executor pipeline.
+
+Counterpart of the reference's create_executor dispatch
+(reference: src/stream/src/from_proto/mod.rs:119-165 — proto plan node →
+executor, recursively). The builder also allocates state tables for every
+stateful operator (the reference's fragmenter fills internal-table ids,
+src/meta/src/stream/stream_graph/fragment.rs:258).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..common.types import Field, INT64, Schema
+from ..expr.expr import InputRef
+from ..ops.join_state import JoinType
+from ..storage.state_store import MemoryStateStore
+from ..storage.state_table import StateTable
+from ..stream.dynamic_filter import DynamicFilterExecutor
+from ..stream.eowc import SortExecutor
+from ..stream.executor import Executor, SingleInputExecutor
+from ..stream.hash_agg import HashAggExecutor, agg_state_schema
+from ..stream.hash_join import HashJoinExecutor
+from ..stream.hop_window import HopWindowExecutor
+from ..stream.materialize import MaterializeExecutor
+from ..stream.project import FilterExecutor, ProjectExecutor
+from ..stream.row_id_gen import RowIdGenExecutor
+from ..stream.simple_agg import SimpleAggExecutor
+from ..stream.top_n import TopNExecutor
+from ..stream.union import UnionExecutor
+from . import planner as P
+from .runtime import QueueSource
+
+_JOIN_TYPES = {
+    "inner": JoinType.INNER, "left": JoinType.LEFT_OUTER,
+    "right": JoinType.RIGHT_OUTER, "full": JoinType.FULL_OUTER,
+    "left_semi": JoinType.LEFT_SEMI, "left_anti": JoinType.LEFT_ANTI,
+}
+
+
+@dataclasses.dataclass
+class BuildConfig:
+    chunk_capacity: int = 1024
+    agg_table_capacity: int = 1 << 16
+    join_key_capacity: int = 1 << 13
+    join_bucket_width: int = 16
+    topn_table_capacity: int = 1 << 16
+
+
+class BuildContext:
+    """Per-job build state: allocated sources and state tables.
+
+    ``source_factory(plan_node) -> Executor`` supplies the leaves — the
+    Session passes a factory that creates queue-fed sources for streaming
+    jobs or snapshot replays for batch queries."""
+
+    def __init__(
+        self,
+        store: MemoryStateStore,
+        next_table_id: Callable[[], int],
+        source_factory: Callable[[P.PlanNode], Executor],
+        config: Optional[BuildConfig] = None,
+        durable: bool = True,
+    ):
+        self.store = store
+        self.next_table_id = next_table_id
+        self.source_factory = source_factory
+        self.config = config or BuildConfig()
+        self.durable = durable
+        self.state_table_ids: list[int] = []
+
+    def state_table(self, schema: Schema, pk) -> Optional[StateTable]:
+        if not self.durable:
+            return None
+        tid = self.next_table_id()
+        self.state_table_ids.append(tid)
+        return StateTable(self.store, tid, schema, list(pk))
+
+
+def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
+    cfg = ctx.config
+    if isinstance(plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)):
+        return ctx.source_factory(plan)
+
+    if isinstance(plan, P.PProject):
+        inp = build_plan(plan.input, ctx)
+        return ProjectExecutor(inp, list(plan.exprs),
+                               names=plan.schema.names)
+
+    if isinstance(plan, P.PFilter):
+        inp = build_plan(plan.input, ctx)
+        return FilterExecutor(inp, plan.predicate)
+
+    if isinstance(plan, P.PHopWindow):
+        inp = build_plan(plan.input, ctx)
+        return HopWindowExecutor(inp, plan.time_col, plan.slide, plan.size)
+
+    if isinstance(plan, P.PAgg):
+        inp = build_plan(plan.input, ctx)
+        if plan.group_keys:
+            key_fields = [plan.input.schema[i] for i in plan.group_keys]
+            st = ctx.state_table(
+                agg_state_schema(key_fields, plan.agg_calls),
+                list(range(len(plan.group_keys))))
+            return HashAggExecutor(
+                inp, list(plan.group_keys), list(plan.agg_calls),
+                state_table=st, table_capacity=cfg.agg_table_capacity,
+                out_capacity=cfg.chunk_capacity)
+        lanes = [Field("id", INT64)]
+        from ..stream.simple_agg import _AggLanes
+        for i, dt in enumerate(_AggLanes(plan.agg_calls).lane_dtypes):
+            import jax.numpy as jnp
+            from ..common.types import FLOAT64
+            lanes.append(Field(f"l{i}", INT64 if dt == jnp.int64 else FLOAT64))
+        lanes.append(Field("flag", INT64))
+        st = ctx.state_table(Schema(tuple(lanes)), [0])
+        return SimpleAggExecutor(inp, list(plan.agg_calls), state_table=st)
+
+    if isinstance(plan, P.PJoin):
+        left = build_plan(plan.left, ctx)
+        right = build_plan(plan.right, ctx)
+        lst = ctx.state_table(plan.left.schema, list(plan.left.pk))
+        rst = ctx.state_table(plan.right.schema, list(plan.right.pk))
+        return HashJoinExecutor(
+            left, right, list(plan.left_keys), list(plan.right_keys),
+            join_type=_JOIN_TYPES[plan.kind], condition=plan.condition,
+            left_state_table=lst, right_state_table=rst,
+            key_capacity=cfg.join_key_capacity,
+            bucket_width=cfg.join_bucket_width,
+            out_capacity=cfg.chunk_capacity)
+
+    if isinstance(plan, P.PTopN):
+        inp = build_plan(plan.input, ctx)
+        st = ctx.state_table(plan.schema, list(plan.pk))
+        return TopNExecutor(
+            inp, list(plan.order), plan.offset, plan.limit,
+            pk_indices=list(plan.pk), group_by=list(plan.group_by),
+            with_ties=plan.with_ties, state_table=st,
+            table_capacity=cfg.topn_table_capacity,
+            out_capacity=cfg.chunk_capacity)
+
+    if isinstance(plan, P.PDynFilter):
+        left = build_plan(plan.input, ctx)
+        right = build_plan(plan.right, ctx)
+        st = ctx.state_table(plan.schema, list(plan.pk))
+        bt = None
+        if st is not None:
+            bt = ctx.state_table(
+                Schema((Field("id", INT64),
+                        Field("bound", plan.schema[plan.key_col].type))), [0])
+        return DynamicFilterExecutor(
+            left, right, key_col=plan.key_col, cmp=plan.cmp,
+            pk_indices=list(plan.pk), state_table=st, bound_table=bt,
+            table_capacity=cfg.topn_table_capacity,
+            out_capacity=cfg.chunk_capacity)
+
+    if isinstance(plan, P.PUnion):
+        return UnionExecutor([build_plan(i, ctx) for i in plan.inputs])
+
+    raise NotImplementedError(f"cannot build {type(plan).__name__}")
+
+
+def collect_leaves(plan: P.PlanNode) -> list:
+    """All leaf nodes (sources/scans/values) in plan order."""
+    if not plan.children:
+        return [plan] if isinstance(
+            plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)) else []
+    out = []
+    for c in plan.children:
+        out.extend(collect_leaves(c))
+    return out

@@ -1,0 +1,138 @@
+"""Catalog: sources, tables, materialized views, indexes.
+
+Counterpart of the reference's CatalogManager + frontend catalog cache
+(reference: src/meta/src/manager/catalog/, src/frontend/src/catalog/ —
+single-process here, one authoritative copy; the meta/frontend split returns
+when the cluster runtime lands).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from ..common.types import (
+    BOOL, DATE, FLOAT32, FLOAT64, INT16, INT32, INT64, INTERVAL,
+    TIME, TIMESTAMP, VARCHAR, DataType, Field, Schema, decimal,
+)
+
+_TYPE_NAMES: dict[str, DataType] = {
+    "boolean": BOOL, "bool": BOOL,
+    "smallint": INT16, "int2": INT16,
+    "int": INT32, "integer": INT32, "int4": INT32,
+    "bigint": INT64, "int8": INT64,
+    "real": FLOAT32, "float4": FLOAT32,
+    "double": FLOAT64, "float8": FLOAT64, "float": FLOAT64,
+    "decimal": decimal(), "numeric": decimal(),
+    "date": DATE, "time": TIME,
+    "timestamp": TIMESTAMP, "timestamptz": TIMESTAMP,
+    "interval": INTERVAL,
+    "varchar": VARCHAR, "text": VARCHAR, "string": VARCHAR,
+    "serial": INT64,
+}
+
+
+def type_from_name(name: str) -> DataType:
+    t = _TYPE_NAMES.get(name.lower())
+    if t is None:
+        raise ValueError(f"unknown type name {name!r}")
+    return t
+
+
+@dataclasses.dataclass
+class SourceDef:
+    name: str
+    schema: Schema
+    connector: str
+    options: dict
+    watermark: Optional[tuple] = None      # (col_name, delay_us)
+    append_only: bool = True
+
+
+@dataclasses.dataclass
+class TableDef:
+    name: str
+    schema: Schema
+    pk: tuple                               # column indices
+    table_id: int = -1
+    append_only: bool = False
+
+
+@dataclasses.dataclass
+class MaterializedViewDef:
+    name: str
+    schema: Schema
+    pk: tuple                               # column indices into schema
+    table_id: int = -1
+    definition: str = ""
+
+
+@dataclasses.dataclass
+class IndexDef:
+    name: str
+    table: str
+    columns: tuple
+
+
+class CatalogError(ValueError):
+    pass
+
+
+class Catalog:
+    def __init__(self) -> None:
+        self.sources: dict[str, SourceDef] = {}
+        self.tables: dict[str, TableDef] = {}
+        self.mvs: dict[str, MaterializedViewDef] = {}
+        self.indexes: dict[str, IndexDef] = {}
+        self._table_ids = itertools.count(1)
+
+    def next_table_id(self) -> int:
+        return next(self._table_ids)
+
+    def _check_free(self, name: str) -> None:
+        for reg in (self.sources, self.tables, self.mvs, self.indexes):
+            if name in reg:
+                raise CatalogError(f"name {name!r} already in use")
+
+    def add_source(self, s: SourceDef) -> None:
+        self._check_free(s.name)
+        self.sources[s.name] = s
+
+    def add_table(self, t: TableDef) -> None:
+        self._check_free(t.name)
+        if t.table_id < 0:
+            t.table_id = self.next_table_id()
+        self.tables[t.name] = t
+
+    def add_mv(self, mv: MaterializedViewDef) -> None:
+        self._check_free(mv.name)
+        if mv.table_id < 0:
+            mv.table_id = self.next_table_id()
+        self.mvs[mv.name] = mv
+
+    def add_index(self, ix: IndexDef) -> None:
+        self._check_free(ix.name)
+        self.indexes[ix.name] = ix
+
+    def resolve_relation(self, name: str):
+        """-> ("source"|"table"|"mv", def)"""
+        if name in self.sources:
+            return "source", self.sources[name]
+        if name in self.tables:
+            return "table", self.tables[name]
+        if name in self.mvs:
+            return "mv", self.mvs[name]
+        raise CatalogError(f"relation {name!r} not found")
+
+    def drop(self, kind: str, name: str, if_exists: bool = False) -> bool:
+        reg = {
+            "source": self.sources, "table": self.tables,
+            "materialized_view": self.mvs, "index": self.indexes,
+        }[kind]
+        if name not in reg:
+            if if_exists:
+                return False
+            raise CatalogError(f"{kind} {name!r} not found")
+        del reg[name]
+        return True

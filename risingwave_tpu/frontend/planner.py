@@ -1,0 +1,680 @@
+"""Planner: bound SELECT → stream plan tree (with stream-key derivation).
+
+Counterpart of the reference's Planner + stream-side optimizer phases
+(reference: src/frontend/src/planner/mod.rs:37,53 and
+optimizer/plan_node/stream_*.rs). Each plan node carries its ``pk`` — the
+stream key that identifies rows across updates (the reference's logical_pk):
+Source appends a hidden ``_row_id``; Agg's pk is its group keys; Join's is
+the concatenation of both sides' pks; Project keeps pk columns alive by
+appending hidden columns when the SELECT list drops them (exactly the
+reference's add-logical-pk rule).
+
+Scalar-subquery comparisons in WHERE lower to DynamicFilter; ORDER BY +
+LIMIT lowers to TopN; DISTINCT lowers to group-by-all-columns Agg
+(reference: the corresponding optimizer rules under
+src/frontend/src/optimizer/rule/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ..common.types import Field, Schema, TIMESTAMP
+from ..expr.agg import AggCall
+from ..expr.expr import Expr, FunctionCall, InputRef, Literal, call
+from ..ops.topn import OrderSpec
+from . import sqlast as A
+from .binder import (
+    AGG_KINDS, BindError, BoundAgg, ExprBinder, Scope, ScopeColumn,
+    _AggPlaceholder, _SubqueryPlaceholder, contains_placeholder,
+    rewrite_placeholders,
+)
+from .catalog import Catalog, CatalogError, MaterializedViewDef, SourceDef, TableDef
+
+
+class PlanError(ValueError):
+    pass
+
+
+# -- plan nodes ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlanNode:
+    schema: Schema
+    pk: tuple                        # stream-key column indices
+
+    @property
+    def children(self) -> tuple:
+        return ()
+
+    def label(self) -> str:
+        return type(self).__name__[1:]
+
+    def explain(self, indent: int = 0) -> str:
+        lines = [" " * indent + self._describe()]
+        for c in self.children:
+            lines.append(c.explain(indent + 2))
+        return "\n".join(lines)
+
+    def _describe(self) -> str:
+        return f"{self.label()} {{pk={list(self.pk)}}}"
+
+
+@dataclasses.dataclass
+class PSource(PlanNode):
+    source: SourceDef
+    row_id_index: int = -1           # hidden _row_id column index
+
+
+@dataclasses.dataclass
+class PTableScan(PlanNode):
+    table: TableDef
+
+
+@dataclasses.dataclass
+class PMvScan(PlanNode):
+    mv: MaterializedViewDef
+
+
+@dataclasses.dataclass
+class PProject(PlanNode):
+    input: PlanNode
+    exprs: tuple                     # runtime Expr per output column
+
+    @property
+    def children(self):
+        return (self.input,)
+
+    def _describe(self):
+        return (f"Project {{exprs={[_expr_str(e) for e in self.exprs]}, "
+                f"pk={list(self.pk)}}}")
+
+
+@dataclasses.dataclass
+class PFilter(PlanNode):
+    input: PlanNode
+    predicate: Expr
+
+    @property
+    def children(self):
+        return (self.input,)
+
+    def _describe(self):
+        return f"Filter {{pred={_expr_str(self.predicate)}, pk={list(self.pk)}}}"
+
+
+@dataclasses.dataclass
+class PHopWindow(PlanNode):
+    input: PlanNode
+    time_col: int
+    slide: int
+    size: int
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclasses.dataclass
+class PAgg(PlanNode):
+    input: PlanNode
+    group_keys: tuple                # input column indices
+    agg_calls: tuple                 # AggCall...
+    append_only_input: bool = False
+    eowc: bool = False
+
+    @property
+    def children(self):
+        return (self.input,)
+
+    def _describe(self):
+        calls = [f"{c.kind}({c.arg if c.arg >= 0 else '*'})"
+                 for c in self.agg_calls]
+        return (f"{'SimpleAgg' if not self.group_keys else 'HashAgg'} "
+                f"{{keys={list(self.group_keys)}, aggs={calls}, "
+                f"pk={list(self.pk)}}}")
+
+
+@dataclasses.dataclass
+class PJoin(PlanNode):
+    left: PlanNode
+    right: PlanNode
+    kind: str                        # inner/left/right/full/left_semi/left_anti
+    left_keys: tuple
+    right_keys: tuple
+    condition: Optional[Expr]        # residual non-equi condition, over concat
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def _describe(self):
+        return (f"HashJoin {{type={self.kind}, on={list(self.left_keys)}="
+                f"{list(self.right_keys)}, pk={list(self.pk)}}}")
+
+
+@dataclasses.dataclass
+class PTopN(PlanNode):
+    input: PlanNode
+    order: tuple                     # OrderSpec...
+    limit: int
+    offset: int
+    with_ties: bool = False
+    group_by: tuple = ()
+
+    @property
+    def children(self):
+        return (self.input,)
+
+    def _describe(self):
+        return (f"TopN {{order={[(o.col, 'desc' if o.desc else 'asc') for o in self.order]}, "
+                f"limit={self.limit}, offset={self.offset}, pk={list(self.pk)}}}")
+
+
+@dataclasses.dataclass
+class PDynFilter(PlanNode):
+    input: PlanNode
+    right: PlanNode                  # 1-row plan producing the bound
+    key_col: int
+    cmp: str
+
+    @property
+    def children(self):
+        return (self.input, self.right)
+
+    def _describe(self):
+        return f"DynamicFilter {{col={self.key_col} {self.cmp} <sub>, pk={list(self.pk)}}}"
+
+
+@dataclasses.dataclass
+class PUnion(PlanNode):
+    inputs: tuple
+
+    @property
+    def children(self):
+        return tuple(self.inputs)
+
+
+@dataclasses.dataclass
+class PValues(PlanNode):
+    rows: tuple
+
+
+def _expr_str(e: Expr) -> str:
+    if isinstance(e, InputRef):
+        return f"${e.index}"
+    if isinstance(e, Literal):
+        return repr(e.value)
+    if isinstance(e, FunctionCall):
+        return f"{e.name}({', '.join(_expr_str(a) for a in e.args)})"
+    return type(e).__name__
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _conjuncts(e: A.Expr) -> list:
+    if isinstance(e, A.BinaryOp) and e.op == "AND":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+_CMP_TO_FN = {
+    ">": "greater_than", ">=": "greater_than_or_equal",
+    "<": "less_than", "<=": "less_than_or_equal",
+}
+_CMP_FLIP = {">": "<", ">=": "<=", "<": ">", "<=": ">="}
+
+
+class Planner:
+    """Plans one SELECT against the catalog. ``fresh`` — hidden-column name
+    uniquifier shared across nested planners."""
+
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    # -- entry ----------------------------------------------------------------
+
+    def plan_select(self, sel: A.Select) -> PlanNode:
+        if sel.union_all is not None:
+            left = self.plan_select(dataclasses.replace(sel, union_all=None))
+            right = self.plan_select(sel.union_all)
+            if len(left.schema) != len(right.schema):
+                raise PlanError("UNION ALL arms must have equal arity")
+            # align pk layout: use full row as key via dedicated hidden cols
+            # (reference unions carry a source-id in the stream key)
+            return PUnion(schema=left.schema, pk=tuple(range(len(left.schema))),
+                          inputs=(left, right))
+
+        if sel.from_ is None:
+            return self._plan_no_from(sel)
+
+        node, scope = self._plan_relation(sel.from_)
+
+        # WHERE: split conjuncts into plain filters and dynamic-filter rewrites
+        dyn_conjuncts = []
+        if sel.where is not None:
+            subqueries: list = []
+            plain = []
+            for conj in _conjuncts(sel.where):
+                if self._has_subquery(conj):
+                    dyn_conjuncts.append(conj)
+                else:
+                    plain.append(conj)
+            for conj in plain:
+                pred = ExprBinder(scope).bind(conj)
+                node = PFilter(schema=node.schema, pk=node.pk, input=node,
+                               predicate=pred)
+
+        # dynamic filters apply pre-projection (reference: the subquery
+        # Apply-rewrite places DynamicFilter below the projection)
+        for conj in dyn_conjuncts:
+            node = self._plan_dynamic_filter(conj, node, scope)
+
+        has_aggs = bool(sel.group_by) or self._select_has_aggs(sel)
+        if has_aggs:
+            node, scope = self._plan_agg(sel, node, scope)
+        else:
+            node, scope = self._plan_projection(sel, node, scope)
+
+        if sel.having is not None and not has_aggs:
+            raise PlanError("HAVING without aggregation")
+
+        if sel.distinct:
+            # dedup over the VISIBLE columns; hidden stream-key columns are
+            # dropped (the distinct keys become the new stream key)
+            visible = tuple(i for i, f in enumerate(node.schema)
+                            if not f.name.startswith("_"))
+            if len(visible) != len(node.schema):
+                node = PProject(
+                    schema=node.schema.select(visible), pk=(), input=node,
+                    exprs=tuple(InputRef(i, node.schema[i].type)
+                                for i in visible))
+            n = len(node.schema)
+            node = PAgg(
+                schema=Schema(tuple(node.schema)), pk=tuple(range(n)),
+                input=node, group_keys=tuple(range(n)), agg_calls=())
+
+        if sel.order_by or sel.limit is not None:
+            node = self._plan_topn(sel, node, scope)
+        return node
+
+    # -- FROM -----------------------------------------------------------------
+
+    def _plan_relation(self, rel: A.Relation):
+        if isinstance(rel, A.TableRef):
+            return self._plan_table_ref(rel)
+        if isinstance(rel, A.WindowTVF):
+            return self._plan_window_tvf(rel)
+        if isinstance(rel, A.SubqueryRef):
+            node = self.plan_select(rel.query)
+            return node, Scope.of_schema(node.schema, rel.alias)
+        if isinstance(rel, A.Join):
+            return self._plan_join(rel)
+        raise PlanError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_table_ref(self, ref: A.TableRef):
+        kind, d = self.catalog.resolve_relation(ref.name)
+        alias = ref.alias or ref.name
+        if kind == "source":
+            # hidden _row_id appended: the stream key of a keyless source
+            # (reference: row_id_gen.rs + logical source planning)
+            from ..common.types import SERIAL
+            schema = Schema(tuple(d.schema) + (Field("_row_id", SERIAL),))
+            n = len(schema)
+            node = PSource(schema=schema, pk=(n - 1,), source=d,
+                           row_id_index=n - 1)
+            scope = Scope([
+                ScopeColumn(f.name, alias, i, f.type)
+                for i, f in enumerate(d.schema)
+            ])
+            return node, scope
+        if kind == "table":
+            node = PTableScan(schema=d.schema, pk=tuple(d.pk), table=d)
+            return node, Scope.of_schema(d.schema, alias)
+        node = PMvScan(schema=d.schema, pk=tuple(d.pk), mv=d)
+        n_vis = getattr(d, "n_visible", len(d.schema))
+        scope = Scope([
+            ScopeColumn(f.name, alias, i, f.type)
+            for i, f in enumerate(d.schema) if i < n_vis
+        ])
+        return node, scope
+
+    def _plan_window_tvf(self, tvf: A.WindowTVF):
+        node, scope = self._plan_table_ref(tvf.table)
+        tc = scope.resolve(tvf.time_col, None)
+        if tc.type.kind != TIMESTAMP.kind:
+            raise PlanError(f"window TVF time column must be timestamp")
+        alias = tvf.alias or tvf.table.name
+
+        def lit_us(e) -> int:
+            b = ExprBinder(scope).bind(e)
+            if not isinstance(b, Literal):
+                raise PlanError("window TVF size/slide must be literal")
+            return int(b.value)
+
+        n_in = len(node.schema)
+        if tvf.kind == "tumble":
+            (size,) = map(lit_us, tvf.args)
+            # TUMBLE = projection: all columns + window_start + window_end
+            exprs = [InputRef(i, f.type) for i, f in enumerate(node.schema)]
+            ws = call("tumble_start", InputRef(tc.index, tc.type),
+                      Literal(size, TIMESTAMP))
+            exprs.append(ws)
+            exprs.append(ws + Literal(size, TIMESTAMP))
+            schema = Schema(tuple(node.schema) + (
+                Field("window_start", TIMESTAMP), Field("window_end", TIMESTAMP)))
+            node = PProject(schema=schema, pk=node.pk, input=node,
+                            exprs=tuple(exprs))
+        else:
+            slide, size = map(lit_us, tvf.args)
+            schema = Schema(tuple(node.schema) + (
+                Field("window_start", TIMESTAMP), Field("window_end", TIMESTAMP)))
+            # pk extends with window_start: one input row yields size/slide rows
+            node = PHopWindow(schema=schema, pk=tuple(node.pk) + (n_in,),
+                              input=node, time_col=tc.index, slide=slide,
+                              size=size)
+        new_scope = Scope(
+            scope.columns + [
+                ScopeColumn("window_start", alias, n_in, TIMESTAMP),
+                ScopeColumn("window_end", alias, n_in + 1, TIMESTAMP),
+            ])
+        return node, new_scope
+
+    def _plan_join(self, j: A.Join):
+        left, lscope = self._plan_relation(j.left)
+        right, rscope = self._plan_relation(j.right)
+        n_left = len(left.schema)
+        scope = lscope.concat(rscope, n_left)
+
+        # split ON into equi-keys and residual condition
+        lkeys, rkeys, residual = [], [], []
+        if j.on is not None:
+            for conj in _conjuncts(j.on):
+                pair = self._equi_pair(conj, scope, n_left)
+                if pair is not None:
+                    lkeys.append(pair[0])
+                    rkeys.append(pair[1])
+                else:
+                    residual.append(conj)
+        if not lkeys:
+            raise PlanError("join requires at least one equality condition "
+                            "(nested-loop streaming join unsupported)")
+        cond = None
+        if residual:
+            bound = [ExprBinder(scope).bind(c) for c in residual]
+            cond = bound[0]
+            for b in bound[1:]:
+                cond = call("and", cond, b)
+
+        schema = Schema(tuple(left.schema) + tuple(right.schema))
+        pk = tuple(left.pk) + tuple(i + n_left for i in right.pk)
+        return PJoin(schema=schema, pk=pk, left=left, right=right,
+                     kind=j.kind, left_keys=tuple(lkeys),
+                     right_keys=tuple(rkeys), condition=cond), scope
+
+    def _equi_pair(self, conj, scope: Scope, n_left: int):
+        if not (isinstance(conj, A.BinaryOp) and conj.op == "="):
+            return None
+        try:
+            l = ExprBinder(scope).bind(conj.left)
+            r = ExprBinder(scope).bind(conj.right)
+        except BindError:
+            return None
+        if isinstance(l, InputRef) and isinstance(r, InputRef):
+            if l.index < n_left <= r.index:
+                return (l.index, r.index - n_left)
+            if r.index < n_left <= l.index:
+                return (r.index, l.index - n_left)
+        return None
+
+    # -- projection / aggregation ---------------------------------------------
+
+    def _expand_stars(self, sel: A.Select, scope: Scope) -> list:
+        items = []
+        for item in sel.items:
+            if isinstance(item.expr, A.Star):
+                for c in scope.columns:
+                    if item.expr.table is None or c.table == item.expr.table:
+                        items.append(A.SelectItem(
+                            A.ColumnRef(c.name, c.table), c.name))
+            else:
+                items.append(item)
+        return items
+
+    def _plan_projection(self, sel: A.Select, node: PlanNode, scope: Scope):
+        items = self._expand_stars(sel, scope)
+        exprs, fields = [], []
+        for item in items:
+            e = ExprBinder(scope).bind(item.expr)
+            exprs.append(e)
+            fields.append(Field(item.alias or self._auto_name(item.expr), e.type))
+        # keep the stream key alive: append hidden pk columns not projected
+        out_pk = []
+        for pk_col in node.pk:
+            found = None
+            for i, e in enumerate(exprs):
+                if isinstance(e, InputRef) and e.index == pk_col:
+                    found = i
+                    break
+            if found is None:
+                exprs.append(InputRef(pk_col, node.schema[pk_col].type))
+                fields.append(Field(f"_pk{len(out_pk)}", node.schema[pk_col].type))
+                found = len(exprs) - 1
+            out_pk.append(found)
+        proj = PProject(schema=Schema(tuple(fields)), pk=tuple(out_pk),
+                        input=node, exprs=tuple(exprs))
+        new_scope = Scope([
+            ScopeColumn(f.name, None, i, f.type)
+            for i, f in enumerate(proj.schema)
+        ])
+        return proj, new_scope
+
+    def _plan_agg(self, sel: A.Select, node: PlanNode, scope: Scope):
+        # 1. bind group keys
+        group_exprs = [ExprBinder(scope).bind(g) for g in sel.group_by]
+        # 2. bind select items + having with agg collection
+        aggs: list[BoundAgg] = []
+        items = self._expand_stars(sel, scope)
+        bound_items = []
+        for item in items:
+            b = ExprBinder(scope, agg_ctx=aggs).bind(item.expr)
+            bound_items.append((b, item.alias or self._auto_name(item.expr)))
+        bound_having = None
+        if sel.having is not None:
+            bound_having = ExprBinder(scope, agg_ctx=aggs).bind(sel.having)
+
+        # 3. pre-projection: group keys first, then agg args
+        pre_exprs = list(group_exprs)
+        for a in aggs:
+            if hasattr(a, "arg_expr"):
+                a.call = dataclasses.replace(a.call, arg=len(pre_exprs))
+                pre_exprs.append(a.arg_expr)  # type: ignore[attr-defined]
+            elif a.call.arg >= 0:
+                # remap plain column arg into pre-projection position
+                pre_exprs.append(InputRef(a.call.arg,
+                                          node.schema[a.call.arg].type))
+                a.call = dataclasses.replace(a.call, arg=len(pre_exprs) - 1)
+        pre_fields = [
+            Field(f"k{i}", e.type) for i, e in enumerate(group_exprs)
+        ] + [
+            Field(f"a{i}", e.type)
+            for i, e in enumerate(pre_exprs[len(group_exprs):])
+        ]
+        pre = PProject(schema=Schema(tuple(pre_fields)), pk=(), input=node,
+                       exprs=tuple(pre_exprs))
+
+        # 4. the agg node: output = group keys ++ agg outputs
+        nk = len(group_exprs)
+        agg_fields = tuple(
+            Field(f"k{i}", e.type) for i, e in enumerate(group_exprs)
+        ) + tuple(
+            Field(f"agg{i}", a.call.output_type) for i, a in enumerate(aggs)
+        )
+        agg_node = PAgg(
+            schema=Schema(agg_fields), pk=tuple(range(nk)), input=pre,
+            group_keys=tuple(range(nk)),
+            agg_calls=tuple(a.call for a in aggs),
+            eowc=sel.emit_on_window_close)
+
+        # 5. post-projection: rewrite select items over agg output
+        def agg_ref(i: int) -> Expr:
+            return InputRef(nk + i, aggs[i].call.output_type)
+
+        def rewrite_tree(e: Expr) -> Expr:
+            # replace group-key subexpressions first, then agg placeholders
+            for gi, g in enumerate(group_exprs):
+                if _expr_eq(e, g):
+                    return InputRef(gi, g.type)
+            if isinstance(e, _AggPlaceholder):
+                return agg_ref(e.agg_index)
+            if isinstance(e, FunctionCall):
+                return dataclasses.replace(
+                    e, args=tuple(rewrite_tree(a) for a in e.args))
+            from ..expr.expr import Cast as RCast
+            if isinstance(e, RCast):
+                return dataclasses.replace(e, arg=rewrite_tree(e.arg))
+            if isinstance(e, InputRef):
+                raise PlanError(
+                    f"column ${e.index} must appear in GROUP BY or an "
+                    "aggregate")
+            return e
+
+        post_node: PlanNode = agg_node
+        if bound_having is not None:
+            post_node = PFilter(schema=agg_node.schema, pk=agg_node.pk,
+                                input=post_node,
+                                predicate=rewrite_tree(bound_having))
+        out_exprs, out_fields = [], []
+        for b, name in bound_items:
+            e = rewrite_tree(b)
+            out_exprs.append(e)
+            out_fields.append(Field(name, e.type))
+        out_pk = []
+        for pk_col in agg_node.pk:
+            found = None
+            for i, e in enumerate(out_exprs):
+                if isinstance(e, InputRef) and e.index == pk_col:
+                    found = i
+                    break
+            if found is None:
+                out_exprs.append(InputRef(pk_col, agg_node.schema[pk_col].type))
+                out_fields.append(
+                    Field(f"_pk{len(out_pk)}", agg_node.schema[pk_col].type))
+                found = len(out_exprs) - 1
+            out_pk.append(found)
+        proj = PProject(schema=Schema(tuple(out_fields)), pk=tuple(out_pk),
+                        input=post_node, exprs=tuple(out_exprs))
+        new_scope = Scope([
+            ScopeColumn(f.name, None, i, f.type)
+            for i, f in enumerate(proj.schema)
+        ])
+        return proj, new_scope
+
+    # -- TopN / dynamic filter / misc -----------------------------------------
+
+    def _plan_topn(self, sel: A.Select, node: PlanNode, scope: Scope):
+        order = []
+        for oi in sel.order_by:
+            b = ExprBinder(scope).bind(oi.expr)
+            if not isinstance(b, InputRef):
+                raise PlanError("ORDER BY expression must be an output column")
+            nulls_last = oi.nulls_last
+            if nulls_last is None:
+                nulls_last = not oi.desc     # PG default
+            order.append(OrderSpec(b.index, oi.desc, nulls_last))
+        if sel.limit is None:
+            # bare ORDER BY on an MV is a presentation property; keep plan
+            return node
+        return PTopN(schema=node.schema, pk=node.pk, input=node,
+                     order=tuple(order), limit=sel.limit,
+                     offset=sel.offset or 0, with_ties=sel.with_ties)
+
+    def _plan_dynamic_filter(self, conj, node: PlanNode, scope: Scope):
+        if not (isinstance(conj, A.BinaryOp) and conj.op in _CMP_TO_FN):
+            raise PlanError(
+                "subquery only supported as 'col CMP (SELECT ...)'")
+        lsub = isinstance(conj.left, A.ScalarSubquery)
+        rsub = isinstance(conj.right, A.ScalarSubquery)
+        if lsub == rsub:
+            raise PlanError("exactly one side must be a scalar subquery")
+        col_ast = conj.right if lsub else conj.left
+        sub = conj.left if lsub else conj.right
+        op = _CMP_FLIP[conj.op] if lsub else conj.op
+        b = ExprBinder(scope).bind(col_ast)
+        if not isinstance(b, InputRef):
+            raise PlanError("dynamic filter LHS must be a plain column")
+        right_plan = self.plan_select(sub.query)
+        if len(right_plan.schema) < 1:
+            raise PlanError("scalar subquery must produce one column")
+        return PDynFilter(schema=node.schema, pk=node.pk, input=node,
+                          right=right_plan, key_col=b.index,
+                          cmp=_CMP_TO_FN[op])
+
+    def _plan_no_from(self, sel: A.Select) -> PlanNode:
+        binder = ExprBinder(Scope([]))
+        rows = []
+        row = tuple(binder.bind(i.expr) for i in sel.items)
+        rows.append(row)
+        fields = tuple(
+            Field(item.alias or self._auto_name(item.expr), e.type)
+            for item, e in zip(sel.items, row))
+        return PValues(schema=Schema(fields), pk=(), rows=tuple(rows))
+
+    # -- small helpers --------------------------------------------------------
+
+    def _has_subquery(self, e) -> bool:
+        if isinstance(e, A.ScalarSubquery):
+            return True
+        if isinstance(e, A.BinaryOp):
+            return self._has_subquery(e.left) or self._has_subquery(e.right)
+        if isinstance(e, A.UnaryOp):
+            return self._has_subquery(e.operand)
+        return False
+
+    def _select_has_aggs(self, sel: A.Select) -> bool:
+        def walk(e) -> bool:
+            if isinstance(e, A.FuncCall):
+                if e.name.lower() in AGG_KINDS:
+                    return True
+                return any(walk(a) for a in e.args)
+            if isinstance(e, A.BinaryOp):
+                return walk(e.left) or walk(e.right)
+            if isinstance(e, A.UnaryOp):
+                return walk(e.operand)
+            if isinstance(e, A.Case):
+                return any(walk(c) or walk(r) for c, r in e.branches) or (
+                    e.else_result is not None and walk(e.else_result))
+            if isinstance(e, A.Cast):
+                return walk(e.expr)
+            return False
+        return any(walk(i.expr) for i in sel.items
+                   if not isinstance(i.expr, A.Star)) or (
+            sel.having is not None and walk(sel.having))
+
+    def _auto_name(self, e) -> str:
+        if isinstance(e, A.ColumnRef):
+            return e.name
+        if isinstance(e, A.FuncCall):
+            return e.name.lower()
+        return "?column?"
+
+
+def _expr_eq(a: Expr, b: Expr) -> bool:
+    """Structural equality of bound expressions (Expr overloads __eq__ for
+    SQL sugar, so compare explicitly)."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, InputRef):
+        return a.index == b.index
+    if isinstance(a, Literal):
+        return a.value == b.value and a.type.kind == b.type.kind
+    if isinstance(a, FunctionCall):
+        return (a.name == b.name and len(a.args) == len(b.args)
+                and all(_expr_eq(x, y) for x, y in zip(a.args, b.args)))
+    from ..expr.expr import Cast as RCast
+    if isinstance(a, RCast):
+        return a.type.kind == b.type.kind and _expr_eq(a.arg, b.arg)
+    return a is b

@@ -1,0 +1,502 @@
+"""Session: SQL entry point + single-process cluster (playground mode).
+
+Counterpart of the reference's Session/handler dispatch + playground runtime
+(reference: src/frontend/src/handler/mod.rs:167 per-statement dispatch;
+src/cmd_all/src/playground.rs one-process cluster). The Session owns the
+catalog, the state store, the running stream jobs, and the epoch clock: its
+``tick()`` is the GlobalBarrierManager's inject/collect cycle (SURVEY.md
+§3.2) — generate source chunks, push a barrier into every root queue, await
+all jobs, commit the epoch on checkpoints.
+
+Batch ``SELECT`` runs the SAME operator pipeline over snapshot sources (two
+barriers bracket the snapshot), then folds the delta stream into rows — the
+streaming/batch unification the reference gets from running batch plans
+over Hummock snapshots (SURVEY.md §3.5), obtained here by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
+    chunk_to_rows, make_chunk,
+)
+from ..common.types import Field, Schema
+from ..connector.nexmark import (
+    AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig, NexmarkGenerator,
+)
+from ..storage.state_store import MemoryStateStore
+from ..storage.state_table import StateTable
+from ..stream.eowc import WatermarkFilterExecutor
+from ..stream.executor import Executor
+from ..stream.materialize import MaterializeExecutor
+from ..stream.message import Barrier, Message
+from ..stream.row_id_gen import RowIdGenExecutor
+from ..stream.source import MockSource
+from . import sqlast as A
+from .binder import BindError, ExprBinder, Scope
+from .build import BuildConfig, BuildContext, build_plan, collect_leaves
+from .catalog import (
+    Catalog, CatalogError, MaterializedViewDef, SourceDef, TableDef,
+    type_from_name,
+)
+from .parser import parse_sql
+from .planner import Planner, PMvScan, PSource, PTableScan, PValues, PlanError
+from .runtime import ChangelogBus, QueueSource, StreamJob
+
+
+class SqlError(ValueError):
+    pass
+
+
+def _values_chunk(leaf: PValues) -> StreamChunk:
+    """Constant-fold VALUES expressions into one chunk (row-less exprs are
+    evaluated over a dummy 1-row chunk — the frontend's eval_const)."""
+    import jax.numpy as jnp
+    from ..expr.expr import Literal
+    dummy = StreamChunk(jnp.zeros(1, jnp.int8), jnp.ones(1, jnp.bool_), ())
+    rows = []
+    for r in leaf.rows:
+        vals = []
+        for e in r:
+            if isinstance(e, Literal):
+                vals.append(e.value)
+            else:
+                c = e.eval(dummy)
+                vals.append(e.type.to_python(c.data[0])
+                            if bool(c.mask[0]) else None)
+        rows.append(tuple(vals))
+    return make_chunk(leaf.schema, rows, capacity=max(len(rows), 1))
+
+
+@dataclasses.dataclass
+class _SourceFeed:
+    """A connector instance feeding one job's source leaf."""
+
+    queue: QueueSource
+    generator: Callable[[], Optional[StreamChunk]]
+
+
+class _RowIdAppendSource(Executor):
+    """Wraps a queue of connector chunks, appending the hidden _row_id
+    column (reference: source executors append the row-id column before
+    RowIdGen fills it)."""
+
+    def __init__(self, inner: QueueSource, out_schema: Schema):
+        self.inner = inner
+        self.schema = out_schema
+
+    async def execute(self):
+        import jax.numpy as jnp
+        from ..common.chunk import Column
+        async for msg in self.inner.execute():
+            if isinstance(msg, StreamChunk):
+                cap = msg.capacity
+                rid = Column(jnp.zeros(cap, jnp.int64),
+                             jnp.ones(cap, jnp.bool_))
+                yield msg.append_columns((rid,))
+            else:
+                yield msg
+            if isinstance(msg, Barrier) and msg.is_stop():
+                return
+
+
+class Session:
+    def __init__(self, checkpoint_frequency: int = 10,
+                 chunks_per_tick: int = 1, source_chunk_capacity: int = 1024,
+                 config: Optional[BuildConfig] = None, seed: int = 42):
+        self.catalog = Catalog()
+        self.store = MemoryStateStore()
+        self.config = config or BuildConfig()
+        self.checkpoint_frequency = checkpoint_frequency
+        self.chunks_per_tick = chunks_per_tick
+        self.source_chunk_capacity = source_chunk_capacity
+        self.seed = seed
+        self.epoch = 1               # last completed epoch
+        self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
+        self.feeds: list[_SourceFeed] = []
+        self.table_dml: dict[str, list[StreamChunk]] = {}
+        self._table_queues: dict[str, list[QueueSource]] = {}
+        self._next_shard = 0
+        # the session owns its event loop: jobs are long-lived tasks that
+        # must survive across synchronous API calls, independent of any
+        # ambient loop other code may create/close
+        self.loop = asyncio.new_event_loop()
+
+    # ------------------------------------------------------------------ SQL --
+
+    def run_sql(self, sql: str) -> list:
+        """Execute statements; returns the last statement's result rows."""
+        out: list = []
+        for stmt in parse_sql(sql):
+            out = self._run_statement(stmt)
+        return out
+
+    def _run_statement(self, stmt: A.Statement) -> list:
+        if isinstance(stmt, A.CreateSource):
+            return self._create_source(stmt)
+        if isinstance(stmt, A.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, A.CreateMaterializedView):
+            return self._create_mv(stmt)
+        if isinstance(stmt, A.DropStatement):
+            return self._drop(stmt)
+        if isinstance(stmt, A.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, A.Query):
+            return self.query(stmt.select)
+        if isinstance(stmt, A.ShowStatement):
+            reg = {"tables": self.catalog.tables,
+                   "sources": self.catalog.sources,
+                   "materialized_views": self.catalog.mvs}.get(stmt.what)
+            if reg is None:
+                raise SqlError(f"cannot SHOW {stmt.what}")
+            return [(name,) for name in sorted(reg)]
+        if isinstance(stmt, A.FlushStatement):
+            self.flush()
+            return []
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ----------------------------------------------------------------- DDL --
+
+    def _create_source(self, stmt: A.CreateSource) -> list:
+        if stmt.if_not_exists and stmt.name in self.catalog.sources:
+            return []
+        connector = str(stmt.with_options.get("connector", ""))
+        if connector == "nexmark":
+            table = str(stmt.with_options.get("nexmark_table",
+                                              stmt.with_options.get("table", "bid")))
+            schema = {"bid": BID_SCHEMA, "auction": AUCTION_SCHEMA,
+                      "person": PERSON_SCHEMA}[table.lower()]
+            if stmt.columns:
+                declared = {c.name for c in stmt.columns}
+                missing = declared - set(schema.names)
+                if missing:
+                    raise SqlError(f"columns {missing} not in nexmark {table}")
+        elif stmt.columns:
+            schema = Schema(tuple(
+                Field(c.name, type_from_name(c.type_name))
+                for c in stmt.columns))
+        else:
+            raise SqlError("CREATE SOURCE requires columns or a known connector")
+        watermark = None
+        if stmt.watermark is not None:
+            watermark = self._bind_watermark(stmt.watermark, schema)
+        self.catalog.add_source(SourceDef(
+            stmt.name, schema, connector, dict(stmt.with_options),
+            watermark=watermark))
+        return []
+
+    def _bind_watermark(self, wm_ast, schema: Schema):
+        col_name, expr = wm_ast
+        try:
+            idx = list(schema.names).index(col_name)
+        except ValueError:
+            raise SqlError(f"watermark column {col_name!r} not found")
+        # supported shape: col - INTERVAL 'x'
+        if (isinstance(expr, A.BinaryOp) and expr.op == "-"
+                and isinstance(expr.left, A.ColumnRef)
+                and expr.left.name == col_name
+                and isinstance(expr.right, A.Lit)):
+            return (idx, int(expr.right.value))
+        raise SqlError("watermark must be '<col> - INTERVAL ...'")
+
+    def _create_table(self, stmt: A.CreateTable) -> list:
+        if stmt.if_not_exists and stmt.name in self.catalog.tables:
+            return []
+        fields = tuple(Field(c.name, type_from_name(c.type_name))
+                       for c in stmt.columns)
+        schema = Schema(fields)
+        names = list(schema.names)
+        if stmt.pk:
+            pk = tuple(names.index(c) for c in stmt.pk)
+        else:
+            # hidden _row_id pk (reference: tables without pk get one)
+            from ..common.types import SERIAL
+            schema = Schema(fields + (Field("_row_id", SERIAL),))
+            pk = (len(fields),)
+        t = TableDef(stmt.name, schema, pk,
+                     table_id=self.catalog.next_table_id(),
+                     append_only=stmt.append_only)
+        self.catalog.add_table(t)
+        # the table IS a stream job: DML queue -> (row id gen) -> materialize
+        q = QueueSource(Schema(fields))
+        src: Executor = q
+        if not stmt.pk:
+            src = _RowIdAppendSource(q, schema)
+            src = RowIdGenExecutor(src, row_id_index=len(fields),
+                                   shard_id=self._alloc_shard())
+        mat = MaterializeExecutor(
+            src, StateTable(self.store, t.table_id, schema, list(pk)))
+        job = StreamJob(stmt.name, mat, [q])
+        self.jobs[stmt.name] = job
+        self.table_dml.setdefault(stmt.name, [])
+        self._table_queues.setdefault(stmt.name, []).append(q)
+        job.start(self.loop)
+        q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def _create_mv(self, stmt: A.CreateMaterializedView) -> list:
+        if stmt.if_not_exists and stmt.name in self.catalog.mvs:
+            return []
+        plan = Planner(self.catalog).plan_select(stmt.query)
+        queues: list[QueueSource] = []
+        init_msgs: list[tuple[QueueSource, list[Message]]] = []
+
+        def factory(leaf) -> Executor:
+            ex, q, init = self._stream_leaf(leaf)
+            if q is not None:
+                queues.append(q)
+                init_msgs.append((q, init))
+            return ex
+
+        ctx = BuildContext(self.store, self.catalog.next_table_id, factory,
+                           self.config, durable=True)
+        pipeline = build_plan(plan, ctx)
+        mv_table_id = self.catalog.next_table_id()
+        mat = MaterializeExecutor(
+            pipeline,
+            StateTable(self.store, mv_table_id, plan.schema, list(plan.pk)))
+        n_visible = sum(1 for f in plan.schema if not f.name.startswith("_"))
+        mv = MaterializedViewDef(
+            stmt.name, plan.schema, tuple(plan.pk), table_id=mv_table_id,
+            definition="")
+        mv.n_visible = n_visible  # type: ignore[attr-defined]
+        self.catalog.add_mv(mv)
+        job = StreamJob(stmt.name, mat, queues)
+        self.jobs[stmt.name] = job
+        job.start(self.loop)
+        # init cut: every root replays up to the current epoch's barrier
+        for q, init in init_msgs:
+            for m in init:
+                q.push(m)
+            q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def _stream_leaf(self, leaf):
+        """-> (executor, session_driven_queue_or_None, init_messages)"""
+        if isinstance(leaf, PSource):
+            src_def = leaf.source
+            q = QueueSource(src_def.schema)
+            gen = self._connector_generator(src_def)
+            self.feeds.append(_SourceFeed(q, gen))
+            ex: Executor = _RowIdAppendSource(q, leaf.schema)
+            ex = RowIdGenExecutor(ex, row_id_index=leaf.row_id_index,
+                                  shard_id=self._alloc_shard())
+            if src_def.watermark is not None:
+                col, delay = src_def.watermark
+                ex = WatermarkFilterExecutor(ex, time_col=col, delay=delay)
+            return ex, q, []
+        if isinstance(leaf, (PTableScan, PMvScan)):
+            name = leaf.table.name if isinstance(leaf, PTableScan) else leaf.mv.name
+            up_job = self.jobs[name]
+            q = QueueSource(leaf.schema)
+            up_job.bus.subscribe(q)
+            snapshot = up_job.snapshot_messages(
+                Barrier.new(self.epoch), self.source_chunk_capacity)
+            # session does NOT drive this queue; upstream bus does. The
+            # snapshot + init barrier are pushed at creation.
+            return q, q, snapshot
+        if isinstance(leaf, PValues):
+            q = QueueSource(leaf.schema)
+            chunk = _values_chunk(leaf)
+            return q, q, [chunk]
+        raise PlanError(f"cannot stream {type(leaf).__name__}")
+
+    def _connector_generator(self, src: SourceDef):
+        if src.connector == "nexmark":
+            table = str(src.options.get("nexmark_table",
+                                        src.options.get("table", "bid"))).lower()
+            rate = src.options.get("rows_per_chunk")
+            cap = int(rate) if rate else self.source_chunk_capacity
+            gen = NexmarkGenerator(NexmarkConfig(chunk_capacity=cap),
+                                   seed=self.seed)
+            fn = {"bid": gen.next_bid_chunk,
+                  "auction": gen.next_auction_chunk,
+                  "person": gen.next_person_chunk}[table]
+            return lambda: fn()
+        if src.connector in ("", "datagen"):
+            return lambda: None
+        raise SqlError(f"unsupported connector {src.connector!r}")
+
+    def _drop(self, stmt: A.DropStatement) -> list:
+        existed = self.catalog.drop(stmt.kind, stmt.name, stmt.if_exists)
+        if existed and stmt.name in self.jobs:
+            job = self.jobs.pop(stmt.name)
+            self._await(job.stop())
+        return []
+
+    # ----------------------------------------------------------------- DML --
+
+    def _insert(self, stmt: A.Insert) -> list:
+        t = self.catalog.tables.get(stmt.table)
+        if t is None:
+            raise SqlError(f"table {stmt.table!r} not found")
+        binder = ExprBinder(Scope([]))
+        data_fields = [f for f in t.schema if f.name != "_row_id"]
+        names = [f.name for f in data_fields]
+        cols = list(stmt.columns) or names
+        rows = []
+        for vrow in stmt.rows:
+            if len(vrow) != len(cols):
+                raise SqlError("INSERT arity mismatch")
+            by_name = {}
+            for cname, vexpr in zip(cols, vrow):
+                lit = binder.bind(vexpr)
+                from ..expr.expr import Literal
+                if not isinstance(lit, Literal):
+                    raise SqlError("INSERT values must be literals")
+                by_name[cname] = lit.value
+            rows.append(tuple(by_name.get(n) for n in names))
+        chunk = make_chunk(Schema(tuple(data_fields)), rows,
+                           capacity=max(len(rows), 1))
+        self.table_dml[stmt.table].append(chunk)
+        return []
+
+    # --------------------------------------------------------------- epochs --
+
+    def tick(self, generate: bool = True, checkpoint: Optional[bool] = None) -> int:
+        """One barrier cycle: feed sources, inject barrier, await all jobs,
+        commit on checkpoint. Returns the completed epoch."""
+        epoch = self.epoch + 1
+        if checkpoint is None:
+            checkpoint = epoch % self.checkpoint_frequency == 0
+        barrier = Barrier.new(epoch, checkpoint=checkpoint)
+        if generate:
+            for feed in self.feeds:
+                for _ in range(self.chunks_per_tick):
+                    chunk = feed.generator()
+                    if chunk is not None:
+                        feed.queue.push(chunk)
+        for name, chunks in self.table_dml.items():
+            for q in self._table_queues.get(name, []):
+                for c in chunks:
+                    q.push(c)
+            chunks.clear()
+        for feed in self.feeds:
+            feed.queue.push(barrier)
+        for queues in self._table_queues.values():
+            for q in queues:
+                q.push(barrier)
+        self._await(self._collect_barrier(epoch))
+        if checkpoint:
+            self.store.commit(epoch)
+        self.epoch = epoch
+        return epoch
+
+    async def _collect_barrier(self, epoch: int) -> None:
+        # gather must be created inside the session loop (it binds futures
+        # to the running loop)
+        await asyncio.gather(
+            *(job.wait_barrier(epoch) for job in self.jobs.values()))
+
+    def flush(self) -> None:
+        """FLUSH: complete a checkpoint epoch (DML + state made durable)."""
+        self.tick(generate=False, checkpoint=True)
+
+    # ---------------------------------------------------------------- query --
+
+    def query(self, sel: A.Select) -> list:
+        """Batch SELECT: run the stream plan over snapshot sources."""
+        plan = Planner(self.catalog).plan_select(sel)
+
+        def factory(leaf) -> Executor:
+            if isinstance(leaf, (PTableScan, PMvScan)):
+                if isinstance(leaf, PTableScan):
+                    tid, schema = leaf.table.table_id, leaf.table.schema
+                else:
+                    tid, schema = leaf.mv.table_id, leaf.mv.schema
+                table = StateTable(self.store, tid, schema, [])
+                rows = list(table.scan_all())
+                msgs: list[Message] = [Barrier.new(1)]
+                from ..common.chunk import physical_chunk
+                cap = self.source_chunk_capacity
+                for i in range(0, len(rows), cap):
+                    msgs.append(physical_chunk(schema, rows[i:i + cap], cap))
+                msgs.append(Barrier.new(2))
+                return MockSource(schema, msgs)
+            if isinstance(leaf, PValues):
+                chunk = _values_chunk(leaf)
+                return MockSource(leaf.schema,
+                                  [Barrier.new(1), chunk, Barrier.new(2)])
+            raise SqlError(
+                "batch SELECT over an unbounded source is not supported; "
+                "create a materialized view instead")
+
+        ctx = BuildContext(self.store, self.catalog.next_table_id, factory,
+                           self.config, durable=False)
+        pipeline = build_plan(plan, ctx)
+        rows = self._await(self._run_batch(pipeline))
+        # fold the change stream into final rows
+        acc: dict = {}
+        for op, row in rows:
+            if op in (OP_INSERT, OP_UPDATE_INSERT):
+                acc[row] = acc.get(row, 0) + 1
+            else:
+                acc[row] = acc.get(row, 0) - 1
+                if acc[row] == 0:
+                    del acc[row]
+        out = []
+        for row, n in acc.items():
+            out.extend([row] * n)
+        out = self._present(out, sel, plan)
+        return out
+
+    async def _run_batch(self, pipeline: Executor) -> list:
+        rows = []
+        async for msg in pipeline.execute():
+            if isinstance(msg, StreamChunk):
+                rows.extend(chunk_to_rows(msg, pipeline.schema, with_ops=True))
+        return rows
+
+    def _present(self, rows: list, sel: A.Select, plan) -> list:
+        """Presentation: ORDER BY sort, then strip hidden columns."""
+        schema = plan.schema
+        if sel.order_by:
+            scope = Scope.of_schema(schema)
+            keys = []
+            for oi in sel.order_by:
+                b = ExprBinder(scope).bind(oi.expr)
+                from ..expr.expr import InputRef
+                if isinstance(b, InputRef):
+                    keys.append((b.index, oi.desc))
+            for idx, desc in reversed(keys):
+                rows = sorted(
+                    rows,
+                    key=lambda r: (r[idx] is None, r[idx] if r[idx] is not None else 0),
+                    reverse=desc)
+        visible = [i for i, f in enumerate(schema) if not f.name.startswith("_")]
+        if len(visible) != len(schema):
+            rows = [tuple(r[i] for i in visible) for r in rows]
+        return rows
+
+    # -------------------------------------------------------------- helpers --
+
+    def mv_rows(self, name: str) -> list:
+        """Current contents of an MV (visible columns, decoded)."""
+        mv = self.catalog.mvs.get(name)
+        if mv is None:
+            raise SqlError(f"materialized view {name!r} not found")
+        job = self.jobs[name]
+        rows = []
+        n_vis = getattr(mv, "n_visible", len(mv.schema))
+        for phys in job.table.scan_all():
+            rows.append(tuple(
+                None if v is None else mv.schema[i].type.to_python(v)
+                for i, v in enumerate(phys[:n_vis])))
+        return rows
+
+    def _alloc_shard(self) -> int:
+        self._next_shard += 1
+        return self._next_shard - 1
+
+    def _await(self, coro):
+        if self.loop.is_running():
+            raise RuntimeError("Session API is synchronous; do not call from "
+                               "inside the event loop")
+        return self.loop.run_until_complete(coro)

@@ -1,0 +1,217 @@
+"""SQL frontend tests: parser, planner shapes, and end-to-end SQL → MV
+(reference: src/sqlparser/test_runner + src/frontend/planner_test golden
+style, and e2e_test/streaming/ sqllogictest style, scaled down)."""
+
+import pytest
+
+from risingwave_tpu.frontend import Session, parse_sql
+from risingwave_tpu.frontend import sqlast as A
+from risingwave_tpu.frontend.parser import parse_one
+from risingwave_tpu.frontend.planner import (
+    PAgg, PDynFilter, PFilter, PHopWindow, PJoin, PProject, PSource, PTopN,
+    Planner,
+)
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def test_parse_select_shapes():
+    q = parse_one("""
+        SELECT auction, count(*) AS n, sum(price)
+        FROM bid
+        WHERE price > 100 AND channel = 'Google'
+        GROUP BY auction HAVING count(*) > 2
+        ORDER BY n DESC LIMIT 10
+    """)
+    sel = q.select
+    assert len(sel.items) == 3
+    assert sel.items[1].alias == "n"
+    assert isinstance(sel.where, A.BinaryOp) and sel.where.op == "AND"
+    assert len(sel.group_by) == 1 and sel.having is not None
+    assert sel.order_by[0].desc and sel.limit == 10
+
+
+def test_parse_create_source_and_mv():
+    stmts = parse_sql("""
+        CREATE SOURCE s (a BIGINT, t TIMESTAMP,
+            WATERMARK FOR t AS t - INTERVAL '5 seconds')
+        WITH (connector = 'nexmark', nexmark_table = 'bid');
+        CREATE MATERIALIZED VIEW v AS SELECT a FROM s;
+    """)
+    src, mv = stmts
+    assert isinstance(src, A.CreateSource)
+    assert src.watermark is not None and src.watermark[0] == "t"
+    assert isinstance(mv, A.CreateMaterializedView) and mv.name == "v"
+
+
+def test_parse_interval_and_tvf():
+    q = parse_one("""
+        SELECT window_start FROM TUMBLE(bid, date_time, INTERVAL '10 seconds')
+    """)
+    tvf = q.select.from_
+    assert isinstance(tvf, A.WindowTVF) and tvf.kind == "tumble"
+    assert tvf.args[0].value == 10_000_000
+
+
+def test_parse_join_and_subquery():
+    q = parse_one("""
+        SELECT a.x FROM a JOIN b ON a.k = b.k
+        WHERE a.x > (SELECT max(y) FROM c)
+    """)
+    assert isinstance(q.select.from_, A.Join)
+    conj = q.select.where
+    assert isinstance(conj.right, A.ScalarSubquery)
+
+
+def test_parse_case_in_between():
+    q = parse_one("""
+        SELECT CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END
+        FROM t WHERE a IN (1, 2, 3) AND b BETWEEN 5 AND 10
+               AND c IS NOT NULL
+    """)
+    assert isinstance(q.select.items[0].expr, A.Case)
+
+
+# ---------------------------------------------------------------------------
+# planner (golden-ish shape tests)
+# ---------------------------------------------------------------------------
+
+
+NEXMARK_DDL = """
+CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,
+  channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)
+WITH (connector = 'nexmark', nexmark_table = 'bid')
+"""
+
+
+def _planner():
+    s = Session()
+    s.run_sql(NEXMARK_DDL)
+    return s, Planner(s.catalog)
+
+
+def test_plan_q1_projection():
+    s, planner = _planner()
+    plan = planner.plan_select(parse_one(
+        "SELECT auction, price * 100 AS p FROM bid").select)
+    assert isinstance(plan, PProject)
+    assert isinstance(plan.input, PSource)
+    # hidden _row_id pk appended
+    assert plan.schema.names[-1].startswith("_pk")
+    assert plan.pk == (2,)
+
+
+def test_plan_agg_shape():
+    s, planner = _planner()
+    plan = planner.plan_select(parse_one(
+        "SELECT auction, count(*) FROM bid GROUP BY auction").select)
+    assert isinstance(plan, PProject)
+    agg = plan.input
+    assert isinstance(agg, PAgg) and agg.group_keys == (0,)
+    assert agg.agg_calls[0].kind == "count"
+    assert plan.pk == (0,)   # group key is the stream key, already visible
+
+
+def test_plan_topn_and_dynfilter():
+    s, planner = _planner()
+    plan = planner.plan_select(parse_one(
+        "SELECT auction, price FROM bid ORDER BY price DESC LIMIT 3").select)
+    assert isinstance(plan, PTopN) and plan.limit == 3
+    plan2 = planner.plan_select(parse_one(
+        "SELECT auction FROM bid WHERE price > (SELECT max(price) FROM bid)"
+    ).select)
+    assert isinstance(plan2, PProject)
+    assert isinstance(plan2.input, PDynFilter)
+
+
+def test_plan_hop_window():
+    s, planner = _planner()
+    plan = planner.plan_select(parse_one("""
+        SELECT auction, window_start
+        FROM HOP(bid, date_time, INTERVAL '2 seconds', INTERVAL '10 seconds')
+    """).select)
+    assert isinstance(plan, PProject)
+    assert isinstance(plan.input, PHopWindow)
+    assert plan.input.slide == 2_000_000 and plan.input.size == 10_000_000
+
+
+# ---------------------------------------------------------------------------
+# end-to-end SQL
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_source_mv_agg_and_select():
+    s = Session(source_chunk_capacity=64)
+    s.run_sql(NEXMARK_DDL)
+    s.run_sql("""CREATE MATERIALIZED VIEW counts AS
+        SELECT auction % 4 AS b, count(*) AS n, max(price) AS top
+        FROM bid GROUP BY auction % 4""")
+    s.run_sql("""CREATE MATERIALIZED VIEW q1 AS
+        SELECT auction, price * 100 AS cents FROM bid""")
+    for _ in range(3):
+        s.tick()
+    q1 = s.mv_rows("q1")
+    counts = s.mv_rows("counts")
+    assert len(q1) == 3 * 64
+    assert sum(r[1] for r in counts) == len(q1)
+    res = s.run_sql("SELECT b, n FROM counts ORDER BY n DESC LIMIT 2")
+    assert len(res) == 2 and res[0][1] >= res[1][1]
+
+
+def test_e2e_table_insert_join():
+    s = Session(source_chunk_capacity=32)
+    s.run_sql("CREATE TABLE person (id BIGINT PRIMARY KEY, name VARCHAR)")
+    s.run_sql("CREATE TABLE orders (oid BIGINT PRIMARY KEY, pid BIGINT, amt BIGINT)")
+    s.run_sql("INSERT INTO person VALUES (1, 'alice'), (2, 'bob')")
+    s.run_sql("INSERT INTO orders VALUES (10, 1, 100), (11, 1, 50), (12, 3, 9)")
+    s.run_sql("""CREATE MATERIALIZED VIEW by_person AS
+        SELECT p.name, sum(o.amt) AS total
+        FROM orders o JOIN person p ON o.pid = p.id
+        GROUP BY p.name""")
+    s.tick()
+    assert sorted(s.mv_rows("by_person")) == [("alice", 150)]
+    # late-arriving person 3 joins retroactively
+    s.run_sql("INSERT INTO person VALUES (3, 'carol')")
+    s.tick()
+    assert sorted(s.mv_rows("by_person")) == [("alice", 150), ("carol", 9)]
+
+
+def test_e2e_mv_on_mv_and_drop():
+    s = Session(source_chunk_capacity=32)
+    s.run_sql(NEXMARK_DDL)
+    s.run_sql("CREATE MATERIALIZED VIEW base AS SELECT auction, price FROM bid")
+    s.tick()
+    s.run_sql("""CREATE MATERIALIZED VIEW derived AS
+        SELECT auction, count(*) AS n FROM base GROUP BY auction""")
+    s.tick()
+    base = s.mv_rows("base")
+    derived = s.mv_rows("derived")
+    assert sum(r[1] for r in derived) == len(base)
+    s.run_sql("DROP MATERIALIZED VIEW derived")
+    assert "derived" not in s.catalog.mvs
+    s.tick()   # remaining jobs still run
+
+
+def test_e2e_values_and_union():
+    s = Session()
+    s.run_sql("CREATE TABLE a (x BIGINT PRIMARY KEY)")
+    s.run_sql("CREATE TABLE b (x BIGINT PRIMARY KEY)")
+    s.run_sql("INSERT INTO a VALUES (1), (2)")
+    s.run_sql("INSERT INTO b VALUES (3)")
+    s.flush()
+    res = s.run_sql("SELECT x FROM a UNION ALL SELECT x FROM b ORDER BY x")
+    assert res == [(1,), (2,), (3,)]
+    res = s.run_sql("SELECT 1 + 1 AS two")
+    assert res == [(2,)]
+
+
+def test_e2e_distinct_and_where():
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.run_sql("INSERT INTO t VALUES (1, 5), (2, 5), (3, 7), (4, 8)")
+    s.flush()
+    res = s.run_sql("SELECT DISTINCT v FROM t WHERE v < 8 ORDER BY v")
+    assert res == [(5,), (7,)]
