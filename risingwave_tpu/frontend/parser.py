@@ -318,6 +318,9 @@ class Parser:
         from_ = None
         if self.eat_kw("from"):
             from_ = self._relation()
+            while self.eat_op(","):
+                right = self._relation()
+                from_ = A.Join("inner", from_, right, None)
         where = self.parse_expr() if self.eat_kw("where") else None
         group_by = []
         if self.eat_kw("group"):
@@ -545,14 +548,18 @@ class Parser:
         if self.at_kw("interval"):
             self.next()
             amount_tok = self.next()
+            unit = None
             if amount_tok.kind == "str":
-                # INTERVAL '5 seconds' / '1 hour'
+                # INTERVAL '5 seconds' / INTERVAL '5' SECOND
                 parts = amount_tok.value.split()
                 amount = float(parts[0])
-                unit = parts[1].lower() if len(parts) > 1 else "second"
+                if len(parts) > 1:
+                    unit = parts[1].lower()
             else:
                 amount = amount_tok.value
-                unit = self.ident()
+            if unit is None and self.peek().kind == "name":
+                unit = self.next().value
+            unit = unit or "second"
             us = _INTERVAL_UNITS.get(unit)
             if us is None:
                 raise SqlParseError(f"unsupported interval unit {unit!r}")

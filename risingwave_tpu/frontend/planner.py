@@ -251,22 +251,25 @@ class Planner:
         if sel.from_ is None:
             return self._plan_no_from(sel)
 
-        node, scope = self._plan_relation(sel.from_)
-
-        # WHERE: split conjuncts into plain filters and dynamic-filter rewrites
-        dyn_conjuncts = []
+        # WHERE: split conjuncts into dynamic-filter rewrites and plain ones;
+        # plain equality conjuncts may be consumed as join keys by keyless
+        # (comma-syntax) joins during relation planning — the reference's
+        # predicate-pushdown-into-join rule
+        dyn_conjuncts: list = []
+        plain: list = []
         if sel.where is not None:
-            subqueries: list = []
-            plain = []
             for conj in _conjuncts(sel.where):
                 if self._has_subquery(conj):
                     dyn_conjuncts.append(conj)
                 else:
                     plain.append(conj)
-            for conj in plain:
-                pred = ExprBinder(scope).bind(conj)
-                node = PFilter(schema=node.schema, pk=node.pk, input=node,
-                               predicate=pred)
+
+        node, scope = self._plan_relation(sel.from_, plain)
+
+        for conj in plain:
+            pred = ExprBinder(scope).bind(conj)
+            node = PFilter(schema=node.schema, pk=node.pk, input=node,
+                           predicate=pred)
 
         # dynamic filters apply pre-projection (reference: the subquery
         # Apply-rewrite places DynamicFilter below the projection)
@@ -303,7 +306,7 @@ class Planner:
 
     # -- FROM -----------------------------------------------------------------
 
-    def _plan_relation(self, rel: A.Relation):
+    def _plan_relation(self, rel: A.Relation, pending_conjuncts=None):
         if isinstance(rel, A.TableRef):
             return self._plan_table_ref(rel)
         if isinstance(rel, A.WindowTVF):
@@ -312,7 +315,7 @@ class Planner:
             node = self.plan_select(rel.query)
             return node, Scope.of_schema(node.schema, rel.alias)
         if isinstance(rel, A.Join):
-            return self._plan_join(rel)
+            return self._plan_join(rel, pending_conjuncts)
         raise PlanError(f"unsupported relation {type(rel).__name__}")
 
     def _plan_table_ref(self, ref: A.TableRef):
@@ -383,9 +386,9 @@ class Planner:
             ])
         return node, new_scope
 
-    def _plan_join(self, j: A.Join):
-        left, lscope = self._plan_relation(j.left)
-        right, rscope = self._plan_relation(j.right)
+    def _plan_join(self, j: A.Join, pending_conjuncts=None):
+        left, lscope = self._plan_relation(j.left, pending_conjuncts)
+        right, rscope = self._plan_relation(j.right, pending_conjuncts)
         n_left = len(left.schema)
         scope = lscope.concat(rscope, n_left)
 
@@ -399,6 +402,15 @@ class Planner:
                     rkeys.append(pair[1])
                 else:
                     residual.append(conj)
+        if not lkeys and j.kind == "inner" and pending_conjuncts:
+            # comma-syntax join: pull equality conjuncts out of WHERE
+            # (consumed conjuncts no longer filter above the join)
+            for conj in list(pending_conjuncts):
+                pair = self._equi_pair(conj, scope, n_left)
+                if pair is not None:
+                    lkeys.append(pair[0])
+                    rkeys.append(pair[1])
+                    pending_conjuncts.remove(conj)
         if not lkeys:
             raise PlanError("join requires at least one equality condition "
                             "(nested-loop streaming join unsupported)")

@@ -93,7 +93,8 @@ class JoinSideState:
     tomb: jax.Array                     # bool[cap, W] — deleted since last ckpt
     degree: jax.Array                   # int32[cap, W] — opposite-side matches
     ckpt_dirty: jax.Array               # bool[cap, W] — changed since last ckpt
-    overflow: jax.Array                 # bool scalar, sticky
+    ht_overflow: jax.Array              # bool scalar, sticky: key table full
+    lane_overflow: jax.Array            # bool scalar, sticky: bucket width full
     inconsistent: jax.Array             # bool scalar, sticky
 
 
@@ -153,7 +154,8 @@ class JoinCore:
             tomb=jnp.zeros((cap, W), jnp.bool_),
             degree=jnp.zeros((cap, W), jnp.int32),
             ckpt_dirty=jnp.zeros((cap, W), jnp.bool_),
-            overflow=jnp.zeros((), jnp.bool_),
+            ht_overflow=jnp.zeros((), jnp.bool_),
+            lane_overflow=jnp.zeros((), jnp.bool_),
             inconsistent=jnp.zeros((), jnp.bool_),
         )
 
@@ -301,8 +303,9 @@ class JoinCore:
                         .reshape(cap, W),
                 ckpt_dirty=A.ckpt_dirty.reshape(-1).at[f].set(True, mode="drop")
                             .reshape(cap, W),
-                overflow=A.overflow | ht_ovf | jnp.any(a_ok & ~lane_ok)
-                         | jnp.any(sel & (a_slot >= cap)),
+                ht_overflow=A.ht_overflow | ht_ovf
+                            | jnp.any(sel & (a_slot >= cap)),
+                lane_overflow=A.lane_overflow | jnp.any(a_ok & ~lane_ok),
             )
         else:
             a_slot, a_found = ht_lookup(A.ht, a_key_cols, sel)
@@ -438,3 +441,81 @@ class JoinCore:
         else:
             cols = b_col_list
         return ops, vis, tuple(cols)
+
+
+def side_any_overflow(st: JoinSideState) -> bool:
+    return bool(st.ht_overflow) | bool(st.lane_overflow)
+
+
+def import_side(core: "JoinCore", old: JoinSideState, schema: Schema,
+                key_idx: Sequence[int]) -> JoinSideState:
+    """Re-layout one side's state into ``core``'s (bigger) geometry.
+
+    Functional growth: the streaming executor applies a chunk, checks the
+    overflow flags, and on overflow discards the new state, grows, and
+    retries on the UNTOUCHED old state — possible only because the whole
+    join state is an immutable pytree (the TPU-native analogue of the
+    reference growing its hash maps on the heap).
+
+    Width growth pads lanes; capacity growth rehashes keys into the new
+    table and moves whole buckets by the slot remap. Degrees move with the
+    rows (they depend only on the opposite side's content)."""
+    cap, W = core.capacity, core.W
+    old_cap, old_W = old.occupied.shape
+    assert cap >= old_cap and W >= old_W
+
+    def pad(a, fill=False):
+        out = jnp.full((old_cap, W), fill, a.dtype)
+        return out.at[:, :old_W].set(a)
+
+    row_data = tuple(pad(rd, 0) for rd in old.row_data)
+    row_mask = tuple(pad(rm) for rm in old.row_mask)
+    occupied = pad(old.occupied)
+    tomb = pad(old.tomb)
+    degree = pad(old.degree, 0)
+    ckpt_dirty = pad(old.ckpt_dirty)
+
+    key_types = tuple(schema[i].type for i in key_idx)
+    if cap == old_cap:
+        ht = old.ht
+        new = JoinSideState(
+            ht=ht, row_data=row_data, row_mask=row_mask, occupied=occupied,
+            tomb=tomb, degree=degree, ckpt_dirty=ckpt_dirty,
+            ht_overflow=jnp.zeros((), jnp.bool_),
+            lane_overflow=jnp.zeros((), jnp.bool_),
+            inconsistent=old.inconsistent,
+        )
+        return new
+    # rehash keys into the larger table, then move buckets by slot remap
+    ht = ht_new(key_types, cap)
+    key_cols = [
+        Column(kd, km) for kd, km in zip(old.ht.key_data, old.ht.key_mask)
+    ]
+    ht, new_slots, _, ovf = ht_lookup_or_insert(ht, key_cols, old.ht.occupied)
+    if bool(ovf):  # cannot happen: cap > old_cap
+        raise RuntimeError("rehash overflow")
+    dst = jnp.where(old.ht.occupied, new_slots, cap)
+
+    def move(padded, init_fill):
+        out = jnp.full((cap, W), init_fill, padded.dtype)
+        return out.at[dst].set(padded, mode="drop")
+
+    return JoinSideState(
+        ht=ht,
+        row_data=tuple(move(rd, 0) for rd in row_data),
+        row_mask=tuple(move(rm, False) for rm in row_mask),
+        occupied=move(occupied, False),
+        tomb=move(tomb, False),
+        degree=move(degree, 0),
+        ckpt_dirty=move(ckpt_dirty, False),
+        ht_overflow=jnp.zeros((), jnp.bool_),
+        lane_overflow=jnp.zeros((), jnp.bool_),
+        inconsistent=old.inconsistent,
+    )
+
+
+def import_state(core: "JoinCore", old: JoinState) -> JoinState:
+    return JoinState(
+        left=import_side(core, old.left, core.left_schema, core.left_keys),
+        right=import_side(core, old.right, core.right_schema, core.right_keys),
+    )

@@ -29,7 +29,9 @@ from ..common.chunk import (
     DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units, gather_units_window,
     make_chunk,
 )
-from ..ops.join_state import JoinCore, JoinSideState, JoinState, JoinType
+from ..ops.join_state import (
+    JoinCore, JoinSideState, JoinState, JoinType, import_state,
+)
 from ..storage.state_table import StateTable
 from .barrier_align import barrier_align
 from .executor import Executor
@@ -55,6 +57,8 @@ class HashJoinExecutor(Executor):
         strict: bool = True,
     ):
         self.left, self.right = left, right
+        self._join_args = dict(join_type=join_type, condition=condition)
+        self._key_args = (left_keys, right_keys)
         self.core = JoinCore(
             left.schema, right.schema, left_keys, right_keys, join_type,
             condition=condition, key_capacity=key_capacity,
@@ -63,19 +67,54 @@ class HashJoinExecutor(Executor):
         self.schema = self.core.out_schema
         self.out_capacity = out_capacity
         self.strict = strict
+        self.max_state_cells = 1 << 26    # growth ceiling (cap * W)
         self.state_tables = {"left": left_state_table,
                              "right": right_state_table}
         self.state = self.core.init_state()
+        self._make_jits()
+        if any(self.state_tables.values()):
+            self._load_from_state_tables()
+
+    def _make_jits(self) -> None:
         self._apply = {
             "left": jax.jit(functools.partial(self.core.apply_chunk, side="left")),
             "right": jax.jit(functools.partial(self.core.apply_chunk, side="right")),
         }
         self._gather = jax.jit(
-            lambda ch, lo: gather_units_window(ch, lo, out_capacity))
+            lambda ch, lo: gather_units_window(ch, lo, self.out_capacity))
         self._count_units = jax.jit(count_units)
         self._clear_ckpt = jax.jit(_clear_ckpt_marks)
-        if any(self.state_tables.values()):
-            self._load_from_state_tables()
+
+    # -- adaptive growth -------------------------------------------------------
+
+    def _apply_growing(self, side: str, chunk: StreamChunk):
+        """Apply a chunk; on overflow discard the result, grow the state
+        geometry (bucket width for hot-key skew, key capacity for table
+        fill), and retry on the untouched previous state. Functional state
+        makes the retry exact — no partial effects to undo."""
+        while True:
+            new_state, big = self._apply[side](self.state, chunk)
+            sides = {"left": new_state.left, "right": new_state.right}
+            lane_ovf = any(bool(st.lane_overflow) for st in sides.values())
+            ht_ovf = any(bool(st.ht_overflow) for st in sides.values())
+            if not lane_ovf and not ht_ovf:
+                self.state = new_state
+                return big
+            new_W = self.core.W * 2 if lane_ovf else self.core.W
+            new_cap = self.core.capacity * 2 if ht_ovf else self.core.capacity
+            if new_W * new_cap > self.max_state_cells:
+                raise RuntimeError(
+                    f"{self.identity}: join state would exceed "
+                    f"{self.max_state_cells} cells (cap={new_cap}, W={new_W})")
+            self._grow(new_cap, new_W)
+
+    def _grow(self, new_cap: int, new_W: int) -> None:
+        left_keys, right_keys = self._key_args
+        self.core = JoinCore(
+            self.left.schema, self.right.schema, left_keys, right_keys,
+            key_capacity=new_cap, bucket_width=new_W, **self._join_args)
+        self.state = import_state(self.core, self.state)
+        self._make_jits()
 
     # -- host loop -------------------------------------------------------------
 
@@ -84,7 +123,7 @@ class HashJoinExecutor(Executor):
             kind = ev[0]
             if kind == "chunk":
                 _, side, chunk = ev
-                self.state, big = self._apply[side](self.state, chunk)
+                big = self._apply_growing(side, chunk)
                 n_units = int(self._count_units(big))
                 for lo in range(0, n_units, self.out_capacity // 2):
                     yield self._gather(big, jnp.int64(lo))
@@ -113,10 +152,10 @@ class HashJoinExecutor(Executor):
     def _check_flags(self) -> None:
         for side in ("left", "right"):
             st: JoinSideState = getattr(self.state, side)
-            if bool(st.overflow):
+            if bool(st.ht_overflow) or bool(st.lane_overflow):
                 raise RuntimeError(
-                    f"{self.identity}: {side} join state overflow "
-                    f"(key_capacity={self.core.capacity}, "
+                    f"{self.identity}: {side} join state overflow escaped "
+                    f"growth (key_capacity={self.core.capacity}, "
                     f"bucket_width={self.core.W})")
             if self.strict and bool(st.inconsistent):
                 raise RuntimeError(
@@ -170,7 +209,7 @@ class HashJoinExecutor(Executor):
             bs = 1024
             for i in range(0, len(rows), bs):
                 chunk = physical_chunk(schema, rows[i: i + bs], bs)
-                self.state, _ = self._apply[side](self.state, chunk)
+                self._apply_growing(side, chunk)
         self.state = self._clear_ckpt(self.state)
 
 
