@@ -1,0 +1,39 @@
+"""Multi-chip dry-run entry: the one function the driver's
+``__graft_entry__.dryrun_multichip`` subprocess executes.
+
+Asserts the virtual CPU mesh is actually present (the round-1 failure was
+silently initializing the single real chip), then jits and runs ONE real
+step of every sharded operator the framework ships — currently the
+vnode-shuffled grouped agg (q5 core) and, once present, the sharded hash
+join (q7 core) — on tiny shapes, with host cross-checks.
+"""
+
+from __future__ import annotations
+
+
+def run_dryrun(n_devices: int) -> None:
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"dryrun needs {n_devices} devices, found {len(devs)} "
+            f"({devs[0].platform if devs else 'none'}); JAX_PLATFORMS=cpu + "
+            f"--xla_force_host_platform_device_count must be set before jax "
+            f"import")
+    if devs[0].platform != "cpu":
+        raise RuntimeError(
+            f"dryrun must run on the virtual CPU mesh, got platform "
+            f"{devs[0].platform!r} — refusing to grab real hardware")
+
+    from .sharded_agg import build_sharded_q5_step
+    build_sharded_q5_step(n_devices)
+
+    try:
+        from .sharded_join import build_sharded_q7_step
+    except ImportError:
+        build_sharded_q7_step = None
+    if build_sharded_q7_step is not None:
+        build_sharded_q7_step(n_devices)
+
+    print(f"dryrun_multichip({n_devices}): all sharded steps OK")

@@ -19,3 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_pytest_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+# The agent image's sitecustomize registers the real-TPU 'axon' PJRT plugin
+# at interpreter startup (before this conftest runs), importing jax with
+# jax_platforms pinned from the then-current env.  The env mutations above
+# are therefore too late for THIS process — force the config directly.
+# Backends are not yet initialized at conftest time, so this takes effect.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
