@@ -46,6 +46,10 @@ class BuildConfig:
     join_key_capacity: int = 1 << 13
     join_bucket_width: int = 16
     topn_table_capacity: int = 1 << 16
+    # Data parallelism: a jax.sharding.Mesh routes grouped aggs and joins
+    # through the mesh-sharded executors (parallel/executors.py); None keeps
+    # every operator single-chip. Capacities above are per shard when set.
+    mesh: Optional[object] = None
 
 
 class BuildContext:
@@ -103,6 +107,13 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             st = ctx.state_table(
                 agg_state_schema(key_fields, plan.agg_calls),
                 list(range(len(plan.group_keys))))
+            if cfg.mesh is not None:
+                from ..parallel.executors import ShardedHashAggExecutor
+                return ShardedHashAggExecutor(
+                    inp, cfg.mesh, list(plan.group_keys),
+                    list(plan.agg_calls), state_table=st,
+                    table_capacity=cfg.agg_table_capacity,
+                    out_capacity=cfg.chunk_capacity)
             return HashAggExecutor(
                 inp, list(plan.group_keys), list(plan.agg_calls),
                 state_table=st, table_capacity=cfg.agg_table_capacity,
@@ -122,6 +133,16 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         right = build_plan(plan.right, ctx)
         lst = ctx.state_table(plan.left.schema, list(plan.left.pk))
         rst = ctx.state_table(plan.right.schema, list(plan.right.pk))
+        if cfg.mesh is not None:
+            from ..parallel.executors import ShardedHashJoinExecutor
+            return ShardedHashJoinExecutor(
+                left, right, cfg.mesh, list(plan.left_keys),
+                list(plan.right_keys), join_type=_JOIN_TYPES[plan.kind],
+                condition=plan.condition,
+                left_state_table=lst, right_state_table=rst,
+                key_capacity=cfg.join_key_capacity,
+                bucket_width=cfg.join_bucket_width,
+                out_capacity=cfg.chunk_capacity)
         return HashJoinExecutor(
             left, right, list(plan.left_keys), list(plan.right_keys),
             join_type=_JOIN_TYPES[plan.kind], condition=plan.condition,

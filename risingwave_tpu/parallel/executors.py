@@ -1,0 +1,356 @@
+"""Sharded stream executors — the frontend-facing wrappers that run the
+multi-chip cores inside the ordinary executor protocol.
+
+This is the TPU-native replacement for the reference's parallel actor
+fan-out: where the reference builds P parallel HashAgg/HashJoin actors
+connected by hash dispatchers and merge executors over gRPC exchanges
+(reference: src/stream/src/executor/dispatch.rs:532 hash dispatch,
+src/stream/src/executor/merge.rs:36 fan-in, docs/consistent-hash.md), here a
+SINGLE executor owns mesh-sharded device state and every chunk step is one
+XLA program whose internal ``lax.all_to_all`` does the routing over ICI —
+the exchange layer has no host-visible existence at all.
+
+An input chunk of capacity C is split into n local chunks of capacity C/n
+(leading [n] axis sharded over the mesh); the vnode shuffle inside the step
+re-routes rows to their owner shard, so the host-side split is free-form.
+Emission gathers per-shard output windows back to the driving device —
+correctness-first for now; a sharded MaterializeExecutor keeps egress
+device-resident later.
+
+Durability mirrors the single-chip executors: dirty deltas flush to host
+StateTables on checkpoint barriers; recovery re-routes committed rows by
+replaying them through the sharded step (join) or per-shard direct loads
+(agg).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    Column, DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units,
+    gather_units_window, pad_chunk, physical_chunk,
+)
+from ..common.types import Field, Schema
+from ..expr.agg import AggCall
+from ..ops.hash_table import ht_lookup_or_insert
+from ..ops.join_state import JoinType
+from ..storage.state_table import StateTable
+from ..stream.barrier_align import barrier_align
+from ..stream.executor import Executor, SingleInputExecutor
+from ..stream.hash_join import _clear_ckpt_marks
+from ..stream.message import Barrier
+from .sharded_agg import ShardedHashAgg
+from .sharded_join import ShardedHashJoin
+
+
+def split_chunk(chunk: StreamChunk, n: int, sharding) -> StreamChunk:
+    """Pad to a multiple of n and reshape into n local chunks (leading [n]
+    axis placed on the mesh); the in-step vnode shuffle re-routes rows, so
+    this split is free-form."""
+    chunk = pad_chunk(chunk, -(-chunk.capacity // n) * n)
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape((n, -1) + x.shape[1:]), chunk)
+    return jax.device_put(
+        stacked, jax.tree_util.tree_map(lambda _: sharding, stacked))
+
+
+class ShardedHashAggExecutor(SingleInputExecutor):
+    """Data-parallel grouped aggregation over a device mesh, behind the
+    single-chip HashAggExecutor's exact protocol surface."""
+
+    identity = "ShardedHashAgg"
+
+    def __init__(
+        self,
+        input: Executor,
+        mesh,
+        group_keys: Sequence[int],
+        agg_calls: Sequence[AggCall],
+        state_table: Optional[StateTable] = None,
+        table_capacity: int = 1 << 14,
+        out_capacity: int = DEFAULT_CHUNK_CAPACITY,
+    ):
+        super().__init__(input)
+        in_schema = input.schema
+        key_types = tuple(in_schema[i].type for i in group_keys)
+        self.agg = ShardedHashAgg(mesh, key_types, list(group_keys),
+                                  list(agg_calls), table_capacity, out_capacity)
+        self.schema = Schema(
+            tuple(in_schema[i] for i in group_keys)
+            + tuple(Field(f"agg{i}", c.output_type)
+                    for i, c in enumerate(agg_calls))
+        )
+        self.state_table = state_table
+        self.n = self.agg.n
+        core = self.agg.core
+        self._gather = jax.jit(
+            jax.vmap(core.gather_flush_chunk, in_axes=(0, 0, None)))
+        self._rank = jax.jit(jax.vmap(core.flush_rank))
+        self._finish = jax.jit(jax.vmap(core.finish_flush))
+        if self.state_table is not None:
+            self._load_from_state_table()
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self.agg.step(split_chunk(chunk, self.n, self.agg._sharding))
+        if False:
+            yield
+
+    async def on_barrier(self, barrier: Barrier):
+        st = self.agg.state
+        rank = self._rank(st)
+        counts, overflow = jax.device_get((rank[:, -1], st.overflow))
+        if bool(np.any(overflow)):
+            raise RuntimeError(
+                f"{self.identity}: group table overflow (per-shard capacity "
+                f"{self.agg.core.capacity}); increase table_capacity")
+        G = self.agg.core.groups_per_chunk
+        lo = 0
+        while lo < int(counts.max(initial=0)):
+            batch = self._gather(self.agg.state, rank, jnp.int64(lo))
+            for s in range(self.n):
+                if counts[s] > lo:
+                    yield jax.tree_util.tree_map(lambda x: x[s], batch)
+            lo += G
+        if barrier.checkpoint and self.state_table is not None:
+            self._checkpoint_to_state_table(barrier.epoch.curr)
+        self.agg.state = self._finish(self.agg.state)
+
+    # -- persistence ----------------------------------------------------------
+
+    def _checkpoint_to_state_table(self, epoch: int) -> None:
+        st = jax.device_get(self.agg.state)
+        wrote = False
+        for s in range(self.n):
+            idx = np.nonzero(np.asarray(st.ckpt_dirty[s]))[0]
+            if not len(idx):
+                continue
+            wrote = True
+            keys_d = [np.asarray(kd[s])[idx] for kd in st.table.key_data]
+            keys_m = [np.asarray(km[s])[idx] for km in st.table.key_mask]
+            lanes = [np.asarray(l[s])[idx] for l in st.lanes]
+            for r in range(len(idx)):
+                key_vals = [
+                    keys_d[c][r].item() if keys_m[c][r] else None
+                    for c in range(len(keys_d))
+                ]
+                lane_vals = [lanes[j][r].item() for j in range(len(lanes))]
+                row = tuple(key_vals) + tuple(lane_vals)
+                if lanes[0][r] > 0:
+                    self.state_table.insert(row)
+                else:
+                    self.state_table.delete(row)
+        if wrote:
+            self.state_table.commit(epoch)
+        self.agg.state = self.agg.state.replace(
+            ckpt_dirty=jnp.zeros_like(self.agg.state.ckpt_dirty))
+
+    def _load_from_state_table(self) -> None:
+        """Recovery: route committed groups to their owner shard (same vnode
+        map the shuffle uses) and load keys + lanes directly."""
+        from ..common.hashing import vnode_of, vnode_to_shard
+
+        rows = list(self.state_table.scan_all())
+        if not rows:
+            return
+        core = self.agg.core
+        nk = len(core.group_keys)
+        key_cols = []
+        for c in range(nk):
+            vals = [r[c] for r in rows]
+            mask = np.array([v is not None for v in vals])
+            data = np.array([v if v is not None else 0 for v in vals],
+                            dtype=core.key_types[c].np_dtype)
+            key_cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+        shard = np.asarray(vnode_to_shard(vnode_of(key_cols), self.n))
+
+        st_host = jax.device_get(self.agg.state)
+        shards = []
+        for s in range(self.n):
+            local = jax.tree_util.tree_map(lambda x: jnp.asarray(x[s]), st_host)
+            sel = np.nonzero(shard == s)[0]
+            bs = 1024
+            for i in range(0, len(sel), bs):
+                batch_idx = sel[i:i + bs]
+                n = len(batch_idx)
+                valid = jnp.arange(bs) < n
+                kcols = []
+                for c in range(nk):
+                    vals = [rows[j][c] for j in batch_idx]
+                    mask = np.array([v is not None for v in vals]
+                                    + [False] * (bs - n))
+                    data = np.array(
+                        [v if v is not None else 0 for v in vals] + [0] * (bs - n),
+                        dtype=core.key_types[c].np_dtype)
+                    kcols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+                table, slots, _, ovf = ht_lookup_or_insert(
+                    local.table, kcols, valid)
+                if bool(ovf):
+                    raise RuntimeError(
+                        "sharded agg table overflow during recovery load")
+                lanes = list(local.lanes)
+                for j in range(len(lanes)):
+                    vals = np.array(
+                        [rows[r][nk + j] for r in batch_idx] + [0] * (bs - n),
+                        dtype=np.dtype(core.lane_dtypes[j]))
+                    lanes[j] = lanes[j].at[slots].set(
+                        jnp.asarray(vals), mode="drop")
+                local = local.replace(table=table, lanes=tuple(lanes))
+            local = local.replace(prev_lanes=local.lanes)
+            shards.append(local)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+        self.agg.state = jax.device_put(
+            stacked,
+            jax.tree_util.tree_map(lambda _: self.agg._sharding, stacked))
+
+
+class ShardedHashJoinExecutor(Executor):
+    """Data-parallel streaming hash join over a device mesh, behind the
+    single-chip HashJoinExecutor's exact protocol surface."""
+
+    identity = "ShardedHashJoin"
+
+    def __init__(
+        self,
+        left: Executor,
+        right: Executor,
+        mesh,
+        left_keys: Sequence[int],
+        right_keys: Sequence[int],
+        join_type: JoinType = JoinType.INNER,
+        condition=None,
+        left_state_table: Optional[StateTable] = None,
+        right_state_table: Optional[StateTable] = None,
+        key_capacity: int = 1 << 10,
+        bucket_width: int = 8,
+        out_capacity: int = DEFAULT_CHUNK_CAPACITY,
+    ):
+        self.left, self.right = left, right
+        self.join = ShardedHashJoin(
+            mesh, left.schema, right.schema, left_keys, right_keys,
+            join_type, condition=condition, key_capacity=key_capacity,
+            bucket_width=bucket_width)
+        self.schema = self.join.out_schema
+        self.out_capacity = out_capacity
+        self.n = self.join.n
+        self.state_tables = {"left": left_state_table,
+                             "right": right_state_table}
+        self._count = jax.jit(jax.vmap(count_units))
+        cap = out_capacity
+        self._gather = jax.jit(jax.vmap(
+            lambda ch, lo: gather_units_window(ch, lo, cap),
+            in_axes=(0, None)))
+        self._clear_ckpt = jax.jit(jax.vmap(_clear_ckpt_marks))
+        if any(self.state_tables.values()):
+            self._load_from_state_tables()
+
+    async def execute(self):
+        async for ev in barrier_align(self.left, self.right):
+            kind = ev[0]
+            if kind == "chunk":
+                _, side, chunk = ev
+                big = self.join.step(
+                    side, split_chunk(chunk, self.n, self.join._sharding))
+                counts = jax.device_get(self._count(big))
+                G = self.out_capacity // 2
+                lo = 0
+                while lo < int(counts.max(initial=0)):
+                    batch = self._gather(big, jnp.int64(lo))
+                    for s in range(self.n):
+                        if counts[s] > lo:
+                            yield jax.tree_util.tree_map(lambda x: x[s], batch)
+                    lo += G
+            elif kind == "barrier":
+                barrier = ev[1]
+                self._check_flags()
+                if barrier.checkpoint:
+                    self._checkpoint(barrier.epoch.curr)
+                yield barrier
+                if barrier.is_stop():
+                    return
+            elif kind == "watermark":
+                _, side, wm = ev
+                out_idx = self._map_watermark_col(side, wm.col_idx)
+                if out_idx is not None:
+                    yield wm.__class__(out_idx, wm.value)
+
+    def _map_watermark_col(self, side: str, col_idx: int) -> Optional[int]:
+        sa = self.join.core.join_type.semi_anti_side
+        if sa is not None:
+            return col_idx if sa == side else None
+        return (col_idx if side == "left"
+                else col_idx + len(self.join.core.left_schema))
+
+    def _check_flags(self) -> None:
+        st = jax.device_get(self.join.state)
+        for side in ("left", "right"):
+            s = getattr(st, side)
+            if bool(np.any(s.inconsistent)):
+                raise RuntimeError(
+                    f"{self.identity}: {side} saw delete of an absent row")
+
+    # -- persistence ----------------------------------------------------------
+
+    def _checkpoint(self, epoch: int) -> None:
+        st = jax.device_get(self.join.state)
+        for side in ("left", "right"):
+            table = self.state_tables[side]
+            if table is None:
+                continue
+            side_st = getattr(st, side)
+            # deletes strictly before inserts ACROSS ALL SHARDS: a same-pk
+            # row whose join key moved to a lower-numbered shard within one
+            # checkpoint window would otherwise have its old-shard delete
+            # clobber the new-shard upsert (StateTable.delete is pk-keyed)
+            deletes, inserts = [], []
+            for sh in range(self.n):
+                dirty = np.asarray(side_st.ckpt_dirty[sh])
+                slots, lanes = np.nonzero(dirty)
+                if not len(slots):
+                    continue
+                occ = np.asarray(side_st.occupied[sh])
+                tomb = np.asarray(side_st.tomb[sh])
+                datas = [np.asarray(d[sh]) for d in side_st.row_data]
+                masks = [np.asarray(m[sh]) for m in side_st.row_mask]
+
+                def row_at(s, l):
+                    return tuple(
+                        datas[c][s, l].item() if masks[c][s, l] else None
+                        for c in range(len(datas)))
+
+                for s, l in zip(slots, lanes):
+                    if tomb[s, l] and not occ[s, l]:
+                        deletes.append(row_at(s, l))
+                    elif occ[s, l]:
+                        inserts.append(row_at(s, l))
+            for row in deletes:
+                table.delete(row)
+            for row in inserts:
+                table.insert(row)
+            table.commit(epoch)
+        self.join.state = self._clear_ckpt(self.join.state)
+
+    def _load_from_state_tables(self) -> None:
+        """Recovery: replay both sides' committed rows through the sharded
+        insert step (the all_to_all re-routes them); outputs discarded."""
+        for side in ("left", "right"):
+            table = self.state_tables[side]
+            if table is None:
+                continue
+            schema = (self.join.core.left_schema if side == "left"
+                      else self.join.core.right_schema)
+            rows = list(table.scan_all())
+            bs = 256
+            stride = self.n * bs
+            for i in range(0, len(rows), stride):
+                group = rows[i:i + stride]
+                chunks = [
+                    physical_chunk(schema, group[j * bs:(j + 1) * bs], bs)
+                    for j in range(self.n)
+                ]
+                self.join.step(side, self.join.batch_chunks(chunks))
+        self.join.state = self._clear_ckpt(self.join.state)

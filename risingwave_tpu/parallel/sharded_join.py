@@ -1,0 +1,233 @@
+"""Sharded (multi-chip) streaming hash join: vnode shuffle of BOTH inputs +
+per-shard JoinCore.
+
+TPU-native counterpart of the reference's parallel HashJoin actors fed by two
+hash dispatchers (reference: hash dispatch src/stream/src/executor/dispatch.rs:532,
+vnode partitioning docs/consistent-hash.md, join executor
+src/stream/src/executor/hash_join.rs:227-270): instead of
+serialize→gRPC→deserialize on every exchange edge, each side's chunk is
+shuffled to its owner shard with one ``lax.all_to_all`` over ICI *inside the
+jitted step*, fused with the join probe/update itself.
+
+Both sides shuffle by their join-key columns, so matching rows co-locate on
+the same shard and each shard runs the UNCHANGED pure ``JoinCore`` step
+(ops/join_state.py) on its slice — the whole multi-chip join is the
+single-chip program under ``shard_map``.
+
+Layout mirrors parallel/sharded_agg.py: every state array carries a leading
+[n_shards] axis sharded over the mesh (``P('shard')``); a step consumes one
+local chunk per shard and returns the per-shard emission grid (compact with
+``gather_units_window`` per shard before sending downstream).
+
+Hot-key skew (NEXmark's 90% hot-auction bids) overflows fixed bucket widths;
+like the single-chip executor, a step that trips an overflow flag is
+discarded and retried on the UNTOUCHED previous state after growing the
+geometry — functional state makes the retry exact even under shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.chunk import StreamChunk, chunk_to_rows
+from ..common.types import Schema
+from ..ops.join_state import JoinCore, JoinType, import_state
+from .sharded_agg import SHARD_AXIS, make_mesh, shuffle_chunk_local
+
+
+class ShardedHashJoin:
+    """Data-parallel streaming hash join over a device mesh.
+
+    One ``step(side, chunk_batch)`` shuffles + joins one local chunk per
+    shard in a single XLA program; outputs keep the sharded leading axis."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        left_schema: Schema,
+        right_schema: Schema,
+        left_keys: Sequence[int],
+        right_keys: Sequence[int],
+        join_type: JoinType = JoinType.INNER,
+        condition=None,
+        key_capacity: int = 1 << 10,
+        bucket_width: int = 8,
+        max_state_cells: int = 1 << 24,
+    ):
+        self.mesh = mesh
+        self.n = mesh.devices.size
+        self._schemas = (left_schema, right_schema)
+        self._keys = (tuple(left_keys), tuple(right_keys))
+        self._join_args = dict(join_type=join_type, condition=condition)
+        self.max_state_cells = max_state_cells
+        self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._build(key_capacity, bucket_width, state=None)
+
+    def _build(self, key_capacity: int, bucket_width: int, state) -> None:
+        ls, rs = self._schemas
+        lk, rk = self._keys
+        self.core = JoinCore(
+            ls, rs, lk, rk, key_capacity=key_capacity,
+            bucket_width=bucket_width, **self._join_args,
+        )
+        self.out_schema = self.core.out_schema
+        if state is None:
+            state = jax.vmap(lambda _: self.core.init_state())(
+                jnp.arange(self.n))
+        self.state = jax.device_put(
+            state, jax.tree_util.tree_map(lambda _: self._sharding, state))
+
+        core, n, mesh = self.core, self.n, self.mesh
+
+        def make_step(side: str):
+            side_keys = lk if side == "left" else rk
+
+            def local_step(state, chunk: StreamChunk):
+                state = jax.tree_util.tree_map(lambda x: x[0], state)
+                chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
+                owned = shuffle_chunk_local(chunk, n, side_keys)
+                state, big = core.apply_chunk(state, owned, side=side)
+                state = jax.tree_util.tree_map(lambda x: x[None], state)
+                big = jax.tree_util.tree_map(lambda x: x[None], big)
+                return state, big
+
+            return jax.jit(
+                jax.shard_map(
+                    local_step, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    check_vma=False,
+                )
+            )
+
+        self._step = {"left": make_step("left"), "right": make_step("right")}
+
+    # -- stepping with functional growth-on-overflow --------------------------
+
+    def step(self, side: str, chunk_batch: StreamChunk) -> StreamChunk:
+        """``chunk_batch``: arrays with a leading [n_shards] axis (one local
+        chunk per shard). Returns the per-shard emission grids (leading
+        [n_shards] axis, mostly-invisible rows). Grows state geometry and
+        retries on overflow (single-chip analogue:
+        stream/hash_join.py:_apply_growing)."""
+        while True:
+            new_state, big = self._step[side](self.state, chunk_batch)
+            flags = jax.device_get((
+                new_state.left.lane_overflow, new_state.left.ht_overflow,
+                new_state.right.lane_overflow, new_state.right.ht_overflow,
+            ))
+            lane_ovf = bool(np.any(flags[0]) | np.any(flags[2]))
+            ht_ovf = bool(np.any(flags[1]) | np.any(flags[3]))
+            if not lane_ovf and not ht_ovf:
+                self.state = new_state
+                return big
+            new_W = self.core.W * 2 if lane_ovf else self.core.W
+            new_cap = self.core.capacity * 2 if ht_ovf else self.core.capacity
+            if new_W * new_cap > self.max_state_cells:
+                raise RuntimeError(
+                    f"ShardedHashJoin: per-shard state would exceed "
+                    f"{self.max_state_cells} cells (cap={new_cap}, W={new_W})")
+            self._grow(new_cap, new_W)
+
+    def _grow(self, new_cap: int, new_W: int) -> None:
+        """Re-layout every shard's state into the larger geometry on host
+        (rare event; import_state's rehash path is not vmappable because it
+        branches on a concrete overflow flag)."""
+        old = jax.device_get(self.state)
+        ls, rs = self._schemas
+        lk, rk = self._keys
+        new_core = JoinCore(
+            ls, rs, lk, rk, key_capacity=new_cap, bucket_width=new_W,
+            **self._join_args)
+        shards = [
+            import_state(new_core,
+                         jax.tree_util.tree_map(lambda x: jnp.asarray(x[s]), old))
+            for s in range(self.n)
+        ]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+        self._build(new_cap, new_W, state=stacked)
+
+    # -- host-side helpers ----------------------------------------------------
+
+    def batch_chunks(self, chunks: Sequence[StreamChunk]) -> StreamChunk:
+        """Stack n single-shard chunks into one sharded batch."""
+        assert len(chunks) == self.n
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *chunks)
+        return jax.device_put(
+            stacked, jax.tree_util.tree_map(lambda _: self._sharding, stacked))
+
+    def collect_rows(self, big: StreamChunk) -> list:
+        """Gather one step's output to host: [(op, row), ...] across shards.
+
+        Test/debug surface — production egress compacts per shard with
+        gather_units_window and keeps flowing on device."""
+        host = jax.device_get(big)
+        out = []
+        for s in range(self.n):
+            shard = jax.tree_util.tree_map(lambda x: x[s], host)
+            out.extend(chunk_to_rows(shard, self.out_schema, with_ops=True,
+                                     physical=True))
+        return out
+
+
+def build_sharded_q7_step(n_devices: int) -> None:
+    """Driver dry-run: full sharded NEXmark q7/q8-shaped windowed join step
+    over an n-device mesh — both sides vnode-shuffled by join key, per-shard
+    JoinCore probe/update with a non-equi window condition — one real step
+    executed on tiny shapes, cross-checked against a host join."""
+    from ..common.chunk import Column
+    from ..connector import NexmarkConfig, NexmarkGenerator
+    from ..connector.nexmark import AUCTION_SCHEMA, BID_SCHEMA
+    from ..expr import call, col
+
+    mesh = make_mesh(n_devices)
+    gen = NexmarkGenerator(NexmarkConfig(chunk_capacity=64))
+
+    # bid ⋈ auction ON bid.auction = auction.id AND bid.date_time <= auction.expires
+    n_l = len(BID_SCHEMA)
+    cond = call("less_than_or_equal",
+                col(5, BID_SCHEMA[5].type),                 # bid.date_time
+                col(n_l + 6, AUCTION_SCHEMA[6].type))       # auction.expires
+    join = ShardedHashJoin(
+        mesh, BID_SCHEMA, AUCTION_SCHEMA, [0], [0], JoinType.INNER,
+        condition=cond, key_capacity=1 << 9, bucket_width=16,
+    )
+
+    def spread(bid_chunk: StreamChunk) -> StreamChunk:
+        # NEXmark's 90%-hot-auction skew would force a giant bucket width on
+        # tiny dryrun shapes; spread bid keys uniformly over the live auction
+        # id range instead (the host cross-check uses the same spread rows,
+        # so the check stays exact)
+        a = bid_chunk.columns[0]
+        rowpos = jnp.arange(a.data.shape[0], dtype=a.data.dtype)
+        spread_ids = 1000 + (a.data + rowpos) % 64
+        cols = (Column(spread_ids.astype(a.data.dtype), a.mask),
+                ) + bid_chunk.columns[1:]
+        return bid_chunk.with_columns(cols)
+
+    auctions = [gen.next_auction_chunk() for _ in range(n_devices)]
+    bids = [spread(gen.next_bid_chunk()) for _ in range(n_devices)]
+    out_a = join.step("right", join.batch_chunks(auctions))
+    out_b = join.step("left", join.batch_chunks(bids))
+    jax.block_until_ready(out_b.ops)
+    got = sorted(join.collect_rows(out_a) + join.collect_rows(out_b))
+
+    # host-model inner join over the same rows
+    a_rows = [r for c in auctions
+              for r in chunk_to_rows(c, AUCTION_SCHEMA, physical=True)]
+    b_rows = [r for c in bids
+              for r in chunk_to_rows(c, BID_SCHEMA, physical=True)]
+    expected = sorted(
+        (0, br + ar)
+        for br in b_rows for ar in a_rows
+        if br[0] == ar[0] and br[5] <= ar[6]
+    )
+    assert got == expected, (
+        f"sharded join mismatch: {len(got)} rows vs host {len(expected)}")
+    print(f"dryrun_multichip({n_devices}): q7-core sharded join OK, "
+          f"{len(got)} joined rows")
