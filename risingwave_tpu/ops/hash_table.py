@@ -91,7 +91,6 @@ def ht_lookup_or_insert(
     masks = [c.mask for c in key_cols]
     n = valid.shape[0]
     h = (hash_columns(key_cols) & jnp.uint64(cap - 1)).astype(jnp.int32)
-    row_ids = jnp.arange(n, dtype=jnp.int32)
 
     def cond(state):
         _, _, _, done, _, _, it = state
@@ -105,13 +104,20 @@ def ht_lookup_or_insert(
         occ = occupied[cand]
         eq = occ & _keys_equal_at(t, cand, datas, masks)
         newly_found = ~done & eq
-        # claim attempt on empty slots
+        # claim attempt on empty slots: winner = min row_id among rows
+        # targeting the same empty slot, resolved by sorting (slot, row_id)
+        # pairs on the CHUNK — O(n log n) on n rows, never O(capacity).
+        # (A capacity-sized scatter-min claims array would memset the whole
+        # table every probe round — at multi-million-slot capacities that
+        # dominates the entire step.)
         want = ~done & ~occ
-        claim_idx = jnp.where(want, cand, cap)
-        claims = jnp.full(cap, n, jnp.int32).at[claim_idx].min(
-            jnp.where(want, row_ids, n), mode="drop"
-        )
-        winner = want & (claims[cand] == row_ids)
+        cand_eff = jnp.where(want, cand, cap)
+        order = jnp.argsort(cand_eff, stable=True)  # stable ⇒ min row_id first
+        sorted_slot = cand_eff[order]
+        first = jnp.concatenate([
+            jnp.ones(1, jnp.bool_), sorted_slot[1:] != sorted_slot[:-1]])
+        winner_sorted = first & (sorted_slot < cap)
+        winner = jnp.zeros(n, jnp.bool_).at[order].set(winner_sorted)
         widx = jnp.where(winner, cand, cap)
         occupied = occupied.at[widx].set(True, mode="drop")
         key_data = tuple(

@@ -15,7 +15,7 @@ from __future__ import annotations
 import asyncio
 from typing import AsyncIterator, Hashable, Mapping
 
-from ..common.chunk import StreamChunk
+from ..common.chunk import ChunkBatch, StreamChunk
 from .executor import Executor
 from .message import Barrier, Watermark
 
@@ -56,6 +56,11 @@ async def align_streams(inputs: Mapping[Hashable, Executor]) -> AsyncIterator[tu
                     held_barrier[s] = msg
                 elif isinstance(msg, StreamChunk):
                     yield ("chunk", s, msg)
+                elif isinstance(msg, ChunkBatch):
+                    # multi-input executors have no batched step yet; unstack
+                    # so batches from upstream are never silently dropped
+                    for i in range(msg.num_chunks):
+                        yield ("chunk", s, msg.at(i))
                 elif isinstance(msg, Watermark):
                     yield ("watermark", s, msg)
             live = [s for s in names if s not in finished]

@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import AsyncIterator, Optional, Sequence
 
 from ..common.chunk import (
-    OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk, chunk_to_rows,
+    ChunkBatch, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk, chunk_to_rows,
 )
 from ..common.types import Schema
 from .message import Barrier, Message, Watermark
@@ -47,6 +47,14 @@ class SingleInputExecutor(Executor):
     async def map_chunk(self, chunk: StreamChunk):
         yield chunk
 
+    async def map_chunk_batch(self, batch: ChunkBatch):
+        """Batched ingest. Default: unstack and run per-chunk (correct for
+        every executor); override with a scanned/vmapped single-dispatch step
+        where throughput matters."""
+        for i in range(batch.num_chunks):
+            async for out in self.map_chunk(batch.at(i)):
+                yield out
+
     async def on_barrier(self, barrier: Barrier):
         if False:  # pragma: no cover - async generator shape
             yield
@@ -58,6 +66,9 @@ class SingleInputExecutor(Executor):
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
                 async for out in self.map_chunk(msg):
+                    yield out
+            elif isinstance(msg, ChunkBatch):
+                async for out in self.map_chunk_batch(msg):
                     yield out
             elif isinstance(msg, Barrier):
                 async for out in self.on_barrier(msg):

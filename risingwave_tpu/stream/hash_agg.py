@@ -70,9 +70,35 @@ class HashAggExecutor(SingleInputExecutor):
         )
         self.state_table = state_table
         self.state = self.core.init_state()
-        self._apply = jax.jit(self.core.apply_chunk)
+        # Donating the state pytree lets XLA update the group table in place
+        # (no copy of the [capacity]-sized lanes per chunk). CPU sometimes
+        # cannot honor donation and warns; keep it for the TPU hot path only.
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        self._apply = jax.jit(self.core.apply_chunk, donate_argnums=donate)
+
+        def _apply_batch(state, batched_chunk):
+            def body(st, ch):
+                return self.core.apply_chunk(st, ch), None
+            state, _ = jax.lax.scan(body, state, batched_chunk)
+            return state
+
+        # One dispatch applies a whole ChunkBatch: the epoch loop stays on
+        # device (lax.scan), amortizing host->device dispatch latency.
+        self._apply_batch = jax.jit(_apply_batch, donate_argnums=donate)
         self._gather = jax.jit(self.core.gather_flush_chunk)
         self._finish = jax.jit(self.core.finish_flush)
+
+        # barrier probe: ONE packed scalar fetch per barrier (every host sync
+        # over a tunneled chip costs a full RTT — ~100ms on axon; the old
+        # separate overflow + n_dirty + per-chunk cardinality syncs made the
+        # barrier path ~5 RTTs). The dirty-rank prefix sums stay on device and
+        # are shared by all flush windows of the barrier.
+        def _probe(st):
+            rank = self.core.flush_rank(st)
+            packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32)])
+            return packed, rank
+
+        self._probe = jax.jit(_probe)
         if self.state_table is not None:
             self._load_from_state_table()
 
@@ -92,17 +118,24 @@ class HashAggExecutor(SingleInputExecutor):
         if False:
             yield
 
+    async def map_chunk_batch(self, batch):
+        self.state = self._apply_batch(self.state, batch.chunk)
+        if False:
+            yield
+
     async def on_barrier(self, barrier: Barrier):
-        if bool(self.state.overflow):
+        packed, rank = self._probe(self.state)
+        n_dirty, overflow = (int(x) for x in jax.device_get(packed))
+        if overflow:
             raise RuntimeError(
                 f"{self.identity}: group table overflow (capacity "
                 f"{self.core.capacity}); increase table_capacity")
-        n_dirty = int(jnp.sum(self.state.dirty))
         lo = 0
         while lo < n_dirty:
-            chunk = self._gather(self.state, jnp.int64(lo))
-            if int(chunk.cardinality()) > 0:
-                yield chunk
+            # no cardinality gating: a rare all-invisible flush chunk (groups
+            # born and killed within one epoch) is a downstream no-op, while
+            # gating costs one RTT sync per chunk
+            yield self._gather(self.state, rank, jnp.int64(lo))
             lo += self.core.groups_per_chunk
         if barrier.checkpoint and self.state_table is not None:
             self._checkpoint_to_state_table(barrier.epoch.curr)

@@ -33,15 +33,19 @@ class ProjectExecutor(SingleInputExecutor):
         names = tuple(names) or tuple(f"expr{i}" for i in range(len(exprs)))
         self.schema = Schema(tuple(Field(n, e.type) for n, e in zip(names, self.exprs)))
 
-        @jax.jit
         def _step(chunk: StreamChunk) -> StreamChunk:
             cols = tuple(e.eval(chunk) for e in self.exprs)
             return chunk.with_columns(cols)
 
-        self._step = _step
+        self._step = jax.jit(_step)
+        self._step_batch = jax.jit(jax.vmap(_step))
 
     async def map_chunk(self, chunk: StreamChunk):
         yield self._step(chunk)
+
+    async def map_chunk_batch(self, batch):
+        from ..common.chunk import ChunkBatch
+        yield ChunkBatch(self._step_batch(batch.chunk))
 
 
 class FilterExecutor(SingleInputExecutor):
@@ -52,7 +56,6 @@ class FilterExecutor(SingleInputExecutor):
         self.schema = input.schema
         self.predicate = predicate
 
-        @jax.jit
         def _step(chunk: StreamChunk) -> StreamChunk:
             cond = predicate.eval(chunk)
             keep = cond.data & cond.mask  # NULL -> filtered out (SQL WHERE)
@@ -70,7 +73,12 @@ class FilterExecutor(SingleInputExecutor):
             ).astype(ops.dtype)
             return chunk.replace(ops=new_ops, vis=chunk.vis & keep)
 
-        self._step = _step
+        self._step = jax.jit(_step)
+        self._step_batch = jax.jit(jax.vmap(_step))
 
     async def map_chunk(self, chunk: StreamChunk):
         yield self._step(chunk)
+
+    async def map_chunk_batch(self, batch):
+        from ..common.chunk import ChunkBatch
+        yield ChunkBatch(self._step_batch(batch.chunk))

@@ -26,6 +26,11 @@ class MockSource(Executor):
         self.schema = schema
         self._messages = list(messages)
 
+    def reset(self, messages: Iterable[Message]) -> None:
+        """Replay surface: swap in a fresh message script so a built (and
+        jit-warmed) pipeline can be driven again (bench / recovery tests)."""
+        self._messages = list(messages)
+
     async def execute(self) -> AsyncIterator[Message]:
         for m in self._messages:
             yield m
