@@ -154,3 +154,59 @@ class AggCore:
             for cur, prev in zip(state.lanes, state.prev_lanes)
         )
         return state.replace(prev_lanes=prev, dirty=jnp.zeros_like(state.dirty))
+
+    # -- watermark-driven state cleaning --------------------------------------
+    # (reference: state cleaning via state-table watermarks,
+    #  src/stream/src/common/table/state_table.rs:885 update_watermark;
+    #  hash_agg group-key watermark handling)
+
+    def clean_below(self, state: AggState, key_pos: int,
+                    threshold) -> AggState:
+        """Mark groups whose ``key_pos``-th group-key value < threshold as
+        dead: lanes reset to init (row_count 0) and ckpt_dirty set so the
+        next checkpoint writes durable deletes. The hash table is NOT
+        touched here — freeing open-addressing slots in place would break
+        probe chains; ``compact`` rebuilds it after the checkpoint."""
+        kd = state.table.key_data[key_pos]
+        km = state.table.key_mask[key_pos]
+        dead = state.table.occupied & km & (kd < threshold)
+        init = self.init_state()
+        lanes = tuple(
+            jnp.where(dead, il, l) for l, il in zip(state.lanes, init.lanes))
+        return state.replace(
+            lanes=lanes,
+            ckpt_dirty=state.ckpt_dirty | dead,
+            # no `dirty` mark: cleaning frees state, it does not retract
+            # already-emitted results downstream
+        )
+
+    def compact(self, state: AggState) -> AggState:
+        """Rebuild the hash table keeping only live groups (row_count > 0),
+        remapping every lane array. Run AFTER the checkpoint that persisted
+        the deletes (the delete path still needs the dead groups' keys)."""
+        cap = self.capacity
+        live = state.table.occupied & (state.lanes[0] > 0)
+        key_cols = [
+            Column(kd, km)
+            for kd, km in zip(state.table.key_data, state.table.key_mask)
+        ]
+        ht, slots, _, rebuild_ovf = ht_lookup_or_insert(
+            ht_new(self.key_types, cap), key_cols, live)
+        dst = jnp.where(live, slots, cap)
+        init = self.init_state()
+
+        def move(arr, init_arr):
+            return init_arr.at[dst].set(arr, mode="drop")
+
+        return AggState(
+            table=ht,
+            lanes=tuple(move(l, il)
+                        for l, il in zip(state.lanes, init.lanes)),
+            prev_lanes=tuple(move(l, il)
+                             for l, il in zip(state.prev_lanes, init.lanes)),
+            dirty=move(state.dirty, init.dirty),
+            ckpt_dirty=move(state.ckpt_dirty, init.ckpt_dirty),
+            # a group that exhausts probing during rebuild would be silently
+            # dropped by mode="drop" — surface it like every overflow path
+            overflow=state.overflow | rebuild_ovf,
+        )

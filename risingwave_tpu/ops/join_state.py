@@ -443,6 +443,58 @@ class JoinCore:
         return ops, vis, tuple(cols)
 
 
+def clean_side_below(st: JoinSideState, col_idx: int, threshold) -> JoinSideState:
+    """Watermark-driven state cleaning: free rows whose ``col_idx`` value is
+    below ``threshold`` (reference: interval-join inequality-watermark
+    cleaning in src/stream/src/executor/hash_join.rs). Freed lanes become
+    tombstones + ckpt_dirty so the next checkpoint persists their deletes;
+    ``compact_side`` afterwards reclaims the hash-table slots. Opposite-side
+    degrees are NOT adjusted — the watermark contract is that cleaned rows
+    can never match again."""
+    cleaned = st.occupied & st.row_mask[col_idx] & (st.row_data[col_idx] < threshold)
+    return st.replace(
+        occupied=st.occupied & ~cleaned,
+        tomb=st.tomb | cleaned,
+        ckpt_dirty=st.ckpt_dirty | cleaned,
+    )
+
+
+def compact_side(core: "JoinCore", old: JoinSideState, schema: Schema,
+                 key_idx: Sequence[int]) -> JoinSideState:
+    """Rebuild the side's hash table keeping only keys with live rows,
+    remapping the bucket arrays — open-addressing slots cannot be freed in
+    place (probe chains), so cleaning reclaims space by rebuild. Run AFTER
+    the checkpoint cleared tombstones (their deletes are persisted)."""
+    cap, W = core.capacity, core.W
+    key_types = tuple(schema[i].type for i in key_idx)
+    key_live = old.ht.occupied & jnp.any(old.occupied | old.tomb, axis=1)
+    key_cols = [
+        Column(kd, km) for kd, km in zip(old.ht.key_data, old.ht.key_mask)
+    ]
+    ht, slots, _, rebuild_ovf = ht_lookup_or_insert(
+        ht_new(key_types, cap), key_cols, key_live)
+    dst = jnp.where(key_live, slots, cap)
+
+    def move(arr, fill):
+        out = jnp.full((cap, W), fill, arr.dtype)
+        return out.at[dst].set(arr, mode="drop")
+
+    return JoinSideState(
+        ht=ht,
+        row_data=tuple(move(rd, 0) for rd in old.row_data),
+        row_mask=tuple(move(rm, False) for rm in old.row_mask),
+        occupied=move(old.occupied, False),
+        tomb=move(old.tomb, False),
+        degree=move(old.degree, 0),
+        ckpt_dirty=move(old.ckpt_dirty, False),
+        # a key that exhausts probing during rebuild would silently drop its
+        # whole bucket via mode="drop" — surface it
+        ht_overflow=old.ht_overflow | rebuild_ovf,
+        lane_overflow=old.lane_overflow,
+        inconsistent=old.inconsistent,
+    )
+
+
 def side_any_overflow(st: JoinSideState) -> bool:
     return bool(st.ht_overflow) | bool(st.lane_overflow)
 

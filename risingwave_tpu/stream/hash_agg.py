@@ -27,7 +27,7 @@ loop + persistence.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +99,11 @@ class HashAggExecutor(SingleInputExecutor):
             return packed, rank
 
         self._probe = jax.jit(_probe)
+        self._clean = jax.jit(self.core.clean_below, static_argnums=(1,))
+        self._compact = jax.jit(self.core.compact)
+        # group-key watermark state cleaning (reference: hash_agg group-key
+        # watermarks + state_table.rs:885 update_watermark)
+        self._pending_clean: dict[int, Any] = {}
         if self.state_table is not None:
             self._load_from_state_table()
 
@@ -137,9 +142,31 @@ class HashAggExecutor(SingleInputExecutor):
             # gating costs one RTT sync per chunk
             yield self._gather(self.state, rank, jnp.int64(lo))
             lo += self.core.groups_per_chunk
+        cleaned = False
+        if barrier.checkpoint and self._pending_clean:
+            # mark dead BEFORE the checkpoint so it persists the deletes
+            # (keys must still be readable from the table), compact AFTER
+            for key_pos, threshold in self._pending_clean.items():
+                self.state = self._clean(self.state, key_pos,
+                                         jnp.asarray(threshold))
+            self._pending_clean.clear()
+            cleaned = True
         if barrier.checkpoint and self.state_table is not None:
             self._checkpoint_to_state_table(barrier.epoch.curr)
+        if cleaned:
+            self.state = self._compact(self.state)
         self.state = self._finish(self.state)
+
+    async def on_watermark(self, watermark):
+        """Watermark on a group-key column: remap to the output position and
+        schedule state cleaning below it; other columns' watermarks cannot
+        be propagated through a grouped agg."""
+        if watermark.col_idx in self.core.group_keys:
+            pos = self.core.group_keys.index(watermark.col_idx)
+            prev = self._pending_clean.get(pos)
+            if prev is None or watermark.value > prev:
+                self._pending_clean[pos] = watermark.value
+            yield watermark.__class__(pos, watermark.value)
 
     # -- persistence ----------------------------------------------------------
 

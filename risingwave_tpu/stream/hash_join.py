@@ -30,7 +30,8 @@ from ..common.chunk import (
     make_chunk,
 )
 from ..ops.join_state import (
-    JoinCore, JoinSideState, JoinState, JoinType, import_state,
+    JoinCore, JoinSideState, JoinState, JoinType, clean_side_below,
+    compact_side, import_state,
 )
 from ..storage.state_table import StateTable
 from .barrier_align import barrier_align
@@ -55,10 +56,21 @@ class HashJoinExecutor(Executor):
         bucket_width: int = 16,
         out_capacity: int = DEFAULT_CHUNK_CAPACITY,
         strict: bool = True,
+        interval_clean: Sequence[tuple] = (),
     ):
+        """``interval_clean``: state-cleaning rules for interval/windowed
+        joins — tuples ``(clean_side, clean_col, watch_side, watch_col,
+        lag)``: when a watermark arrives on ``watch_side``'s column
+        ``watch_col``, rows on ``clean_side`` whose ``clean_col`` value is
+        below ``watermark - lag`` are freed at the next checkpoint
+        (reference: interval-join state cleaning, hash_join.rs)."""
         self.left, self.right = left, right
+        from .metrics import ExecutorStats
+        self.stats = ExecutorStats()
         self._join_args = dict(join_type=join_type, condition=condition)
         self._key_args = (left_keys, right_keys)
+        self.interval_clean = tuple(interval_clean)
+        self._pending_clean: dict[tuple[str, int], int] = {}
         self.core = JoinCore(
             left.schema, right.schema, left_keys, right_keys, join_type,
             condition=condition, key_capacity=key_capacity,
@@ -84,6 +96,18 @@ class HashJoinExecutor(Executor):
             lambda ch, lo: gather_units_window(ch, lo, self.out_capacity))
         self._count_units = jax.jit(count_units)
         self._clear_ckpt = jax.jit(_clear_ckpt_marks)
+        self._clean_side = jax.jit(clean_side_below, static_argnums=(1,))
+
+        def _compact(state: JoinState) -> JoinState:
+            return JoinState(
+                left=compact_side(self.core, state.left,
+                                  self.core.left_schema, self.core.left_keys),
+                right=compact_side(self.core, state.right,
+                                   self.core.right_schema,
+                                   self.core.right_keys),
+            )
+
+        self._compact = jax.jit(_compact)
 
     # -- adaptive growth -------------------------------------------------------
 
@@ -119,29 +143,57 @@ class HashJoinExecutor(Executor):
     # -- host loop -------------------------------------------------------------
 
     async def execute(self):
+        from .metrics import barrier_timer
+        stats = self.stats
         async for ev in barrier_align(self.left, self.right):
             kind = ev[0]
             if kind == "chunk":
                 _, side, chunk = ev
+                stats.chunks_in += 1
+                stats.capacity_rows_in += chunk.capacity
                 big = self._apply_growing(side, chunk)
                 n_units = int(self._count_units(big))
                 for lo in range(0, n_units, self.out_capacity // 2):
+                    stats.chunks_out += 1
                     yield self._gather(big, jnp.int64(lo))
             elif kind == "barrier":
                 barrier = ev[1]
-                self._check_flags()
-                if barrier.checkpoint:
-                    self._checkpoint(barrier.epoch.curr)
+                with barrier_timer(stats):
+                    self._check_flags()
+                    if barrier.checkpoint:
+                        cleaned = self._apply_pending_clean()
+                        self._checkpoint(barrier.epoch.curr)
+                        if cleaned:
+                            self.state = self._compact(self.state)
                 yield barrier
                 if barrier.is_stop():
                     return
             elif kind == "watermark":
-                # forward with the column index remapped into the output
-                # schema (state-cleaning hooks land with interval joins)
                 _, side, wm = ev
+                stats.watermarks += 1
+                for cs, cc, ws, wc, lag in self.interval_clean:
+                    if ws == side and wc == wm.col_idx:
+                        key = (cs, cc)
+                        thr = wm.value - lag
+                        if (key not in self._pending_clean
+                                or thr > self._pending_clean[key]):
+                            self._pending_clean[key] = thr
+                # forward with the column index remapped into the output schema
                 out_idx = self._map_watermark_col(side, wm.col_idx)
                 if out_idx is not None:
                     yield wm.__class__(out_idx, wm.value)
+
+    def _apply_pending_clean(self) -> bool:
+        """Free rows below the pending watermark thresholds (mark dead +
+        tombstone; deletes persist via the checkpoint that follows)."""
+        if not self._pending_clean:
+            return False
+        for (side, col), threshold in self._pending_clean.items():
+            st = getattr(self.state, side)
+            st = self._clean_side(st, col, jnp.asarray(threshold))
+            self.state = self.state.replace(**{side: st})
+        self._pending_clean.clear()
+        return True
 
     def _map_watermark_col(self, side: str, col_idx: int) -> Optional[int]:
         sa = self.core.join_type.semi_anti_side

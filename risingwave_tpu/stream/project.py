@@ -20,7 +20,38 @@ from ..common.chunk import (
 )
 from ..common.types import Field, Schema
 from ..expr import Expr
+from ..expr.expr import FunctionCall, InputRef, Literal
 from .executor import Executor, SingleInputExecutor
+from .message import Watermark
+
+# Expressions through which a watermark can be derived: monotone in the
+# watermark column (reference: watermark derivation over exprs,
+# src/frontend/src/optimizer/property/watermark_columns.rs + stream project's
+# watermark derivation). tumble_start is the load-bearing one — it carries
+# source watermarks onto window-start group keys for state cleaning.
+_MONOTONE_FNS = {"tumble_start"}
+
+
+def derive_watermark(expr: Expr, wm: Watermark):
+    """Map an input watermark through one output expression; None if the
+    expression does not preserve the watermark order."""
+    if isinstance(expr, InputRef):
+        return wm.value if expr.index == wm.col_idx else None
+    if (isinstance(expr, FunctionCall) and expr.name in _MONOTONE_FNS
+            and expr.args and isinstance(expr.args[0], InputRef)
+            and expr.args[0].index == wm.col_idx
+            and all(isinstance(a, Literal) for a in expr.args[1:])):
+        # evaluate the monotone fn on the watermark value via a 1-row chunk
+        # (only the watermark column is ever read by the expression)
+        cols = tuple(
+            Column(jnp.full(1, wm.value if i == wm.col_idx else 0, jnp.int64),
+                   jnp.ones(1, jnp.bool_))
+            for i in range(wm.col_idx + 1))
+        one = StreamChunk(jnp.zeros(1, jnp.int8), jnp.ones(1, jnp.bool_), cols)
+        res = expr.eval(one)
+        if bool(res.mask[0]):
+            return res.data[0].item()
+    return None
 
 
 class ProjectExecutor(SingleInputExecutor):
@@ -46,6 +77,12 @@ class ProjectExecutor(SingleInputExecutor):
     async def map_chunk_batch(self, batch):
         from ..common.chunk import ChunkBatch
         yield ChunkBatch(self._step_batch(batch.chunk))
+
+    async def on_watermark(self, watermark: Watermark):
+        for i, e in enumerate(self.exprs):
+            v = derive_watermark(e, watermark)
+            if v is not None:
+                yield Watermark(i, v)
 
 
 class FilterExecutor(SingleInputExecutor):

@@ -1,0 +1,205 @@
+"""Watermark-driven state cleaning (VERDICT r2 item 4): bounded state across
+many windows for hash agg and interval hash join, with checkpoint/compaction
+correctness (no broken probe chains after rebuild)."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import INT64, TIMESTAMP, Schema, chunk_to_rows, make_chunk
+from risingwave_tpu.expr.agg import agg as agg_call, count_star
+from risingwave_tpu.ops.join_state import JoinType
+from risingwave_tpu.storage.state_store import MemoryStateStore
+from risingwave_tpu.storage.state_table import StateTable
+from risingwave_tpu.stream.executor import collect_until_barrier
+from risingwave_tpu.stream.hash_agg import HashAggExecutor, agg_state_schema
+from risingwave_tpu.stream.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.message import Barrier, Watermark
+from risingwave_tpu.stream.source import MockSource
+
+S_WIN = Schema.of(("w", TIMESTAMP), ("k", INT64), ("v", INT64))
+S_TIME = Schema.of(("k", INT64), ("t", TIMESTAMP))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def drain_collect(ex):
+    chunks = []
+    async for m in ex.execute():
+        from risingwave_tpu.common.chunk import StreamChunk
+        if isinstance(m, StreamChunk):
+            chunks.append(m)
+    return chunks
+
+
+def live_agg_groups(ex: HashAggExecutor) -> int:
+    st = ex.state
+    return int(jnp.sum(st.table.occupied & (st.lanes[0] > 0)))
+
+
+def occupied_ht_slots(ex: HashAggExecutor) -> int:
+    return int(jnp.sum(ex.state.table.occupied))
+
+
+def test_agg_state_bounded_across_windows():
+    """Stream 40 windows with a trailing watermark; live groups AND occupied
+    hash-table slots stay bounded near one window's worth, while the emitted
+    results cover every window."""
+    msgs = [Barrier.new(1)]
+    epoch = 1
+    for w in range(40):
+        rows = [(w * 1000, k, 1) for k in range(8)]
+        msgs.append(make_chunk(S_WIN, rows, capacity=16))
+        msgs.append(Watermark(0, w * 1000))  # window w closed
+        epoch += 1
+        msgs.append(Barrier.new(epoch, checkpoint=True))
+    src = MockSource(S_WIN, msgs)
+    ex = HashAggExecutor(src, [0, 1], [count_star()], table_capacity=256,
+                         out_capacity=64)
+    chunks = run(drain_collect(ex))
+    emitted = [r for c in chunks for r in chunk_to_rows(c, ex.schema)]
+    # every (window, k) group was emitted exactly once as an insert
+    assert len({(r[0], r[1]) for r in emitted}) == 40 * 8
+    # state bounded: only the last window's groups survive; table slots
+    # reclaimed by compaction (not 40*8 = 320 > capacity would have overflowed)
+    assert live_agg_groups(ex) <= 8
+    assert occupied_ht_slots(ex) <= 8
+
+
+def test_agg_cleaning_persists_deletes():
+    """Cleaned groups are deleted from the durable state table; recovery
+    reloads only live groups."""
+    store = MemoryStateStore()
+    schema = agg_state_schema([S_WIN[0], S_WIN[1]], [count_star()])
+    table = StateTable(store, 3, schema, [0, 1])
+    msgs = [Barrier.new(1),
+            make_chunk(S_WIN, [(0, 1, 1), (0, 2, 1)], capacity=8),
+            Barrier.new(2, checkpoint=True),
+            make_chunk(S_WIN, [(1000, 1, 1)], capacity=8),
+            Watermark(0, 1000),
+            Barrier.new(3, checkpoint=True)]
+    src = MockSource(S_WIN, msgs)
+    ex = HashAggExecutor(src, [0, 1], [count_star()], state_table=table,
+                         table_capacity=64, out_capacity=16)
+    run(drain_collect(ex))
+    store.commit(3)
+    rows = list(StateTable(store, 3, schema, [0, 1]).scan_all())
+    assert [(r[0], r[1]) for r in rows] == [(1000, 1)]
+
+    # recovery sees only the live group
+    src2 = MockSource(S_WIN, [Barrier.new(4)])
+    ex2 = HashAggExecutor(src2, [0, 1], [count_star()],
+                          state_table=StateTable(store, 3, schema, [0, 1]),
+                          table_capacity=64, out_capacity=16)
+    assert live_agg_groups(ex2) == 1
+
+
+def test_compact_preserves_lookups():
+    """After clean+compact, updates to surviving groups still find them
+    (rebuilt probe chains), and re-inserting a cleaned key starts fresh."""
+    msgs = [Barrier.new(1)]
+    # 50 groups, clean those below 40, then update survivors + revive a dead one
+    msgs.append(make_chunk(S_WIN, [(g, g % 4, 1) for g in range(50)], capacity=64))
+    msgs.append(Watermark(0, 40))
+    msgs.append(Barrier.new(2, checkpoint=True))
+    msgs.append(make_chunk(S_WIN, [(45, 1, 1), (10, 2, 1)], capacity=64))
+    msgs.append(Barrier.new(3))
+    src = MockSource(S_WIN, msgs)
+    ex = HashAggExecutor(src, [0, 1], [count_star()], table_capacity=128,
+                         out_capacity=64)
+    run(drain_collect(ex))
+    st = ex.state
+    occ = np.asarray(st.table.occupied)
+    keys = np.asarray(st.table.key_data[0])
+    counts = np.asarray(st.lanes[0])
+    live = {(int(keys[i])): int(counts[i]) for i in np.nonzero(occ)[0]
+            if counts[i] > 0}
+    assert live[45] == 2      # update found the surviving group
+    assert live[10] == 1      # revived group starts fresh (old count cleaned)
+    assert all(k >= 40 or k == 10 for k in live)
+
+
+def host_interval_join(l_rows, r_rows, width):
+    return sorted(
+        (lr + rr) for lr in l_rows for rr in r_rows
+        if lr[0] == rr[0] and abs(lr[1] - rr[1]) <= width)
+
+
+def test_interval_join_bounded_state():
+    """q7-shaped interval join: both sides cleaned by the opposite side's
+    time watermark; state stays bounded across many windows and outputs
+    match the host model."""
+    WIDTH = 100
+    n_windows = 30
+    left_msgs = [Barrier.new(1)]
+    right_msgs = [Barrier.new(1)]
+    l_rows_all, r_rows_all = [], []
+    epoch = 1
+    for w in range(n_windows):
+        t = w * 1000
+        l_rows = [(k, t + k) for k in range(4)]
+        r_rows = [(k, t + k + 10) for k in range(2)]
+        l_rows_all += l_rows
+        r_rows_all += r_rows
+        left_msgs.append(make_chunk(S_TIME, l_rows, capacity=8))
+        right_msgs.append(make_chunk(S_TIME, r_rows, capacity=8))
+        left_msgs.append(Watermark(1, t))
+        right_msgs.append(Watermark(1, t))
+        epoch += 1
+        left_msgs.append(Barrier.new(epoch, checkpoint=True))
+        right_msgs.append(Barrier.new(epoch, checkpoint=True))
+    left = MockSource(S_TIME, left_msgs)
+    right = MockSource(S_TIME, right_msgs)
+    # real interval condition: |l.t - r.t| <= WIDTH over the combined schema
+    from risingwave_tpu.expr import Literal, call, col
+    lt_ = col(1, TIMESTAMP)
+    rt_ = col(3, TIMESTAMP)
+    w_ = Literal(WIDTH, INT64)
+    cond = call("and",
+                call("less_than_or_equal", call("subtract", lt_, rt_), w_),
+                call("less_than_or_equal", call("subtract", rt_, lt_), w_))
+    ex = HashJoinExecutor(
+        left, right, [0], [0], JoinType.INNER, condition=cond,
+        key_capacity=64, bucket_width=8, out_capacity=64,
+        interval_clean=(
+            # clean each side's rows once the OPPOSITE side's watermark
+            # passes them by the interval width
+            ("left", 1, "right", 1, WIDTH),
+            ("right", 1, "left", 1, WIDTH),
+        ))
+    chunks = run(drain_collect(ex))
+    got = sorted(r for c in chunks for r in chunk_to_rows(c, ex.schema))
+    exp = host_interval_join(l_rows_all, r_rows_all, WIDTH)
+    assert got == exp
+    assert len(got) == n_windows * 2  # k in {0,1} matches each window
+    # state bounded: far fewer lanes live than total rows ingested
+    live_l = int(jnp.sum(ex.state.left.occupied))
+    live_r = int(jnp.sum(ex.state.right.occupied))
+    assert live_l <= 8, live_l    # one window's worth, not 120
+    assert live_r <= 4, live_r
+    # ht slots reclaimed by compaction too
+    assert int(jnp.sum(ex.state.left.ht.occupied)) <= 8
+
+
+def test_interval_join_cleaning_persists_deletes():
+    store = MemoryStateStore()
+    lt = StateTable(store, 1, S_TIME, [0, 1])
+    rt = StateTable(store, 2, S_TIME, [0, 1])
+    left_msgs = [Barrier.new(1), make_chunk(S_TIME, [(1, 10), (2, 20)], capacity=8),
+                 Barrier.new(2, checkpoint=True),
+                 Watermark(1, 1000),
+                 Barrier.new(3, checkpoint=True)]
+    right_msgs = [Barrier.new(1), Barrier.new(2, checkpoint=True),
+                  Barrier.new(3, checkpoint=True)]
+    ex = HashJoinExecutor(
+        MockSource(S_TIME, left_msgs), MockSource(S_TIME, right_msgs),
+        [0], [0], JoinType.INNER, left_state_table=lt, right_state_table=rt,
+        key_capacity=64, bucket_width=4,
+        interval_clean=(("left", 1, "left", 1, 0),))
+    run(drain_collect(ex))
+    store.commit(3)
+    assert list(StateTable(store, 1, S_TIME, [0, 1]).scan_all()) == []
