@@ -78,6 +78,72 @@ def encode_key(values: Sequence[Optional[Any]], types: Sequence[DataType]) -> by
     return b"".join(encode_value(v, t) for v, t in zip(values, types))
 
 
+# ---------------------------------------------------------------------------
+# Value encoding (row serde) — the durable-tier row representation
+# (reference: src/common/src/util/value_encoding/). Unlike key encoding it is
+# NOT order-preserving; it is compact, self-delimiting per the schema, and
+# process-independent: VARCHAR/BYTEA are stored as their string bytes, not as
+# process-local dictionary ids, so a recovered process re-interns them.
+# ---------------------------------------------------------------------------
+
+_NULL = b"\x00"
+_PRESENT = b"\x01"
+
+
+def encode_value_row(row: Sequence[Optional[Any]],
+                     types: Sequence[DataType]) -> bytes:
+    """Physical row tuple -> durable bytes."""
+    parts = []
+    for v, t in zip(row, types):
+        if v is None:
+            parts.append(_NULL)
+            continue
+        parts.append(_PRESENT)
+        k = t.kind
+        if k == TypeKind.BOOL:
+            parts.append(b"\x01" if v else b"\x00")
+        elif t.is_string:
+            raw = GLOBAL_STRING_DICT.lookup(int(v)).encode("utf-8")
+            parts.append(struct.pack("<I", len(raw)))
+            parts.append(raw)
+        elif t.is_float:
+            parts.append(struct.pack("<d", float(v)))
+        else:
+            parts.append(struct.pack("<q", int(v)))
+    return b"".join(parts)
+
+
+def decode_value_row(data: bytes, types: Sequence[DataType]) -> tuple:
+    """Durable bytes -> physical row tuple (strings re-interned)."""
+    out: list = []
+    pos = 0
+    for t in types:
+        tag = data[pos]
+        pos += 1
+        if tag == 0:
+            out.append(None)
+            continue
+        k = t.kind
+        if k == TypeKind.BOOL:
+            out.append(bool(data[pos]))
+            pos += 1
+        elif t.is_string:
+            (n,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            s = data[pos:pos + n].decode("utf-8")
+            pos += n
+            out.append(GLOBAL_STRING_DICT.intern(s))
+        elif t.is_float:
+            (f,) = struct.unpack_from("<d", data, pos)
+            pos += 8
+            out.append(f)
+        else:
+            (i,) = struct.unpack_from("<q", data, pos)
+            pos += 8
+            out.append(i)
+    return tuple(out)
+
+
 def encode_vnode_key(vnode: int, values: Sequence, types: Sequence[DataType]) -> bytes:
     """vnode-prefixed key — the reference's table key layout
     ``table_id | vnode | key`` (docs/state-store-overview.md:96); table_id is

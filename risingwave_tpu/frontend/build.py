@@ -118,14 +118,8 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
                 inp, list(plan.group_keys), list(plan.agg_calls),
                 state_table=st, table_capacity=cfg.agg_table_capacity,
                 out_capacity=cfg.chunk_capacity)
-        lanes = [Field("id", INT64)]
-        from ..stream.simple_agg import _AggLanes
-        for i, dt in enumerate(_AggLanes(plan.agg_calls).lane_dtypes):
-            import jax.numpy as jnp
-            from ..common.types import FLOAT64
-            lanes.append(Field(f"l{i}", INT64 if dt == jnp.int64 else FLOAT64))
-        lanes.append(Field("flag", INT64))
-        st = ctx.state_table(Schema(tuple(lanes)), [0])
+        from ..stream.simple_agg import simple_agg_state_schema
+        st = ctx.state_table(simple_agg_state_schema(plan.agg_calls), [0])
         return SimpleAggExecutor(inp, list(plan.agg_calls), state_table=st)
 
     if isinstance(plan, P.PJoin):

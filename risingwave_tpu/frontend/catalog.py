@@ -85,10 +85,16 @@ class Catalog:
         self.tables: dict[str, TableDef] = {}
         self.mvs: dict[str, MaterializedViewDef] = {}
         self.indexes: dict[str, IndexDef] = {}
-        self._table_ids = itertools.count(1)
+        # plain int (not itertools.count) so DDL can roll it back on failure:
+        # a failed statement must not shift later statements' table ids or
+        # recovery replay (which skips failed DDL) would allocate different
+        # ids than the original run
+        self._next_table_id = 1
 
     def next_table_id(self) -> int:
-        return next(self._table_ids)
+        i = self._next_table_id
+        self._next_table_id += 1
+        return i
 
     def _check_free(self, name: str) -> None:
         for reg in (self.sources, self.tables, self.mvs, self.indexes):

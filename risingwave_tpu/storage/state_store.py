@@ -24,9 +24,11 @@ from typing import Any, Iterator, Optional
 class MemoryStateStore:
     """Process-local multi-table KV store with epoch commit.
 
-    Keys are ``(table_id, key_bytes)``; values are opaque python tuples
-    (physical row values). Not thread-safe; the single-process runtime drives
-    it from one event loop, matching the per-CN LocalStateStore usage.
+    Keys are ``(table_id, key_bytes)``; values are opaque bytes (StateTable
+    value-encodes rows at this boundary). Not thread-safe; the
+    single-process runtime drives it from one event loop, matching the
+    per-CN LocalStateStore usage. ``DurableStateStore``
+    (storage/checkpoint.py) persists commits to disk.
     """
 
     def __init__(self) -> None:
@@ -99,6 +101,12 @@ class MemoryStateStore:
 
     def table_len(self, table_id: int) -> int:
         return len(self._merged_view(table_id))
+
+    def drop_table(self, table_id: int) -> None:
+        """Free a dropped object's state (committed + pending)."""
+        self._committed.pop(table_id, None)
+        for buf in self._pending.values():
+            buf.pop(table_id, None)
 
     # -- snapshot (checkpoint/restore hooks) ----------------------------------
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Iterator, Optional, Sequence
 
-from ..common.row import encode_key
+from ..common.row import decode_value_row, encode_key, encode_value_row
 from ..common.types import Schema
 from .state_store import MemoryStateStore
 
@@ -61,9 +61,16 @@ class StateTable:
 
     def commit(self, epoch: int) -> None:
         """Hand the buffered epoch delta to the store (visible after the
-        store-level commit of this epoch)."""
+        store-level commit of this epoch). Rows cross the table/store
+        boundary as value-encoded bytes — the store is an opaque KV tier,
+        and the durable backend persists process-independent bytes
+        (reference: value encoding at the table layer, state_table.rs:62)."""
         if self._puts or self._dels:
-            self.store.ingest(self.table_id, epoch, self._puts, self._dels)
+            encoded = {
+                k: encode_value_row(v, self.schema.types)
+                for k, v in self._puts.items()
+            }
+            self.store.ingest(self.table_id, epoch, encoded, self._dels)
             self._puts, self._dels = {}, set()
 
     def is_dirty(self) -> bool:
@@ -77,12 +84,14 @@ class StateTable:
             return None
         if k in self._puts:
             return self._puts[k]
-        return self.store.get(self.table_id, k)
+        v = self.store.get(self.table_id, k)
+        return None if v is None else decode_value_row(v, self.schema.types)
 
     def scan_all(self) -> Iterator[tuple]:
         """Committed rows merged with the uncommitted buffer, pk order."""
-        merged: dict[bytes, Optional[tuple]] = {
-            k: v for k, v in self.store.iter_table(self.table_id)
+        merged: dict[bytes, Optional[Any]] = {
+            k: decode_value_row(v, self.schema.types)
+            for k, v in self.store.iter_table(self.table_id)
         }
         for k in self._dels:
             merged.pop(k, None)

@@ -38,9 +38,13 @@ class MaterializeExecutor(SingleInputExecutor):
         yield chunk
 
     async def on_barrier(self, barrier: Barrier):
+        # table-level seal only: the STORE-level epoch commit belongs to the
+        # barrier conductor (Session.tick) after ALL jobs collected the
+        # barrier — an executor-side commit raced concurrent jobs' ingests
+        # and could strand them pending forever (reference: HummockManager.
+        # commit_epoch is driven by meta after barrier collection, not by
+        # materialize).
         self.table.commit(barrier.epoch.curr)
-        if barrier.checkpoint:
-            self.table.store.commit(barrier.epoch.curr)
         if False:
             yield
 

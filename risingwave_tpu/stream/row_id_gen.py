@@ -19,11 +19,12 @@ from .executor import Executor, SingleInputExecutor
 class RowIdGenExecutor(SingleInputExecutor):
     identity = "RowIdGen"
 
-    def __init__(self, input: Executor, row_id_index: int, shard_id: int = 0):
+    def __init__(self, input: Executor, row_id_index: int, shard_id: int = 0,
+                 start_seq: int = 0):
         super().__init__(input)
         self.schema = input.schema
         self.row_id_index = row_id_index
-        self.seq = jnp.zeros((), jnp.int64)
+        self.seq = jnp.asarray(start_seq, jnp.int64)
         base = jnp.int64(shard_id) << 48
 
         @jax.jit

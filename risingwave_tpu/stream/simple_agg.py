@@ -104,6 +104,19 @@ class _AggLanes:
         ))
 
 
+def simple_agg_state_schema(agg_calls: Sequence[AggCall]) -> Schema:
+    """Schema of the durable simple-agg state row: id pk, raw lanes, flag.
+    The single source of truth for the arity the checkpoint row carries —
+    value encoding is schema-driven, so a short hand-built schema silently
+    truncates state."""
+    from ..common.types import FLOAT64, INT64
+    lanes = [Field("id", INT64)]
+    for i, dt in enumerate(_AggLanes(agg_calls).lane_dtypes):
+        lanes.append(Field(f"l{i}", INT64 if dt == jnp.int64 else FLOAT64))
+    lanes.append(Field("flag", INT64))
+    return Schema(tuple(lanes))
+
+
 class SimpleAggExecutor(SingleInputExecutor):
     """Global aggregation: output is always exactly one logical row."""
 

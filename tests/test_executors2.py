@@ -86,9 +86,9 @@ def test_simple_agg_checkpoint_recovery():
     from risingwave_tpu.common.types import Field
 
     def make_table():
-        lanes = [Field("id", INT64), Field("cnt", INT64), Field("sum", INT64),
-                 Field("flag", INT64)]
-        return StateTable(store, 7, Schema(tuple(lanes)), [0])
+        from risingwave_tpu.stream.simple_agg import simple_agg_state_schema
+        schema = simple_agg_state_schema([count_star(), agg("sum", 1, INT64)])
+        return StateTable(store, 7, schema, [0])
 
     src = MockSource(KV, [
         Barrier.new(1),

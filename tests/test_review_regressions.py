@@ -39,6 +39,7 @@ def test_non_checkpoint_epochs_survive_to_next_checkpoint():
     ])
     mv = MaterializeExecutor(src, StateTable(store, 7, S, [0]))
     run(drain(mv))
+    store.commit(3)   # the barrier conductor's sync_epoch commit
     assert sorted(mv.rows()) == [(1, 10), (2, 20)]
     assert store.committed_epoch == 3
 
