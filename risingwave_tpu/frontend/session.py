@@ -33,7 +33,7 @@ from ..storage.state_table import StateTable
 from ..stream.eowc import WatermarkFilterExecutor
 from ..stream.executor import Executor
 from ..stream.materialize import MaterializeExecutor
-from ..stream.message import Barrier, Message
+from ..stream.message import Barrier, Message, Mutation, MutationKind
 from ..stream.row_id_gen import RowIdGenExecutor
 from ..stream.source import MockSource
 from . import sqlast as A
@@ -104,44 +104,133 @@ class _RowIdAppendSource(Executor):
                 return
 
 
+def _split_sql(sql: str) -> list[str]:
+    """Split a script into statement texts (';' outside string literals and
+    ``--`` line comments) so DDL statements can be logged verbatim for
+    recovery replay."""
+    parts, buf = [], []
+    in_str = in_comment = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_comment:
+            buf.append(ch)
+            if ch == "\n":
+                in_comment = False
+        elif in_str:
+            buf.append(ch)
+            if ch == "'":
+                in_str = False
+        elif ch == "'":
+            in_str = True
+            buf.append(ch)
+        elif ch == "-" and sql[i:i + 2] == "--":
+            in_comment = True
+            buf.append(ch)
+        elif ch == ";":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return [p for p in parts if p.strip()]
+
+
 class Session:
     def __init__(self, checkpoint_frequency: int = 10,
                  chunks_per_tick: int = 1, source_chunk_capacity: int = 1024,
-                 config: Optional[BuildConfig] = None, seed: int = 42):
+                 config: Optional[BuildConfig] = None, seed: int = 42,
+                 data_dir: Optional[str] = None,
+                 in_flight_barriers: int = 1):
         self.catalog = Catalog()
-        self.store = MemoryStateStore()
+        self.data_dir = data_dir
+        if data_dir is not None:
+            from ..storage.checkpoint import DurableStateStore
+            self.store: MemoryStateStore = DurableStateStore(data_dir)
+        else:
+            self.store = MemoryStateStore()
         self.config = config or BuildConfig()
         self.checkpoint_frequency = checkpoint_frequency
         self.chunks_per_tick = chunks_per_tick
         self.source_chunk_capacity = source_chunk_capacity
         self.seed = seed
-        self.epoch = 1               # last completed epoch
+        self.epoch = max(1, self.store.committed_epoch)  # last completed epoch
         self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
         self.feeds: list[_SourceFeed] = []
         self.table_dml: dict[str, list[StreamChunk]] = {}
         self._table_queues: dict[str, list[QueueSource]] = {}
         self._next_shard = 0
+        self._recovering = False
+        # barrier pipelining: up to k epochs in flight before tick() blocks
+        # on the oldest (reference: in_flight_barrier_nums,
+        # src/common/src/config.rs:380-381; GlobalBarrierManager pipelining,
+        # src/meta/src/barrier/mod.rs:152)
+        self.in_flight_barriers = max(1, in_flight_barriers)
+        self._inflight: list[tuple[int, bool]] = []  # (epoch, checkpoint)
+        self._injected = self.epoch                  # last injected epoch
+        self.paused = False
+        self._pending_mutation: Optional[Mutation] = None
+        from ..stream.metrics import LatencyRecorder
+        self.barrier_latency = LatencyRecorder()
+        self._inject_time: dict[int, float] = {}
         # the session owns its event loop: jobs are long-lived tasks that
         # must survive across synchronous API calls, independent of any
         # ambient loop other code may create/close
         self.loop = asyncio.new_event_loop()
+        if data_dir is not None:
+            self._recover()
+
+    def _recover(self) -> None:
+        """Crash recovery: replay the logged DDL over the recovered store.
+        Executors find non-empty state tables and reload device state from
+        them; MV-on-MV leaves skip the backfill snapshot (their recovered
+        state already reflects the upstream through the committed epoch).
+        Source connector offsets are not yet persisted — generators restart
+        (split-state checkpointing arrives with the connector framework).
+        Reference: orchestrated recovery, src/meta/src/barrier/recovery.rs:110."""
+        ddl = self.store.log.ddl()  # type: ignore[attr-defined]
+        if not ddl:
+            return
+        self._recovering = True
+        try:
+            for piece in ddl:
+                for stmt in parse_sql(piece):
+                    self._run_statement(stmt)
+        finally:
+            self._recovering = False
 
     # ------------------------------------------------------------------ SQL --
 
     def run_sql(self, sql: str) -> list:
         """Execute statements; returns the last statement's result rows."""
         out: list = []
-        for stmt in parse_sql(sql):
-            out = self._run_statement(stmt)
+        for piece in _split_sql(sql):
+            for stmt in parse_sql(piece):
+                out = self._run_statement(stmt)
+                if (self.data_dir is not None and not self._recovering
+                        and isinstance(stmt, (
+                            A.CreateSource, A.CreateTable,
+                            A.CreateMaterializedView, A.DropStatement))):
+                    self.store.log.log_ddl(piece)  # type: ignore[attr-defined]
         return out
 
     def _run_statement(self, stmt: A.Statement) -> list:
-        if isinstance(stmt, A.CreateSource):
-            return self._create_source(stmt)
-        if isinstance(stmt, A.CreateTable):
-            return self._create_table(stmt)
-        if isinstance(stmt, A.CreateMaterializedView):
-            return self._create_mv(stmt)
+        if isinstance(stmt, (A.CreateSource, A.CreateTable,
+                             A.CreateMaterializedView)):
+            # transactional table-id allocation: a failed CREATE must not
+            # shift later statements' ids (recovery replays only logged —
+            # successful — DDL, so id assignment must be replay-deterministic)
+            saved_id = self.catalog._next_table_id
+            try:
+                if isinstance(stmt, A.CreateSource):
+                    return self._create_source(stmt)
+                if isinstance(stmt, A.CreateTable):
+                    return self._create_table(stmt)
+                return self._create_mv(stmt)
+            except BaseException:
+                self.catalog._next_table_id = saved_id
+                raise
         if isinstance(stmt, A.DropStatement):
             return self._drop(stmt)
         if isinstance(stmt, A.Insert):
@@ -207,6 +296,8 @@ class Session:
     def _create_table(self, stmt: A.CreateTable) -> list:
         if stmt.if_not_exists and stmt.name in self.catalog.tables:
             return []
+        self._drain_inflight()   # job wiring happens at a quiesced boundary
+        self.catalog._check_free(stmt.name)   # fail BEFORE allocating ids
         fields = tuple(Field(c.name, type_from_name(c.type_name))
                        for c in stmt.columns)
         schema = Schema(fields)
@@ -226,9 +317,18 @@ class Session:
         q = QueueSource(Schema(fields))
         src: Executor = q
         if not stmt.pk:
+            start_seq = 0
+            if self._recovering:
+                # continue above the recovered max row id (ids are
+                # shard<<48 | seq; mask off the shard prefix)
+                recovered = StateTable(self.store, t.table_id, schema, list(pk))
+                seqs = [r[len(fields)] & ((1 << 48) - 1)
+                        for r in recovered.scan_all()]
+                start_seq = max(seqs) + 1 if seqs else 0
             src = _RowIdAppendSource(q, schema)
             src = RowIdGenExecutor(src, row_id_index=len(fields),
-                                   shard_id=self._alloc_shard())
+                                   shard_id=self._alloc_shard(),
+                                   start_seq=start_seq)
         mat = MaterializeExecutor(
             src, StateTable(self.store, t.table_id, schema, list(pk)))
         job = StreamJob(stmt.name, mat, [q])
@@ -243,15 +343,22 @@ class Session:
     def _create_mv(self, stmt: A.CreateMaterializedView) -> list:
         if stmt.if_not_exists and stmt.name in self.catalog.mvs:
             return []
+        self._drain_inflight()   # subscribe at a quiesced epoch boundary
+        self.catalog._check_free(stmt.name)   # fail BEFORE building executors
         plan = Planner(self.catalog).plan_select(stmt.query)
         queues: list[QueueSource] = []
         init_msgs: list[tuple[QueueSource, list[Message]]] = []
+        scan_leaf_queues: list[tuple[list, StreamJob]] = []
 
         def factory(leaf) -> Executor:
             ex, q, init = self._stream_leaf(leaf)
             if q is not None:
                 queues.append(q)
                 init_msgs.append((q, init))
+                if self._recovering and isinstance(leaf, (PTableScan, PMvScan)):
+                    name = (leaf.table.name if isinstance(leaf, PTableScan)
+                            else leaf.mv.name)
+                    scan_leaf_queues.append((init, self.jobs[name]))
             return ex
 
         ctx = BuildContext(self.store, self.catalog.next_table_id, factory,
@@ -261,15 +368,32 @@ class Session:
         mat = MaterializeExecutor(
             pipeline,
             StateTable(self.store, mv_table_id, plan.schema, list(plan.pk)))
+        if self._recovering:
+            # the DDL log records a CREATE MV the moment it succeeds, but its
+            # state first persists at the NEXT checkpoint. If we crashed in
+            # that window the recovered MV state is empty — re-run the
+            # backfill snapshot from the recovered upstream instead of
+            # trusting state that never existed.
+            has_state = (self.store.table_len(mv_table_id) > 0 or any(
+                self.store.table_len(tid) > 0
+                for tid in ctx.state_table_ids))
+            if not has_state:
+                for init, up_job in scan_leaf_queues:
+                    init.extend(up_job.snapshot_messages(
+                        Barrier.new(self.epoch), self.source_chunk_capacity))
         n_visible = sum(1 for f in plan.schema if not f.name.startswith("_"))
         mv = MaterializedViewDef(
             stmt.name, plan.schema, tuple(plan.pk), table_id=mv_table_id,
             definition="")
         mv.n_visible = n_visible  # type: ignore[attr-defined]
+        mv.state_table_ids = tuple(ctx.state_table_ids)  # type: ignore[attr-defined]
         self.catalog.add_mv(mv)
         job = StreamJob(stmt.name, mat, queues)
         self.jobs[stmt.name] = job
         job.start(self.loop)
+        # the next barrier announces the new downstream to the graph
+        # (reference: Mutation::Add, executor/mod.rs:220-238)
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
         # init cut: every root replays up to the current epoch's barrier
         for q, init in init_msgs:
             for m in init:
@@ -297,8 +421,13 @@ class Session:
             up_job = self.jobs[name]
             q = QueueSource(leaf.schema)
             up_job.bus.subscribe(q)
-            snapshot = up_job.snapshot_messages(
-                Barrier.new(self.epoch), self.source_chunk_capacity)
+            if self._recovering:
+                # recovered executor state already reflects the upstream
+                # through the committed epoch — no backfill snapshot
+                snapshot = []
+            else:
+                snapshot = up_job.snapshot_messages(
+                    Barrier.new(self.epoch), self.source_chunk_capacity)
             # session does NOT drive this queue; upstream bus does. The
             # snapshot + init barrier are pushed at creation.
             return q, q, snapshot
@@ -325,10 +454,20 @@ class Session:
         raise SqlError(f"unsupported connector {src.connector!r}")
 
     def _drop(self, stmt: A.DropStatement) -> list:
+        self._drain_inflight()
+        # free the object's durable state (tombstoned in the manifest so
+        # recovery and compaction skip it)
+        obj = (self.catalog.tables.get(stmt.name)
+               or self.catalog.mvs.get(stmt.name))
         existed = self.catalog.drop(stmt.kind, stmt.name, stmt.if_exists)
         if existed and stmt.name in self.jobs:
             job = self.jobs.pop(stmt.name)
             self._await(job.stop())
+        if existed and obj is not None:
+            for tid in ((obj.table_id,)
+                        + tuple(getattr(obj, "state_table_ids", ()))):
+                if tid >= 0:
+                    self.store.drop_table(tid)
         return []
 
     # ----------------------------------------------------------------- DML --
@@ -360,14 +499,23 @@ class Session:
 
     # --------------------------------------------------------------- epochs --
 
-    def tick(self, generate: bool = True, checkpoint: Optional[bool] = None) -> int:
-        """One barrier cycle: feed sources, inject barrier, await all jobs,
-        commit on checkpoint. Returns the completed epoch."""
-        epoch = self.epoch + 1
+    def tick(self, generate: bool = True, checkpoint: Optional[bool] = None,
+             mutation: Optional[Mutation] = None) -> int:
+        """One barrier cycle: feed sources, inject the barrier, and await
+        completion of the oldest in-flight epoch once more than
+        ``in_flight_barriers`` are outstanding — the reference's pipelined
+        inject/collect loop (src/meta/src/barrier/mod.rs:152,
+        in_flight_barrier_nums config.rs:380-381). With the default of 1
+        this is the classic synchronous cycle. Returns the last COMPLETED
+        epoch."""
+        epoch = self._injected + 1
         if checkpoint is None:
             checkpoint = epoch % self.checkpoint_frequency == 0
-        barrier = Barrier.new(epoch, checkpoint=checkpoint)
-        if generate:
+        if mutation is None and self._pending_mutation is not None:
+            mutation = self._pending_mutation
+            self._pending_mutation = None
+        barrier = Barrier.new(epoch, checkpoint=checkpoint, mutation=mutation)
+        if generate and not self.paused:
             for feed in self.feeds:
                 for _ in range(self.chunks_per_tick):
                     chunk = feed.generator()
@@ -383,11 +531,28 @@ class Session:
         for queues in self._table_queues.values():
             for q in queues:
                 q.push(barrier)
-        self._await(self._collect_barrier(epoch))
-        if checkpoint:
-            self.store.commit(epoch)
-        self.epoch = epoch
-        return epoch
+        self._injected = epoch
+        self._inflight.append((epoch, checkpoint))
+        import time as _time
+        self._inject_time[epoch] = _time.perf_counter()
+        while len(self._inflight) >= self.in_flight_barriers:
+            self._complete_oldest()
+        return self.epoch
+
+    def _complete_oldest(self) -> None:
+        e, ckpt = self._inflight.pop(0)
+        self._await(self._collect_barrier(e))
+        if ckpt:
+            self.store.commit(e)
+        import time as _time
+        t0 = self._inject_time.pop(e, None)
+        if t0 is not None:
+            self.barrier_latency.record(_time.perf_counter() - t0)
+        self.epoch = e
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._complete_oldest()
 
     async def _collect_barrier(self, epoch: int) -> None:
         # gather must be created inside the session loop (it binds futures
@@ -398,11 +563,28 @@ class Session:
     def flush(self) -> None:
         """FLUSH: complete a checkpoint epoch (DML + state made durable)."""
         self.tick(generate=False, checkpoint=True)
+        self._drain_inflight()
+
+    # ----------------------------------------------------------- mutations --
+
+    def pause(self) -> None:
+        """Stop source data flow; barriers keep flowing (reference:
+        Mutation::Pause, executor/mod.rs:241-251 — used during config
+        changes and recovery)."""
+        if not self.paused:
+            self.paused = True
+            self.tick(generate=False, mutation=Mutation(MutationKind.PAUSE))
+
+    def resume(self) -> None:
+        if self.paused:
+            self.paused = False
+            self.tick(generate=False, mutation=Mutation(MutationKind.RESUME))
 
     # ---------------------------------------------------------------- query --
 
     def query(self, sel: A.Select) -> list:
         """Batch SELECT: run the stream plan over snapshot sources."""
+        self._drain_inflight()   # read-your-writes snapshot
         plan = Planner(self.catalog).plan_select(sel)
 
         def factory(leaf) -> Executor:
@@ -479,6 +661,7 @@ class Session:
 
     def mv_rows(self, name: str) -> list:
         """Current contents of an MV (visible columns, decoded)."""
+        self._drain_inflight()   # read-your-writes
         mv = self.catalog.mvs.get(name)
         if mv is None:
             raise SqlError(f"materialized view {name!r} not found")
@@ -490,6 +673,20 @@ class Session:
                 None if v is None else mv.schema[i].type.to_python(v)
                 for i, v in enumerate(phys[:n_vis])))
         return rows
+
+    def metrics(self) -> dict:
+        """Observability dump: per-job per-executor counters + session
+        barrier latency percentiles (reference:
+        src/stream/src/executor/monitor/streaming_stats.rs:27-88)."""
+        from ..stream.metrics import pipeline_metrics
+        return {
+            "barrier_latency": self.barrier_latency.snapshot(),
+            "epoch": self.epoch,
+            "jobs": {
+                name: pipeline_metrics(job.pipeline)
+                for name, job in self.jobs.items()
+            },
+        }
 
     def _alloc_shard(self) -> int:
         self._next_shard += 1

@@ -230,6 +230,8 @@ class ShardedHashJoinExecutor(Executor):
         out_capacity: int = DEFAULT_CHUNK_CAPACITY,
     ):
         self.left, self.right = left, right
+        from ..stream.metrics import ExecutorStats
+        self.stats = ExecutorStats()
         self.join = ShardedHashJoin(
             mesh, left.schema, right.schema, left_keys, right_keys,
             join_type, condition=condition, key_capacity=key_capacity,
@@ -249,10 +251,14 @@ class ShardedHashJoinExecutor(Executor):
             self._load_from_state_tables()
 
     async def execute(self):
+        from ..stream.metrics import barrier_timer
+        stats = self.stats
         async for ev in barrier_align(self.left, self.right):
             kind = ev[0]
             if kind == "chunk":
                 _, side, chunk = ev
+                stats.chunks_in += 1
+                stats.capacity_rows_in += chunk.capacity
                 big = self.join.step(
                     side, split_chunk(chunk, self.n, self.join._sharding))
                 counts = jax.device_get(self._count(big))
@@ -262,18 +268,21 @@ class ShardedHashJoinExecutor(Executor):
                     batch = self._gather(big, jnp.int64(lo))
                     for s in range(self.n):
                         if counts[s] > lo:
+                            stats.chunks_out += 1
                             yield jax.tree_util.tree_map(lambda x: x[s], batch)
                     lo += G
             elif kind == "barrier":
                 barrier = ev[1]
-                self._check_flags()
-                if barrier.checkpoint:
-                    self._checkpoint(barrier.epoch.curr)
+                with barrier_timer(stats):
+                    self._check_flags()
+                    if barrier.checkpoint:
+                        self._checkpoint(barrier.epoch.curr)
                 yield barrier
                 if barrier.is_stop():
                     return
             elif kind == "watermark":
                 _, side, wm = ev
+                stats.watermarks += 1
                 out_idx = self._map_watermark_col(side, wm.col_idx)
                 if out_idx is not None:
                     yield wm.__class__(out_idx, wm.value)

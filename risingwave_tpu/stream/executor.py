@@ -43,6 +43,8 @@ class SingleInputExecutor(Executor):
 
     def __init__(self, input: Executor):
         self.input = input
+        from .metrics import ExecutorStats
+        self.stats = ExecutorStats()
 
     async def map_chunk(self, chunk: StreamChunk):
         yield chunk
@@ -63,20 +65,33 @@ class SingleInputExecutor(Executor):
         yield watermark
 
     async def execute(self) -> AsyncIterator[Message]:
+        from .metrics import barrier_timer
+        stats = self.stats
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
+                stats.chunks_in += 1
+                stats.capacity_rows_in += msg.capacity
                 async for out in self.map_chunk(msg):
+                    stats.chunks_out += 1
                     yield out
             elif isinstance(msg, ChunkBatch):
+                stats.batches_in += 1
+                stats.batch_chunks_in += msg.num_chunks
+                stats.capacity_rows_in += msg.num_chunks * msg.chunk_capacity
                 async for out in self.map_chunk_batch(msg):
+                    stats.chunks_out += 1
                     yield out
             elif isinstance(msg, Barrier):
-                async for out in self.on_barrier(msg):
+                with barrier_timer(stats):
+                    outs = [out async for out in self.on_barrier(msg)]
+                for out in outs:
+                    stats.chunks_out += 1
                     yield out
                 yield msg
                 if msg.is_stop():
                     return
             elif isinstance(msg, Watermark):
+                stats.watermarks += 1
                 async for out in self.on_watermark(msg):
                     yield out
 

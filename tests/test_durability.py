@@ -1,0 +1,178 @@
+"""Durable checkpoint + crash recovery (VERDICT r2 item 3).
+
+The e2e test REALLY kills the process: a subprocess builds a session over a
+data dir, checkpoints via FLUSH, then os._exit(0)s without any graceful
+shutdown; the parent recovers a fresh Session from the directory and
+cross-checks MV contents, then keeps streaming into the recovered session."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from risingwave_tpu.common.row import decode_value_row, encode_value_row
+from risingwave_tpu.common.types import (
+    BOOL, FLOAT64, INT64, VARCHAR, GLOBAL_STRING_DICT,
+)
+from risingwave_tpu.storage.checkpoint import CheckpointLog, DurableStateStore
+
+
+def test_value_row_roundtrip():
+    types = [INT64, FLOAT64, BOOL, VARCHAR, INT64]
+    sid = GLOBAL_STRING_DICT.intern("hello world")
+    row = (42, -1.5, True, sid, None)
+    enc = encode_value_row(row, types)
+    assert decode_value_row(enc, types) == row
+    # all-null row
+    row2 = (None, None, None, None, None)
+    assert decode_value_row(encode_value_row(row2, types), types) == row2
+
+
+def test_durable_store_roundtrip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    s1 = DurableStateStore(d)
+    s1.ingest(7, 2, {b"a": b"row-a", b"b": b"row-b"}, set())
+    s1.commit(2)
+    s1.ingest(7, 3, {b"c": b"row-c"}, {b"a"})
+    s1.ingest(9, 3, {b"x": b"row-x"}, set())
+    s1.commit(3)
+
+    s2 = DurableStateStore(d)
+    assert s2.committed_epoch == 3
+    assert dict(s2.iter_table(7)) == {b"b": b"row-b", b"c": b"row-c"}
+    assert dict(s2.iter_table(9)) == {b"x": b"row-x"}
+
+    # compaction folds segments without changing the view
+    s2.log.compact()
+    s3 = DurableStateStore(d)
+    assert dict(s3.iter_table(7)) == {b"b": b"row-b", b"c": b"row-c"}
+    assert s3.committed_epoch == 3
+
+
+def test_mv_created_after_last_checkpoint_rebackfills(tmp_path):
+    """Crash in the window between CREATE MV (logged immediately) and the
+    next checkpoint (which would persist its state): recovery must re-run
+    the backfill snapshot from the recovered upstream."""
+    d = str(tmp_path / "db")
+    child = textwrap.dedent(f"""
+        import os, sys
+        from risingwave_tpu.frontend import Session
+        s = Session(data_dir={d!r})
+        s.run_sql("CREATE TABLE t (k BIGINT, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1,10),(2,20)")
+        s.flush()                      # t's rows durably committed
+        s.run_sql('''CREATE MATERIALIZED VIEW m AS
+            SELECT k, v * 2 AS d FROM t''')
+        # crash BEFORE any checkpoint that includes m's state
+        os._exit(0)
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run([sys.executable, "-c", child], env=env,
+                         capture_output=True, text=True, timeout=600,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    from risingwave_tpu.frontend import Session
+    s = Session(data_dir=d)
+    assert sorted(s.mv_rows("m")) == [(1, 20), (2, 40)]
+
+
+def test_empty_flush_adds_no_segments(tmp_path):
+    d = str(tmp_path / "db")
+    from risingwave_tpu.frontend import Session
+    s = Session(data_dir=d)
+    s.run_sql("CREATE TABLE t (k BIGINT)")
+    s.run_sql("INSERT INTO t VALUES (1)")
+    s.flush()
+    n0 = len(s.store.log._read_manifest()["segments"])
+    for _ in range(5):
+        s.flush()   # nothing new to persist
+    m = s.store.log._read_manifest()
+    assert len(m["segments"]) == n0
+    assert m["committed_epoch"] == s.store.committed_epoch
+
+
+def test_drop_tombstones_durable_state(tmp_path):
+    d = str(tmp_path / "db")
+    from risingwave_tpu.frontend import Session
+    s = Session(data_dir=d)
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY)")
+    tid = s.catalog.tables["t"].table_id
+    s.run_sql("INSERT INTO t VALUES (1),(2),(3)")
+    s.flush()
+    s.run_sql("DROP TABLE t")
+    s.flush()
+    assert s.store.table_len(tid) == 0
+
+    s2 = Session(data_dir=d)
+    assert "t" not in s2.catalog.tables
+    assert s2.store.table_len(tid) == 0   # not resurrected from old segments
+    # compaction discards the dead rows entirely
+    s2.store.log.compact()
+    _, tables = s2.store.log.load_tables()
+    assert tid not in tables
+
+
+def test_crash_recovery_e2e(tmp_path):
+    d = str(tmp_path / "db")
+    child = textwrap.dedent(f"""
+        import json, os, sys
+        from risingwave_tpu.frontend import Session
+        s = Session(data_dir={d!r})
+        s.run_sql('''
+            CREATE TABLE events (k BIGINT, cat VARCHAR, v BIGINT);
+            CREATE MATERIALIZED VIEW agg AS
+              SELECT cat, COUNT(*) AS cnt, SUM(v) AS total
+              FROM events GROUP BY cat
+        ''')
+        s.run_sql("INSERT INTO events VALUES (1,'a',10),(2,'b',20),(3,'a',30)")
+        s.flush()
+        s.run_sql("INSERT INTO events VALUES (4,'b',5),(5,'c',7)")
+        s.flush()
+        # one more insert that is NOT checkpointed: must be lost on crash
+        s.run_sql("INSERT INTO events VALUES (6,'z',999)")
+        s.tick(generate=False, checkpoint=False)
+        print("EXPECT " + json.dumps(sorted(s.mv_rows('agg'))))
+        sys.stdout.flush()
+        os._exit(0)   # crash: no graceful shutdown, no final checkpoint
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_LIBRARY_PATH", None)
+    res = subprocess.run([sys.executable, "-c", child], env=env,
+                         capture_output=True, text=True, timeout=600,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr[-2000:]
+    line = [l for l in res.stdout.splitlines() if l.startswith("EXPECT ")][0]
+    pre_crash = [tuple(r) for r in json.loads(line[len("EXPECT "):])]
+    # the 'z' row was never checkpointed
+    committed = sorted(r for r in pre_crash if r[0] != "z")
+    assert ("z", 1, 999) in pre_crash
+
+    from risingwave_tpu.frontend import Session
+    s = Session(data_dir=d)
+    assert sorted(s.mv_rows("agg")) == committed
+    assert sorted(s.run_sql("SELECT k, cat, v FROM events")) == [
+        (1, "a", 10), (2, "b", 20), (3, "a", 30), (4, "b", 5), (5, "c", 7)]
+
+    # the recovered session keeps streaming: new DML folds into the MV
+    s.run_sql("INSERT INTO events VALUES (7,'a',100)")
+    s.flush()
+    got = {r[0]: (r[1], r[2]) for r in s.mv_rows("agg")}
+    assert got["a"] == (3, 140)
+    assert got["b"] == (2, 25)
+    assert got["c"] == (1, 7)
+
+    # and survives a SECOND recovery
+    s2 = Session(data_dir=d)
+    assert sorted(s2.mv_rows("agg")) == sorted(s.mv_rows("agg"))
+    # row ids continued above the recovered ones: all 6 rows distinct
+    assert len(s2.run_sql("SELECT k, cat, v FROM events")) == 6
