@@ -1,3 +1,8 @@
 from .nexmark import (  # noqa: F401
     AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig, NexmarkGenerator,
 )
+from .base import SplitReader  # noqa: F401
+from .datagen import DatagenReader  # noqa: F401
+from .filesource import FileSourceReader  # noqa: F401
+from .nexmark_split import NexmarkReader  # noqa: F401
+from .sinks import BlackHoleSink, FileSink, Sink, build_sink  # noqa: F401

@@ -1,0 +1,54 @@
+"""Source connector framework: splits, readers, offset state.
+
+Counterpart of the reference's source framework — ``SplitEnumerator`` /
+``SplitReader`` traits and the ``SplitImpl`` state enum
+(reference: src/connector/src/source/base.rs:295,326,340;
+docs/data-source.md). A *split* is the unit of parallel, seekable ingest
+(a Kafka partition, a file, a datagen shard); its *offset* is the
+checkpointable read position. The runtime persists ``{split_id: offset}``
+per source into a split-state table on checkpoint barriers and seeks
+readers on recovery — the reference's split-state checkpointing
+(src/stream/src/executor/source/state_table_handler.rs).
+
+TPU angle: readers emit fixed-capacity columnar StreamChunks (static
+shapes for XLA); ingest-side string interning happens here so device
+columns stay integer-typed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..common.chunk import StreamChunk
+
+
+class SplitReader:
+    """One source instance: a set of splits read round-robin.
+
+    Offsets are *next-to-read* positions: after ``next_chunk`` returns rows
+    ``[o, o+n)`` of split s, ``offsets[s] == o+n``. ``seek`` must make the
+    subsequent chunks identical to a fresh reader fast-forwarded to the
+    same offsets — that determinism is what makes source replay after
+    recovery exactly-once end to end.
+    """
+
+    def splits(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def offsets(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+    def seek(self, offsets: Dict[str, int]) -> None:
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[StreamChunk]:
+        """Next chunk, or None when (currently) exhausted. Bounded sources
+        return None forever once drained; unbounded ones never return None."""
+        raise NotImplementedError
+
+    def rows_emitted(self) -> int:
+        """Rows emitted through the current offsets — an upper bound is
+        acceptable. Used to restart serial row-id assignment above any id
+        handed out before a crash (RowIdGen continuation on recovery)."""
+        return sum(self.offsets.values())

@@ -1,0 +1,80 @@
+"""Format parsers: encoded source bytes → typed rows.
+
+Counterpart of the reference's parser layer
+(reference: src/connector/src/parser/ — JSON, CSV, Debezium et al.). The
+parse boundary is also the string-interning boundary: VARCHAR values become
+dictionary ids here so the device columns stay integral (SURVEY.md §7
+"Varlen strings on device").
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+import json
+from typing import Any, List, Optional, Sequence
+
+from ..common.types import Schema, TypeKind
+
+
+def _coerce(v: Any, kind: TypeKind) -> Any:
+    if v is None:
+        return None
+    if kind in (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
+                TypeKind.SERIAL, TypeKind.DATE, TypeKind.TIME,
+                TypeKind.TIMESTAMP, TypeKind.INTERVAL):
+        return int(v)
+    if kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        return float(v)
+    if kind == TypeKind.BOOL:
+        if isinstance(v, str):
+            return v.strip().lower() in ("t", "true", "1", "yes")
+        return bool(v)
+    return str(v)
+
+
+def parse_json_line(line: str, schema: Schema) -> Optional[tuple]:
+    """One JSON object → row tuple in schema order; unknown keys ignored,
+    missing keys NULL. Returns None for blank lines."""
+    line = line.strip()
+    if not line:
+        return None
+    obj = json.loads(line)
+    return tuple(_coerce(obj.get(f.name), f.type.kind) for f in schema)
+
+
+def parse_json_lines(text: str, schema: Schema) -> List[tuple]:
+    rows = []
+    for line in text.splitlines():
+        r = parse_json_line(line, schema)
+        if r is not None:
+            rows.append(r)
+    return rows
+
+
+def parse_csv_lines(text: str, schema: Schema,
+                    has_header: bool = True,
+                    delimiter: str = ",") -> List[tuple]:
+    """CSV text → rows. With a header, columns are matched by name;
+    without, by position."""
+    reader = _csv.reader(io.StringIO(text), delimiter=delimiter)
+    rows: List[tuple] = []
+    col_order: Optional[Sequence[int]] = None
+    first = True
+    for rec in reader:
+        if not rec:
+            continue
+        if first and has_header:
+            name_to_pos = {n.strip(): i for i, n in enumerate(rec)}
+            col_order = [name_to_pos.get(f.name, -1) for f in schema]
+            first = False
+            continue
+        first = False
+        if col_order is None:
+            col_order = list(range(len(schema)))
+        vals = []
+        for f, pos in zip(schema, col_order):
+            raw = rec[pos] if 0 <= pos < len(rec) else None
+            vals.append(None if raw in (None, "") else _coerce(raw, f.type.kind))
+        rows.append(tuple(vals))
+    return rows

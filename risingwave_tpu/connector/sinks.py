@@ -1,0 +1,111 @@
+"""Sink implementations: where changelogs leave the system.
+
+Counterpart of the reference's sink connectors
+(reference: src/connector/src/sink/mod.rs:150-160 — Kafka, Redis,
+BlackHole, Remote…). Only host-side IO lives here; the delivery protocol
+(log store, epoch tracking, exactly-once truncation) is the SinkExecutor's
+job (stream/sink.py).
+
+``FileSink`` is the durable local sink: JSONL/CSV appended per epoch with
+a byte-offset handle, so the executor can truncate uncommitted tail bytes
+after a crash — the file-system analogue of the reference's two-phase
+commit per sink epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..common.chunk import OP_DELETE, OP_INSERT, OP_UPDATE_DELETE
+from ..common.types import Schema
+
+Row = Tuple[int, tuple]          # (op, values)
+
+_OP_NAMES = {0: "insert", 1: "delete", 2: "update_delete", 3: "update_insert"}
+
+
+class Sink:
+    def write_rows(self, rows: Sequence[Row]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        """Make previous writes durable (fsync/commit)."""
+
+    def position(self) -> int:
+        """Opaque monotone delivery position (bytes/rows delivered)."""
+        return 0
+
+    def truncate_to(self, position: int) -> None:
+        """Recovery: discard deliveries past ``position`` when possible."""
+
+    def close(self) -> None:
+        pass
+
+
+class BlackHoleSink(Sink):
+    """Swallow everything; count rows (reference: sink/mod.rs BlackHole)."""
+
+    def __init__(self) -> None:
+        self.rows_written = 0
+
+    def write_rows(self, rows: Sequence[Row]) -> None:
+        self.rows_written += len(rows)
+
+    def position(self) -> int:
+        return self.rows_written
+
+    def truncate_to(self, position: int) -> None:
+        self.rows_written = position
+
+
+class FileSink(Sink):
+    def __init__(self, path: str, schema: Schema, fmt: str = "jsonl"):
+        self.path = path
+        self.schema = schema
+        self.fmt = fmt.lower()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a+", encoding="utf-8")
+
+    def _encode(self, op: int, values: tuple) -> str:
+        if self.fmt == "csv":
+            vals = ",".join("" if v is None else str(v) for v in values)
+            return f"{_OP_NAMES[op]},{vals}\n"
+        obj = {f.name: v for f, v in zip(self.schema, values)}
+        obj["__op"] = _OP_NAMES[op]
+        return json.dumps(obj, default=str) + "\n"
+
+    def write_rows(self, rows: Sequence[Row]) -> None:
+        for op, values in rows:
+            self._f.write(self._encode(op, values))
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def position(self) -> int:
+        self._f.flush()
+        return self._f.tell()
+
+    def truncate_to(self, position: int) -> None:
+        self._f.flush()
+        self._f.truncate(position)
+        self._f.seek(position)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def build_sink(connector: str, options: dict, schema: Schema) -> Sink:
+    """Sink registry (reference: SinkImpl::new, sink/mod.rs:150)."""
+    c = connector.lower()
+    if c in ("blackhole", ""):
+        return BlackHoleSink()
+    if c == "file":
+        path = options.get("path")
+        if not path:
+            raise ValueError("file sink requires path option")
+        return FileSink(str(path), schema,
+                        fmt=str(options.get("format", "jsonl")))
+    raise ValueError(f"unsupported sink connector {connector!r}")

@@ -69,6 +69,21 @@ class MaterializedViewDef:
 
 
 @dataclasses.dataclass
+class SinkDef:
+    """Reference: sink catalog entry (src/connector/src/sink/catalog/).
+    ``table_id`` is the log-store table; ``progress_table_id`` holds the
+    delivered-epoch/position row (stream/sink.py)."""
+
+    name: str
+    schema: Schema
+    connector: str
+    options: dict
+    from_name: str = ""
+    table_id: int = -1
+    progress_table_id: int = -1
+
+
+@dataclasses.dataclass
 class IndexDef:
     name: str
     table: str
@@ -84,6 +99,7 @@ class Catalog:
         self.sources: dict[str, SourceDef] = {}
         self.tables: dict[str, TableDef] = {}
         self.mvs: dict[str, MaterializedViewDef] = {}
+        self.sinks: dict[str, SinkDef] = {}
         self.indexes: dict[str, IndexDef] = {}
         # plain int (not itertools.count) so DDL can roll it back on failure:
         # a failed statement must not shift later statements' table ids or
@@ -97,7 +113,8 @@ class Catalog:
         return i
 
     def _check_free(self, name: str) -> None:
-        for reg in (self.sources, self.tables, self.mvs, self.indexes):
+        for reg in (self.sources, self.tables, self.mvs, self.sinks,
+                    self.indexes):
             if name in reg:
                 raise CatalogError(f"name {name!r} already in use")
 
@@ -117,6 +134,10 @@ class Catalog:
             mv.table_id = self.next_table_id()
         self.mvs[mv.name] = mv
 
+    def add_sink(self, s: SinkDef) -> None:
+        self._check_free(s.name)
+        self.sinks[s.name] = s
+
     def add_index(self, ix: IndexDef) -> None:
         self._check_free(ix.name)
         self.indexes[ix.name] = ix
@@ -134,7 +155,8 @@ class Catalog:
     def drop(self, kind: str, name: str, if_exists: bool = False) -> bool:
         reg = {
             "source": self.sources, "table": self.tables,
-            "materialized_view": self.mvs, "index": self.indexes,
+            "materialized_view": self.mvs, "sink": self.sinks,
+            "index": self.indexes,
         }[kind]
         if name not in reg:
             if if_exists:

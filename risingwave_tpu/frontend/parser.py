@@ -33,6 +33,7 @@ KEYWORDS = {
     "key", "watermark", "for", "interval", "asc", "desc", "nulls", "first",
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
+    "sink", "sinks",
 }
 
 
@@ -194,6 +195,18 @@ class Parser:
             self.expect_kw("as")
             q = self._select()
             return A.CreateMaterializedView(name, q, if_not_exists=ine)
+        if self.eat_kw("sink"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            from_name, q = None, None
+            if self.eat_kw("from"):
+                from_name = self.ident()
+            else:
+                self.expect_kw("as")
+                q = self._select()
+            opts = self._with_options()
+            return A.CreateSink(name, from_name=from_name, query=q,
+                                with_options=opts, if_not_exists=ine)
         if self.eat_kw("index"):
             ine = self._if_not_exists()
             name = self.ident()
@@ -256,7 +269,9 @@ class Parser:
         if self.eat_kw("with"):
             self.expect_op("(")
             while True:
-                k = self.ident()
+                # option keys may be quoted ('datagen.split.num' = 2)
+                k = (self.next().value if self.peek().kind == "str"
+                     else self.ident())
                 self.expect_op("=")
                 t = self.next()
                 opts[k] = t.value
@@ -272,6 +287,8 @@ class Parser:
             kind = "materialized_view"
         elif self.eat_kw("source"):
             kind = "source"
+        elif self.eat_kw("sink"):
+            kind = "sink"
         elif self.eat_kw("table"):
             kind = "table"
         elif self.eat_kw("index"):

@@ -40,7 +40,7 @@ from . import sqlast as A
 from .binder import BindError, ExprBinder, Scope
 from .build import BuildConfig, BuildContext, build_plan, collect_leaves
 from .catalog import (
-    Catalog, CatalogError, MaterializedViewDef, SourceDef, TableDef,
+    Catalog, CatalogError, MaterializedViewDef, SinkDef, SourceDef, TableDef,
     type_from_name,
 )
 from .parser import parse_sql
@@ -74,10 +74,21 @@ def _values_chunk(leaf: PValues) -> StreamChunk:
 
 @dataclasses.dataclass
 class _SourceFeed:
-    """A connector instance feeding one job's source leaf."""
+    """A connector instance feeding one job's source leaf.
+
+    ``reader`` + ``state_table`` carry the split-state checkpoint contract
+    (reference: source split state,
+    src/stream/src/executor/source/state_table_handler.rs): the session
+    records ``reader.offsets`` per injected epoch and persists the offsets
+    for each checkpoint epoch atomically with that epoch's state commit;
+    recovery seeks the reader before the first tick."""
 
     queue: QueueSource
     generator: Callable[[], Optional[StreamChunk]]
+    reader: Optional[Any] = None
+    state_table: Optional[StateTable] = None
+    offsets_at_epoch: dict = dataclasses.field(default_factory=dict)
+    job: str = ""          # owning stream job; feed dies with it on DROP
 
 
 class _RowIdAppendSource(Executor):
@@ -211,13 +222,14 @@ class Session:
                 if (self.data_dir is not None and not self._recovering
                         and isinstance(stmt, (
                             A.CreateSource, A.CreateTable,
-                            A.CreateMaterializedView, A.DropStatement))):
+                            A.CreateMaterializedView, A.CreateSink,
+                            A.DropStatement))):
                     self.store.log.log_ddl(piece)  # type: ignore[attr-defined]
         return out
 
     def _run_statement(self, stmt: A.Statement) -> list:
         if isinstance(stmt, (A.CreateSource, A.CreateTable,
-                             A.CreateMaterializedView)):
+                             A.CreateMaterializedView, A.CreateSink)):
             # transactional table-id allocation: a failed CREATE must not
             # shift later statements' ids (recovery replays only logged —
             # successful — DDL, so id assignment must be replay-deterministic)
@@ -227,6 +239,8 @@ class Session:
                     return self._create_source(stmt)
                 if isinstance(stmt, A.CreateTable):
                     return self._create_table(stmt)
+                if isinstance(stmt, A.CreateSink):
+                    return self._create_sink(stmt)
                 return self._create_mv(stmt)
             except BaseException:
                 self.catalog._next_table_id = saved_id
@@ -240,6 +254,7 @@ class Session:
         if isinstance(stmt, A.ShowStatement):
             reg = {"tables": self.catalog.tables,
                    "sources": self.catalog.sources,
+                   "sinks": self.catalog.sinks,
                    "materialized_views": self.catalog.mvs}.get(stmt.what)
             if reg is None:
                 raise SqlError(f"cannot SHOW {stmt.what}")
@@ -340,12 +355,12 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
-    def _create_mv(self, stmt: A.CreateMaterializedView) -> list:
-        if stmt.if_not_exists and stmt.name in self.catalog.mvs:
-            return []
-        self._drain_inflight()   # subscribe at a quiesced epoch boundary
-        self.catalog._check_free(stmt.name)   # fail BEFORE building executors
-        plan = Planner(self.catalog).plan_select(stmt.query)
+    def _build_query_pipeline(self, query: A.Select):
+        """Shared CREATE MV / CREATE SINK AS SELECT plumbing: plan, build
+        executors via the stream-leaf factory, collect session-driven
+        queues + their init feeds and (under recovery) the scan leaves
+        whose backfill may need re-running."""
+        plan = Planner(self.catalog).plan_select(query)
         queues: list[QueueSource] = []
         init_msgs: list[tuple[QueueSource, list[Message]]] = []
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
@@ -364,23 +379,36 @@ class Session:
         ctx = BuildContext(self.store, self.catalog.next_table_id, factory,
                            self.config, durable=True)
         pipeline = build_plan(plan, ctx)
+        return plan, pipeline, ctx, queues, init_msgs, scan_leaf_queues
+
+    def _maybe_rebackfill(self, state_tids, scan_leaf_queues) -> None:
+        """Recovery: the DDL log records a CREATE the moment it succeeds,
+        but its state first persists at the NEXT checkpoint. If we crashed
+        in that window the recovered state is empty — re-run the backfill
+        snapshot from the recovered upstream instead of trusting state
+        that never existed."""
+        if not self._recovering:
+            return
+        has_state = any(self.store.table_len(tid) > 0 for tid in state_tids)
+        if not has_state:
+            for init, up_job in scan_leaf_queues:
+                init.extend(up_job.snapshot_messages(
+                    Barrier.new(self.epoch), self.source_chunk_capacity))
+
+    def _create_mv(self, stmt: A.CreateMaterializedView) -> list:
+        if stmt.if_not_exists and stmt.name in self.catalog.mvs:
+            return []
+        self._drain_inflight()   # subscribe at a quiesced epoch boundary
+        self.catalog._check_free(stmt.name)   # fail BEFORE building executors
+        n_feeds0 = len(self.feeds)
+        (plan, pipeline, ctx, queues, init_msgs,
+         scan_leaf_queues) = self._build_query_pipeline(stmt.query)
         mv_table_id = self.catalog.next_table_id()
         mat = MaterializeExecutor(
             pipeline,
             StateTable(self.store, mv_table_id, plan.schema, list(plan.pk)))
-        if self._recovering:
-            # the DDL log records a CREATE MV the moment it succeeds, but its
-            # state first persists at the NEXT checkpoint. If we crashed in
-            # that window the recovered MV state is empty — re-run the
-            # backfill snapshot from the recovered upstream instead of
-            # trusting state that never existed.
-            has_state = (self.store.table_len(mv_table_id) > 0 or any(
-                self.store.table_len(tid) > 0
-                for tid in ctx.state_table_ids))
-            if not has_state:
-                for init, up_job in scan_leaf_queues:
-                    init.extend(up_job.snapshot_messages(
-                        Barrier.new(self.epoch), self.source_chunk_capacity))
+        self._maybe_rebackfill((mv_table_id,) + tuple(ctx.state_table_ids),
+                               scan_leaf_queues)
         n_visible = sum(1 for f in plan.schema if not f.name.startswith("_"))
         mv = MaterializedViewDef(
             stmt.name, plan.schema, tuple(plan.pk), table_id=mv_table_id,
@@ -388,6 +416,8 @@ class Session:
         mv.n_visible = n_visible  # type: ignore[attr-defined]
         mv.state_table_ids = tuple(ctx.state_table_ids)  # type: ignore[attr-defined]
         self.catalog.add_mv(mv)
+        for f in self.feeds[n_feeds0:]:
+            f.job = stmt.name
         job = StreamJob(stmt.name, mat, queues)
         self.jobs[stmt.name] = job
         job.start(self.loop)
@@ -402,16 +432,117 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    def _create_sink(self, stmt: A.CreateSink) -> list:
+        """CREATE SINK: a stream job whose terminal is a SinkExecutor over
+        a log store instead of a MaterializeExecutor (reference:
+        src/stream/src/executor/sink.rs:38; log store
+        common/log_store/mod.rs:57-168)."""
+        if stmt.if_not_exists and stmt.name in self.catalog.sinks:
+            return []
+        self._drain_inflight()
+        self.catalog._check_free(stmt.name)
+        from ..connector.sinks import build_sink
+        from ..stream.sink import PROGRESS_SCHEMA, SinkExecutor, log_table_schema
+        connector = str(stmt.with_options.get("connector", "blackhole"))
+        n_feeds0 = len(self.feeds)
+        scan_leaf_queues: list[tuple[list, StreamJob]] = []
+        ctx_tids: tuple = ()
+        if stmt.from_name is not None:
+            kind, obj = self.catalog.resolve_relation(stmt.from_name)
+            if kind == "source":
+                raise SqlError("CREATE SINK FROM a source is not supported; "
+                               "use CREATE SINK ... AS SELECT")
+            up_job = self.jobs[stmt.from_name]
+            q = QueueSource(obj.schema)
+            up_job.bus.subscribe(q)
+            pipeline: Executor = q
+            schema = obj.schema
+            # visible = non-hidden columns (pk-less tables carry _row_id)
+            n_visible = getattr(
+                obj, "n_visible",
+                sum(1 for f in schema if not f.name.startswith("_")))
+            queues = [q]
+            init_msgs = [(q, [])]   # snapshot decided after tid allocation
+            scan_leaf_queues.append((init_msgs[0][1], up_job))
+        else:
+            (plan, pipeline, ctx, queues, init_msgs,
+             scan_leaf_queues) = self._build_query_pipeline(stmt.query)
+            ctx_tids = tuple(ctx.state_table_ids)
+            schema = plan.schema
+            n_visible = sum(1 for f in schema if not f.name.startswith("_"))
+        log_tid = self.catalog.next_table_id()
+        prog_tid = self.catalog.next_table_id()
+        if stmt.from_name is not None and not self._recovering:
+            init_msgs[0][1].extend(up_job.snapshot_messages(
+                Barrier.new(self.epoch), self.source_chunk_capacity))
+        # recovery in the created-but-never-checkpointed window: state
+        # tables (incl. the sink's own log/progress) are all empty — re-run
+        # the backfill snapshot (same rule as MVs)
+        self._maybe_rebackfill(ctx_tids + (log_tid, prog_tid),
+                               scan_leaf_queues)
+        visible_schema = Schema(tuple(schema)[:n_visible])
+        sink = build_sink(connector, dict(stmt.with_options), visible_schema)
+        ex = SinkExecutor(
+            pipeline, sink,
+            StateTable(self.store, log_tid, log_table_schema(schema), [0, 1]),
+            StateTable(self.store, prog_tid, PROGRESS_SCHEMA, [0]),
+            n_visible=n_visible, recovering=self._recovering)
+        sdef = SinkDef(stmt.name, schema, connector, dict(stmt.with_options),
+                       from_name=stmt.from_name or "", table_id=log_tid,
+                       progress_table_id=prog_tid)
+        sdef.state_table_ids = ctx_tids + (prog_tid,)  # type: ignore[attr-defined]
+        self.catalog.add_sink(sdef)
+        for f in self.feeds[n_feeds0:]:
+            f.job = stmt.name
+        job = StreamJob(stmt.name, ex, queues)
+        self.jobs[stmt.name] = job
+        job.start(self.loop)
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+        for q, init in init_msgs:
+            for m in init:
+                q.push(m)
+            q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def sink_of(self, name: str):
+        """The live Sink instance of a sink job (inspection/testing)."""
+        job = self.jobs.get(name)
+        return getattr(job.pipeline, "sink", None) if job else None
+
     def _stream_leaf(self, leaf):
         """-> (executor, session_driven_queue_or_None, init_messages)"""
         if isinstance(leaf, PSource):
             src_def = leaf.source
             q = QueueSource(src_def.schema)
-            gen = self._connector_generator(src_def)
-            self.feeds.append(_SourceFeed(q, gen))
+            reader = self._connector_reader(src_def)
+            start_seq = 0
+            if reader is None:
+                self.feeds.append(_SourceFeed(q, lambda: None))
+            else:
+                # split-state table: (split_id, next_offset), persisted on
+                # checkpoint epochs, sought on recovery
+                from ..common.types import INT64, VARCHAR
+                st = StateTable(
+                    self.store, self.catalog.next_table_id(),
+                    Schema((Field("split_id", VARCHAR),
+                            Field("next_offset", INT64))), [0])
+                if self._recovering:
+                    offsets = {
+                        VARCHAR.to_python(r[0]): int(r[1])
+                        for r in st.scan_all()}
+                    if offsets:
+                        reader.seek(offsets)
+                        # row ids must continue above any id assigned
+                        # before the crash (pk collisions in downstream
+                        # materialized state otherwise)
+                        start_seq = reader.rows_emitted()
+                self.feeds.append(_SourceFeed(
+                    q, reader.next_chunk, reader=reader, state_table=st))
             ex: Executor = _RowIdAppendSource(q, leaf.schema)
             ex = RowIdGenExecutor(ex, row_id_index=leaf.row_id_index,
-                                  shard_id=self._alloc_shard())
+                                  shard_id=self._alloc_shard(),
+                                  start_seq=start_seq)
             if src_def.watermark is not None:
                 col, delay = src_def.watermark
                 ex = WatermarkFilterExecutor(ex, time_col=col, delay=delay)
@@ -437,20 +568,35 @@ class Session:
             return q, q, [chunk]
         raise PlanError(f"cannot stream {type(leaf).__name__}")
 
-    def _connector_generator(self, src: SourceDef):
+    def _connector_reader(self, src: SourceDef):
+        """Instantiate the connector's SplitReader (reference:
+        SplitReaderImpl dispatch, src/connector/src/source/base.rs:326);
+        None for declared-schema sources fed only by tests."""
         if src.connector == "nexmark":
+            from ..connector.nexmark_split import NexmarkReader
             table = str(src.options.get("nexmark_table",
                                         src.options.get("table", "bid"))).lower()
             rate = src.options.get("rows_per_chunk")
             cap = int(rate) if rate else self.source_chunk_capacity
-            gen = NexmarkGenerator(NexmarkConfig(chunk_capacity=cap),
-                                   seed=self.seed)
-            fn = {"bid": gen.next_bid_chunk,
-                  "auction": gen.next_auction_chunk,
-                  "person": gen.next_person_chunk}[table]
-            return lambda: fn()
-        if src.connector in ("", "datagen"):
-            return lambda: None
+            return NexmarkReader(table, chunk_capacity=cap, seed=self.seed)
+        if src.connector == "datagen":
+            from ..connector.datagen import DatagenReader
+            opts = dict(src.options)
+            opts.setdefault("datagen.rows.per.chunk",
+                            opts.get("rows_per_chunk",
+                                     self.source_chunk_capacity))
+            return DatagenReader(src.schema, opts)
+        if src.connector in ("file", "posix_fs", "fs"):
+            from ..connector.filesource import FileSourceReader
+            path = src.options.get("path", src.options.get("posix_fs.root"))
+            if not path:
+                raise SqlError("file source requires path option")
+            return FileSourceReader(
+                src.schema, str(path),
+                fmt=str(src.options.get("format", "jsonl")),
+                rows_per_chunk=self.source_chunk_capacity)
+        if src.connector == "":
+            return None
         raise SqlError(f"unsupported connector {src.connector!r}")
 
     def _drop(self, stmt: A.DropStatement) -> list:
@@ -458,11 +604,25 @@ class Session:
         # free the object's durable state (tombstoned in the manifest so
         # recovery and compaction skip it)
         obj = (self.catalog.tables.get(stmt.name)
-               or self.catalog.mvs.get(stmt.name))
+               or self.catalog.mvs.get(stmt.name)
+               or self.catalog.sinks.get(stmt.name))
         existed = self.catalog.drop(stmt.kind, stmt.name, stmt.if_exists)
         if existed and stmt.name in self.jobs:
             job = self.jobs.pop(stmt.name)
+            sink = getattr(job.pipeline, "sink", None)
+            if sink is not None:
+                sink.close()
             self._await(job.stop())
+        if existed:
+            # the job's source feeds die with it: stop generating, free
+            # their split-state tables
+            live, dead = [], []
+            for f in self.feeds:
+                (dead if f.job == stmt.name else live).append(f)
+            self.feeds = live
+            for f in dead:
+                if f.state_table is not None:
+                    self.store.drop_table(f.state_table.table_id)
         if existed and obj is not None:
             for tid in ((obj.table_id,)
                         + tuple(getattr(obj, "state_table_ids", ()))):
@@ -527,6 +687,8 @@ class Session:
                     q.push(c)
             chunks.clear()
         for feed in self.feeds:
+            if feed.reader is not None:
+                feed.offsets_at_epoch[epoch] = feed.reader.offsets
             feed.queue.push(barrier)
         for queues in self._table_queues.values():
             for q in queues:
@@ -543,6 +705,21 @@ class Session:
         e, ckpt = self._inflight.pop(0)
         self._await(self._collect_barrier(e))
         if ckpt:
+            # persist source split offsets atomically with the epoch commit
+            # (reference: split state committed with the checkpoint barrier)
+            from ..common.types import VARCHAR
+            for feed in self.feeds:
+                if feed.state_table is None:
+                    continue
+                latest = None
+                for oe in sorted(list(feed.offsets_at_epoch)):
+                    if oe <= e:
+                        latest = feed.offsets_at_epoch.pop(oe)
+                if latest is not None:
+                    for sid, off in latest.items():
+                        feed.state_table.insert(
+                            (VARCHAR.to_physical(sid), int(off)))
+                    feed.state_table.commit(e)
             self.store.commit(e)
         import time as _time
         t0 = self._inject_time.pop(e, None)

@@ -199,6 +199,17 @@ class CreateMaterializedView:
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateSink:
+    """CREATE SINK name FROM upstream | AS SELECT … WITH (connector=…)."""
+
+    name: str
+    from_name: Optional[str] = None
+    query: Optional[Select] = None
+    with_options: dict = dataclasses.field(default_factory=dict)
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateIndex:
     name: str
     table: str
@@ -237,6 +248,6 @@ class FlushStatement:
     pass
 
 
-Statement = Union[CreateSource, CreateTable, CreateMaterializedView,
+Statement = Union[CreateSink, CreateSource, CreateTable, CreateMaterializedView,
                   CreateIndex, DropStatement, Insert, Query, ShowStatement,
                   FlushStatement]

@@ -1,0 +1,120 @@
+"""SinkExecutor + log store: exactly-once changelog delivery.
+
+Counterpart of the reference's SinkExecutor with its LogStore decoupling
+(reference: src/stream/src/executor/sink.rs:38;
+src/stream/src/common/log_store/mod.rs:57-168 — LogWriter buffers the
+epoch's chunks, LogReader delivers them to the external system and
+*truncates* up to the delivered offset). Here both halves run in one host
+loop per barrier; the log lives in a StateTable keyed (epoch, seq) so it
+shares the state store's atomic epoch commit:
+
+  on chunk      — buffer rows (host decode; sinks are host IO anyway)
+  on barrier e  — append buffered rows to the log table,
+                  deliver log rows up to e to the sink,
+                  record (delivered_epoch, sink position) in the progress
+                  table, truncate delivered log rows; all three writes
+                  commit atomically with epoch e.
+
+Exactly-once across crashes: the sink's byte/row position is persisted in
+the SAME epoch commit as the log truncation. After a crash the executor
+rolls the sink back to the last committed position (FileSink.truncate_to),
+and undelivered log rows (still present — their truncation never
+committed) are re-delivered. Delivered-but-uncommitted bytes are exactly
+the truncated tail.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.chunk import StreamChunk, chunk_to_rows
+from ..common.types import INT64, Field, Schema
+from ..connector.sinks import Sink
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+from .message import Barrier
+
+
+def log_table_schema(value_schema: Schema) -> Schema:
+    """(epoch, seq, op) ⧺ row values; pk = (epoch, seq) so iteration order
+    is delivery order (reference: KvLogStore key layout)."""
+    head = (Field("_epoch", INT64), Field("_seq", INT64), Field("_op", INT64))
+    return Schema(head + tuple(value_schema))
+
+
+PROGRESS_SCHEMA = Schema((Field("_id", INT64), Field("_delivered_epoch", INT64),
+                          Field("_position", INT64)))
+
+
+class SinkExecutor(SingleInputExecutor):
+    identity = "Sink"
+
+    def __init__(self, input: Executor, sink: Sink,
+                 log_table: StateTable, progress_table: StateTable,
+                 n_visible: Optional[int] = None, recovering: bool = False):
+        super().__init__(input)
+        self.schema = input.schema
+        self.n_visible = len(self.schema) if n_visible is None else n_visible
+        self._recovering = recovering
+        self.sink = sink
+        self.log = log_table
+        self.progress = progress_table
+        # sink jobs are StreamJobs; .table is the job's "output" table —
+        # for a sink that is its progress table (scanned by nothing, but
+        # keeps the job protocol uniform)
+        self.table = progress_table
+        self._pending: list[tuple[int, tuple]] = []
+        self._seq = 0
+        self.delivered_epoch = 0
+        self._recover()
+
+    def _recover(self) -> None:
+        row = self.progress.get_row((0,))
+        if row is not None:
+            self.delivered_epoch = int(row[1])
+            self.sink.truncate_to(int(row[2]))
+        elif self._recovering:
+            # crashed before the first progress row durably committed:
+            # anything already delivered is phantom output — roll the sink
+            # back to empty (the committed position is 0)
+            self.sink.truncate_to(0)
+        # seq continues above any undelivered log rows
+        seqs = [int(r[1]) for r in self.log.scan_all()]
+        self._seq = max(seqs) + 1 if seqs else 0
+
+    async def map_chunk(self, chunk: StreamChunk):
+        self._pending.extend(
+            chunk_to_rows(chunk, self.schema, with_ops=True, physical=True))
+        yield chunk
+
+    async def on_barrier(self, barrier: Barrier):
+        epoch = barrier.epoch.curr
+        for op, values in self._pending:
+            self.log.insert((epoch, self._seq, int(op)) + tuple(values))
+            self._seq += 1
+        self._pending.clear()
+        # deliver everything logged through this epoch, oldest first
+        to_deliver = []
+        for row in self.log.scan_all():
+            if int(row[0]) <= epoch:
+                to_deliver.append(row)
+        if to_deliver or self.delivered_epoch < epoch:
+            typed = [(int(r[2]), tuple(
+                None if v is None else self.schema[i].type.to_python(v)
+                for i, v in enumerate(r[3:3 + self.n_visible])))
+                for r in to_deliver]
+            self.sink.write_rows(typed)
+            self.sink.flush()
+            for r in to_deliver:
+                self.log.delete(r)
+            self.delivered_epoch = epoch
+            old = self.progress.get_row((0,))
+            new = (0, epoch, int(self.sink.position()))
+            if old is not None:
+                self.progress.update(old, new)
+            else:
+                self.progress.insert(new)
+        self.log.commit(epoch)
+        self.progress.commit(epoch)
+        if False:  # pragma: no cover - async generator shape
+            yield

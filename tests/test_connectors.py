@@ -1,0 +1,308 @@
+"""Source connector framework + sinks (VERDICT r2 item 6).
+
+Covers: datagen split reader determinism + seek, format parsers, file
+source offsets, CREATE SINK (blackhole + file) e2e, split-state recovery
+(source offsets survive a crash), and file-sink exactly-once across a real
+process kill.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from risingwave_tpu.common.chunk import chunk_to_rows
+from risingwave_tpu.common.types import (
+    INT64, FLOAT64, VARCHAR, Field, Schema,
+)
+from risingwave_tpu.connector.datagen import DatagenReader
+from risingwave_tpu.connector.filesource import FileSourceReader
+from risingwave_tpu.connector.parsers import parse_csv_lines, parse_json_lines
+from risingwave_tpu.frontend import Session
+
+SCHEMA = Schema((Field("k", INT64), Field("x", FLOAT64)))
+
+
+def _rows(reader, chunk):
+    return chunk_to_rows(chunk, reader.schema)
+
+
+class TestDatagen:
+    def test_sequence_and_seek_determinism(self):
+        opts = {"datagen.split.num": 2, "datagen.rows.per.chunk": 4}
+        r1 = DatagenReader(SCHEMA, opts)
+        first = _rows(r1, r1.next_chunk())
+        mark = r1.offsets
+        rest = [_rows(r1, r1.next_chunk()) for _ in range(3)]
+
+        r2 = DatagenReader(SCHEMA, opts)
+        r2.seek(mark)
+        rest2 = [_rows(r2, r2.next_chunk()) for _ in range(3)]
+        assert rest == rest2
+        # sequence fields interleave across splits: union is contiguous
+        allk = sorted(r[0] for rows in [first] + rest for r in rows)
+        assert allk == list(range(len(allk)))
+
+    def test_bounded(self):
+        r = DatagenReader(SCHEMA, {"datagen.rows.per.chunk": 4,
+                                   "datagen.max.rows": 10})
+        total = 0
+        while (c := r.next_chunk()) is not None:
+            total += len(_rows(r, c))
+        assert total == 10
+        assert r.next_chunk() is None
+
+
+class TestParsers:
+    def test_json(self):
+        text = '{"k": 1, "x": 2.5}\n\n{"x": 1.0, "k": 2, "junk": 9}\n{"k": 3}'
+        rows = parse_json_lines(text, SCHEMA)
+        assert rows == [(1, 2.5), (2, 1.0), (3, None)]
+
+    def test_csv(self):
+        text = "x,k\n2.5,1\n,2"
+        assert parse_csv_lines(text, SCHEMA) == [(1, 2.5), (2, None)]
+        text2 = "1,2.5\n2,"
+        assert parse_csv_lines(text2, SCHEMA, has_header=False) == \
+            [(1, 2.5), (2, None)]
+
+
+class TestFileSource:
+    def test_jsonl_offsets_and_growth(self, tmp_path):
+        p = tmp_path / "events.jsonl"
+        p.write_text("\n".join(json.dumps({"k": i, "x": i * 0.5})
+                               for i in range(5)))
+        r = FileSourceReader(SCHEMA, str(p), rows_per_chunk=3)
+        c1 = _rows(r, r.next_chunk())
+        assert [row[0] for row in c1] == [0, 1, 2]
+        assert r.offsets[str(p)] == 3
+        c2 = _rows(r, r.next_chunk())
+        assert [row[0] for row in c2] == [3, 4]
+        assert r.next_chunk() is None
+        # appended lines are picked up from the stored offset
+        with open(p, "a") as f:
+            f.write("\n" + json.dumps({"k": 99, "x": 0.0}))
+        c3 = _rows(r, r.next_chunk())
+        assert [row[0] for row in c3] == [99]
+
+
+class TestSinkSql:
+    def test_blackhole_sink_from_table(self):
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE SINK snk FROM t WITH (connector = 'blackhole')")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        sink = s.sink_of("snk")
+        assert sink.rows_written == 2
+        assert s.run_sql("SHOW SINKS") == [("snk",)]
+        s.run_sql("DROP SINK snk")
+        assert s.run_sql("SHOW SINKS") == []
+
+    def test_file_sink_changelog(self, tmp_path):
+        out = tmp_path / "out.jsonl"
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v * 2 AS d FROM t")
+        s.run_sql(f"CREATE SINK snk FROM m WITH (connector = 'file', "
+                  f"path = '{out}')")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        inserts = [(l["k"], l["d"]) for l in lines if l["__op"] == "insert"]
+        assert sorted(inserts) == [(1, 20), (2, 40)]
+
+    def test_sink_as_select(self, tmp_path):
+        out = tmp_path / "sel.jsonl"
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1, 5), (2, 50)")
+        s.flush()
+        s.run_sql(f"CREATE SINK snk AS SELECT k FROM t WHERE v > 10 "
+                  f"WITH (connector = 'file', path = '{out}')")
+        s.flush()
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert [(l["k"], l["__op"]) for l in lines] == [(2, "insert")]
+
+
+class TestDatagenSourceSql:
+    def test_datagen_source_mv(self):
+        s = Session(source_chunk_capacity=8)
+        s.run_sql("""CREATE SOURCE g (k BIGINT, x DOUBLE)
+                     WITH (connector = 'datagen',
+                           'datagen.rows.per.chunk' = 8)""")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM g")
+        for _ in range(3):
+            s.tick()
+        rows = sorted(r[0] for r in s.mv_rows("m"))
+        assert rows == list(range(len(rows)))
+        assert len(rows) >= 8
+
+
+def _run_child(code: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestReviewRegressions:
+    def test_sink_from_pkless_table_hides_row_id(self, tmp_path):
+        out = tmp_path / "o.jsonl"
+        s = Session()
+        s.run_sql("CREATE TABLE t (a BIGINT)")   # hidden _row_id pk
+        s.run_sql(f"CREATE SINK snk FROM t WITH (connector='file', "
+                  f"path='{out}')")
+        s.run_sql("INSERT INTO t VALUES (7)")
+        s.flush()
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert lines and all("_row_id" not in l for l in lines)
+
+    def test_drop_mv_stops_feed_and_frees_split_state(self):
+        s = Session(source_chunk_capacity=4)
+        s.run_sql("""CREATE SOURCE g (k BIGINT)
+                     WITH (connector='datagen',
+                           'datagen.rows.per.chunk'=4)""")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM g")
+        s.tick()
+        assert len(s.feeds) == 1
+        tid = s.feeds[0].state_table.table_id
+        s.run_sql("DROP MATERIALIZED VIEW m")
+        assert s.feeds == []
+        assert s.store.table_len(tid) == 0
+        s.tick()   # no dangling queue/readers
+
+    def test_phantom_sink_output_truncated_on_recovery(self, tmp_path):
+        """Crash after a delivery but before ANY progress row committed:
+        the delivered bytes are phantom output and must be rolled back."""
+        d = str(tmp_path / "db")
+        out = str(tmp_path / "o.jsonl")
+        child = textwrap.dedent(f"""
+            import os
+            from risingwave_tpu.frontend import Session
+            s = Session(data_dir={d!r}, checkpoint_frequency=100)
+            s.run_sql("CREATE TABLE t (a BIGINT PRIMARY KEY)")
+            s.run_sql("CREATE SINK snk FROM t WITH (connector='file', "
+                      "path='{out}')")
+            s.run_sql("INSERT INTO t VALUES (1)")
+            s.tick(checkpoint=False)   # delivers without durability
+            s._drain_inflight()
+            assert open({out!r}).read().strip(), "file should have bytes"
+            os._exit(0)
+        """)
+        res = _run_child(child)
+        assert res.returncode == 0, res.stderr[-2000:]
+        s = Session(data_dir=d, checkpoint_frequency=100)
+        # recovered table is empty (nothing checkpointed) → sink empty too
+        assert s.run_sql("SELECT * FROM t") == []
+        assert open(out).read() == ""
+
+    def test_sink_as_select_agg_recovers_in_window(self, tmp_path):
+        """Crash between CREATE SINK AS SELECT count(*) and its first
+        checkpoint: recovery must re-backfill, not restart from zero."""
+        d = str(tmp_path / "db")
+        out = str(tmp_path / "o.jsonl")
+        child = textwrap.dedent(f"""
+            import os
+            from risingwave_tpu.frontend import Session
+            s = Session(data_dir={d!r})
+            s.run_sql("CREATE TABLE t (a BIGINT PRIMARY KEY)")
+            s.run_sql("INSERT INTO t VALUES (1), (2), (3)")
+            s.flush()                  # rows durable
+            s.run_sql("CREATE SINK snk AS SELECT count(*) AS n FROM t "
+                      "WITH (connector='file', path='{out}')")
+            os._exit(0)                # before any checkpoint of snk state
+        """)
+        res = _run_child(child)
+        assert res.returncode == 0, res.stderr[-2000:]
+        s = Session(data_dir=d)
+        s.run_sql("INSERT INTO t VALUES (4)")
+        s.flush()
+        lines = [json.loads(l) for l in open(out).read().splitlines()]
+        # fold the changelog: final count must be 4 (3 backfilled + 1)
+        final = None
+        for l in lines:
+            if l["__op"] in ("insert", "update_insert"):
+                final = l["n"]
+        assert final == 4
+
+
+class TestCrashRecovery:
+    def test_split_state_resumes_after_kill(self, tmp_path):
+        """Source offsets persisted at checkpoints are sought on recovery:
+        the MV keeps extending the sequence with no duplicates/gaps."""
+        d = str(tmp_path / "db")
+        child = textwrap.dedent(f"""
+            import os
+            from risingwave_tpu.frontend import Session
+            s = Session(data_dir={d!r}, source_chunk_capacity=4,
+                        checkpoint_frequency=1)
+            s.run_sql('''CREATE SOURCE g (k BIGINT)
+                         WITH (connector = 'datagen',
+                               'datagen.rows.per.chunk' = 4)''')
+            s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM g")
+            for _ in range(3):
+                s.tick()          # every tick checkpoints
+            s._drain_inflight()
+            print(len(s.mv_rows("m")))
+            os._exit(0)           # no graceful shutdown
+        """)
+        res = _run_child(child)
+        assert res.returncode == 0, res.stderr[-2000:]
+        n_before = int(res.stdout.strip().splitlines()[-1])
+        assert n_before == 12
+
+        s = Session(data_dir=d, source_chunk_capacity=4,
+                    checkpoint_frequency=1)
+        rows = sorted(r[0] for r in s.mv_rows("m"))
+        assert rows == list(range(n_before))
+        for _ in range(2):
+            s.tick()
+        rows = sorted(r[0] for r in s.mv_rows("m"))
+        # resumed exactly where it left off: still contiguous, no dups
+        assert rows == list(range(len(rows)))
+        assert len(rows) == n_before + 8
+
+    def test_file_sink_exactly_once_across_kill(self, tmp_path):
+        """Kill between checkpoints: delivered-but-uncommitted sink bytes
+        are truncated on recovery and re-delivered exactly once."""
+        d = str(tmp_path / "db")
+        out = str(tmp_path / "out.jsonl")
+        child = textwrap.dedent(f"""
+            import os
+            from risingwave_tpu.frontend import Session
+            s = Session(data_dir={d!r}, source_chunk_capacity=4,
+                        checkpoint_frequency=2)
+            s.run_sql('''CREATE SOURCE g (k BIGINT)
+                         WITH (connector = 'datagen',
+                               'datagen.rows.per.chunk' = 4)''')
+            s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM g")
+            s.run_sql("CREATE SINK snk FROM m WITH (connector='file', "
+                      "path='{out}')")
+            s.flush()
+            for _ in range(5):
+                s.tick()          # epochs 2..: ckpt every 2nd
+            s._drain_inflight()
+            os._exit(0)           # die with non-checkpointed deliveries
+        """)
+        res = _run_child(child)
+        assert res.returncode == 0, res.stderr[-2000:]
+
+        s = Session(data_dir=d, source_chunk_capacity=4,
+                    checkpoint_frequency=2)
+        for _ in range(2):
+            s.tick()
+        s.flush()
+        lines = [json.loads(l) for l in open(out).read().splitlines()]
+        ks = [l["k"] for l in lines if l["__op"] == "insert"]
+        # exactly-once: every k delivered once, contiguous from 0
+        assert len(ks) == len(set(ks))
+        assert sorted(ks) == list(range(len(ks)))
+        assert len(ks) == len(s.mv_rows("m"))
