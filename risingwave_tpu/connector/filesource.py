@@ -94,7 +94,12 @@ class FileSourceReader(SplitReader):
             body = lines[start:start + self.rows_per_chunk]
             rows = []
             for ln in body:
-                r = parse_json_line(ln, self.schema)
+                try:
+                    r = parse_json_line(ln, self.schema)
+                except (ValueError, TypeError):
+                    # malformed line: skip it but still advance the offset
+                    # — a poisoned line must not wedge the whole source
+                    continue
                 if r is not None:
                     rows.append(r)
         if body:

@@ -70,8 +70,12 @@ class FileSink(Sink):
 
     def _encode(self, op: int, values: tuple) -> str:
         if self.fmt == "csv":
-            vals = ",".join("" if v is None else str(v) for v in values)
-            return f"{_OP_NAMES[op]},{vals}\n"
+            import csv as _csv
+            import io as _io
+            buf = _io.StringIO()
+            _csv.writer(buf, lineterminator="\n").writerow(
+                [_OP_NAMES[op]] + ["" if v is None else v for v in values])
+            return buf.getvalue()
         obj = {f.name: v for f, v in zip(self.schema, values)}
         obj["__op"] = _OP_NAMES[op]
         return json.dumps(obj, default=str) + "\n"

@@ -79,6 +79,9 @@ _BINOP_FN = {
 
 AGG_KINDS = {"count", "sum", "min", "max", "avg"}
 
+RANK_FUNC_KINDS = {"row_number", "rank", "dense_rank"}
+WINDOW_ONLY_KINDS = RANK_FUNC_KINDS | {"lag", "lead"}
+
 
 @dataclasses.dataclass
 class BoundAgg:
@@ -88,15 +91,43 @@ class BoundAgg:
     output_index: int     # index in the agg operator's output (after keys)
 
 
+@dataclasses.dataclass
+class BoundWindow:
+    """A window function call found during binding (planner turns the set
+    of these into one POverWindow node; all calls must share the same
+    PARTITION BY / ORDER BY)."""
+
+    kind: str
+    output_type: DataType
+    arg_expr: Optional[Expr]           # lag/lead/agg argument
+    offset: int                        # lag/lead distance
+    partition_exprs: tuple             # Expr...
+    order_exprs: tuple                 # (Expr, desc, nulls_last)...
+
+
+def _const_int(e: Expr) -> Optional[int]:
+    """Constant-fold an integer literal (incl. unary minus)."""
+    from ..expr.expr import FunctionCall
+    if isinstance(e, Literal) and e.value is not None:
+        return int(e.value)
+    if (isinstance(e, FunctionCall) and e.name == "neg"
+            and len(e.args) == 1 and isinstance(e.args[0], Literal)
+            and e.args[0].value is not None):
+        return -int(e.args[0].value)
+    return None
+
+
 class ExprBinder:
     """Binds one expression tree. ``agg_ctx`` non-None => aggregate calls are
     allowed and collected (SELECT/HAVING position in a GROUP BY query)."""
 
     def __init__(self, scope: Scope, agg_ctx: Optional[list] = None,
-                 subquery_sink: Optional[list] = None):
+                 subquery_sink: Optional[list] = None,
+                 win_ctx: Optional[list] = None):
         self.scope = scope
         self.agg_ctx = agg_ctx
         self.subquery_sink = subquery_sink
+        self.win_ctx = win_ctx
 
     def bind(self, node) -> Expr:
         if isinstance(node, A.ColumnRef):
@@ -140,6 +171,8 @@ class ExprBinder:
             return call(fn, self.bind(node.expr))
         if isinstance(node, A.Cast):
             return cast(self.bind(node.expr), type_from_name(node.type_name))
+        if isinstance(node, A.WindowFunc):
+            return self._bind_window(node)
         if isinstance(node, A.ScalarSubquery):
             if self.subquery_sink is None:
                 raise BindError("scalar subquery not supported here")
@@ -172,12 +205,66 @@ class ExprBinder:
 
     def _func(self, node: A.FuncCall) -> Expr:
         name = node.name.lower()
+        if name in WINDOW_ONLY_KINDS:
+            raise BindError(f"{name}() requires an OVER clause")
+        from ..stream.project_set import TABLE_FUNC_KINDS, TableFuncCall
+        if name in TABLE_FUNC_KINDS:
+            args = tuple(self.bind(a) for a in node.args)
+            return TableFuncCall(name, args, INT64)
         if name in AGG_KINDS:
             if self.agg_ctx is None:
                 raise BindError(f"aggregate {name}() not allowed here")
             return self._bind_agg(name, node)
         args = [self.bind(a) for a in node.args]
         return call(name, *args)
+
+    def _bind_window(self, node: A.WindowFunc) -> Expr:
+        if self.win_ctx is None:
+            raise BindError("window functions are not allowed here")
+        kind = node.func.name.lower()
+        if kind not in WINDOW_ONLY_KINDS | AGG_KINDS:
+            raise BindError(f"{kind}() is not a window function")
+        plain = ExprBinder(self.scope)
+        args = [plain.bind(a) for a in node.func.args
+                if not isinstance(a, A.Star)]
+        arg_expr: Optional[Expr] = None
+        offset = 1
+        if kind in RANK_FUNC_KINDS:
+            if args:
+                raise BindError(f"{kind}() takes no arguments")
+            out_t = INT64
+        elif kind in ("lag", "lead"):
+            if not 1 <= len(args) <= 2:
+                raise BindError(f"{kind}(value [, offset]) expected")
+            arg_expr = args[0]
+            if len(args) == 2:
+                off = _const_int(args[1])
+                if off is None:
+                    raise BindError(f"{kind}() offset must be a literal")
+                if off < 0:
+                    raise BindError(
+                        f"{kind}() offset must be non-negative")
+                offset = off
+            out_t = arg_expr.type
+        else:   # windowed aggregate
+            if kind == "count" and not args:
+                out_t = INT64
+            else:
+                if len(args) != 1:
+                    raise BindError(f"{kind}() takes one argument")
+                arg_expr = args[0]
+                if arg_expr.type.is_string and kind != "count":
+                    raise BindError(
+                        f"window {kind}() over varchar is unsupported")
+                out_t = AggCall(kind, -1, arg_expr.type).output_type
+        partition = tuple(plain.bind(p) for p in node.partition_by)
+        order = tuple(
+            (plain.bind(oi.expr), oi.desc,
+             oi.nulls_last if oi.nulls_last is not None else not oi.desc)
+            for oi in node.order_by)
+        bw = BoundWindow(kind, out_t, arg_expr, offset, partition, order)
+        self.win_ctx.append(bw)
+        return _WindowPlaceholder(len(self.win_ctx) - 1, out_t)
 
     def _bind_agg(self, kind: str, node: A.FuncCall) -> Expr:
         if len(node.args) > 1:
@@ -215,6 +302,18 @@ class _AggPlaceholder(Expr):
 
     def eval(self, chunk):  # pragma: no cover
         raise RuntimeError("unresolved aggregate placeholder")
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class _WindowPlaceholder(Expr):
+    """Stands for 'output of window call #i'; the planner rewrites it to an
+    InputRef over the over-window operator's output schema."""
+
+    win_index: int
+    type: DataType
+
+    def eval(self, chunk):  # pragma: no cover
+        raise RuntimeError("unresolved window placeholder")
 
 
 @dataclasses.dataclass(frozen=True, eq=False)

@@ -170,6 +170,39 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             table_capacity=cfg.topn_table_capacity,
             out_capacity=cfg.chunk_capacity)
 
+    if isinstance(plan, P.POverWindow):
+        from ..stream.over_window import (
+            EowcOverWindowExecutor, OverWindowExecutor, eowc_acc_schema,
+        )
+        inp = build_plan(plan.input, ctx)
+        in_schema = plan.input.schema
+        pk = list(plan.input.pk)
+        if plan.eowc:
+            order_col = plan.calls[0].order_by[0].col
+            sort_st = ctx.state_table(in_schema, pk)
+            inp = SortExecutor(inp, time_col=order_col, pk_indices=pk,
+                               state_table=sort_st,
+                               table_capacity=cfg.topn_table_capacity,
+                               out_capacity=cfg.chunk_capacity)
+            acc_schema = eowc_acc_schema(in_schema, plan.calls)
+            npart = len(plan.calls[0].partition_by)
+            acc_st = ctx.state_table(acc_schema, list(range(npart)))
+            buf_st = ctx.state_table(in_schema, pk)
+            return EowcOverWindowExecutor(
+                inp, plan.calls, pk_indices=pk, acc_table=acc_st,
+                buffer_table=buf_st, out_capacity=cfg.chunk_capacity)
+        st = ctx.state_table(in_schema, pk)
+        return OverWindowExecutor(inp, plan.calls, pk_indices=pk,
+                                  state_table=st,
+                                  out_capacity=cfg.chunk_capacity)
+
+    if isinstance(plan, P.PProjectSet):
+        from ..stream.project_set import ProjectSetExecutor
+        inp = build_plan(plan.input, ctx)
+        return ProjectSetExecutor(inp, list(plan.exprs),
+                                  names=plan.schema.names,
+                                  out_capacity=cfg.chunk_capacity)
+
     if isinstance(plan, P.PUnion):
         return UnionExecutor([build_plan(i, ctx) for i in plan.inputs])
 

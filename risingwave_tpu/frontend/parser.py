@@ -33,7 +33,7 @@ KEYWORDS = {
     "key", "watermark", "for", "interval", "asc", "desc", "nulls", "first",
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
-    "sink", "sinks",
+    "sink", "sinks", "over", "partition",
 }
 
 
@@ -390,6 +390,24 @@ class Parser:
             alias = self.next().value
         return A.SelectItem(e, alias)
 
+    def _over_clause(self, fc: A.FuncCall) -> A.WindowFunc:
+        """OVER (PARTITION BY e, … ORDER BY e [ASC|DESC], …)"""
+        self.expect_op("(")
+        partition_by: list = []
+        order_by: list = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                partition_by.append(self.parse_expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._order_item())
+            while self.eat_op(","):
+                order_by.append(self._order_item())
+        self.expect_op(")")
+        return A.WindowFunc(fc, tuple(partition_by), tuple(order_by))
+
     def _order_item(self) -> A.OrderItem:
         e = self.parse_expr()
         desc = False
@@ -453,6 +471,21 @@ class Parser:
                 alias = self.next().value
             return A.SubqueryRef(q, alias)
         name = self.ident()
+        if self.at_op("("):
+            # FROM table_function(args), e.g. generate_series(1, 10)
+            self.next()
+            args = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.eat_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            alias = None
+            if self.eat_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind == "name":
+                alias = self.next().value
+            return A.TableFuncRef(name, tuple(args), alias)
         alias = None
         if self.eat_kw("as"):
             alias = self.ident()
@@ -618,7 +651,10 @@ class Parser:
                     while self.eat_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return A.FuncCall(name, tuple(args), distinct)
+                fc = A.FuncCall(name, tuple(args), distinct)
+                if self.eat_kw("over"):
+                    return self._over_clause(fc)
+                return fc
             if self.eat_op("."):
                 if self.at_op("*"):
                     self.next()

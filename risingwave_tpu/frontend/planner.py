@@ -26,9 +26,9 @@ from ..expr.expr import Expr, FunctionCall, InputRef, Literal, call
 from ..ops.topn import OrderSpec
 from . import sqlast as A
 from .binder import (
-    AGG_KINDS, BindError, BoundAgg, ExprBinder, Scope, ScopeColumn,
-    _AggPlaceholder, _SubqueryPlaceholder, contains_placeholder,
-    rewrite_placeholders,
+    AGG_KINDS, WINDOW_ONLY_KINDS, BindError, BoundAgg, BoundWindow,
+    ExprBinder, Scope, ScopeColumn, _AggPlaceholder, _SubqueryPlaceholder,
+    _WindowPlaceholder, contains_placeholder, rewrite_placeholders,
 )
 from .catalog import Catalog, CatalogError, MaterializedViewDef, SourceDef, TableDef
 
@@ -202,6 +202,36 @@ class PValues(PlanNode):
     rows: tuple
 
 
+@dataclasses.dataclass
+class POverWindow(PlanNode):
+    """Window functions over a shared (partition, order) frame; output =
+    input columns ⧺ one column per call (reference: StreamOverWindow plan
+    node, optimizer/plan_node/stream_over_window.rs)."""
+
+    input: PlanNode
+    calls: tuple                     # stream.over_window.WindowCall...
+    eowc: bool = False
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclasses.dataclass
+class PProjectSet(PlanNode):
+    """Set-returning projection: each input row yields one output row per
+    element of the table function's result (reference: ProjectSetExecutor,
+    src/stream/src/executor/project_set.rs). ``exprs`` are per-output-col;
+    exactly one is a _TableFuncExpr. Output pk = input pk ⧺ hidden index."""
+
+    input: PlanNode
+    exprs: tuple
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
 def _expr_str(e: Expr) -> str:
     if isinstance(e, InputRef):
         return f"${e.index}"
@@ -277,7 +307,19 @@ class Planner:
             node = self._plan_dynamic_filter(conj, node, scope)
 
         has_aggs = bool(sel.group_by) or self._select_has_aggs(sel)
-        if has_aggs:
+        has_windows = self._select_has_windows(sel)
+        if self._select_has_table_funcs(sel):
+            if has_aggs or has_windows:
+                raise PlanError("set-returning functions cannot mix with "
+                                "aggregates/window functions; use a subquery")
+            node, scope = self._plan_project_set(sel, node, scope)
+        elif has_windows:
+            if has_aggs:
+                raise PlanError(
+                    "window functions cannot mix with GROUP BY/aggregates "
+                    "in one SELECT; use a subquery")
+            node, scope = self._plan_over_window(sel, node, scope)
+        elif has_aggs:
             node, scope = self._plan_agg(sel, node, scope)
         else:
             node, scope = self._plan_projection(sel, node, scope)
@@ -309,6 +351,8 @@ class Planner:
     def _plan_relation(self, rel: A.Relation, pending_conjuncts=None):
         if isinstance(rel, A.TableRef):
             return self._plan_table_ref(rel)
+        if isinstance(rel, A.TableFuncRef):
+            return self._plan_table_func_ref(rel)
         if isinstance(rel, A.WindowTVF):
             return self._plan_window_tvf(rel)
         if isinstance(rel, A.SubqueryRef):
@@ -344,6 +388,29 @@ class Planner:
             for i, f in enumerate(d.schema) if i < n_vis
         ])
         return node, scope
+
+    def _plan_table_func_ref(self, ref: A.TableFuncRef):
+        """FROM generate_series(…) with constant args → Values leaf
+        (reference: table function scan lowered to batch values when
+        constant; src/frontend/src/optimizer/plan_node/logical_table_function.rs)."""
+        from ..stream.project_set import TABLE_FUNC_KINDS, series_values
+        name = ref.name.lower()
+        if name not in TABLE_FUNC_KINDS:
+            raise PlanError(f"unknown table function {ref.name!r}")
+        binder = ExprBinder(Scope([]))
+        args = []
+        for a in ref.args:
+            b = binder.bind(a)
+            if not isinstance(b, Literal):
+                raise PlanError(
+                    f"FROM {name}(...) requires constant arguments")
+            args.append(b.value)
+        from ..common.types import INT64 as _I64
+        rows = tuple((Literal(v, _I64),) for v in series_values(name, args))
+        alias = ref.alias or name
+        schema = Schema((Field(alias, _I64),))
+        node = PValues(schema=schema, pk=(), rows=rows)
+        return node, Scope.of_schema(schema, alias)
 
     def _plan_window_tvf(self, tvf: A.WindowTVF):
         node, scope = self._plan_table_ref(tvf.table)
@@ -585,6 +652,102 @@ class Planner:
         ])
         return proj, new_scope
 
+    def _plan_over_window(self, sel: A.Select, node: PlanNode, scope: Scope):
+        """SELECT with OVER clauses → pre-projection (input cols + hidden
+        partition/order/arg exprs) → POverWindow → post-projection."""
+        from ..stream.over_window import WindowCall
+        wins: list[BoundWindow] = []
+        items = self._expand_stars(sel, scope)
+        bound_items = []
+        for item in items:
+            b = ExprBinder(scope, win_ctx=wins).bind(item.expr)
+            bound_items.append((b, item.alias or self._auto_name(item.expr)))
+        first = wins[0]
+        for w in wins[1:]:
+            same = (len(w.partition_exprs) == len(first.partition_exprs)
+                    and all(_expr_eq(a, b) for a, b in
+                            zip(w.partition_exprs, first.partition_exprs))
+                    and len(w.order_exprs) == len(first.order_exprs)
+                    and all(_expr_eq(a[0], b[0]) and a[1:] == b[1:]
+                            for a, b in
+                            zip(w.order_exprs, first.order_exprs)))
+            if not same:
+                raise PlanError("all window functions in one SELECT must "
+                                "share PARTITION BY / ORDER BY")
+
+        pre_exprs: list[Expr] = [
+            InputRef(i, f.type) for i, f in enumerate(node.schema)]
+
+        def col_of(e: Expr) -> int:
+            for i, pe in enumerate(pre_exprs):
+                if _expr_eq(pe, e):
+                    return i
+            pre_exprs.append(e)
+            return len(pre_exprs) - 1
+
+        part_idx = tuple(col_of(p) for p in first.partition_exprs)
+        order_specs = tuple(
+            OrderSpec(col_of(oe), desc, nulls_last)
+            for (oe, desc, nulls_last) in first.order_exprs)
+        calls = tuple(
+            WindowCall(
+                kind=w.kind, output_type=w.output_type,
+                arg=col_of(w.arg_expr) if w.arg_expr is not None else -1,
+                offset=w.offset, partition_by=part_idx,
+                order_by=order_specs)
+            for w in wins)
+        n_base = len(node.schema)
+        if len(pre_exprs) > n_base:
+            pre_schema = Schema(tuple(node.schema) + tuple(
+                Field(f"_w{i}", e.type)
+                for i, e in enumerate(pre_exprs[n_base:])))
+            pre: PlanNode = PProject(schema=pre_schema, pk=node.pk,
+                                     input=node, exprs=tuple(pre_exprs))
+        else:
+            pre = node
+        n_in = len(pre.schema)
+        win_schema = Schema(tuple(pre.schema) + tuple(
+            Field(f"_win{i}", c.output_type) for i, c in enumerate(calls)))
+        wnode = POverWindow(schema=win_schema, pk=pre.pk, input=pre,
+                            calls=calls, eowc=sel.emit_on_window_close)
+
+        def rw(e: Expr) -> Expr:
+            if isinstance(e, _WindowPlaceholder):
+                return InputRef(n_in + e.win_index, e.type)
+            if isinstance(e, FunctionCall):
+                return dataclasses.replace(
+                    e, args=tuple(rw(a) for a in e.args))
+            from ..expr.expr import Cast as RCast
+            if isinstance(e, RCast):
+                return dataclasses.replace(e, arg=rw(e.arg))
+            return e
+
+        out_exprs, out_fields = [], []
+        for b, name in bound_items:
+            e = rw(b)
+            out_exprs.append(e)
+            out_fields.append(Field(name, e.type))
+        out_pk = []
+        for pk_col in wnode.pk:
+            found = None
+            for i, e in enumerate(out_exprs):
+                if isinstance(e, InputRef) and e.index == pk_col:
+                    found = i
+                    break
+            if found is None:
+                out_exprs.append(InputRef(pk_col, win_schema[pk_col].type))
+                out_fields.append(
+                    Field(f"_pk{len(out_pk)}", win_schema[pk_col].type))
+                found = len(out_exprs) - 1
+            out_pk.append(found)
+        proj = PProject(schema=Schema(tuple(out_fields)), pk=tuple(out_pk),
+                        input=wnode, exprs=tuple(out_exprs))
+        new_scope = Scope([
+            ScopeColumn(f.name, None, i, f.type)
+            for i, f in enumerate(proj.schema)
+        ])
+        return proj, new_scope
+
     # -- TopN / dynamic filter / misc -----------------------------------------
 
     def _plan_topn(self, sel: A.Select, node: PlanNode, scope: Scope):
@@ -666,11 +829,96 @@ class Planner:
                    if not isinstance(i.expr, A.Star)) or (
             sel.having is not None and walk(sel.having))
 
+    def _plan_project_set(self, sel: A.Select, node: PlanNode, scope: Scope):
+        """Select list containing a set-returning function → PProjectSet.
+        The table function must be a top-level select item; its elements
+        land in that output column, other items replicate."""
+        from ..stream.project_set import TableFuncCall
+        items = self._expand_stars(sel, scope)
+        exprs, fields = [], []
+        n_tf = 0
+        for item in items:
+            b = ExprBinder(scope).bind(item.expr)
+            if isinstance(b, TableFuncCall):
+                n_tf += 1
+            elif contains_placeholder(b, TableFuncCall):
+                raise PlanError("set-returning functions must be top-level "
+                                "select items")
+            exprs.append(b)
+            fields.append(Field(item.alias or self._auto_name(item.expr),
+                                b.type))
+        if n_tf != 1:
+            raise PlanError("exactly one set-returning function per SELECT "
+                            "is supported")
+        # stream key: input pk passthrough + hidden element index
+        out_pk = []
+        for pk_col in node.pk:
+            found = None
+            for i, e in enumerate(exprs):
+                if isinstance(e, InputRef) and e.index == pk_col:
+                    found = i
+                    break
+            if found is None:
+                exprs.append(InputRef(pk_col, node.schema[pk_col].type))
+                fields.append(
+                    Field(f"_pk{len(out_pk)}", node.schema[pk_col].type))
+                found = len(exprs) - 1
+            out_pk.append(found)
+        from ..common.types import INT64 as _I64
+        exprs.append(Literal(0, _I64))       # executor fills the index
+        fields.append(Field("_pidx", _I64))
+        out_pk.append(len(exprs) - 1)
+        ps = PProjectSet(schema=Schema(tuple(fields)), pk=tuple(out_pk),
+                         input=node, exprs=tuple(exprs))
+        new_scope = Scope([
+            ScopeColumn(f.name, None, i, f.type)
+            for i, f in enumerate(ps.schema)
+        ])
+        return ps, new_scope
+
+    def _select_has_table_funcs(self, sel: A.Select) -> bool:
+        from ..stream.project_set import TABLE_FUNC_KINDS
+
+        def walk(e) -> bool:
+            if isinstance(e, A.FuncCall):
+                return (e.name.lower() in TABLE_FUNC_KINDS
+                        or any(walk(a) for a in e.args))
+            if isinstance(e, A.BinaryOp):
+                return walk(e.left) or walk(e.right)
+            if isinstance(e, A.UnaryOp):
+                return walk(e.operand)
+            if isinstance(e, A.Cast):
+                return walk(e.expr)
+            return False
+        return any(walk(i.expr) for i in sel.items
+                   if not isinstance(i.expr, A.Star))
+
+    def _select_has_windows(self, sel: A.Select) -> bool:
+        def walk(e) -> bool:
+            if isinstance(e, A.WindowFunc):
+                return True
+            if isinstance(e, A.FuncCall):
+                return any(walk(a) for a in e.args)
+            if isinstance(e, A.BinaryOp):
+                return walk(e.left) or walk(e.right)
+            if isinstance(e, A.UnaryOp):
+                return walk(e.operand)
+            if isinstance(e, A.Case):
+                return any(walk(c) or walk(r) for c, r in e.branches) or (
+                    e.else_result is not None and walk(e.else_result))
+            if isinstance(e, A.Cast):
+                return walk(e.expr)
+            return False
+        return any(walk(i.expr) for i in sel.items
+                   if not isinstance(i.expr, A.Star))
+
     def _auto_name(self, e) -> str:
         if isinstance(e, A.ColumnRef):
             return e.name
         if isinstance(e, A.FuncCall):
             return e.name.lower()
+        if isinstance(e, A.WindowFunc):
+            return e.func.name.lower()
         return "?column?"
 
 

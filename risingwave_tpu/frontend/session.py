@@ -197,9 +197,10 @@ class Session:
         Executors find non-empty state tables and reload device state from
         them; MV-on-MV leaves skip the backfill snapshot (their recovered
         state already reflects the upstream through the committed epoch).
-        Source connector offsets are not yet persisted — generators restart
-        (split-state checkpointing arrives with the connector framework).
-        Reference: orchestrated recovery, src/meta/src/barrier/recovery.rs:110."""
+        Source connector offsets are persisted per checkpoint epoch in each
+        feed's split-state table; replayed CREATEs seek their readers there
+        (_stream_leaf). Reference: orchestrated recovery,
+        src/meta/src/barrier/recovery.rs:110."""
         ddl = self.store.log.ddl()  # type: ignore[attr-defined]
         if not ddl:
             return

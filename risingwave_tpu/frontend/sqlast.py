@@ -88,6 +88,15 @@ class ScalarSubquery:
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFunc:
+    """fn(args) OVER (PARTITION BY … ORDER BY …)."""
+
+    func: "FuncCall"
+    partition_by: tuple = ()
+    order_by: tuple = ()     # OrderItem...
+
+
+@dataclasses.dataclass(frozen=True)
 class Star:
     table: Optional[str] = None
 
@@ -102,6 +111,15 @@ Expr = Union[ColumnRef, Lit, FuncCall, BinaryOp, UnaryOp, Case, InList,
 @dataclasses.dataclass(frozen=True)
 class TableRef:
     name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableFuncRef:
+    """FROM generate_series(1, 10) [AS g]."""
+
+    name: str
+    args: tuple
     alias: Optional[str] = None
 
 
@@ -130,7 +148,7 @@ class SubqueryRef:
     alias: str
 
 
-Relation = Union[TableRef, WindowTVF, Join, SubqueryRef]
+Relation = Union[TableRef, TableFuncRef, WindowTVF, Join, SubqueryRef]
 
 
 # -- statements ---------------------------------------------------------------

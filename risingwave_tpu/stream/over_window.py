@@ -1,0 +1,450 @@
+"""OverWindow executors: window functions over partitions.
+
+Counterparts of the reference's OverWindowExecutor (general, retractable)
+and EowcOverWindowExecutor (append-only, emit-on-window-close)
+(reference: src/stream/src/executor/over_window/general.rs,
+over_window/eowc.rs, delta_btree_map.rs). Supported functions:
+row_number / rank / dense_rank, lag(k) / lead(k) (general only), and the
+running aggregates sum/count/min/max/avg with the PG default frame
+(RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW — peers included; the
+whole partition when there is no ORDER BY).
+
+Design notes (TPU-first framing): window maintenance is control-flow-heavy
+and output-sparse — the wrong shape for the MXU — so like the reference it
+runs at the host tier over the partition cache, recomputing only *dirty*
+partitions per barrier and emitting changed rows as retraction pairs. The
+device path stays upstream (joins/aggs); chunks leave this operator as
+ordinary device chunks.
+
+* ``OverWindowExecutor`` — keeps the input rows per partition, recomputes
+  dirty partitions at each barrier, and diffs against the previously
+  emitted output (delete / insert / update pairs).
+* ``EowcOverWindowExecutor`` — expects watermark-sorted append-only input
+  (SortExecutor upstream, the reference's SortBuffer): rows flow through
+  per-partition *running accumulators* and are emitted exactly once, when
+  their peer group closes; O(1) state per partition + the open peer group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+from ..common.chunk import (
+    DEFAULT_CHUNK_CAPACITY, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, StreamChunk, chunk_to_rows, make_chunk,
+)
+from ..common.types import DataType, Field, INT64, Schema, TypeKind
+from ..ops.topn import OrderSpec
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+from .message import Barrier, Watermark
+
+AGG_WINDOW_KINDS = {"sum", "count", "min", "max", "avg"}
+RANK_KINDS = {"row_number", "rank", "dense_rank"}
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """One window function over the shared (partition_by, order_by) frame.
+    ``arg`` indexes the operator's input schema (-1 = none)."""
+
+    kind: str
+    output_type: DataType
+    arg: int = -1
+    offset: int = 1                    # lag/lead distance
+    partition_by: tuple = ()           # input col indices
+    order_by: tuple = ()               # OrderSpec over input cols
+
+
+def _order_key(row, order_by: Sequence[OrderSpec]):
+    """Sortable key implementing desc + nulls placement per spec."""
+    key = []
+    for spec in order_by:
+        v = row[spec.col] if spec.col < len(row) else None
+        null_rank = 1 if spec.nulls_last else -1
+        if v is None:
+            key.append((null_rank, 0))
+        else:
+            key.append((0, -v if spec.desc else v))
+    return tuple(key)
+
+
+def _sort_partition(rows: list, order_by, pk_indices) -> list:
+    return sorted(rows, key=lambda r: (
+        _order_key(r, order_by), tuple(r[i] for i in pk_indices)))
+
+
+def _agg_step(kind: str, acc, v):
+    if v is None:
+        return acc
+    cnt, s, mn, mx = acc
+    return (cnt + 1, (s or 0) + v,
+            v if mn is None else min(mn, v),
+            v if mx is None else max(mx, v))
+
+
+def _agg_value(kind: str, acc, out_type: DataType):
+    cnt, s, mn, mx = acc
+    if kind == "count":
+        return cnt
+    if kind == "sum":
+        return None if cnt == 0 else s
+    if kind == "min":
+        return mn
+    if kind == "max":
+        return mx
+    if kind == "avg":
+        return None if cnt == 0 else (
+            s / cnt if out_type.is_float else s // cnt)
+    raise AssertionError(kind)
+
+
+def compute_window_values(rows: list, calls: Sequence[WindowCall],
+                          pk_indices) -> dict:
+    """Full recompute for one partition: {pk: (win values…)} — the host
+    model the executors and tests share. ``rows`` are physical tuples."""
+    if not rows:
+        return {}
+    order_by = calls[0].order_by
+    srows = _sort_partition(rows, order_by, pk_indices)
+    n = len(srows)
+    keys = [_order_key(r, order_by) for r in srows]
+    # peer groups: [start, end) spans of equal order key
+    group_of = [0] * n
+    g = 0
+    for i in range(1, n):
+        if keys[i] != keys[i - 1]:
+            g += 1
+        group_of[i] = g
+    group_start = {}
+    group_end = {}
+    for i in range(n):
+        group_start.setdefault(group_of[i], i)
+        group_end[group_of[i]] = i + 1
+
+    out_cols = []
+    for c in calls:
+        vals: list = [None] * n
+        if c.kind == "row_number":
+            vals = [i + 1 for i in range(n)]
+        elif c.kind == "rank":
+            vals = [group_start[group_of[i]] + 1 for i in range(n)]
+        elif c.kind == "dense_rank":
+            vals = [group_of[i] + 1 for i in range(n)]
+        elif c.kind == "lag":
+            vals = [srows[i - c.offset][c.arg] if i - c.offset >= 0 else None
+                    for i in range(n)]
+        elif c.kind == "lead":
+            vals = [srows[i + c.offset][c.arg] if i + c.offset < n else None
+                    for i in range(n)]
+        elif c.kind in AGG_WINDOW_KINDS:
+            acc = (0, None, None, None)
+
+            def arg_of(r, _c=c):
+                # count(*) (arg=-1) counts every row; others skip NULL args
+                return 1 if _c.arg < 0 else r[_c.arg]
+
+            if not order_by:
+                for r in srows:
+                    acc = _agg_step(c.kind, acc, arg_of(r))
+                v = _agg_value(c.kind, acc, c.output_type)
+                vals = [v] * n
+            else:
+                # RANGE ... CURRENT ROW: value at end of own peer group
+                per_group_val = {}
+                for gi in sorted(group_end):
+                    for i in range(group_start[gi], group_end[gi]):
+                        acc = _agg_step(c.kind, acc, arg_of(srows[i]))
+                    per_group_val[gi] = _agg_value(c.kind, acc, c.output_type)
+                vals = [per_group_val[group_of[i]] for i in range(n)]
+        else:
+            raise ValueError(f"unsupported window function {c.kind}")
+        out_cols.append(vals)
+    return {
+        tuple(srows[i][j] for j in pk_indices):
+            tuple(col[i] for col in out_cols)
+        for i in range(n)
+    }
+
+
+def _emit_chunks(schema: Schema, pairs: list, out_capacity: int):
+    """pairs: list of (op, physical_row); U-/U+ pairs kept adjacent and
+    never split across chunk boundaries."""
+    i = 0
+    while i < len(pairs):
+        take = pairs[i:i + out_capacity]
+        if (take and take[-1][0] == OP_UPDATE_DELETE
+                and i + len(take) < len(pairs)):
+            take = take[:-1]
+        i += len(take)
+        yield make_chunk(schema, [r for _, r in take],
+                         ops=[op for op, _ in take],
+                         capacity=max(out_capacity, len(take)),
+                         physical=True)
+
+
+class OverWindowExecutor(SingleInputExecutor):
+    """General (retractable) over-window: recompute dirty partitions on
+    barrier, emit output diffs. Output schema = input ⧺ window columns."""
+
+    identity = "OverWindow"
+
+    def __init__(self, input: Executor, calls: Sequence[WindowCall],
+                 pk_indices: Sequence[int],
+                 state_table: Optional[StateTable] = None,
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+        super().__init__(input)
+        self.calls = tuple(calls)
+        self.pk_indices = tuple(pk_indices)
+        self.schema = Schema(tuple(input.schema) + tuple(
+            Field(f"_win{i}", c.output_type)
+            for i, c in enumerate(self.calls)))
+        self.in_schema = input.schema
+        self.state_table = state_table
+        self.out_capacity = out_capacity
+        self._part_cols = self.calls[0].partition_by
+        self._rows: dict[tuple, tuple] = {}       # pk -> input row
+        self._parts: dict[tuple, set] = {}        # part key -> {pk}
+        self._out: dict[tuple, dict] = {}         # part key -> {pk: win vals}
+        self._dirty: set = set()
+        if state_table is not None:
+            for row in state_table.scan_all():
+                self._apply_row(OP_INSERT, tuple(row))
+            for part in list(self._dirty):
+                rows = [self._rows[pk] for pk in self._parts.get(part, ())]
+                vals = compute_window_values(rows, self.calls,
+                                             self.pk_indices)
+                self._out[part] = {
+                    pk: (self._rows[pk], v) for pk, v in vals.items()}
+            self._dirty.clear()
+
+    def _part_of(self, row) -> tuple:
+        return tuple(row[i] for i in self._part_cols)
+
+    def _apply_row(self, op: int, row: tuple) -> None:
+        pk = tuple(row[i] for i in self.pk_indices)
+        part = self._part_of(row)
+        if op in (OP_INSERT, OP_UPDATE_INSERT):
+            old = self._rows.get(pk)
+            if old is not None:
+                self._parts.get(self._part_of(old), set()).discard(pk)
+                self._dirty.add(self._part_of(old))
+            self._rows[pk] = row
+            self._parts.setdefault(part, set()).add(pk)
+        else:
+            self._rows.pop(pk, None)
+            self._parts.get(part, set()).discard(pk)
+        self._dirty.add(part)
+
+    async def map_chunk(self, chunk: StreamChunk):
+        for op, row in chunk_to_rows(chunk, self.in_schema, with_ops=True,
+                                     physical=True):
+            self._apply_row(op, tuple(row))
+            if self.state_table is not None:
+                if op in (OP_INSERT, OP_UPDATE_INSERT):
+                    self.state_table.insert(row)
+                else:
+                    self.state_table.delete(row)
+        if False:
+            yield
+
+    async def on_barrier(self, barrier: Barrier):
+        pairs: list = []
+        for part in sorted(self._dirty):
+            pks = self._parts.get(part, set())
+            rows = [self._rows[pk] for pk in pks]
+            new = compute_window_values(rows, self.calls, self.pk_indices)
+            old = self._out.get(part, {})
+            for pk in old:
+                if pk not in new:
+                    pairs.append((OP_DELETE,
+                                  self._out_row_from(old, part, pk)))
+            for pk, vals in new.items():
+                row = self._rows[pk] + vals
+                if pk not in old:
+                    pairs.append((OP_INSERT, row))
+                elif old[pk][1] != vals or old[pk][0] != self._rows[pk]:
+                    pairs.append((OP_UPDATE_DELETE,
+                                  old[pk][0] + old[pk][1]))
+                    pairs.append((OP_UPDATE_INSERT, row))
+            if new:
+                self._out[part] = {
+                    pk: (self._rows[pk], vals) for pk, vals in new.items()}
+            else:
+                self._out.pop(part, None)
+        self._dirty.clear()
+        for chunk in _emit_chunks(self.schema, pairs, self.out_capacity):
+            yield chunk
+        if self.state_table is not None:
+            self.state_table.commit(barrier.epoch.curr)
+
+    def _out_row_from(self, old: dict, part, pk) -> tuple:
+        row, vals = old[pk]
+        return row + vals
+
+
+def eowc_acc_schema(in_schema: Schema, calls: Sequence[WindowCall]) -> Schema:
+    """Accumulator-table schema for the EOWC executor: partition key cols
+    ⧺ (n, last_order, rank_last, dense_last) ⧺ per-call (cnt, sum, min, max)."""
+    part = calls[0].partition_by
+    fields = [Field(f"p{i}", in_schema[c].type) for i, c in enumerate(part)]
+    fields += [Field("_n", INT64), Field("_last_ord", INT64),
+               Field("_rank_last", INT64), Field("_dense_last", INT64)]
+    for i, c in enumerate(calls):
+        arg_t = in_schema[c.arg].type if c.arg >= 0 else INT64
+        sum_t = c.output_type if c.kind in ("sum", "avg") else arg_t
+        fields += [Field(f"c{i}_cnt", INT64), Field(f"c{i}_sum", sum_t),
+                   Field(f"c{i}_min", arg_t), Field(f"c{i}_max", arg_t)]
+    return Schema(tuple(fields))
+
+
+class EowcOverWindowExecutor(SingleInputExecutor):
+    """Append-only over-window with emit-on-window-close semantics
+    (reference: over_window/eowc.rs). Input must arrive sorted by the
+    order column (SortExecutor upstream) and append-only; each row is
+    emitted exactly once, when its peer group closes (a later order value
+    arrives, or the watermark passes it at a barrier)."""
+
+    identity = "EowcOverWindow"
+
+    def __init__(self, input: Executor, calls: Sequence[WindowCall],
+                 pk_indices: Sequence[int],
+                 acc_table: Optional[StateTable] = None,
+                 buffer_table: Optional[StateTable] = None,
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+        super().__init__(input)
+        self.calls = tuple(calls)
+        for c in self.calls:
+            if c.kind not in RANK_KINDS | AGG_WINDOW_KINDS:
+                raise ValueError(
+                    f"{c.kind} is not emit-on-window-close capable")
+        if not self.calls[0].order_by:
+            raise ValueError("EOWC over-window requires ORDER BY")
+        self.order_col = self.calls[0].order_by[0].col
+        self.pk_indices = tuple(pk_indices)
+        self.schema = Schema(tuple(input.schema) + tuple(
+            Field(f"_win{i}", c.output_type)
+            for i, c in enumerate(self.calls)))
+        self.in_schema = input.schema
+        self.out_capacity = out_capacity
+        self.acc_table = acc_table
+        self.buffer_table = buffer_table
+        self._part_cols = self.calls[0].partition_by
+        # part -> {"n", "last_ord", "rank_last", "dense_last", "accs": [...]}
+        self._accs: dict[tuple, dict] = {}
+        self._pending: dict[tuple, list] = {}     # open peer group rows
+        self._wm: Optional[int] = None
+        self._emit_buf: list = []
+        if acc_table is not None:
+            npart = len(self._part_cols)
+            for row in acc_table.scan_all():
+                part = tuple(row[:npart])
+                st = {"n": row[npart], "last_ord": row[npart + 1],
+                      "rank_last": row[npart + 2],
+                      "dense_last": row[npart + 3], "accs": []}
+                base = npart + 4
+                for i in range(len(self.calls)):
+                    st["accs"].append(tuple(row[base + 4 * i:base + 4 * i + 4]))
+                self._accs[part] = st
+        if buffer_table is not None:
+            for row in buffer_table.scan_all():
+                part = tuple(row[i] for i in self._part_cols)
+                self._pending.setdefault(part, []).append(tuple(row))
+
+    def _flush_group(self, part: tuple) -> None:
+        """Close the open peer group: run it through the accumulators and
+        emit its rows."""
+        rows = self._pending.pop(part, None)
+        if not rows:
+            return
+        rows = _sort_partition(rows, self.calls[0].order_by, self.pk_indices)
+        st = self._accs.setdefault(part, {
+            "n": 0, "last_ord": None, "rank_last": 0, "dense_last": 0,
+            "accs": [(0, None, None, None)] * len(self.calls)})
+        n0 = st["n"]
+        rank = n0 + 1
+        dense = st["dense_last"] + 1
+        # aggregates: whole peer group folds in before any row's value
+        # (RANGE frame includes peers)
+        for i, c in enumerate(self.calls):
+            if c.kind in AGG_WINDOW_KINDS:
+                acc = st["accs"][i]
+                for r in rows:
+                    acc = _agg_step(c.kind, acc,
+                                    1 if c.arg < 0 else r[c.arg])
+                st["accs"][i] = acc
+        for j, r in enumerate(rows):
+            vals = []
+            for i, c in enumerate(self.calls):
+                if c.kind == "row_number":
+                    vals.append(n0 + j + 1)
+                elif c.kind == "rank":
+                    vals.append(rank)
+                elif c.kind == "dense_rank":
+                    vals.append(dense)
+                else:
+                    vals.append(_agg_value(c.kind, st["accs"][i],
+                                           c.output_type))
+            self._emit_buf.append((OP_INSERT, r + tuple(vals)))
+            if self.buffer_table is not None:
+                self.buffer_table.delete(r)
+        st["n"] = n0 + len(rows)
+        st["last_ord"] = rows[-1][self.order_col]
+        st["rank_last"] = rank
+        st["dense_last"] = dense
+
+    async def map_chunk(self, chunk: StreamChunk):
+        for op, row in chunk_to_rows(chunk, self.in_schema, with_ops=True,
+                                     physical=True):
+            if op != OP_INSERT:
+                raise AssertionError(
+                    "EOWC over-window requires append-only input")
+            row = tuple(row)
+            part = self._part_of(row)
+            pend = self._pending.get(part)
+            if pend and row[self.order_col] != pend[0][self.order_col]:
+                if row[self.order_col] < pend[0][self.order_col]:
+                    raise AssertionError(
+                        "EOWC over-window input not sorted by order column")
+                self._flush_group(part)
+            self._pending.setdefault(part, []).append(row)
+            if self.buffer_table is not None:
+                self.buffer_table.insert(row)
+        for chunk_out in self._drain_emit():
+            yield chunk_out
+
+    def _part_of(self, row) -> tuple:
+        return tuple(row[i] for i in self._part_cols)
+
+    def _drain_emit(self):
+        buf, self._emit_buf = self._emit_buf, []
+        yield from _emit_chunks(self.schema, buf, self.out_capacity)
+
+    async def on_watermark(self, watermark: Watermark):
+        if watermark.col_idx == self.order_col:
+            self._wm = watermark.value
+        yield watermark
+
+    async def on_barrier(self, barrier: Barrier):
+        # peer groups strictly below the watermark can never grow again
+        # (rows with ts >= wm may still arrive; ts < wm were dropped
+        # upstream by the WatermarkFilter): close them now
+        if self._wm is not None:
+            for part in list(self._pending):
+                rows = self._pending[part]
+                if rows and rows[0][self.order_col] < self._wm:
+                    self._flush_group(part)
+        for chunk in self._drain_emit():
+            yield chunk
+        epoch = barrier.epoch.curr
+        if self.acc_table is not None:
+            for part, st in self._accs.items():
+                row = list(part) + [st["n"], st["last_ord"],
+                                    st["rank_last"], st["dense_last"]]
+                for acc in st["accs"]:
+                    row.extend(acc)
+                self.acc_table.insert(tuple(row))
+            self.acc_table.commit(epoch)
+        if self.buffer_table is not None:
+            self.buffer_table.commit(epoch)

@@ -1,0 +1,123 @@
+"""ProjectSet: set-returning functions in the select list.
+
+Counterpart of the reference's ProjectSetExecutor + table functions
+(reference: src/stream/src/executor/project_set.rs,
+src/expr/src/table_function/ — generate_series, unnest…). Each input row
+yields one output row per element of the table function's result; plain
+expressions are replicated. The output stream key is the input key plus a
+hidden element index (the reference's ``projected_row_id``).
+
+Update pairs are rewritten to Delete+Insert on expansion: the old and new
+rows of a pair may generate different element counts, so pairwise U-/U+
+alignment cannot be preserved in general (same rule as the reference's
+dispatch when keys change).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common.chunk import (
+    DEFAULT_CHUNK_CAPACITY, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, StreamChunk, make_chunk,
+)
+from ..common.types import DataType, Field, INT64, Schema
+from ..expr.expr import Expr
+from .executor import Executor, SingleInputExecutor
+
+TABLE_FUNC_KINDS = {"generate_series"}
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class TableFuncCall(Expr):
+    """A set-returning call; only valid inside PProjectSet / FROM position
+    (row-wise eval is meaningless — the planner intercepts it)."""
+
+    name: str
+    args: tuple
+    type: DataType = INT64
+
+    def eval(self, chunk):  # pragma: no cover
+        raise RuntimeError("table function outside ProjectSet")
+
+
+def series_values(name: str, args: Sequence) -> list:
+    """Host evaluation for one row's argument values → list of elements."""
+    if name == "generate_series":
+        if len(args) == 2:
+            lo, hi, step = args[0], args[1], 1
+        else:
+            lo, hi, step = args
+        if lo is None or hi is None or step in (None, 0):
+            return []
+        return list(range(int(lo), int(hi) + (1 if step > 0 else -1),
+                          int(step)))
+    raise ValueError(f"unknown table function {name}")
+
+
+class ProjectSetExecutor(SingleInputExecutor):
+    identity = "ProjectSet"
+
+    def __init__(self, input: Executor, exprs: Sequence[Expr],
+                 names: Sequence[str],
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+        super().__init__(input)
+        self.exprs = list(exprs)
+        self.schema = Schema(tuple(
+            Field(n, e.type) for n, e in zip(names, self.exprs)))
+        self.out_capacity = out_capacity
+
+    async def map_chunk(self, chunk: StreamChunk):
+        vis = np.asarray(chunk.vis)
+        ops = np.asarray(chunk.ops)
+        # vectorized eval of every expression / table-func argument
+        plain_cols: dict[int, tuple] = {}
+        tf_args: dict[int, list] = {}
+        for ci, e in enumerate(self.exprs):
+            if isinstance(e, TableFuncCall):
+                cols = [a.eval(chunk) for a in e.args]
+                tf_args[ci] = [
+                    (np.asarray(c.data), np.asarray(c.mask)) for c in cols]
+            else:
+                c = e.eval(chunk)
+                plain_cols[ci] = (np.asarray(c.data), np.asarray(c.mask))
+        out_rows: list = []
+        out_ops: list = []
+        for i in np.nonzero(vis)[0]:
+            op = int(ops[i])
+            if op == OP_UPDATE_DELETE:
+                op = OP_DELETE
+            elif op == OP_UPDATE_INSERT:
+                op = OP_INSERT
+            base = {}
+            for ci, (data, mask) in plain_cols.items():
+                base[ci] = data[i].item() if mask[i] else None
+            series: list = [()]
+            for ci, e in enumerate(self.exprs):
+                if isinstance(e, TableFuncCall):
+                    argv = [d[i].item() if m[i] else None
+                            for d, m in tf_args[ci]]
+                    elems = series_values(e.name, argv)
+                    series = [(ci, v, idx) for idx, v in enumerate(elems)]
+            for ci, v, idx in series:
+                row = [None] * len(self.exprs)
+                for pc, bv in base.items():
+                    row[pc] = bv
+                row[ci] = v
+                # hidden element index lives in the last column (the
+                # planner appends the _pidx field)
+                if self.schema.names[-1] == "_pidx":
+                    row[-1] = idx
+                out_rows.append(tuple(row))
+                out_ops.append(op)
+        i = 0
+        while i < len(out_rows):
+            take_rows = out_rows[i:i + self.out_capacity]
+            take_ops = out_ops[i:i + self.out_capacity]
+            i += len(take_rows)
+            yield make_chunk(self.schema, take_rows, ops=take_ops,
+                             capacity=max(self.out_capacity, len(take_rows)),
+                             physical=True)
