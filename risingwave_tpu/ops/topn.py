@@ -71,6 +71,120 @@ def topn_order(state: RowSetState, gid: jax.Array,
     return perm
 
 
+def _key_sentinels(dtype):
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int64):
+        return (jnp.asarray(jnp.iinfo(jnp.int64).max, jnp.int64),
+                jnp.asarray(jnp.iinfo(jnp.int64).min, jnp.int64))
+    return (jnp.asarray(jnp.inf, jnp.float64),
+            jnp.asarray(-jnp.inf, jnp.float64))
+
+
+def key0_dtype(state: RowSetState, spec: OrderSpec):
+    """Dtype of the leading sort key (threshold scalar storage)."""
+    return (jnp.int64 if state.cols[spec.col].data.dtype == jnp.int64
+            else jnp.float64)
+
+
+def topn_candidate_flush(
+    state: RowSetState,
+    order: Sequence[OrderSpec],
+    offset: int,
+    limit: int,
+    cand: jax.Array,          # bool[cap] candidate slots
+    cand_cap: int,            # compact buffer size (static)
+    cand_keep: int,           # candidates retained after shrink
+    t1: jax.Array,            # scalar: best leading key among forgotten rows
+):
+    """Incremental TopN flush (plain TopN fast path): sort only the
+    candidate subset, O(cand_cap log cand_cap) instead of a full-capacity
+    sort — the TPU analogue of the reference's low/middle/high TopNCache
+    (top_n_cache.rs:43): candidates ≈ low+middle segments, the full row set
+    ≈ the high segment re-read on a miss.
+
+    Correctness gate: rows dropped from the candidate set ("forgotten")
+    are remembered only through ``t1`` — the best (ascending-sort) leading
+    key ever dropped. The result is valid only when the window's worst
+    leading key stays strictly below ``t1``; otherwise the caller must run
+    the full-sort refill. Returns
+    ``(in_set, new_cand, new_t1, bad)`` — ``bad`` = overflow / underflow /
+    threshold breach, conservatively forcing a refill."""
+    cap = state.live.shape[0]
+    spec0 = order[0]
+    big0, small0 = _key_sentinels(key0_dtype(state, spec0))
+
+    cidx = jnp.nonzero(cand, size=cand_cap, fill_value=cap)[0].astype(jnp.int32)
+    valid = cidx < cap
+    safe = jnp.clip(cidx, 0, cap - 1)
+    live_m = valid & state.live[safe]
+
+    perm = jnp.arange(cand_cap, dtype=jnp.int32)
+    for spec in reversed(list(order)):
+        keyf = _sort_key(state.cols[spec.col], spec)
+        big, _ = _key_sentinels(keyf.dtype)
+        keym = jnp.where(valid, keyf[safe], big)
+        perm = perm[jnp.argsort(keym[perm], stable=True)]
+    # dead/filler last (stable => key order preserved within live)
+    perm = perm[jnp.argsort(~live_m[perm], stable=True)]
+
+    rank = jnp.arange(cand_cap, dtype=jnp.int64)
+    live_sorted = live_m[perm]
+    in_win_sorted = live_sorted & (rank >= offset) & (rank < offset + limit)
+    keep_sorted = live_sorted & (rank < cand_keep)
+
+    n_cand = jnp.sum(cand)
+    n_live_cand = jnp.sum(live_m)
+    n_live = jnp.sum(state.live)
+    overflow = n_cand > cand_cap
+    underflow = (n_live_cand < offset + limit) & (n_live > n_live_cand)
+
+    key0_full = _sort_key(state.cols[spec0.col], spec0).astype(big0.dtype)
+    key0_sorted = jnp.where(valid, key0_full[safe], big0)[perm]
+    nwin = jnp.minimum(offset + limit, n_live_cand)
+    worst_win = jnp.where(
+        nwin > 0, key0_sorted[jnp.clip(nwin - 1, 0, cand_cap - 1)], small0)
+    stale = worst_win >= t1
+    drop_exists = n_live_cand > cand_keep
+    drop_key = key0_sorted[jnp.clip(cand_keep, 0, cand_cap - 1)]
+    new_t1 = jnp.where(drop_exists, jnp.minimum(t1, drop_key), t1)
+    bad = overflow | underflow | stale
+
+    in_win_orig = jnp.zeros(cand_cap, jnp.bool_).at[perm].set(in_win_sorted)
+    keep_orig = jnp.zeros(cand_cap, jnp.bool_).at[perm].set(keep_sorted)
+    tgt = jnp.where(valid, cidx, cap)
+    in_set = jnp.zeros(cap, jnp.bool_).at[tgt].set(in_win_orig, mode="drop")
+    new_cand = jnp.zeros(cap, jnp.bool_).at[tgt].set(keep_orig, mode="drop")
+    return in_set, new_cand, new_t1, bad
+
+
+def topn_refill(
+    state: RowSetState,
+    gid: jax.Array,
+    order: Sequence[OrderSpec],
+    offset: int,
+    limit: int,
+    cand_keep: int,
+):
+    """Full-sort recompute + candidate reseed: one permutation yields the
+    rank window, the new candidate set (global top-``cand_keep``), and the
+    forget threshold (leading key of the first dropped row)."""
+    cap = state.live.shape[0]
+    spec0 = order[0]
+    big0, _ = _key_sentinels(key0_dtype(state, spec0))
+    perm = topn_order(state, gid, order)
+    live_sorted = state.live[perm]
+    # dead slots were routed last by topn_order's gid pass (gid=0 for plain)
+    rank = jnp.arange(cap, dtype=jnp.int64)
+    in_win_sorted = live_sorted & (rank >= offset) & (rank < offset + limit)
+    keep_sorted = live_sorted & (rank < cand_keep)
+    key0 = _sort_key(state.cols[spec0.col], spec0).astype(big0.dtype)[perm]
+    n_live = jnp.sum(state.live)
+    t1 = jnp.where(n_live > cand_keep,
+                   key0[jnp.clip(cand_keep, 0, cap - 1)], big0)
+    in_set = jnp.zeros(cap, jnp.bool_).at[perm].set(in_win_sorted)
+    cand = jnp.zeros(cap, jnp.bool_).at[perm].set(keep_sorted)
+    return in_set, cand, t1
+
+
 def topn_in_set(
     state: RowSetState,
     gid: jax.Array,

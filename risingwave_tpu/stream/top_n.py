@@ -30,7 +30,10 @@ from ..ops.row_set import (
     RowSetState, rs_apply_chunk, rs_changed, rs_checkpoint, rs_finish_flush,
     rs_gather_delta, rs_new,
 )
-from ..ops.topn import OrderSpec, topn_in_set
+from ..ops.topn import (
+    OrderSpec, _key_sentinels, key0_dtype, topn_candidate_flush,
+    topn_in_set, topn_refill,
+)
 from ..storage.state_table import StateTable
 from .executor import Executor, SingleInputExecutor
 from .message import Barrier
@@ -41,6 +44,8 @@ class TopNState:
     rows: RowSetState
     group_table: DeviceHashTable   # group key -> gid (own slot index)
     gid: jax.Array                 # int32[cap]: group slot per row
+    cand: jax.Array                # bool[cap]: incremental candidate slots
+    t1: jax.Array                  # scalar: forget threshold (leading key)
 
 
 class TopNExecutor(SingleInputExecutor):
@@ -94,15 +99,36 @@ class TopNExecutor(SingleInputExecutor):
         col_types = [f.type for f in input.schema]
         rows = rs_new(pk_types, col_types, table_capacity)
         group_types = [input.schema[i].type for i in self.group_by]
+
+        # incremental fast path (plain TopN): sort only a candidate subset
+        # per barrier (reference: 3-segment TopNCache, top_n_cache.rs:43);
+        # groups/ties fall back to the full-sort flush
+        win = offset + limit
+        cand_cap = 1
+        while cand_cap < max(2 * win + 128, 512):
+            cand_cap *= 2
+        self.cand_cap = cand_cap
+        self.cand_keep = max(win, cand_cap // 2)
+        self.use_incremental = (not group_by and not with_ties
+                                and cand_cap < table_capacity)
+        big0, _ = _key_sentinels(key0_dtype(rows, self.order[0]))
+
         # group table sized like the row table: worst case every row is its
         # own group; gid values are group-table slot indices
         self.state = TopNState(
             rows=rows,
             group_table=ht_new(group_types, table_capacity),
             gid=jnp.zeros(table_capacity, jnp.int32),
+            cand=jnp.zeros(table_capacity, jnp.bool_),
+            t1=big0,
         )
+        self._dirty = False
+        self.n_fast_flushes = 0      # observability: incremental flushes…
+        self.n_refills = 0           # …vs full-sort refills
         self._apply = jax.jit(self._apply_impl)
         self._compute_flush = jax.jit(self._compute_flush_impl)
+        self._flush_fast = jax.jit(self._flush_fast_impl)
+        self._flush_refill = jax.jit(self._flush_refill_impl)
         self._gather = jax.jit(rs_gather_delta, static_argnames=("out_capacity",))
         self._finish = jax.jit(rs_finish_flush)
         if state_table is not None:
@@ -112,46 +138,99 @@ class TopNExecutor(SingleInputExecutor):
 
     def _apply_impl(self, state: TopNState, chunk: StreamChunk) -> TopNState:
         rows, slots, applied = rs_apply_chunk(state.rows, chunk, self.pk_indices)
+        idx = jnp.where(applied, slots, self.capacity)
+        cand = state.cand.at[idx].set(True, mode="drop")
         if not self.group_by:
-            return state.replace(rows=rows)
+            return state.replace(rows=rows, cand=cand)
         gcols = [chunk.columns[i] for i in self.group_by]
         gtable, gslots, _, govf = ht_lookup_or_insert(
             state.group_table, gcols, applied)
-        idx = jnp.where(applied, slots, self.capacity)
         gid = state.gid.at[idx].set(gslots, mode="drop")
         rows = rows.replace(overflow=rows.overflow | govf)
-        return state.replace(rows=rows, group_table=gtable, gid=gid)
+        return state.replace(rows=rows, group_table=gtable, gid=gid,
+                             cand=cand)
+
+    def _stats(self, state: TopNState, changed, bad):
+        """All host-fetched scalars in ONE array → one tunnel round trip
+        (dispatch latency dominates on remote chips)."""
+        return jnp.stack([
+            jnp.sum(changed),
+            bad.astype(jnp.int64),
+            state.rows.overflow.astype(jnp.int64),
+            state.rows.saw_delete.astype(jnp.int64),
+        ])
 
     def _compute_flush_impl(self, state: TopNState):
         in_set = topn_in_set(
             state.rows, state.gid, self.order, self.offset, self.limit,
             self.with_ties, n_tie_keys=self.n_user_keys)
         changed = rs_changed(state.rows, in_set)
-        return in_set, changed, jnp.sum(changed)
+        return in_set, changed, self._stats(
+            state, changed, jnp.zeros((), jnp.bool_))
+
+    def _flush_fast_impl(self, state: TopNState):
+        in_set, new_cand, new_t1, bad = topn_candidate_flush(
+            state.rows, self.order, self.offset, self.limit,
+            state.cand, self.cand_cap, self.cand_keep, state.t1)
+        changed = rs_changed(state.rows, in_set)
+        return in_set, changed, new_cand, new_t1, self._stats(
+            state, changed, bad)
+
+    def _flush_refill_impl(self, state: TopNState):
+        in_set, cand, t1 = topn_refill(
+            state.rows, state.gid, self.order, self.offset, self.limit,
+            self.cand_keep)
+        changed = rs_changed(state.rows, in_set)
+        return in_set, changed, cand, t1, self._stats(
+            state, changed, jnp.zeros((), jnp.bool_))
 
     # -- host control ---------------------------------------------------------
 
     async def map_chunk(self, chunk: StreamChunk):
         self.state = self._apply(self.state, chunk)
+        self._dirty = True
         if False:
             yield
 
     async def on_barrier(self, barrier: Barrier):
-        if bool(self.state.rows.overflow):
+        if not self._dirty:
+            # idle barrier: membership cannot have changed — skip the sort
+            # entirely (barrier cost independent of stored row count)
+            if barrier.checkpoint and self.state_table is not None:
+                self._checkpoint(barrier.epoch.curr)
+            return
+        self._dirty = False
+        import numpy as np
+        if self.use_incremental:
+            in_set, changed, cand, t1, stats = self._flush_fast(self.state)
+            n_changed, bad, ovf, sawdel = (int(x) for x in np.asarray(stats))
+            if bad:
+                # candidate set over/underflowed or the window reached the
+                # forgotten region: full-sort refill
+                (in_set, changed, cand, t1,
+                 stats) = self._flush_refill(self.state)
+                n_changed, _, ovf, sawdel = (
+                    int(x) for x in np.asarray(stats))
+                self.n_refills += 1
+            else:
+                self.n_fast_flushes += 1
+            self.state = self.state.replace(cand=cand, t1=t1)
+        else:
+            in_set, changed, stats = self._compute_flush(self.state)
+            n_changed, _, ovf, sawdel = (int(x) for x in np.asarray(stats))
+        if ovf:
             raise RuntimeError(
                 f"{self.identity}: row table overflow (capacity "
                 f"{self.capacity}); increase table_capacity")
-        if self.append_only and bool(self.state.rows.saw_delete):
+        if self.append_only and sawdel:
             raise RuntimeError(
                 f"{self.identity}: delete arrived on declared append-only "
                 "input")
-        in_set, changed, n_changed = self._compute_flush(self.state)
-        lo, n = 0, int(n_changed)
+        lo, n = 0, n_changed
         while lo < n:
             chunk = self._gather(self.state.rows, in_set, changed,
                                  jnp.int64(lo), out_capacity=self.out_capacity)
-            if bool(jnp.any(chunk.vis)):
-                yield chunk
+            yield chunk
             lo += self.out_capacity // 2
         if barrier.checkpoint and self.state_table is not None:
             self._checkpoint(barrier.epoch.curr)
@@ -178,7 +257,18 @@ class TopNExecutor(SingleInputExecutor):
         # emitted snapshot so the first post-recovery flush emits no spurious
         # inserts; the reloaded slots are not checkpoint-dirty (they ARE the
         # checkpoint)
-        in_set, _, _ = self._compute_flush(self.state)
+        # overflow during reload must surface immediately — idle barriers
+        # skip the (sync-costing) check until the next data chunk
+        if bool(self.state.rows.overflow):
+            raise RuntimeError(
+                f"{self.identity}: row table overflow while reloading "
+                f"checkpoint (capacity {self.capacity})")
+        if self.use_incremental:
+            in_set, _, cand, t1, _ = self._flush_refill(self.state)
+            self.state = self.state.replace(cand=cand, t1=t1)
+        else:
+            in_set, _, _ = self._compute_flush(self.state)
+        self._dirty = False
         rows_st = self._finish(self.state.rows, in_set)
         import jax.numpy as _jnp
         rows_st = rows_st.replace(ckpt_dirty=_jnp.zeros_like(rows_st.ckpt_dirty))

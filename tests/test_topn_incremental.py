@@ -1,0 +1,121 @@
+"""Incremental TopN (VERDICT r2 item 9): the plain-TopN fast path sorts
+only a bounded candidate set per barrier; full-sort refills happen only on
+candidate exhaustion or threshold breach. Randomized churn cross-checks
+the emitted fold against a brute-force host model."""
+
+import asyncio
+import random
+
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, chunk_to_rows, make_chunk,
+)
+from risingwave_tpu.common.types import INT64, Field, Schema
+from risingwave_tpu.ops.topn import OrderSpec
+from risingwave_tpu.stream.executor import collect_until_barrier
+from risingwave_tpu.stream.message import Barrier
+from risingwave_tpu.stream.source import MockSource
+from risingwave_tpu.stream.top_n import TopNExecutor
+
+S = Schema((Field("k", INT64), Field("v", INT64)))
+
+
+def _run(messages, **kw):
+    n_b = sum(1 for m in messages if isinstance(m, Barrier))
+    ex = TopNExecutor(MockSource(S, messages), order=[OrderSpec(1)],
+                      pk_indices=[0], table_capacity=1 << 12, **kw)
+
+    async def go():
+        chunks, _, _ = await collect_until_barrier(ex.execute(), n_b)
+        return chunks
+    return ex, asyncio.run(go())
+
+
+def _fold(chunks):
+    acc = {}
+    for c in chunks:
+        for op, row in chunk_to_rows(c, S, with_ops=True):
+            acc[row] = acc.get(row, 0) + (1 if op in (0, 3) else -1)
+    return {row for row, n in acc.items() if n > 0}
+
+
+def _host_topn(rows, offset, limit):
+    ordered = sorted(rows.items(), key=lambda kv: (kv[1], kv[0]))
+    return {(k, v) for k, v in ordered[offset:offset + limit]}
+
+
+class TestIncremental:
+    def test_fast_path_used_and_correct(self):
+        msgs = [Barrier.new(1)]
+        for e in range(2, 8):
+            rows = [(e * 100 + i, random.randint(0, 1000)) for i in range(20)]
+            msgs.append(make_chunk(S, rows, capacity=32))
+            msgs.append(Barrier.new(e))
+        ex, chunks = _run(msgs, offset=0, limit=5)
+        assert ex.use_incremental
+        assert ex.n_fast_flushes >= 4   # most barriers avoid the full sort
+
+    def test_randomized_churn_matches_host_model(self):
+        rng = random.Random(7)
+        live = {}
+        msgs = [Barrier.new(1)]
+        epoch = 2
+        for _ in range(30):
+            rows, ops = [], []
+            for _ in range(rng.randint(1, 12)):
+                if live and rng.random() < 0.45:
+                    k = rng.choice(list(live))
+                    rows.append((k, live.pop(k)))
+                    ops.append(OP_DELETE)
+                else:
+                    k = rng.randint(0, 10_000)
+                    v = rng.randint(0, 500)   # heavy ties
+                    if k in live:
+                        continue
+                    live[k] = v
+                    rows.append((k, v))
+                    ops.append(OP_INSERT)
+            if rows:
+                msgs.append(make_chunk(S, rows, ops=ops, capacity=16))
+            msgs.append(Barrier.new(epoch))
+            epoch += 1
+        ex, chunks = _run(msgs, offset=0, limit=7)
+        assert _fold(chunks) == _host_topn(live, 0, 7)
+        assert ex.n_fast_flushes > 0    # fast path actually exercised
+
+    def test_delete_drain_forces_refill(self):
+        """Delete the whole window repeatedly: underflow must trigger
+        refills and promotion from beyond the candidate set."""
+        rows = [(i, i) for i in range(600)]
+        msgs = [Barrier.new(1),
+                make_chunk(S, rows[:512], capacity=512),
+                Barrier.new(2),
+                make_chunk(S, rows[512:], capacity=512),
+                Barrier.new(3)]
+        # delete the current top-300 (covers cand_keep=256 twice over)
+        epoch = 4
+        for lo in range(0, 300, 50):
+            dels = [(i, i) for i in range(lo, lo + 50)]
+            msgs.append(make_chunk(S, dels, ops=[OP_DELETE] * 50,
+                                   capacity=64))
+            msgs.append(Barrier.new(epoch))
+            epoch += 1
+        ex, chunks = _run(msgs, offset=0, limit=3)
+        expect = {(i, i) for i in range(300, 303)}
+        assert _fold(chunks) == expect
+        assert ex.n_refills >= 1
+
+    def test_offset_window(self):
+        rows = [(i, i * 10) for i in range(50)]
+        msgs = [Barrier.new(1), make_chunk(S, rows, capacity=64),
+                Barrier.new(2)]
+        ex, chunks = _run(msgs, offset=5, limit=3)
+        assert _fold(chunks) == {(5, 50), (6, 60), (7, 70)}
+
+    def test_idle_barrier_skips_flush(self):
+        rows = [(i, i) for i in range(100)]
+        msgs = [Barrier.new(1), make_chunk(S, rows, capacity=128),
+                Barrier.new(2), Barrier.new(3), Barrier.new(4)]
+        ex, chunks = _run(msgs, offset=0, limit=5)
+        # idle barriers (3, 4) do no flush work at all
+        assert ex.n_fast_flushes + ex.n_refills == 1
+        assert _fold(chunks) == {(i, i) for i in range(5)}
