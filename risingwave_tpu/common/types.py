@@ -72,17 +72,29 @@ class StringDict:
     egress path (materialize / pgwire). Device code only ever compares,
     hashes, or shuffles the int32 ids. Id 0 is reserved for the empty string
     so zero-initialised buffers decode cleanly.
+
+    Growth is bounded (``max_size``): an unbounded append-only dictionary
+    would leak for high-cardinality string workloads — hitting the bound is
+    a capacity-planning error surfaced loudly, not silent growth.
     """
 
-    __slots__ = ("_to_id", "_to_str")
+    __slots__ = ("_to_id", "_to_str", "max_size")
 
-    def __init__(self) -> None:
+    DEFAULT_MAX = 1 << 22          # 4M distinct strings
+
+    def __init__(self, max_size: int = DEFAULT_MAX) -> None:
         self._to_id: dict[str, int] = {"": 0}
         self._to_str: list[str] = [""]
+        self.max_size = max_size
 
     def intern(self, s: str) -> int:
         i = self._to_id.get(s)
         if i is None:
+            if len(self._to_str) >= self.max_size:
+                raise RuntimeError(
+                    f"string dictionary full ({self.max_size} distinct "
+                    "values); raise StringDict.max_size or reduce string "
+                    "cardinality (e.g. avoid interning unbounded keys)")
             i = len(self._to_str)
             self._to_id[s] = i
             self._to_str.append(s)
@@ -99,6 +111,12 @@ class StringDict:
 # operators and fragments without a coordination protocol. Sources intern,
 # sinks look up. (A per-column dictionary would shrink ids but require id
 # translation at every join on strings.)
+#
+# Process-locality contract (multi-host safety): raw ids may cross DEVICE
+# boundaries within one process (mesh collectives share the host dict) but
+# must NEVER cross PROCESS boundaries — every durable/remote edge
+# (checkpoint value encoding in common/row.py, sink delivery, future DCN
+# exchange) re-encodes ids as string bytes and re-interns on the far side.
 GLOBAL_STRING_DICT = StringDict()
 
 

@@ -127,9 +127,48 @@ class FunctionCall(Expr):
         return Column(data, mask)
 
 
+_DECIMAL_ALIGN_FNS = {
+    "add", "subtract", "modulus", "equal", "not_equal", "less_than",
+    "less_than_or_equal", "greater_than", "greater_than_or_equal",
+}
+
+
+def _decimal_fixup(name: str, args: tuple) -> tuple:
+    """Fixed-point scale handling (reference: Decimal arithmetic in
+    src/common/src/types/decimal.rs). DECIMAL is a scaled int64; aligned
+    scales make +/-/cmp plain int ops; ``multiply`` adds scales (its type
+    inference); ``divide`` and any float operand lower decimals to f64."""
+    if not any(a.type.kind == TypeKind.DECIMAL for a in args):
+        return args
+    if name == "divide" or any(a.type.is_float for a in args):
+        return tuple(
+            cast(a, T.FLOAT64) if a.type.kind == TypeKind.DECIMAL else a
+            for a in args)
+    from ..common.types import decimal as _dec
+    s = max(a.type.scale for a in args)
+
+    def align(a):
+        return cast(a, _dec(s)) if (a.type.kind == TypeKind.DECIMAL
+                                    or a.type.is_integral) else a
+
+    if name in _DECIMAL_ALIGN_FNS or name == "coalesce":
+        return tuple(align(a) for a in args)
+    if name == "case":
+        # value positions only: odd indices + the trailing ELSE
+        has_else = len(args) % 2 == 1
+        out = list(args)
+        for i in range(1, len(args) - (1 if has_else else 0), 2):
+            out[i] = align(args[i])
+        if has_else:
+            out[-1] = align(args[-1])
+        return tuple(out)
+    return args
+
+
 def call(name: str, *args: Expr) -> FunctionCall:
     if name not in _REGISTRY:
         raise KeyError(f"unknown function {name!r}")
+    args = _decimal_fixup(name, tuple(args))
     _, infer = _REGISTRY[name]
     out_type = infer([a.type for a in args])
     return FunctionCall(name, tuple(args), out_type)
@@ -162,6 +201,8 @@ def _promote(ts: Sequence[DataType]) -> DataType:
     best = ts[0]
     for t in ts[1:]:
         if t.kind == best.kind:
+            if t.kind == TypeKind.DECIMAL and t.scale > best.scale:
+                best = t
             continue
         if _NUM_ORDER.index(t.kind) > _NUM_ORDER.index(best.kind):
             best = t
@@ -204,10 +245,19 @@ def _cmp(fn):
     return impl
 
 
+def _t_mul(ts):
+    """Fixed-point product: scales add (decimal(s1) * decimal(s2) →
+    decimal(s1+s2)); mixed float operands were lowered by _decimal_fixup."""
+    decs = [t for t in ts if t.kind == TypeKind.DECIMAL]
+    if decs:
+        return T.decimal(sum(t.scale for t in decs))
+    return _promote(ts)
+
+
 # arithmetic (reference: src/expr/src/vector_op/arithmetic_op.rs)
 register("add", _t_same)(_binary(jnp.add))
 register("subtract", _t_same)(_binary(jnp.subtract))
-register("multiply", _t_same)(_binary(jnp.multiply))
+register("multiply", _t_mul)(_binary(jnp.multiply))
 register("neg", _t_first)(_unary(jnp.negative))
 register("abs", _t_first)(_unary(jnp.abs))
 
@@ -328,10 +378,29 @@ class Cast(Expr):
         c = self.arg.eval(chunk)
         src, dst = self.arg.type, self.type
         data = c.data
-        if src.kind == TypeKind.DECIMAL and dst.is_float:
+
+        def _round_div(d, factor):
+            # PG rounds half away from zero when narrowing fixed point
+            f = jnp.asarray(factor, d.dtype)
+            half = jnp.where(d >= 0, f // 2, -(f // 2))
+            return jax.lax.div(d + half, f)
+
+        if src.kind == TypeKind.DECIMAL and dst.kind == TypeKind.DECIMAL:
+            if dst.scale >= src.scale:
+                data = data * (10 ** (dst.scale - src.scale))
+            else:
+                data = _round_div(data, 10 ** (src.scale - dst.scale))
+        elif src.kind == TypeKind.DECIMAL and dst.is_float:
             data = data.astype(dst.dtype) / (10 ** src.scale)
-        elif dst.kind == TypeKind.DECIMAL and not src.kind == TypeKind.DECIMAL:
-            data = jnp.round(data.astype(jnp.float64) * 10 ** dst.scale).astype(jnp.int64)
+        elif src.kind == TypeKind.DECIMAL:
+            data = _round_div(data, 10 ** src.scale).astype(dst.dtype)
+        elif dst.kind == TypeKind.DECIMAL:
+            data = jnp.round(
+                data.astype(jnp.float64) * 10 ** dst.scale).astype(jnp.int64)
+        elif (src.kind == TypeKind.DATE and dst.kind == TypeKind.TIMESTAMP):
+            data = data.astype(jnp.int64) * USECS_PER_DAY
+        elif (src.kind == TypeKind.TIMESTAMP and dst.kind == TypeKind.DATE):
+            data = (data.astype(jnp.int64) // USECS_PER_DAY).astype(dst.dtype)
         else:
             data = data.astype(dst.dtype)
         return Column(data, c.mask)
@@ -363,14 +432,239 @@ def _tumble_start(datas, masks, out_type):
     return (ts.astype(jnp.int64) // safe) * safe, _strict_mask(masks) & (w != 0)
 
 
-@register("extract_epoch", _t_int64)
-def _extract_epoch(datas, masks, out_type):
-    return datas[0].astype(jnp.int64) // USECS_PER_SEC, masks[0]
+# (field-specific extract registrations are created on demand by
+# make_extract below, keyed on the argument's logical type)
 
 
-@register("extract_hour", _t_int64)
-def _extract_hour(datas, masks, out_type):
-    return (datas[0].astype(jnp.int64) % USECS_PER_DAY) // USECS_PER_HOUR, masks[0]
+# ---------------------------------------------------------------------------
+# Temporal extract family (reference: src/expr/src/vector_op/extract.rs)
+# ---------------------------------------------------------------------------
+# Vectorized civil-date math (Howard Hinnant's algorithm) — pure integer
+# ops, fuses into the surrounding jitted step; no host round trip.
+
+
+def _civil_from_days(days):
+    z = days.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d, doy
+
+
+def make_extract(field: str, arg: Expr) -> Expr:
+    """extract() needs the argument's logical type (date vs timestamp) to
+    find the day number — FunctionCall impls only see raw arrays, so the
+    binder routes extract through per-(field, type) registered wrappers."""
+    field = field.lower()
+    t = arg.type
+    days_div = 1 if t.kind == TypeKind.DATE else USECS_PER_DAY
+
+    def with_days(fn):
+        def impl(datas, masks, out_type):
+            days = datas[0].astype(jnp.int64) // days_div
+            return fn(days).astype(jnp.int64), masks[0]
+        return impl
+
+    def time_part(unit_us, modulo):
+        def impl(datas, masks, out_type):
+            us = datas[0].astype(jnp.int64)
+            return (us % modulo) // unit_us, masks[0]
+        return impl
+
+    name = f"__extract_{field}_{t.kind.name.lower()}"
+    if name not in _REGISTRY:
+        if field == "year":
+            impl = with_days(lambda d: _civil_from_days(d)[0])
+        elif field == "month":
+            impl = with_days(lambda d: _civil_from_days(d)[1])
+        elif field == "day":
+            impl = with_days(lambda d: _civil_from_days(d)[2])
+        elif field == "quarter":
+            impl = with_days(lambda d: (_civil_from_days(d)[1] + 2) // 3)
+        elif field == "dow":        # Sunday = 0 (PG); 1970-01-01 = Thursday
+            impl = with_days(lambda d: (d + 4) % 7)
+        elif field == "doy":
+            def impl(datas, masks, out_type):
+                days = datas[0].astype(jnp.int64) // days_div
+                y, m, _, _ = _civil_from_days(days)
+                jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+                return days - jan1 + 1, masks[0]
+        elif field == "epoch":
+            if t.kind == TypeKind.DATE:
+                def impl(datas, masks, out_type):
+                    return (datas[0].astype(jnp.int64)
+                            * (USECS_PER_DAY // USECS_PER_SEC)), masks[0]
+            else:
+                def impl(datas, masks, out_type):
+                    return datas[0].astype(jnp.int64) // USECS_PER_SEC, masks[0]
+        elif field == "hour":
+            impl = time_part(USECS_PER_HOUR, USECS_PER_DAY)
+        elif field == "minute":
+            impl = time_part(USECS_PER_MIN, USECS_PER_HOUR)
+        elif field == "second":
+            impl = time_part(USECS_PER_SEC, USECS_PER_MIN)
+        else:
+            raise KeyError(f"unsupported EXTRACT field {field!r}")
+        _REGISTRY[name] = (impl, _t_int64)
+    return call(name, arg)
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (jnp.where(m > 2, m - 3, m + 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+# ---------------------------------------------------------------------------
+# String functions over dictionary ids (reference: src/expr/src/vector_op/
+# {lower,upper,length,substr,concat_op,like}.rs)
+# ---------------------------------------------------------------------------
+# VARCHAR columns carry int32 dictionary ids; string *content* lives in the
+# host dictionary. These impls compute on the HOST over concrete arrays,
+# per UNIQUE id (dictionary-sized work, not row-sized), re-interning
+# results — the survey's "varlen strings on device: dictionary-encode at
+# ingest, host fallback path for string ops" (SURVEY.md §7). They must
+# only run EAGERLY: Project/Filter detect them via ``uses_host_callback``
+# and skip jit (some PJRT backends — axon — support no host callbacks at
+# all, so pure_callback inside jit is not an option). Inside a trace the
+# host transfer below raises TracerArrayConversionError, loudly.
+
+
+def _lookup_str(i: int) -> str:
+    from ..common.types import GLOBAL_STRING_DICT
+    try:
+        return GLOBAL_STRING_DICT.lookup(int(i))
+    except (KeyError, IndexError):
+        return ""
+
+
+def _intern_str(s: str) -> int:
+    from ..common.types import GLOBAL_STRING_DICT
+    return GLOBAL_STRING_DICT.intern(s)
+
+
+def _register_str_to_str(name: str, pyfn):
+    """pyfn(str, *scalar_args) -> str; first arg is the id column, the rest
+    are broadcast numeric columns. Work is per unique argument tuple
+    (dictionary-sized), never per row."""
+    def impl(datas, masks, out_type):
+        import numpy as np
+        cols = [np.asarray(d) for d in datas]    # host transfer (eager only)
+        stacked = np.stack([c.astype(np.int64) for c in cols], axis=1)
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        results = np.empty(len(uniq), np.int32)
+        for u, tup in enumerate(uniq):
+            results[u] = _intern_str(
+                pyfn(_lookup_str(tup[0]), *(int(v) for v in tup[1:])))
+        return jnp.asarray(results[inverse]), _strict_mask(masks)
+    _REGISTRY[name] = (impl, lambda ts: T.VARCHAR)
+
+
+_register_str_to_str("lower", lambda s: s.lower())
+_register_str_to_str("upper", lambda s: s.upper())
+_register_str_to_str("trim", lambda s: s.strip())
+_register_str_to_str("ltrim", lambda s: s.lstrip())
+_register_str_to_str("rtrim", lambda s: s.rstrip())
+# PG semantics: the window is [start-1, start-1+n) in VIRTUAL positions —
+# a start below 1 consumes length before the string begins
+def _substr(s, start, n=None):
+    if n is None:
+        return s[max(start - 1, 0):]
+    return s[max(start - 1, 0):max(start - 1 + n, 0)]
+
+
+_register_str_to_str("substr", _substr)
+_register_str_to_str("substring", _substr)
+
+
+@register("length", _t_int64)
+def _length(datas, masks, out_type):
+    import numpy as np
+    ids = np.asarray(datas[0])
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    results = np.array([len(_lookup_str(u)) for u in uniq], np.int64)
+    return jnp.asarray(results[inverse]), masks[0]
+
+
+@register("concat_op", lambda ts: T.VARCHAR)
+def _concat_op(datas, masks, out_type):
+    import numpy as np
+    a, b = np.asarray(datas[0]), np.asarray(datas[1])
+    pairs, inverse = np.unique(np.stack([a, b], axis=1), axis=0,
+                               return_inverse=True)
+    results = np.array([
+        _intern_str(_lookup_str(pa) + _lookup_str(pb)) for pa, pb in pairs],
+        np.int32)
+    return jnp.asarray(results[inverse]), _strict_mask(masks)
+
+
+def _like_to_regex(pattern: str) -> "re.Pattern":
+    import re
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            # LIKE's default escape: \% and \_ match literally
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _make_like(negated: bool, name: str):
+    def impl(datas, masks, out_type):
+        import numpy as np
+        ids, pat_ids = np.asarray(datas[0]), np.asarray(datas[1])
+        pairs, inverse = np.unique(np.stack([ids, pat_ids], axis=1), axis=0,
+                                   return_inverse=True)
+        rx_cache: dict = {}
+        results = np.empty(len(pairs), np.bool_)
+        for u, (uid, pid) in enumerate(pairs):
+            rx = rx_cache.get(pid)
+            if rx is None:
+                rx = rx_cache[pid] = _like_to_regex(_lookup_str(pid))
+            results[u] = (rx.match(_lookup_str(uid)) is not None) != negated
+        return jnp.asarray(results[inverse]), _strict_mask(masks)
+    _REGISTRY[name] = (impl, _t_bool)
+
+
+_make_like(False, "like")
+_make_like(True, "not_like")
+
+
+#: functions implemented via jax.pure_callback — they cannot appear inside
+#: a jitted step on backends without host-callback support (axon PJRT);
+#: operators check ``uses_host_callback`` and fall back to eager eval
+HOST_CALLBACK_FNS = {
+    "lower", "upper", "trim", "ltrim", "rtrim", "substr", "substring",
+    "length", "concat_op", "like", "not_like",
+}
+
+
+def uses_host_callback(e: Expr) -> bool:
+    if isinstance(e, FunctionCall):
+        return (e.name in HOST_CALLBACK_FNS
+                or any(uses_host_callback(a) for a in e.args))
+    if isinstance(e, Cast):
+        return uses_host_callback(e.arg)
+    return False
 
 
 def eval_many(exprs: Sequence[Expr], chunk: StreamChunk) -> tuple[Column, ...]:

@@ -15,9 +15,26 @@ import dataclasses
 from typing import Optional, Sequence
 
 from ..common.types import (
-    BOOL, FLOAT64, INT32, INT64, INTERVAL, TIMESTAMP, VARCHAR, DataType,
-    Field, Schema, TypeKind,
+    BOOL, DATE, FLOAT64, INT32, INT64, INTERVAL, TIMESTAMP, VARCHAR,
+    DataType, Field, Schema, TypeKind,
 )
+
+
+def _parse_date(s: str) -> int:
+    """ISO date string → days since the Unix epoch (DATE physical)."""
+    import datetime as _dt
+    return (_dt.date.fromisoformat(s.strip()) - _dt.date(1970, 1, 1)).days
+
+
+def _parse_timestamp(s: str) -> int:
+    """ISO timestamp string (naive = UTC) → epoch microseconds (exact
+    integer arithmetic; float seconds would drop microseconds)."""
+    import datetime as _dt
+    dt = _dt.datetime.fromisoformat(s.strip())
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    return (dt - epoch) // _dt.timedelta(microseconds=1)
 from ..expr.agg import AggCall
 from ..expr.expr import Cast as RCast, Expr, InputRef, Literal, call, cast
 from . import sqlast as A
@@ -75,6 +92,7 @@ _BINOP_FN = {
     "%": "modulus", "=": "equal", "<>": "not_equal", "<": "less_than",
     "<=": "less_than_or_equal", ">": "greater_than",
     ">=": "greater_than_or_equal", "AND": "and", "OR": "or",
+    "||": "concat_op", "LIKE": "like", "NOT LIKE": "not_like",
 }
 
 AGG_KINDS = {"count", "sum", "min", "max", "avg"}
@@ -141,7 +159,10 @@ class ExprBinder:
             if node.op == "NOT":
                 return call("not", self.bind(node.operand))
             if node.op == "-":
-                return call("neg", self.bind(node.operand))
+                b = self.bind(node.operand)
+                if isinstance(b, Literal) and b.value is not None:
+                    return Literal(-b.value, b.type)
+                return call("neg", b)
             raise BindError(f"unsupported unary op {node.op}")
         if isinstance(node, A.FuncCall):
             return self._func(node)
@@ -189,6 +210,10 @@ class ExprBinder:
             return Literal(v, INTERVAL)
         if node.type_hint == "varchar":
             return Literal(v, VARCHAR)
+        if node.type_hint == "date":
+            return Literal(_parse_date(str(v)), DATE)
+        if node.type_hint == "timestamp":
+            return Literal(_parse_timestamp(str(v)), TIMESTAMP)
         if isinstance(v, bool):
             return Literal(v, BOOL)
         if isinstance(v, int):
@@ -201,7 +226,16 @@ class ExprBinder:
         fn = _BINOP_FN.get(node.op)
         if fn is None:
             raise BindError(f"unsupported operator {node.op}")
-        return call(fn, self.bind(node.left), self.bind(node.right))
+        left, right = self.bind(node.left), self.bind(node.right)
+        if fn in ("concat_op", "like", "not_like"):
+            # the impls interpret values as dictionary ids — a non-string
+            # operand would silently decode garbage
+            for side in (left, right):
+                if not side.type.is_string:
+                    raise BindError(
+                        f"{node.op} requires varchar operands; got "
+                        f"{side.type.kind.value} (cast to varchar first)")
+        return call(fn, left, right)
 
     def _func(self, node: A.FuncCall) -> Expr:
         name = node.name.lower()
@@ -211,6 +245,11 @@ class ExprBinder:
         if name in TABLE_FUNC_KINDS:
             args = tuple(self.bind(a) for a in node.args)
             return TableFuncCall(name, args, INT64)
+        if name == "extract":
+            from ..expr.expr import make_extract
+            field = node.args[0]
+            assert isinstance(field, A.Lit)
+            return make_extract(str(field.value), self.bind(node.args[1]))
         if name in AGG_KINDS:
             if self.agg_ctx is None:
                 raise BindError(f"aggregate {name}() not allowed here")

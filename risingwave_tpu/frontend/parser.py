@@ -33,7 +33,8 @@ KEYWORDS = {
     "key", "watermark", "for", "interval", "asc", "desc", "nulls", "first",
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
-    "sink", "sinks", "over", "partition",
+    "sink", "sinks", "over", "partition", "like", "extract", "set", "to",
+    "parameters",
 }
 
 
@@ -161,6 +162,12 @@ class Parser:
             return A.ShowStatement(what)
         if self.eat_kw("flush"):
             return A.FlushStatement()
+        if self.eat_kw("set"):
+            name = self.ident()
+            if not self.eat_op("="):
+                self.expect_kw("to")
+            t = self.next()
+            return A.SetStatement(name, t.value)
         raise SqlParseError(f"unsupported statement at {self.peek()}")
 
     def _if_not_exists(self) -> bool:
@@ -547,6 +554,10 @@ class Parser:
                 high = self._add_expr()
                 e = A.Between(e, low, high, negated)
                 continue
+            if self.eat_kw("like"):
+                e = A.BinaryOp("NOT LIKE" if negated else "LIKE",
+                               e, self._add_expr())
+                continue
             if negated:
                 self.i = save
             if self.eat_kw("is"):
@@ -630,6 +641,20 @@ class Parser:
             tn = self._type_name()
             self.expect_op(")")
             return A.Cast(e, tn)
+        if self.eat_kw("extract"):
+            # EXTRACT(field FROM expr)
+            self.expect_op("(")
+            field = self.ident()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return A.FuncCall("extract", (A.Lit(field, "varchar"), e))
+        if (t.kind == "name" and t.value in ("date", "timestamp", "timestamptz")
+                and self.peek(1).kind == "str"):
+            # typed literal: DATE '1995-03-15' / TIMESTAMP '… 00:00:00'
+            kind = self.next().value
+            return A.Lit(self.next().value,
+                         "date" if kind == "date" else "timestamp")
         if self.eat_op("("):
             if self.at_kw("select"):
                 q = self._select()

@@ -482,17 +482,35 @@ class Planner:
             raise PlanError("join requires at least one equality condition "
                             "(nested-loop streaming join unsupported)")
         cond = None
+        post_filters: list = []
         if residual:
+            from ..expr.expr import uses_host_callback
             bound = [ExprBinder(scope).bind(c) for c in residual]
-            cond = bound[0]
-            for b in bound[1:]:
-                cond = call("and", cond, b)
+            for b in bound:
+                if uses_host_callback(b):
+                    # host-tier string predicates cannot run inside the
+                    # jitted join core; for inner joins they are equivalent
+                    # to a filter above the join
+                    if j.kind != "inner":
+                        raise PlanError(
+                            "string predicates in outer-join conditions "
+                            "are not supported; filter in a subquery")
+                    post_filters.append(b)
+                elif cond is None:
+                    cond = b
+                else:
+                    cond = call("and", cond, b)
 
         schema = Schema(tuple(left.schema) + tuple(right.schema))
         pk = tuple(left.pk) + tuple(i + n_left for i in right.pk)
-        return PJoin(schema=schema, pk=pk, left=left, right=right,
-                     kind=j.kind, left_keys=tuple(lkeys),
-                     right_keys=tuple(rkeys), condition=cond), scope
+        node: PlanNode = PJoin(
+            schema=schema, pk=pk, left=left, right=right,
+            kind=j.kind, left_keys=tuple(lkeys),
+            right_keys=tuple(rkeys), condition=cond)
+        for b in post_filters:
+            node = PFilter(schema=node.schema, pk=node.pk, input=node,
+                           predicate=b)
+        return node, scope
 
     def _equi_pair(self, conj, scope: Scope, n_left: int):
         if not (isinstance(conj, A.BinaryOp) and conj.op == "="):

@@ -68,13 +68,25 @@ class ProjectExecutor(SingleInputExecutor):
             cols = tuple(e.eval(chunk) for e in self.exprs)
             return chunk.with_columns(cols)
 
-        self._step = jax.jit(_step)
-        self._step_batch = jax.jit(jax.vmap(_step))
+        from ..expr.expr import uses_host_callback
+        if any(uses_host_callback(e) for e in self.exprs):
+            # string functions hop to the host dictionary via
+            # pure_callback, which some PJRT backends (axon) reject inside
+            # compiled programs — run the step eagerly
+            self._step = _step
+            self._step_batch = None
+        else:
+            self._step = jax.jit(_step)
+            self._step_batch = jax.jit(jax.vmap(_step))
 
     async def map_chunk(self, chunk: StreamChunk):
         yield self._step(chunk)
 
     async def map_chunk_batch(self, batch):
+        if self._step_batch is None:
+            async for out in super().map_chunk_batch(batch):
+                yield out
+            return
         from ..common.chunk import ChunkBatch
         yield ChunkBatch(self._step_batch(batch.chunk))
 
@@ -110,12 +122,21 @@ class FilterExecutor(SingleInputExecutor):
             ).astype(ops.dtype)
             return chunk.replace(ops=new_ops, vis=chunk.vis & keep)
 
-        self._step = jax.jit(_step)
-        self._step_batch = jax.jit(jax.vmap(_step))
+        from ..expr.expr import uses_host_callback
+        if uses_host_callback(predicate):
+            self._step = _step          # eager: see ProjectExecutor note
+            self._step_batch = None
+        else:
+            self._step = jax.jit(_step)
+            self._step_batch = jax.jit(jax.vmap(_step))
 
     async def map_chunk(self, chunk: StreamChunk):
         yield self._step(chunk)
 
     async def map_chunk_batch(self, batch):
+        if self._step_batch is None:
+            async for out in super().map_chunk_batch(batch):
+                yield out
+            return
         from ..common.chunk import ChunkBatch
         yield ChunkBatch(self._step_batch(batch.chunk))
