@@ -1,0 +1,105 @@
+"""Playground CLI: one-process cluster behind a Postgres port.
+
+Counterpart of the reference's all-in-one binary
+(reference: src/cmd_all/src/bin/risingwave.rs:118 ``playground`` mode and
+the node binaries under src/cmd/src/bin/). Usage:
+
+    python -m risingwave_tpu playground [--port 4566] [--data-dir DIR]
+    python -m risingwave_tpu sql "CREATE TABLE ..." [--data-dir DIR]
+    python -m risingwave_tpu sql-file script.sql [--data-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _build_session(args):
+    from .frontend.session import Session
+    kwargs = {}
+    if args.data_dir:
+        kwargs["data_dir"] = args.data_dir
+    if getattr(args, "checkpoint_frequency", None):
+        kwargs["checkpoint_frequency"] = args.checkpoint_frequency
+    return Session(**kwargs)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="risingwave_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pg = sub.add_parser("playground",
+                        help="serve SQL over the Postgres wire protocol")
+    pg.add_argument("--host", default="127.0.0.1")
+    pg.add_argument("--port", type=int, default=4566)
+    pg.add_argument("--data-dir", default=None,
+                    help="durable state directory (RAM-only if absent)")
+    pg.add_argument("--checkpoint-frequency", type=int, default=10)
+    pg.add_argument("--tick-interval-ms", type=int, default=1000,
+                    help="barrier interval (reference default 1000ms)")
+
+    q = sub.add_parser("sql", help="run SQL statements and print results")
+    q.add_argument("statement")
+    q.add_argument("--data-dir", default=None)
+
+    qf = sub.add_parser("sql-file", help="run a SQL script file")
+    qf.add_argument("path")
+    qf.add_argument("--data-dir", default=None)
+
+    args = p.parse_args(argv)
+
+    if args.command == "playground":
+        return _playground(args)
+    session = _build_session(args)
+    sql = (args.statement if args.command == "sql"
+           else open(args.path, "r", encoding="utf-8").read())
+    rows = session.run_sql(sql)
+    for row in rows:
+        print("\t".join("" if v is None else str(v) for v in row))
+    return 0
+
+
+def _playground(args) -> int:
+    import asyncio
+    from .frontend.pgwire import PgWireServer
+
+    session = _build_session(args)
+
+    async def run():
+        server = PgWireServer(session, args.host, args.port)
+        await server.start()
+        print(f"risingwave_tpu playground listening on "
+              f"{args.host}:{args.port}", flush=True)
+
+        session.barrier_interval_ms = args.tick_interval_ms
+
+        async def ticker():
+            # the meta barrier tick (reference: GlobalBarrierManager
+            # barrier_interval_ms, src/common/src/config.rs:595). Reads the
+            # interval live so SET barrier_interval_ms takes effect; a tick
+            # failure is logged and retried, never silently fatal.
+            while True:
+                await asyncio.sleep(session.barrier_interval_ms / 1000)
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        server._executor,
+                        lambda: session.jobs and session.tick())
+                except Exception as e:  # noqa: BLE001
+                    print(f"barrier tick failed: {e}", file=sys.stderr,
+                          flush=True)
+
+        tick_task = asyncio.ensure_future(ticker())
+        try:
+            await server.serve_forever()
+        finally:
+            tick_task.cancel()
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Layered configuration + runtime-mutable system parameters.
+
+Counterpart of the reference's config system and system params
+(reference: src/common/src/config.rs:128-634 — ``RwConfig`` sections with
+defaults-in-code so absent keys stay version-stable;
+src/common/src/system_param/mod.rs — cluster params mutable at runtime and
+propagated to all nodes). Layering: defaults-in-code → TOML file →
+explicit overrides; unknown keys are rejected loudly (the reference warns;
+we fail fast since there is no compatibility surface yet).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class StreamingConfig:
+    # reference: config.rs streaming section + system params
+    barrier_interval_ms: int = 1000
+    checkpoint_frequency: int = 10
+    in_flight_barrier_nums: int = 1
+    chunk_capacity: int = 1024
+    agg_table_capacity: int = 1 << 16
+    join_key_capacity: int = 1 << 13
+    join_bucket_width: int = 16
+    topn_table_capacity: int = 1 << 16
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    data_dir: Optional[str] = None          # None = RAM-only playground
+    segment_target_bytes: int = 4 << 20
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 4566
+    telemetry_enabled: bool = False         # reference: telemetry/
+
+
+@dataclasses.dataclass
+class RwConfig:
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    streaming: StreamingConfig = dataclasses.field(
+        default_factory=StreamingConfig)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+
+
+def load_config(path: Optional[str] = None, **overrides: Any) -> RwConfig:
+    """defaults ← TOML file ← dotted-key overrides
+    (e.g. ``load_config("rw.toml", **{"streaming.checkpoint_frequency": 4})``)."""
+    cfg = RwConfig()
+    if path is not None:
+        import tomllib
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for section, values in data.items():
+            _apply_section(cfg, section, values)
+    for dotted, v in overrides.items():
+        section, _, key = dotted.partition(".")
+        if not key:
+            raise ValueError(f"override key must be section.key: {dotted!r}")
+        _apply_section(cfg, section, {key: v})
+    return cfg
+
+
+def _apply_section(cfg: RwConfig, section: str, values: dict) -> None:
+    target = getattr(cfg, section, None)
+    if target is None or not dataclasses.is_dataclass(target):
+        raise ValueError(f"unknown config section {section!r}")
+    names = {f.name for f in dataclasses.fields(target)}
+    for k, v in values.items():
+        if k not in names:
+            raise ValueError(f"unknown config key {section}.{k}")
+        setattr(target, k, v)
+
+
+# -- system params (runtime-mutable; reference: system_param/mod.rs) ---------
+
+#: params a live session accepts via SET; value = coercion fn
+MUTABLE_SYSTEM_PARAMS = {
+    "checkpoint_frequency": int,
+    "barrier_interval_ms": int,
+    "in_flight_barrier_nums": int,
+}

@@ -1,0 +1,154 @@
+"""Host interchange: StreamChunk ↔ Arrow RecordBatch / numpy / DLPack.
+
+Counterpart of the reference's Arrow bridge
+(reference: src/common/src/array/arrow.rs:29-44 — bi-directional
+DataChunk ↔ arrow RecordBatch, used by the UDF boundary and sinks) plus
+the survey's DLPack note (SURVEY.md §2.1 Arrow-bridge row: "TPU
+equivalent: zero-copy DLPack/jax.dlpack bridge").
+
+Semantics at the boundary:
+  * Arrow is a HOST logical format: VARCHAR ids decode to utf8, DECIMAL to
+    decimal128, DATE/TIMESTAMP to date32/timestamp[us]; NULLs from masks.
+  * DLPack is a DEVICE physical format: raw column buffers (dictionary ids
+    included) move zero-copy into torch/numpy; masks travel alongside.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from .chunk import Column, StreamChunk, make_chunk
+from .types import Schema, TypeKind
+
+
+# -- Arrow -------------------------------------------------------------------
+
+def _arrow_type(t, pa):
+    k = t.kind
+    if k == TypeKind.BOOL:
+        return pa.bool_()
+    if k == TypeKind.INT16:
+        return pa.int16()
+    if k == TypeKind.INT32:
+        return pa.int32()
+    if k in (TypeKind.INT64, TypeKind.SERIAL):
+        return pa.int64()
+    if k == TypeKind.FLOAT32:
+        return pa.float32()
+    if k == TypeKind.FLOAT64:
+        return pa.float64()
+    if k == TypeKind.DECIMAL:
+        return pa.decimal128(38, t.scale)
+    if k == TypeKind.DATE:
+        return pa.date32()
+    if k == TypeKind.TIME:
+        return pa.time64("us")
+    if k == TypeKind.TIMESTAMP:
+        return pa.timestamp("us")
+    if k == TypeKind.INTERVAL:
+        return pa.duration("us")
+    if k in (TypeKind.VARCHAR, TypeKind.BYTEA):
+        return pa.string()
+    raise TypeError(f"no arrow mapping for {k}")
+
+
+def chunk_to_arrow(chunk: StreamChunk, schema: Schema,
+                   with_ops: bool = False):
+    """Visible rows of a chunk → pyarrow.RecordBatch (logical values)."""
+    import pyarrow as pa
+    import decimal as _dec
+    vis = np.asarray(chunk.vis)
+    idx = np.nonzero(vis)[0]
+    arrays, fields = [], []
+    if with_ops:
+        ops = np.asarray(chunk.ops)[idx]
+        arrays.append(pa.array(ops, pa.int8()))
+        fields.append(pa.field("__op", pa.int8()))
+    for f, col in zip(schema, chunk.columns):
+        data = np.asarray(col.data)[idx]
+        mask = np.asarray(col.mask)[idx]
+        at = _arrow_type(f.type, pa)
+        if f.type.is_string:
+            vals = [f.type.to_python(v) if m else None
+                    for v, m in zip(data, mask)]
+            arrays.append(pa.array(vals, at))
+        elif f.type.kind == TypeKind.DECIMAL:
+            q = _dec.Decimal(1).scaleb(-f.type.scale)
+            vals = [(_dec.Decimal(int(v)) * q) if m else None
+                    for v, m in zip(data, mask)]
+            arrays.append(pa.array(vals, at))
+        else:
+            arrays.append(pa.array(data, at, mask=~mask))
+        fields.append(pa.field(f.name, at))
+    return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def arrow_to_chunk(batch, schema: Schema,
+                   capacity: Optional[int] = None) -> StreamChunk:
+    """pyarrow.RecordBatch → insert-op chunk (logical decode + intern)."""
+    import datetime as _dt
+    epoch_d = _dt.date(1970, 1, 1)
+    epoch_ts = _dt.datetime(1970, 1, 1)
+    rows: List[tuple] = []
+    cols = [batch.column(f.name) for f in schema]
+    for i in range(batch.num_rows):
+        row = []
+        for f, c in zip(schema, cols):
+            v = c[i].as_py()
+            if v is not None:
+                k = f.type.kind
+                if k == TypeKind.DECIMAL:
+                    v = float(v)
+                elif k == TypeKind.DATE:
+                    v = (v - epoch_d).days
+                elif k == TypeKind.TIMESTAMP:
+                    v = (v.replace(tzinfo=None) - epoch_ts) \
+                        // _dt.timedelta(microseconds=1)
+                elif k == TypeKind.TIME:
+                    v = ((v.hour * 60 + v.minute) * 60
+                         + v.second) * 1_000_000 + v.microsecond
+                elif k == TypeKind.INTERVAL:
+                    v = v // _dt.timedelta(microseconds=1)
+            row.append(v)
+        rows.append(tuple(row))
+    return make_chunk(schema, rows,
+                      capacity=capacity or max(len(rows), 1))
+
+
+# -- numpy / DLPack ----------------------------------------------------------
+
+def chunk_to_numpy(chunk: StreamChunk) -> dict:
+    """Physical host view: {'ops', 'vis', 'columns': [(data, mask), ...]}."""
+    return {
+        "ops": np.asarray(chunk.ops),
+        "vis": np.asarray(chunk.vis),
+        "columns": [(np.asarray(c.data), np.asarray(c.mask))
+                    for c in chunk.columns],
+    }
+
+
+def column_to_dlpack(col: Column):
+    """Zero-copy DLPack capsules for (data, mask) device buffers — consume
+    with torch.utils.dlpack.from_dlpack / np.from_dlpack."""
+    import jax
+    return jax.dlpack.to_dlpack(col.data), jax.dlpack.to_dlpack(col.mask)
+
+
+def column_to_torch(col: Column):
+    """Column device buffers → torch tensors (zero-copy where the backend
+    allows; TPU buffers transfer through host)."""
+    import torch
+    data = np.asarray(col.data)
+    mask = np.asarray(col.mask)
+    return torch.from_numpy(np.ascontiguousarray(data)), \
+        torch.from_numpy(np.ascontiguousarray(mask))
+
+
+def torch_to_column(data, mask=None) -> Column:
+    import jax.numpy as jnp
+    d = np.asarray(data.detach().cpu().numpy())
+    m = (np.ones(d.shape, bool) if mask is None
+         else np.asarray(mask.detach().cpu().numpy()).astype(bool))
+    return Column(jnp.asarray(d), jnp.asarray(m))

@@ -1,0 +1,64 @@
+"""Memory accounting: EstimateSize for device state.
+
+Counterpart of the reference's memory accounting
+(reference: src/common/src/estimate_size/ ``EstimateSize`` trait +
+src/utils/local_stats_alloc — cache-size accounting feeding eviction
+decisions). Here the dominant budget is HBM: every stateful executor's
+device state is a pytree of jax arrays, so sizes are exact (`nbytes`), not
+estimated. ``executor_state_bytes`` walks an executor's known state
+attributes; ``pipeline_state_bytes`` aggregates a whole job — surfaced via
+``Session.metrics()`` for capacity planning against the chip's HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: executor attributes that may hold device-state pytrees
+_STATE_ATTRS = ("state", "rows", "_state", "table_state")
+
+
+def tree_device_bytes(tree: Any) -> int:
+    """Total bytes of jax arrays in a pytree (0 for host-only objects)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None and hasattr(leaf, "dtype"):
+            total += int(nbytes)
+    return total
+
+
+def executor_state_bytes(ex: Any) -> int:
+    import jax
+    total = 0
+    seen: set = set()
+    for attr in _STATE_ATTRS:
+        v = getattr(ex, attr, None)
+        if v is None or id(v) in seen:
+            continue
+        seen.add(id(v))
+        try:
+            total += tree_device_bytes(v)
+        except Exception:   # noqa: BLE001 - non-pytree attribute
+            continue
+    return total
+
+
+def pipeline_state_bytes(root: Any) -> dict:
+    """{'<Identity>#<n>': bytes} over a pipeline; includes a '_total'."""
+    from ..stream.metrics import iter_executors
+    out: dict = {}
+    counts: dict = {}
+    total = 0
+    for ex in iter_executors(root):
+        b = executor_state_bytes(ex)
+        if b == 0:
+            continue
+        ident = getattr(ex, "identity", type(ex).__name__)
+        n = counts.get(ident, 0)
+        counts[ident] = n + 1
+        out[f"{ident}#{n}" if n else ident] = b
+        total += b
+    out["_total"] = total
+    return out

@@ -1,0 +1,214 @@
+"""pgwire: Postgres wire-protocol (v3) server over asyncio.
+
+Counterpart of the reference's pgwire crate
+(reference: src/utils/pgwire/src/pg_server.rs:131 ``pg_serve``,
+pg_protocol.rs:220-259 message loop). Implements the simple-query flow —
+startup (trust auth), Query, RowDescription/DataRow/CommandComplete,
+ErrorResponse, ReadyForQuery, Terminate — enough for psql/BI tools and the
+sqllogictest-style drivers the reference serves.
+
+The Session API is synchronous and owns its private event loop, so query
+execution is serialized onto one worker thread; protocol IO stays on the
+server's asyncio loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import struct
+from typing import Optional
+
+from ..common.types import DataType, TypeKind
+from .session import Session, SqlError
+
+# Postgres type OIDs (reference: pg_type.h; pgwire/src/types.rs)
+_OIDS = {
+    TypeKind.BOOL: 16,
+    TypeKind.INT16: 21,
+    TypeKind.INT32: 23,
+    TypeKind.INT64: 20,
+    TypeKind.FLOAT32: 700,
+    TypeKind.FLOAT64: 701,
+    TypeKind.DECIMAL: 1700,
+    TypeKind.DATE: 1082,
+    TypeKind.TIME: 1083,
+    TypeKind.TIMESTAMP: 1114,
+    TypeKind.INTERVAL: 1186,
+    TypeKind.VARCHAR: 25,
+    TypeKind.BYTEA: 17,
+    TypeKind.SERIAL: 20,
+}
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+def _fmt_value(v, t: Optional[DataType]) -> str:
+    import datetime as _dt
+    if t is None:
+        return str(v)
+    if t.kind == TypeKind.BOOL:
+        return "t" if v else "f"
+    if t.kind == TypeKind.DATE and isinstance(v, int):
+        return (_dt.date(1970, 1, 1) + _dt.timedelta(days=v)).isoformat()
+    if t.kind == TypeKind.TIMESTAMP and isinstance(v, int):
+        return (_dt.datetime(1970, 1, 1)
+                + _dt.timedelta(microseconds=v)).isoformat(sep=" ")
+    return str(v)
+
+
+class PgWireServer:
+    def __init__(self, session: Session, host: str = "127.0.0.1",
+                 port: int = 4566):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        # one worker thread: the Session is single-threaded by design
+        self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self._executor.shutdown(wait=False)
+
+    # -- protocol -------------------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            if not await self._startup(reader, writer):
+                return
+            while True:
+                hdr = await reader.readexactly(5)
+                tag, ln = hdr[0:1], struct.unpack("!I", hdr[1:5])[0]
+                body = await reader.readexactly(ln - 4)
+                if tag == b"X":          # Terminate
+                    break
+                if tag == b"Q":
+                    sql = body.rstrip(b"\x00").decode()
+                    await self._run_query(writer, sql)
+                elif tag in (b"P", b"B", b"D", b"E", b"S", b"C"):
+                    # extended protocol not supported: report cleanly once a
+                    # Sync arrives (reference: pg_protocol extended mode)
+                    if tag == b"S":
+                        self._send_error(
+                            writer, "extended query protocol not supported")
+                        writer.write(_msg(b"Z", b"I"))
+                        await writer.drain()
+                else:
+                    self._send_error(writer, f"unknown message {tag!r}")
+                    writer.write(_msg(b"Z", b"I"))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _startup(self, reader, writer) -> bool:
+        while True:
+            ln = struct.unpack("!I", await reader.readexactly(4))[0]
+            body = await reader.readexactly(ln - 4)
+            code = struct.unpack("!I", body[:4])[0]
+            if code == 80877103:         # SSLRequest
+                writer.write(b"N")
+                await writer.drain()
+                continue
+            if code == 80877102:         # CancelRequest
+                return False
+            break                         # StartupMessage
+        # trust auth (reference playground default)
+        writer.write(_msg(b"R", struct.pack("!I", 0)))       # AuthenticationOk
+        for k, v in (("server_version", "13.0"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8")):
+            writer.write(_msg(b"S", _cstr(k) + _cstr(v)))    # ParameterStatus
+        writer.write(_msg(b"K", struct.pack("!II", 0, 0)))   # BackendKeyData
+        writer.write(_msg(b"Z", b"I"))                       # ReadyForQuery
+        await writer.drain()
+        return True
+
+    async def _run_query(self, writer, sql: str) -> None:
+        if not sql.strip():
+            writer.write(_msg(b"I", b""))            # EmptyQueryResponse
+            writer.write(_msg(b"Z", b"I"))
+            await writer.drain()
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            rows, schema, command = await loop.run_in_executor(
+                self._executor, self._execute, sql)
+        except Exception as e:  # noqa: BLE001 - surfaced as ErrorResponse
+            self._send_error(writer, str(e))
+            writer.write(_msg(b"Z", b"I"))
+            await writer.drain()
+            return
+        if schema is not None:
+            payload = struct.pack("!H", len(schema))
+            for name, t in schema:
+                payload += (_cstr(name) + struct.pack(
+                    "!IHIhih", 0, 0, _OIDS.get(t.kind, 25), -1, -1, 0))
+            writer.write(_msg(b"T", payload))        # RowDescription
+            for row in rows:
+                body = struct.pack("!H", len(row))
+                for v, (_, t) in zip(row, schema):
+                    if v is None:
+                        body += struct.pack("!i", -1)
+                    else:
+                        s = _fmt_value(v, t).encode()
+                        body += struct.pack("!i", len(s)) + s
+                writer.write(_msg(b"D", body))       # DataRow
+            command = f"SELECT {len(rows)}"
+        writer.write(_msg(b"C", _cstr(command)))     # CommandComplete
+        writer.write(_msg(b"Z", b"I"))               # ReadyForQuery
+        await writer.drain()
+
+    def _execute(self, sql: str):
+        """Worker-thread entry: returns (rows, schema-or-None, command)."""
+        from . import sqlast as A
+        from ..common.types import VARCHAR
+        from .parser import parse_sql
+        stmts = parse_sql(sql)
+        rows = self.session.run_sql(sql)
+        schema = None
+        if stmts and isinstance(stmts[-1], A.ShowStatement):
+            if stmts[-1].what == "parameters":
+                schema = [("Name", VARCHAR), ("Value", VARCHAR)]
+            else:
+                schema = [("Name", VARCHAR)]
+        elif stmts and isinstance(stmts[-1], A.Query):
+            # plan-derived output schema, stored by Session.query — no
+            # second planning pass
+            schema = list(self.session.last_select_schema)
+        command = "OK"
+        if stmts:
+            command = type(stmts[-1]).__name__.replace("Statement", "").upper()
+        return rows, schema, command
+
+    def _send_error(self, writer, message: str) -> None:
+        payload = (b"S" + _cstr("ERROR") + b"C" + _cstr("XX000")
+                   + b"M" + _cstr(message) + b"\x00")
+        writer.write(_msg(b"E", payload))
+
+
+def serve(session: Session, host: str = "127.0.0.1", port: int = 4566):
+    """Blocking entry point (reference: pg_serve, pg_server.rs:131)."""
+    srv = PgWireServer(session, host, port)
+    asyncio.run(srv.serve_forever())

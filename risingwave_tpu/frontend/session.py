@@ -153,7 +153,23 @@ class Session:
                  chunks_per_tick: int = 1, source_chunk_capacity: int = 1024,
                  config: Optional[BuildConfig] = None, seed: int = 42,
                  data_dir: Optional[str] = None,
-                 in_flight_barriers: int = 1):
+                 in_flight_barriers: int = 1,
+                 rw_config=None):
+        # layered config (common/config.py): an RwConfig overrides the
+        # keyword defaults; explicit kwargs are not merged (callers pick one
+        # style). Reference: load_config + SystemParams (config.rs:128).
+        if rw_config is not None:
+            st = rw_config.streaming
+            checkpoint_frequency = st.checkpoint_frequency
+            in_flight_barriers = st.in_flight_barrier_nums
+            source_chunk_capacity = st.chunk_capacity
+            data_dir = rw_config.storage.data_dir or data_dir
+            config = config or BuildConfig(
+                chunk_capacity=st.chunk_capacity,
+                agg_table_capacity=st.agg_table_capacity,
+                join_key_capacity=st.join_key_capacity,
+                join_bucket_width=st.join_bucket_width,
+                topn_table_capacity=st.topn_table_capacity)
         self.catalog = Catalog()
         self.data_dir = data_dir
         if data_dir is not None:
@@ -163,6 +179,14 @@ class Session:
             self.store = MemoryStateStore()
         self.config = config or BuildConfig()
         self.checkpoint_frequency = checkpoint_frequency
+        # barrier cadence for interval-driven drivers (CLI ticker); mutable
+        # via SET barrier_interval_ms
+        self.barrier_interval_ms = (
+            rw_config.streaming.barrier_interval_ms
+            if rw_config is not None else 1000)
+        # output schema of the most recent batch SELECT (pgwire reads it
+        # instead of re-planning the statement)
+        self.last_select_schema: list = []
         self.chunks_per_tick = chunks_per_tick
         self.source_chunk_capacity = source_chunk_capacity
         self.seed = seed
@@ -253,6 +277,8 @@ class Session:
         if isinstance(stmt, A.Query):
             return self.query(stmt.select)
         if isinstance(stmt, A.ShowStatement):
+            if stmt.what == "parameters":
+                return self.parameters()
             reg = {"tables": self.catalog.tables,
                    "sources": self.catalog.sources,
                    "sinks": self.catalog.sinks,
@@ -263,7 +289,37 @@ class Session:
         if isinstance(stmt, A.FlushStatement):
             self.flush()
             return []
+        if isinstance(stmt, A.SetStatement):
+            return self._set_param(stmt)
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    def _set_param(self, stmt: A.SetStatement) -> list:
+        """Runtime-mutable system params (reference:
+        src/common/src/system_param/mod.rs — hot-propagated; here applied
+        directly since the session IS the cluster)."""
+        from ..common.config import MUTABLE_SYSTEM_PARAMS
+        name = stmt.name.lower()
+        coerce = MUTABLE_SYSTEM_PARAMS.get(name)
+        if coerce is None:
+            raise SqlError(f"unknown or immutable parameter {stmt.name!r}")
+        value = coerce(stmt.value)
+        if name == "checkpoint_frequency":
+            if value < 1:
+                raise SqlError("checkpoint_frequency must be >= 1")
+            self.checkpoint_frequency = value
+        elif name == "in_flight_barrier_nums":
+            self.in_flight_barriers = max(1, value)
+        elif name == "barrier_interval_ms":
+            self.barrier_interval_ms = value   # read live by the CLI ticker
+        return []
+
+    def parameters(self) -> list:
+        """SHOW PARAMETERS rows (name, value)."""
+        return [
+            ("barrier_interval_ms", str(self.barrier_interval_ms)),
+            ("checkpoint_frequency", str(self.checkpoint_frequency)),
+            ("in_flight_barrier_nums", str(self.in_flight_barriers)),
+        ]
 
     # ----------------------------------------------------------------- DDL --
 
@@ -764,6 +820,9 @@ class Session:
         """Batch SELECT: run the stream plan over snapshot sources."""
         self._drain_inflight()   # read-your-writes snapshot
         plan = Planner(self.catalog).plan_select(sel)
+        self.last_select_schema = [
+            (f.name, f.type) for f in plan.schema
+            if not f.name.startswith("_")]
 
         def factory(leaf) -> Executor:
             if isinstance(leaf, (PTableScan, PMvScan)):
@@ -856,6 +915,7 @@ class Session:
         """Observability dump: per-job per-executor counters + session
         barrier latency percentiles (reference:
         src/stream/src/executor/monitor/streaming_stats.rs:27-88)."""
+        from ..common.memory import pipeline_state_bytes
         from ..stream.metrics import pipeline_metrics
         return {
             "barrier_latency": self.barrier_latency.snapshot(),
@@ -864,7 +924,27 @@ class Session:
                 name: pipeline_metrics(job.pipeline)
                 for name, job in self.jobs.items()
             },
+            "state_bytes": {
+                name: pipeline_state_bytes(job.pipeline)
+                for name, job in self.jobs.items()
+            },
         }
+
+    def close(self) -> None:
+        """Graceful shutdown: stop all stream jobs, close sinks, close the
+        session loop. A closed session cannot be reused."""
+        if self.loop.is_closed():
+            return
+        self._drain_inflight()
+        for job in list(self.jobs.values()):
+            sink = getattr(job.pipeline, "sink", None)
+            if sink is not None:
+                sink.close()
+        self._await(asyncio.gather(
+            *(job.stop() for job in self.jobs.values()),
+            return_exceptions=True))
+        self.jobs.clear()
+        self.loop.close()
 
     def _alloc_shard(self) -> int:
         self._next_shard += 1

@@ -266,6 +266,14 @@ class FlushStatement:
     pass
 
 
+@dataclasses.dataclass(frozen=True)
+class SetStatement:
+    """SET param = value (system params / session vars)."""
+
+    name: str
+    value: Any
+
+
 Statement = Union[CreateSink, CreateSource, CreateTable, CreateMaterializedView,
                   CreateIndex, DropStatement, Insert, Query, ShowStatement,
-                  FlushStatement]
+                  FlushStatement, SetStatement]

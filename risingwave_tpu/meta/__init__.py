@@ -1,0 +1,3 @@
+from .cluster import ClusterManager, WorkerNode  # noqa: F401
+from .fragment import Fragment, FragmentManager, fragment_plan  # noqa: F401
+from .notification import NotificationManager  # noqa: F401

@@ -1,0 +1,80 @@
+"""ClusterManager: worker registry + heartbeat failure detector.
+
+Counterpart of the reference's ClusterManager
+(reference: src/meta/src/manager/cluster.rs:64 registration/parallel
+units, :300 heartbeat, :320-344 ``start_heartbeat_checker`` TTL expiry).
+The clock is injectable so the deterministic sim can drive expiry without
+wall time (reference: madsim virtual time).
+
+Failure flow mirrors §3.4: on expiry the manager marks the worker DOWN and
+invokes the registered failure listeners (the barrier conductor's recovery
+hook in a full deployment; the sim harness in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class WorkerNode:
+    worker_id: int
+    host: str
+    parallelism: int                  # parallel units (device count)
+    state: str = "RUNNING"            # RUNNING | DOWN
+    last_heartbeat: float = 0.0
+
+
+class ClusterManager:
+    def __init__(self, heartbeat_ttl_s: float = 30.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.heartbeat_ttl_s = heartbeat_ttl_s
+        self.clock = clock or time.monotonic
+        self.workers: Dict[int, WorkerNode] = {}
+        self._next_id = 1
+        self._failure_listeners: List[Callable[[WorkerNode], None]] = []
+
+    def add_worker(self, host: str, parallelism: int) -> WorkerNode:
+        w = WorkerNode(self._next_id, host, parallelism,
+                       last_heartbeat=self.clock())
+        self._next_id += 1
+        self.workers[w.worker_id] = w
+        return w
+
+    def delete_worker(self, worker_id: int) -> None:
+        self.workers.pop(worker_id, None)
+
+    def heartbeat(self, worker_id: int) -> None:
+        w = self.workers.get(worker_id)
+        if w is None:
+            raise KeyError(f"unknown worker {worker_id}")
+        w.last_heartbeat = self.clock()
+        if w.state == "DOWN":
+            w.state = "RUNNING"       # rejoin after transient expiry
+
+    def on_failure(self, fn: Callable[[WorkerNode], None]) -> None:
+        self._failure_listeners.append(fn)
+
+    def check_heartbeats(self) -> List[WorkerNode]:
+        """One detector sweep; returns newly-expired workers (reference:
+        the periodic checker task, cluster.rs:320-344)."""
+        now = self.clock()
+        expired = []
+        for w in self.workers.values():
+            if (w.state == "RUNNING"
+                    and now - w.last_heartbeat > self.heartbeat_ttl_s):
+                w.state = "DOWN"
+                expired.append(w)
+        for w in expired:
+            for fn in self._failure_listeners:
+                fn(w)
+        return expired
+
+    def live_workers(self) -> List[WorkerNode]:
+        return [w for w in self.workers.values() if w.state == "RUNNING"]
+
+    @property
+    def total_parallelism(self) -> int:
+        return sum(w.parallelism for w in self.live_workers())

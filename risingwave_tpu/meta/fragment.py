@@ -1,0 +1,130 @@
+"""Stream fragmenter + FragmentManager.
+
+Counterparts of the reference's fragmenter and fragment registry
+(reference: src/frontend/src/stream_fragmenter/mod.rs:115 — cut the plan
+at exchange edges; src/meta/src/stream/stream_graph/fragment.rs:237;
+manager/catalog/fragment.rs — persisted fragment→actor mapping).
+
+In the TPU design an "exchange edge" is a *distribution change*: the
+operators below it can run in one fused device step, and crossing it
+requires a shuffle (all_to_all by key) or a singleton gather. Fragments
+therefore cut at: hash-distributed Agg/Join inputs (shuffle by group/join
+key), singleton operators (SimpleAgg/TopN/Sort), and Union fan-ins. The
+fragment graph is what the meta tier schedules onto mesh slices and what
+reschedule remaps (vnode → shard assignment per fragment).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..frontend import planner as P
+
+
+@dataclasses.dataclass
+class Fragment:
+    fragment_id: int
+    root: P.PlanNode                 # subtree executed inside this fragment
+    distribution: str                # "hash" | "single" | "source"
+    dist_keys: Tuple[int, ...] = ()
+    upstream: Tuple[int, ...] = ()   # fragment ids feeding this one
+
+
+@dataclasses.dataclass
+class FragmentGraph:
+    fragments: Dict[int, Fragment]
+    root_id: int
+
+    def explain(self) -> str:
+        lines = []
+        for fid in sorted(self.fragments):
+            f = self.fragments[fid]
+            up = f" <- {list(f.upstream)}" if f.upstream else ""
+            keys = f" keys={list(f.dist_keys)}" if f.dist_keys else ""
+            lines.append(
+                f"Fragment {fid} [{f.distribution}{keys}]{up}: "
+                f"{f.root.label()}")
+        return "\n".join(lines)
+
+
+def fragment_plan(plan: P.PlanNode) -> FragmentGraph:
+    """Cut a plan tree into fragments at distribution changes."""
+    fragments: Dict[int, Fragment] = {}
+    counter = {"next": 0}
+
+    def new_fragment(root, distribution, dist_keys=(), upstream=()):
+        fid = counter["next"]
+        counter["next"] += 1
+        fragments[fid] = Fragment(fid, root, distribution,
+                                  tuple(dist_keys), tuple(upstream))
+        return fid
+
+    def visit(node: P.PlanNode) -> Tuple[P.PlanNode, List[int]]:
+        """Returns (node, upstream fragment ids feeding the CURRENT
+        fragment through exchanges below this node)."""
+        if isinstance(node, P.PAgg):
+            child, child_up = visit(node.input)
+            if node.group_keys:
+                up = new_fragment(child, _dist_of(child), (), child_up)
+                return node, [up]            # hash exchange by group key
+            up = new_fragment(child, _dist_of(child), (), child_up)
+            return node, [up]                # singleton exchange
+        if isinstance(node, P.PJoin):
+            left, lup = visit(node.left)
+            right, rup = visit(node.right)
+            lf = new_fragment(left, _dist_of(left), (), lup)
+            rf = new_fragment(right, _dist_of(right), (), rup)
+            return node, [lf, rf]            # hash exchange both sides
+        if isinstance(node, P.PTopN):
+            child, child_up = visit(node.input)
+            if not node.group_by:
+                up = new_fragment(child, _dist_of(child), (), child_up)
+                return node, [up]            # gather to singleton
+        if isinstance(node, P.PUnion):
+            ups = []
+            for inp in node.inputs:
+                c, cup = visit(inp)
+                ups.append(new_fragment(c, _dist_of(c), (), cup))
+            return node, ups
+        ups: List[int] = []
+        for c in node.children:
+            _, cup = visit(c)
+            ups.extend(cup)
+        return node, ups
+
+    root, ups = visit(plan)
+    root_id = new_fragment(root, _dist_of(root), (), ups)
+    return FragmentGraph(fragments, root_id)
+
+
+def _dist_of(node: P.PlanNode) -> str:
+    if isinstance(node, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)):
+        return "source"
+    if isinstance(node, P.PAgg):
+        return "hash" if node.group_keys else "single"
+    if isinstance(node, P.PJoin):
+        return "hash"
+    if isinstance(node, P.PTopN):
+        return "single" if not node.group_by else "hash"
+    return "inherit"
+
+
+class FragmentManager:
+    """Registry of fragment graphs per streaming job (reference:
+    FragmentManager, manager/catalog/fragment.rs)."""
+
+    def __init__(self) -> None:
+        self._graphs: Dict[str, FragmentGraph] = {}
+
+    def register(self, job_name: str, graph: FragmentGraph) -> None:
+        self._graphs[job_name] = graph
+
+    def drop(self, job_name: str) -> None:
+        self._graphs.pop(job_name, None)
+
+    def get(self, job_name: str) -> Optional[FragmentGraph]:
+        return self._graphs.get(job_name)
+
+    def all_jobs(self) -> List[str]:
+        return sorted(self._graphs)

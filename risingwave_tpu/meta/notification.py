@@ -1,0 +1,49 @@
+"""NotificationManager: versioned metadata push to observers.
+
+Counterpart of the reference's NotificationManager / ObserverManager
+(reference: src/meta/src/manager/notification.rs:75-218;
+src/common/common_service/src/observer_manager.rs:61). Observers (frontend
+catalog caches, compute nodes, the dashboard) subscribe per channel and
+receive ordered, versioned deltas; a late subscriber gets a snapshot
+first — the same snapshot-then-deltas contract MV subscriptions use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+
+class NotificationManager:
+    def __init__(self) -> None:
+        self._version = 0
+        self._log: List[Tuple[int, str, Any]] = []   # (version, channel, info)
+        self._observers: Dict[str, List[Callable[[int, Any], None]]] = {}
+
+    @property
+    def current_version(self) -> int:
+        return self._version
+
+    def notify(self, channel: str, info: Any) -> int:
+        """Publish one delta; returns its version."""
+        self._version += 1
+        self._log.append((self._version, channel, info))
+        for fn in self._observers.get(channel, []):
+            fn(self._version, info)
+        return self._version
+
+    def subscribe(self, channel: str,
+                  fn: Callable[[int, Any], None],
+                  from_version: int = 0) -> int:
+        """Register an observer; replays deltas after ``from_version``
+        before live notifications (snapshot catch-up). Returns the version
+        the observer is now current to."""
+        for v, ch, info in self._log:
+            if ch == channel and v > from_version:
+                fn(v, info)
+        self._observers.setdefault(channel, []).append(fn)
+        return self._version
+
+    def unsubscribe(self, channel: str, fn) -> None:
+        obs = self._observers.get(channel, [])
+        if fn in obs:
+            obs.remove(fn)
