@@ -60,6 +60,19 @@ def _fmt_value(v, t: Optional[DataType]) -> str:
     if t.kind == TypeKind.TIMESTAMP and isinstance(v, int):
         return (_dt.datetime(1970, 1, 1)
                 + _dt.timedelta(microseconds=v)).isoformat(sep=" ")
+    if t.kind == TypeKind.TIME and isinstance(v, int):
+        us = v % 1_000_000
+        sec = v // 1_000_000
+        base = f"{sec // 3600:02d}:{(sec // 60) % 60:02d}:{sec % 60:02d}"
+        return f"{base}.{us:06d}" if us else base
+    if t.kind == TypeKind.INTERVAL and isinstance(v, int):
+        sign = "-" if v < 0 else ""
+        av = abs(v)
+        us = av % 1_000_000
+        sec = av // 1_000_000
+        base = (f"{sign}{sec // 3600:02d}:"
+                f"{(sec // 60) % 60:02d}:{sec % 60:02d}")
+        return f"{base}.{us:06d}" if us else base
     return str(v)
 
 
@@ -127,8 +140,8 @@ class PgWireServer:
             ln = struct.unpack("!I", await reader.readexactly(4))[0]
             body = await reader.readexactly(ln - 4)
             code = struct.unpack("!I", body[:4])[0]
-            if code == 80877103:         # SSLRequest
-                writer.write(b"N")
+            if code in (80877103, 80877104):   # SSLRequest / GSSENCRequest
+                writer.write(b"N")             # not supported; plaintext
                 await writer.drain()
                 continue
             if code == 80877102:         # CancelRequest

@@ -213,6 +213,11 @@ class Session:
         # must survive across synchronous API calls, independent of any
         # ambient loop other code may create/close
         self.loop = asyncio.new_event_loop()
+        # pre-warm the native row codec off the hot path: its first use
+        # otherwise pays a synchronous g++ compile inside a barrier
+        import threading
+        from ..native import codec as _native_codec
+        threading.Thread(target=_native_codec, daemon=True).start()
         if data_dir is not None:
             self._recover()
 

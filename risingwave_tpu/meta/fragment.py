@@ -27,6 +27,8 @@ class Fragment:
     fragment_id: int
     root: P.PlanNode                 # subtree executed inside this fragment
     distribution: str                # "hash" | "single" | "source"
+    #: shuffle keys on this fragment's OUTPUT exchange (column indices of
+    #: its root schema); empty = gather-to-singleton or passthrough
     dist_keys: Tuple[int, ...] = ()
     upstream: Tuple[int, ...] = ()   # fragment ids feeding this one
 
@@ -64,17 +66,19 @@ def fragment_plan(plan: P.PlanNode) -> FragmentGraph:
         """Returns (node, upstream fragment ids feeding the CURRENT
         fragment through exchanges below this node)."""
         if isinstance(node, P.PAgg):
+            # exchange below the agg: hash by group key, or gather to a
+            # singleton for the global agg
             child, child_up = visit(node.input)
-            if node.group_keys:
-                up = new_fragment(child, _dist_of(child), (), child_up)
-                return node, [up]            # hash exchange by group key
-            up = new_fragment(child, _dist_of(child), (), child_up)
-            return node, [up]                # singleton exchange
+            up = new_fragment(child, _dist_of(child),
+                              tuple(node.group_keys), child_up)
+            return node, [up]
         if isinstance(node, P.PJoin):
             left, lup = visit(node.left)
             right, rup = visit(node.right)
-            lf = new_fragment(left, _dist_of(left), (), lup)
-            rf = new_fragment(right, _dist_of(right), (), rup)
+            lf = new_fragment(left, _dist_of(left),
+                              tuple(node.left_keys), lup)
+            rf = new_fragment(right, _dist_of(right),
+                              tuple(node.right_keys), rup)
             return node, [lf, rf]            # hash exchange both sides
         if isinstance(node, P.PTopN):
             child, child_up = visit(node.input)

@@ -69,6 +69,13 @@ class SimCluster:
         """Abandon the session with no shutdown (uncommitted state and
         unacked DML are lost), then recover + re-apply unacked DML."""
         self.kills += 1
+        # crash semantics: no job shutdown, no flush — but do close the
+        # abandoned private event loop so kills don't leak loops
+        old = self.session
+        try:
+            old.loop.close()
+        except Exception:   # noqa: BLE001
+            pass
         self.session = Session(data_dir=self.data_dir, **self.session_kw)
         for sql in self._unacked:
             self.session.run_sql(sql)

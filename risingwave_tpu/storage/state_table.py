@@ -34,6 +34,7 @@ class StateTable:
         self.pk_indices = tuple(pk_indices)
         self._pk_types = tuple(schema[i].type for i in self.pk_indices)
         self._puts: dict[bytes, tuple] = {}
+        self._puts_enc: dict[bytes, bytes] = {}   # pre-encoded (native path)
         self._dels: set[bytes] = set()
 
     # -- key helpers ----------------------------------------------------------
@@ -46,12 +47,28 @@ class StateTable:
     def insert(self, row: Sequence[Any]) -> None:
         k = self.key_of(row)
         self._dels.discard(k)
+        self._puts_enc.pop(k, None)
         self._puts[k] = tuple(row)
 
     def delete(self, row: Sequence[Any]) -> None:
         k = self.key_of(row)
         self._puts.pop(k, None)
+        self._puts_enc.pop(k, None)
         self._dels.add(k)
+
+    def stage_encoded(self, puts: dict, dels: Sequence[bytes]) -> None:
+        """Batch-staged rows already in durable form — the native
+        checkpoint fast path (native/rowcodec.cpp): keys are memcomparable
+        bytes, values are value-encoded bytes. Semantically identical to
+        insert()/delete() row by row."""
+        for k in dels:
+            self._puts.pop(k, None)
+            self._puts_enc.pop(k, None)
+            self._dels.add(k)
+        for k, v in puts.items():
+            self._dels.discard(k)
+            self._puts.pop(k, None)
+            self._puts_enc[k] = v
 
     def update(self, old_row: Sequence[Any], new_row: Sequence[Any]) -> None:
         ko, kn = self.key_of(old_row), self.key_of(new_row)
@@ -65,16 +82,17 @@ class StateTable:
         boundary as value-encoded bytes — the store is an opaque KV tier,
         and the durable backend persists process-independent bytes
         (reference: value encoding at the table layer, state_table.rs:62)."""
-        if self._puts or self._dels:
+        if self._puts or self._puts_enc or self._dels:
             encoded = {
                 k: encode_value_row(v, self.schema.types)
                 for k, v in self._puts.items()
             }
+            encoded.update(self._puts_enc)
             self.store.ingest(self.table_id, epoch, encoded, self._dels)
-            self._puts, self._dels = {}, set()
+            self._puts, self._puts_enc, self._dels = {}, {}, set()
 
     def is_dirty(self) -> bool:
-        return bool(self._puts or self._dels)
+        return bool(self._puts or self._puts_enc or self._dels)
 
     # -- reads (committed + own uncommitted buffer) ---------------------------
 
@@ -84,6 +102,8 @@ class StateTable:
             return None
         if k in self._puts:
             return self._puts[k]
+        if k in self._puts_enc:
+            return decode_value_row(self._puts_enc[k], self.schema.types)
         v = self.store.get(self.table_id, k)
         return None if v is None else decode_value_row(v, self.schema.types)
 
@@ -95,6 +115,9 @@ class StateTable:
         }
         for k in self._dels:
             merged.pop(k, None)
+        merged.update({
+            k: decode_value_row(v, self.schema.types)
+            for k, v in self._puts_enc.items()})
         merged.update(self._puts)
         for k in sorted(merged):
             v = merged[k]
@@ -109,6 +132,8 @@ class StateTable:
 
     def __len__(self) -> int:
         n = self.store.table_len(self.table_id)
-        new_puts = sum(1 for k in self._puts if self.store.get(self.table_id, k) is None)
+        new_puts = sum(
+            1 for k in (*self._puts, *self._puts_enc)
+            if self.store.get(self.table_id, k) is None)
         dead = sum(1 for k in self._dels if self.store.get(self.table_id, k) is not None)
         return n + new_puts - dead

@@ -178,20 +178,41 @@ class HashAggExecutor(SingleInputExecutor):
         st = self.state
         idx = np.nonzero(np.asarray(st.ckpt_dirty))[0]
         if len(idx):
-            keys_d = [np.asarray(kd)[idx] for kd in st.table.key_data]
-            keys_m = [np.asarray(km)[idx] for km in st.table.key_mask]
-            lanes = [np.asarray(l)[idx] for l in st.lanes]
-            for r in range(len(idx)):
-                key_vals = [
-                    keys_d[c][r].item() if keys_m[c][r] else None
-                    for c in range(len(keys_d))
-                ]
-                lane_vals = [lanes[j][r].item() for j in range(len(lanes))]
-                row = tuple(key_vals) + tuple(lane_vals)
-                if lanes[0][r] > 0:
-                    self.state_table.insert(row)
-                else:
-                    self.state_table.delete(row)
+            from ..native import codec as _native_codec
+            codec = _native_codec()
+            if codec is not None:
+                keys_d = [np.asarray(kd) for kd in st.table.key_data]
+                keys_m = [np.asarray(km) for km in st.table.key_mask]
+                lanes = [np.asarray(l) for l in st.lanes]
+                datas = keys_d + lanes
+                ones = np.ones(lanes[0].shape, bool)
+                masks = keys_m + [ones] * len(lanes)
+                types = self.state_table.schema.types
+                nk = len(keys_d)
+                live = lanes[0][idx] > 0
+                ins_idx, del_idx = idx[live], idx[~live]
+                pk_t = list(types[:nk])
+                puts = dict(zip(
+                    codec.encode_keys(keys_d, keys_m, pk_t, ins_idx),
+                    codec.encode_value_rows(datas, masks, types, ins_idx)))
+                dels = codec.encode_keys(keys_d, keys_m, pk_t, del_idx)
+                self.state_table.stage_encoded(puts, dels)
+            else:
+                keys_d = [np.asarray(kd)[idx] for kd in st.table.key_data]
+                keys_m = [np.asarray(km)[idx] for km in st.table.key_mask]
+                lanes = [np.asarray(l)[idx] for l in st.lanes]
+                for r in range(len(idx)):
+                    key_vals = [
+                        keys_d[c][r].item() if keys_m[c][r] else None
+                        for c in range(len(keys_d))
+                    ]
+                    lane_vals = [lanes[j][r].item()
+                                 for j in range(len(lanes))]
+                    row = tuple(key_vals) + tuple(lane_vals)
+                    if lanes[0][r] > 0:
+                        self.state_table.insert(row)
+                    else:
+                        self.state_table.delete(row)
             self.state_table.commit(epoch)
         self.state = st.replace(ckpt_dirty=jnp.zeros_like(st.ckpt_dirty))
 

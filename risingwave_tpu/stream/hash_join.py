@@ -228,6 +228,34 @@ class HashJoinExecutor(Executor):
                 tomb = np.asarray(st.tomb)
                 datas = [np.asarray(d) for d in st.row_data]
                 masks = [np.asarray(m) for m in st.row_mask]
+                from ..native import codec as _native_codec
+                codec = _native_codec()
+                if codec is not None:
+                    # batch path: flatten (slot, lane) → row index and
+                    # encode the whole dirty delta in one native call;
+                    # stage_encoded applies deletes before inserts, the
+                    # same-pk update ordering rule below
+                    width = occ.shape[1]
+                    flat = slots * width + lanes
+                    fdatas = [d.reshape(-1) for d in datas]
+                    fmasks = [m.reshape(-1) for m in masks]
+                    occ_f = occ.reshape(-1)
+                    tomb_f = tomb.reshape(-1)
+                    del_idx = flat[tomb_f[flat] & ~occ_f[flat]]
+                    ins_idx = flat[occ_f[flat]]
+                    types = table.schema.types
+                    pk = table.pk_indices
+                    pk_d = [fdatas[i] for i in pk]
+                    pk_m = [fmasks[i] for i in pk]
+                    pk_t = [types[i] for i in pk]
+                    table.stage_encoded(
+                        dict(zip(codec.encode_keys(pk_d, pk_m, pk_t,
+                                                   ins_idx),
+                                 codec.encode_value_rows(
+                                     fdatas, fmasks, types, ins_idx))),
+                        codec.encode_keys(pk_d, pk_m, pk_t, del_idx))
+                    table.commit(epoch)
+                    continue
 
                 def row_at(s, l):
                     return tuple(
