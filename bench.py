@@ -42,6 +42,15 @@ N_CHUNKS = 1024
 WARMUP_CHUNKS = 256
 CHUNKS_PER_EPOCH = 256
 CPU_N_CHUNKS = 256      # stand-in run is shorter; it reports a rate
+Q7_N_CHUNKS = 512       # join consumes every event on both sides
+Q7_CPU_N_CHUNKS = 128
+# q7 window: 5 ms of event time ≈ 50 bids/window at the generator's
+# 10K events/s. The probe side stores every bid of a live window under ONE
+# join key, and the bucketed arena bounds per-key cardinality by its lane
+# width — the 10 s window of the full q7 (100K rows/key) needs the sharded
+# join + watermark cleaning, not a single-chip dense arena; window size is
+# a bench parameter of the join core, not of its throughput semantics.
+Q7_WINDOW_US = 5_000
 
 
 def _emit_failure(msg: str) -> None:
@@ -67,9 +76,9 @@ from risingwave_tpu.common import INT64, TIMESTAMP
 from risingwave_tpu.common.chunk import stack_chunks
 from risingwave_tpu.connector import BID_SCHEMA, NexmarkConfig, NexmarkGenerator
 from risingwave_tpu.expr import Literal, call, col
-from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.expr.agg import agg, count_star
 from risingwave_tpu.stream import (
-    Barrier, HashAggExecutor, MockSource, ProjectExecutor,
+    Barrier, HashAggExecutor, HashJoinExecutor, MockSource, ProjectExecutor,
 )
 
 
@@ -116,8 +125,57 @@ def measure_q5(n_chunks: int) -> float:
     return n_chunks * CHUNK / elapsed
 
 
-def measure_cpu_standin() -> float:
-    """Run the same pipeline under JAX_PLATFORMS=cpu in a fresh subprocess
+def measure_q7(n_chunks: int) -> float:
+    """Sustained source rows/s of the q7-core windowed join: bids joined
+    with the per-window MAX(price) (reference workload
+    src/tests/simulation/src/nexmark/q7.sql — BASELINE.md config 3). Each
+    source event feeds both join sides; the rate reported is source
+    events/s."""
+    gen = NexmarkGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    warm_msgs, last_epoch = build_messages(gen, 64, 1)
+    main_msgs, _ = build_messages(gen, n_chunks, last_epoch + 1)
+
+    def pipeline(side_msgs):
+        # probe side: (window, auction, price); build side: per-window max
+        probe_src = MockSource(BID_SCHEMA, side_msgs)
+        probe = ProjectExecutor(probe_src, [
+            call("tumble_start", col(5, TIMESTAMP), Literal(Q7_WINDOW_US, INT64)),
+            col(0, INT64),
+            col(2, INT64),
+        ], names=("window_start", "auction", "price"))
+        build_src = MockSource(BID_SCHEMA, side_msgs)
+        build_pre = ProjectExecutor(build_src, [
+            call("tumble_start", col(5, TIMESTAMP), Literal(Q7_WINDOW_US, INT64)),
+            col(2, INT64),
+        ], names=("window_start", "price"))
+        build = HashAggExecutor(build_pre, [0], [agg("max", 1, INT64)],
+                                table_capacity=1 << 16, out_capacity=CHUNK)
+        cond = call("equal", col(2, INT64), col(4, INT64))  # price = max
+        join = HashJoinExecutor(
+            probe, build, [0], [0], condition=cond,
+            key_capacity=1 << 16, bucket_width=128, out_capacity=CHUNK)
+        return probe_src, build_src, join
+
+    probe_src, build_src, join = pipeline(warm_msgs)
+
+    async def drive() -> float:
+        async for _ in join.execute():   # warmup compiles all steps
+            pass
+        jax.block_until_ready(join.state.left.occupied)
+        probe_src.reset(main_msgs)
+        build_src.reset(main_msgs)
+        t0 = time.perf_counter()
+        async for _ in join.execute():
+            pass
+        jax.block_until_ready(join.state.left.occupied)
+        return time.perf_counter() - t0
+
+    elapsed = asyncio.run(drive())
+    return n_chunks * CHUNK / elapsed
+
+
+def measure_cpu_standin() -> dict:
+    """Run the same pipelines under JAX_PLATFORMS=cpu in a fresh subprocess
     (the in-process backend is already bound to the TPU)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -127,20 +185,23 @@ def measure_cpu_standin() -> float:
     env.pop("TPU_LIBRARY_PATH", None)
     res = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--rate-only",
-         str(CPU_N_CHUNKS)],
+         str(CPU_N_CHUNKS), str(Q7_CPU_N_CHUNKS)],
         env=env, capture_output=True, text=True, timeout=1500,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     if res.returncode != 0:
         raise RuntimeError(f"cpu stand-in failed: {res.stderr[-500:]}")
-    return float(json.loads(res.stdout.strip().splitlines()[-1])["value"])
+    return json.loads(res.stdout.strip().splitlines()[-1])
 
 
 def main(rearm=lambda: None):
-    cpu_rps = measure_cpu_standin()
+    cpu = measure_cpu_standin()
+    cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     rearm()  # fresh watchdog budget for the TPU phase (the stand-in
     #          subprocess has its own 1500s timeout)
     tpu_rps = measure_q5(N_CHUNKS)
+    rearm()
+    tpu_q7 = measure_q7(Q7_N_CHUNKS)
     print(json.dumps({
         "metric": "nexmark_q5_core_throughput",
         "value": round(tpu_rps, 1),
@@ -149,15 +210,21 @@ def main(rearm=lambda: None):
         "baseline_kind": "same pipeline, JAX_PLATFORMS=cpu (Rust-engine stand-in)",
         "cpu_standin_rows_per_sec": round(cpu_rps, 1),
         "chunks_per_dispatch": CHUNKS_PER_EPOCH,
+        "q7_join_rows_per_sec": round(tpu_q7, 1),
+        "q7_vs_baseline": round(tpu_q7 / cpu_q7, 2),
+        "q7_cpu_standin_rows_per_sec": round(cpu_q7, 1),
     }))
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--rate-only":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else CPU_N_CHUNKS
+        n7 = int(sys.argv[3]) if len(sys.argv) > 3 else Q7_CPU_N_CHUNKS
         rps = measure_q5(n)
+        q7 = measure_q7(n7)
         print(json.dumps({"metric": "nexmark_q5_core_throughput",
-                          "value": round(rps, 1), "unit": "rows/s"}))
+                          "value": round(rps, 1), "unit": "rows/s",
+                          "q7_rows_per_sec": round(q7, 1)}))
         raise SystemExit(0)
     watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
     watchdog.daemon = True

@@ -1,0 +1,67 @@
+"""Telemetry: anonymous usage reporting (disabled by default).
+
+Counterpart of the reference's telemetry subsystem
+(reference: src/common/src/telemetry/ — manager.rs collects node/system
+stats on an interval and report.rs posts them; per-node impls e.g.
+src/meta/src/telemetry.rs). This build collects the same shape of report
+but never transmits anywhere: there is no egress in the target
+environment, so ``TelemetryManager.report()`` hands the dict to an
+injectable sink (default: in-memory list) — the transmission layer is the
+deployment's concern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import platform
+import time
+import uuid
+from typing import Any, Callable, List, Optional
+
+
+@dataclasses.dataclass
+class TelemetryReport:
+    tracking_id: str
+    session_id: str
+    up_time_s: float
+    system: dict
+    job_counts: dict
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class TelemetryManager:
+    def __init__(self, enabled: bool = False,
+                 sink: Optional[Callable[[dict], None]] = None):
+        self.enabled = enabled
+        self.tracking_id = str(uuid.uuid4())
+        self.session_id = str(uuid.uuid4())
+        self.started_at = time.time()
+        self.reports: List[dict] = []
+        self._sink = sink or self.reports.append
+
+    def report(self, session=None) -> Optional[dict]:
+        """Collect one report and hand it to the sink; None if disabled."""
+        if not self.enabled:
+            return None
+        job_counts: dict = {}
+        if session is not None:
+            job_counts = {
+                "tables": len(session.catalog.tables),
+                "materialized_views": len(session.catalog.mvs),
+                "sources": len(session.catalog.sources),
+                "sinks": len(session.catalog.sinks),
+            }
+        r = TelemetryReport(
+            tracking_id=self.tracking_id,
+            session_id=self.session_id,
+            up_time_s=time.time() - self.started_at,
+            system={
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+            },
+            job_counts=job_counts,
+        ).as_dict()
+        self._sink(r)
+        return r

@@ -1,0 +1,70 @@
+"""User-defined scalar functions (in-process Python).
+
+Counterpart of the reference's UDF support
+(reference: src/udf/src/lib.rs:28 ArrowFlightUdfClient + expr_udf.rs —
+external Python/Java UDF servers over Arrow Flight). This build runs the
+UDF *in process*: the host tier already owns a Python interpreter, so the
+Flight hop would add serialization for nothing. The interchange module
+(common/interchange.py) provides the Arrow boundary when out-of-process
+isolation is wanted later.
+
+UDFs evaluate on the host and are registered as host-callback functions,
+so the enclosing Project/Filter runs eagerly (same rule as the string
+library — some PJRT backends reject host callbacks inside compiled
+programs). NULL handling is strict: any NULL argument yields NULL without
+calling the function.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..common.types import DataType
+from .expr import HOST_CALLBACK_FNS, _REGISTRY, _strict_mask
+
+
+def register_udf(name: str, fn: Callable, arg_types: Sequence[DataType],
+                 return_type: DataType, vectorized: bool = False) -> None:
+    """Register ``fn`` as a SQL scalar function.
+
+    ``vectorized=False``: fn(*scalar_args) -> scalar, called per visible
+    row (logical values: VARCHAR args arrive as str, results re-intern).
+    ``vectorized=True``: fn(*numpy_arrays) -> numpy_array over physical
+    values (no VARCHAR support).
+    """
+    name = name.lower()
+    if name in _REGISTRY:
+        raise ValueError(f"function {name!r} already exists")
+    arg_types = list(arg_types)
+    import jax.numpy as jnp
+
+    def impl(datas, masks, out_type):
+        mask = _strict_mask(masks)
+        m = np.asarray(mask)
+        if vectorized:
+            arrs = [np.asarray(d) for d in datas]
+            out = np.asarray(fn(*arrs))
+            return jnp.asarray(out.astype(return_type.np_dtype)), mask
+        arrs = [np.asarray(d) for d in datas]
+        out = np.zeros(len(m), return_type.np_dtype)
+        rows = np.nonzero(m)[0]
+        for r in rows:
+            args = [t.to_python(a[r]) for t, a in zip(arg_types, arrs)]
+            v = fn(*args)
+            out[r] = (return_type.to_physical(v)
+                      if v is not None else return_type.null_sentinel())
+            if v is None:
+                m[r] = False
+        return jnp.asarray(out), jnp.asarray(m)
+
+    _REGISTRY[name] = (impl, lambda ts: return_type)
+    HOST_CALLBACK_FNS.add(name)
+
+
+def drop_udf(name: str) -> None:
+    name = name.lower()
+    if name in HOST_CALLBACK_FNS:
+        HOST_CALLBACK_FNS.discard(name)
+        _REGISTRY.pop(name, None)

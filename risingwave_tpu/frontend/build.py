@@ -170,6 +170,17 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             table_capacity=cfg.topn_table_capacity,
             out_capacity=cfg.chunk_capacity)
 
+    if isinstance(plan, P.PTemporalJoin):
+        from ..stream.temporal_join import TemporalJoinExecutor
+        inp = build_plan(plan.input, ctx)
+        rdef = plan.right_def
+        right_table = StateTable(ctx.store, rdef.table_id, rdef.schema,
+                                 list(rdef.pk))
+        return TemporalJoinExecutor(
+            inp, right_table, list(plan.left_keys), list(plan.right_keys),
+            outer=plan.outer, condition=plan.condition,
+            out_capacity=cfg.chunk_capacity)
+
     if isinstance(plan, P.POverWindow):
         from ..stream.over_window import (
             EowcOverWindowExecutor, OverWindowExecutor, eowc_acc_schema,

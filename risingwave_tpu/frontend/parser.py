@@ -445,10 +445,24 @@ class Parser:
             else:
                 break
             right = self._relation_primary()
+            temporal = False
+            if self.eat_kw("for"):
+                # FOR SYSTEM_TIME AS OF PROCTIME()
+                if self.ident() != "system_time":
+                    raise SqlParseError("expected SYSTEM_TIME after FOR")
+                self.expect_kw("as")
+                if self.ident() != "of":
+                    raise SqlParseError("expected OF")
+                if self.ident() != "proctime":
+                    raise SqlParseError("only PROCTIME() temporal joins "
+                                        "are supported")
+                self.expect_op("(")
+                self.expect_op(")")
+                temporal = True
             on = None
             if self.eat_kw("on"):
                 on = self.parse_expr()
-            rel = A.Join(kind, rel, right, on)
+            rel = A.Join(kind, rel, right, on, temporal=temporal)
         return rel
 
     def _relation_primary(self) -> A.Relation:

@@ -218,6 +218,25 @@ class POverWindow(PlanNode):
 
 
 @dataclasses.dataclass
+class PTemporalJoin(PlanNode):
+    """Process-time lookup join (reference: temporal_join.rs:352): the
+    stream side probes the right relation's CURRENT materialized rows; no
+    stream-side state, no retraction on table changes."""
+
+    input: PlanNode                  # the stream side
+    right_kind: str                  # "table" | "mv"
+    right_def: object                # TableDef | MaterializedViewDef
+    left_keys: tuple
+    right_keys: tuple
+    outer: bool = False
+    condition: object = None
+
+    @property
+    def children(self):
+        return (self.input,)
+
+
+@dataclasses.dataclass
 class PProjectSet(PlanNode):
     """Set-returning projection: each input row yields one output row per
     element of the table function's result (reference: ProjectSetExecutor,
@@ -454,6 +473,8 @@ class Planner:
         return node, new_scope
 
     def _plan_join(self, j: A.Join, pending_conjuncts=None):
+        if j.temporal:
+            return self._plan_temporal_join(j)
         left, lscope = self._plan_relation(j.left, pending_conjuncts)
         right, rscope = self._plan_relation(j.right, pending_conjuncts)
         n_left = len(left.schema)
@@ -511,6 +532,50 @@ class Planner:
             node = PFilter(schema=node.schema, pk=node.pk, input=node,
                            predicate=b)
         return node, scope
+
+    def _plan_temporal_join(self, j: A.Join):
+        """FOR SYSTEM_TIME AS OF PROCTIME(): right side must be a named
+        table/MV; its current rows are probed, not streamed."""
+        if j.kind not in ("inner", "left"):
+            raise PlanError("temporal joins support INNER and LEFT only")
+        if not isinstance(j.right, A.TableRef):
+            raise PlanError("temporal join right side must be a table/MV")
+        left, lscope = self._plan_relation(j.left)
+        kind, rdef = self.catalog.resolve_relation(j.right.name)
+        if kind == "source":
+            raise PlanError("temporal join right side must be materialized")
+        alias = j.right.alias or j.right.name
+        rscope = Scope.of_schema(rdef.schema, alias)
+        n_left = len(left.schema)
+        scope = lscope.concat(rscope, n_left)
+        lkeys, rkeys, residual = [], [], []
+        for conj in _conjuncts(j.on) if j.on is not None else []:
+            pair = self._equi_pair(conj, scope, n_left)
+            if pair is not None:
+                lkeys.append(pair[0])
+                rkeys.append(pair[1])
+            else:
+                residual.append(conj)
+        if not lkeys:
+            raise PlanError("temporal join requires an equality condition")
+        cond = None
+        if residual:
+            if j.kind == "left":
+                raise PlanError("non-equi conditions on LEFT temporal "
+                                "joins are not supported")
+            bound = [ExprBinder(scope).bind(c) for c in residual]
+            cond = bound[0]
+            for b in bound[1:]:
+                cond = call("and", cond, b)
+        schema = Schema(tuple(left.schema) + tuple(rdef.schema))
+        # stream key: the probe side's key + the table pk (a probe row can
+        # match several table rows unless probing by full pk)
+        pk = tuple(left.pk) + tuple(i + n_left for i in rdef.pk)
+        return PTemporalJoin(
+            schema=schema, pk=pk, input=left,
+            right_kind="table" if kind == "table" else "mv",
+            right_def=rdef, left_keys=tuple(lkeys), right_keys=tuple(rkeys),
+            outer=j.kind == "left", condition=cond), scope
 
     def _equi_pair(self, conj, scope: Scope, n_left: int):
         if not (isinstance(conj, A.BinaryOp) and conj.op == "="):

@@ -64,6 +64,10 @@ class ChangelogBus:
     def subscribe(self, q: QueueSource) -> None:
         self.subscribers.append(q)
 
+    def unsubscribe(self, q: QueueSource) -> None:
+        if q in self.subscribers:
+            self.subscribers.remove(q)
+
 
 class StreamJob:
     """One materialized view job: executor pipeline → Materialize → bus."""

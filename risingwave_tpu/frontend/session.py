@@ -236,6 +236,14 @@ class Session:
         self._recovering = True
         try:
             for piece in ddl:
+                if piece.strip().startswith("-- reschedule"):
+                    import warnings
+                    warnings.warn(
+                        f"{piece.strip()[3:]}: rescale configs (meshes) "
+                        "are not persisted; the job recovered with the "
+                        "session's default BuildConfig — re-issue "
+                        "Session.reschedule() to restore the layout")
+                    continue
                 for stmt in parse_sql(piece):
                     self._run_statement(stmt)
         finally:
@@ -463,6 +471,7 @@ class Session:
         self._drain_inflight()   # subscribe at a quiesced epoch boundary
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
         n_feeds0 = len(self.feeds)
+        id0 = self.catalog._next_table_id   # for reschedule id replay
         (plan, pipeline, ctx, queues, init_msgs,
          scan_leaf_queues) = self._build_query_pipeline(stmt.query)
         mv_table_id = self.catalog.next_table_id()
@@ -477,6 +486,11 @@ class Session:
             definition="")
         mv.n_visible = n_visible  # type: ignore[attr-defined]
         mv.state_table_ids = tuple(ctx.state_table_ids)  # type: ignore[attr-defined]
+        # reschedule metadata: the query AST + the id range the build
+        # consumed (allocation order is deterministic, so a rebuild can
+        # replay the same ids over the same durable state tables)
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (id0, self.catalog._next_table_id)  # type: ignore[attr-defined]
         self.catalog.add_mv(mv)
         for f in self.feeds[n_feeds0:]:
             f.job = stmt.name
@@ -566,6 +580,79 @@ class Session:
             q.push(Barrier.new(self.epoch))
         self._await(job.wait_barrier(self.epoch))
         return []
+
+    def reschedule(self, name: str, config: Optional[BuildConfig] = None):
+        """Online rescale of one MV job: rebuild its executors under a new
+        BuildConfig (typically a different ``mesh``) from durable state at
+        a quiesced checkpoint boundary, without losing a row.
+
+        Reference: the scale controller's Reschedule command
+        (src/meta/src/stream/scale.rs:657, barrier/command.rs:48-60) —
+        actors are rebuilt with new vnode mappings and state re-read from
+        shared storage; here the "vnode mapping" is the mesh sharding of
+        the rebuilt executors and the shared storage is the state store.
+        """
+        mv = self.catalog.mvs.get(name)
+        if mv is None:
+            raise SqlError(f"materialized view {name!r} not found "
+                           "(only MV jobs reschedule)")
+        self.flush()                       # all state durable + quiesced
+        old_job = self.jobs[name]
+        self._await(old_job.stop())
+        self._unsubscribe_job(old_job)     # upstreams stop feeding dead queues
+        # this job's source feeds are recreated (sought to their offsets)
+        live = [f for f in self.feeds if f.job != name]
+        self.feeds = live
+        # durable note: a BuildConfig (mesh = live device handles) cannot
+        # be persisted; recovery rebuilds with the session's default
+        # config. Record the fact so recovery can WARN instead of
+        # silently reverting the rescale.
+        if self.data_dir is not None:
+            self.store.log.log_ddl(f"-- reschedule {name}")  # type: ignore[attr-defined]
+        id0, id1 = mv.table_id_range  # type: ignore[attr-defined]
+        ids = iter(range(id0, id1))
+        saved_alloc = self.catalog.next_table_id
+        saved_recovering = self._recovering
+        saved_config = self.config
+
+        def replay_id() -> int:
+            try:
+                return next(ids)
+            except StopIteration:
+                raise RuntimeError(
+                    "reschedule id replay diverged from the original build")
+
+        self.catalog.next_table_id = replay_id  # type: ignore[assignment]
+        self._recovering = True      # reload state, seek sources, no snapshot
+        if config is not None:
+            self.config = config
+        n_feeds0 = len(self.feeds)
+        try:
+            (plan, pipeline, ctx, queues, init_msgs,
+             _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
+            mv_table_id = self.catalog.next_table_id()
+            mat = MaterializeExecutor(
+                pipeline,
+                StateTable(self.store, mv_table_id, plan.schema,
+                           list(plan.pk)))
+        finally:
+            self.catalog.next_table_id = saved_alloc  # type: ignore[assignment]
+            self._recovering = saved_recovering
+            self.config = saved_config
+        for f in self.feeds[n_feeds0:]:
+            f.job = name
+        job = StreamJob(name, mat, queues)
+        job.bus.subscribers = old_job.bus.subscribers   # downstreams keep
+        self.jobs[name] = job
+        job.start(self.loop)
+        # the next barrier announces the config change (reference:
+        # Mutation::Update on the reschedule barrier)
+        self._pending_mutation = Mutation(MutationKind.UPDATE, name)
+        for q, init in init_msgs:
+            for m in init:
+                q.push(m)
+            q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
 
     def sink_of(self, name: str):
         """The live Sink instance of a sink job (inspection/testing)."""
@@ -661,6 +748,15 @@ class Session:
             return None
         raise SqlError(f"unsupported connector {src.connector!r}")
 
+    def _unsubscribe_job(self, job: StreamJob) -> None:
+        """Remove a stopped job's input queues from every upstream bus —
+        otherwise upstreams keep pushing into dead queues forever."""
+        for other in self.jobs.values():
+            if other is job:
+                continue
+            for q in job.sources:
+                other.bus.unsubscribe(q)
+
     def _drop(self, stmt: A.DropStatement) -> list:
         self._drain_inflight()
         # free the object's durable state (tombstoned in the manifest so
@@ -675,6 +771,7 @@ class Session:
             if sink is not None:
                 sink.close()
             self._await(job.stop())
+            self._unsubscribe_job(job)
         if existed:
             # the job's source feeds die with it: stop generating, free
             # their split-state tables

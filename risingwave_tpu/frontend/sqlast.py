@@ -140,6 +140,9 @@ class Join:
     left: Any
     right: Any
     on: Optional[Expr]
+    #: FOR SYSTEM_TIME AS OF PROCTIME() — process-time temporal join:
+    #: probe the right side's CURRENT materialized rows, no retractions
+    temporal: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -78,6 +78,8 @@ class HashJoinExecutor(Executor):
         )
         self.schema = self.core.out_schema
         self.out_capacity = out_capacity
+        # chunks applied per host sync (optimistic batched emission)
+        self.emit_batch = 16
         self.strict = strict
         self.max_state_cells = 1 << 26    # growth ceiling (cap * W)
         self.state_tables = {"left": left_state_table,
@@ -95,6 +97,19 @@ class HashJoinExecutor(Executor):
         self._gather = jax.jit(
             lambda ch, lo: gather_units_window(ch, lo, self.out_capacity))
         self._count_units = jax.jit(count_units)
+
+        def _pack_stats(state: JoinState, big) -> jax.Array:
+            # every host-read scalar of one applied chunk in ONE vector:
+            # [l.lane_ovf, l.ht_ovf, r.lane_ovf, r.ht_ovf, n_units]
+            return jnp.stack([
+                state.left.lane_overflow.astype(jnp.int64),
+                state.left.ht_overflow.astype(jnp.int64),
+                state.right.lane_overflow.astype(jnp.int64),
+                state.right.ht_overflow.astype(jnp.int64),
+                count_units(big),
+            ])
+
+        self._pack_stats = jax.jit(_pack_stats)
         self._clear_ckpt = jax.jit(_clear_ckpt_marks)
         self._clean_side = jax.jit(clean_side_below, static_argnums=(1,))
 
@@ -142,22 +157,64 @@ class HashJoinExecutor(Executor):
 
     # -- host loop -------------------------------------------------------------
 
+    # -- optimistic batched emission ------------------------------------------
+    # Applying a chunk is ONE async device dispatch, but reading its output
+    # row count (and the overflow flags) is a host sync — on a tunneled
+    # chip that sync dominated throughput (~1 RTT per chunk). The hot path
+    # is therefore optimistic: apply up to ``emit_batch`` chunks without
+    # syncing, then fetch ALL their packed stats in one transfer and emit.
+    # If any chunk overflowed, rewind to the pre-batch state snapshot and
+    # replay chunk-by-chunk through the growing path (rare; functional
+    # state makes the rewind exact).
+
+    def _flush_pending(self):
+        if not self._pending:
+            return
+        import numpy as np
+        stats = self.stats
+        packed = np.asarray(jnp.stack([p[2] for p in self._pending]))
+        if not packed[:, :4].any():
+            for (side, chunk, _, big), row in zip(self._pending, packed):
+                n_units = int(row[4])
+                for lo in range(0, n_units, self.out_capacity // 2):
+                    stats.chunks_out += 1
+                    yield self._gather(big, jnp.int64(lo))
+        else:
+            # overflow inside the batch: rewind and replay with growth
+            self.state = self._rewind_state
+            for side, chunk, _, _ in self._pending:
+                big = self._apply_growing(side, chunk)
+                n_units = int(self._count_units(big))
+                for lo in range(0, n_units, self.out_capacity // 2):
+                    stats.chunks_out += 1
+                    yield self._gather(big, jnp.int64(lo))
+        self._pending.clear()
+        self._rewind_state = None
+
     async def execute(self):
         from .metrics import barrier_timer
         stats = self.stats
+        self._pending: list = []
+        self._rewind_state = None
         async for ev in barrier_align(self.left, self.right):
             kind = ev[0]
             if kind == "chunk":
                 _, side, chunk = ev
                 stats.chunks_in += 1
                 stats.capacity_rows_in += chunk.capacity
-                big = self._apply_growing(side, chunk)
-                n_units = int(self._count_units(big))
-                for lo in range(0, n_units, self.out_capacity // 2):
-                    stats.chunks_out += 1
-                    yield self._gather(big, jnp.int64(lo))
+                if self._rewind_state is None:
+                    self._rewind_state = self.state
+                new_state, big = self._apply[side](self.state, chunk)
+                self.state = new_state
+                self._pending.append(
+                    (side, chunk, self._pack_stats(new_state, big), big))
+                if len(self._pending) >= self.emit_batch:
+                    for out in self._flush_pending():
+                        yield out
             elif kind == "barrier":
                 barrier = ev[1]
+                for out in self._flush_pending():
+                    yield out
                 with barrier_timer(stats):
                     self._check_flags()
                     if barrier.checkpoint:
@@ -181,6 +238,11 @@ class HashJoinExecutor(Executor):
                 # forward with the column index remapped into the output schema
                 out_idx = self._map_watermark_col(side, wm.col_idx)
                 if out_idx is not None:
+                    # pending join output must not be overtaken by the
+                    # watermark — downstream EOWC operators would finalize
+                    # windows those buffered rows still belong to
+                    for out in self._flush_pending():
+                        yield out
                     yield wm.__class__(out_idx, wm.value)
 
     def _apply_pending_clean(self) -> bool:

@@ -1,0 +1,102 @@
+"""TemporalJoinExecutor: probe-time lookup against a materialized table.
+
+Counterpart of the reference's TemporalJoin / Lookup executors
+(reference: src/stream/src/executor/temporal_join.rs:352, executor/
+lookup.rs — ``FOR SYSTEM_TIME AS OF PROCTIME()``). Unlike the symmetric
+hash join, the stream side keeps NO join state and table-side updates
+produce NO retractions: each probe row is enriched with the table's rows
+*as of processing time* and the output is append-only with respect to the
+table side. This is the cheap pattern for enrichment joins (orders ⋈
+current price) where replaying history on a dimension change is unwanted.
+
+The table side is read straight from its StateTable (the session drives
+table jobs and the probe job in the same epoch loop; probe rows of epoch
+N see the table as of the epoch's processing order — process-time
+semantics, exactly as loose as the reference's). The probe side must be
+APPEND-ONLY: a delete's enrichment would be recomputed from the table's
+current rows and could fail to cancel what was originally emitted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..common.chunk import (
+    DEFAULT_CHUNK_CAPACITY, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, StreamChunk, chunk_to_rows, make_chunk,
+)
+from ..common.types import Field, Schema
+from ..expr.expr import Expr
+from ..storage.state_table import StateTable
+from .executor import Executor, SingleInputExecutor
+
+
+class TemporalJoinExecutor(SingleInputExecutor):
+    identity = "TemporalJoin"
+
+    def __init__(self, input: Executor, right_table: StateTable,
+                 left_keys: Sequence[int], right_keys: Sequence[int],
+                 outer: bool = False, condition: Optional[Expr] = None,
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+        super().__init__(input)
+        self.right_table = right_table
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.outer = outer
+        self.condition = condition
+        self.out_capacity = out_capacity
+        self.in_schema = input.schema
+        self.schema = Schema(tuple(input.schema)
+                             + tuple(right_table.schema))
+        # fast path: probing by the table's full pk is a point get;
+        # otherwise a (rare) prefix/full scan per probe key
+        self._point_lookup = (self.right_keys
+                              == tuple(right_table.pk_indices))
+
+    def _matches(self, key_vals) -> list:
+        if any(v is None for v in key_vals):
+            return []
+        if self._point_lookup:
+            row = self.right_table.get_row(key_vals)
+            return [row] if row is not None else []
+        return [
+            r for r in self.right_table.scan_all()
+            if tuple(r[i] for i in self.right_keys) == tuple(key_vals)
+        ]
+
+    async def map_chunk(self, chunk: StreamChunk):
+        out_rows, out_ops = [], []
+        nright = len(self.right_table.schema)
+        for op, row in chunk_to_rows(chunk, self.in_schema, with_ops=True,
+                                     physical=True):
+            # append-only probe contract (the reference requires it too):
+            # a DELETE's enrichment would be recomputed from the table's
+            # CURRENT rows, which may differ from what was emitted at
+            # insert time — the retraction would not cancel the original
+            if op != OP_INSERT:
+                raise AssertionError(
+                    "temporal join requires an append-only probe side "
+                    "(got a delete/update); join a snapshot instead")
+            keys = [row[i] for i in self.left_keys]
+            matches = self._matches(keys)
+            if not matches and self.outer:
+                out_rows.append(tuple(row) + (None,) * nright)
+                out_ops.append(op)
+            for m in matches:
+                out_rows.append(tuple(row) + tuple(m))
+                out_ops.append(op)
+        i = 0
+        while i < len(out_rows):
+            take_r = out_rows[i:i + self.out_capacity]
+            take_o = out_ops[i:i + self.out_capacity]
+            i += len(take_r)
+            chunk_out = make_chunk(
+                self.schema, take_r, ops=take_o,
+                capacity=max(self.out_capacity, len(take_r)),
+                physical=True)
+            if self.condition is not None:
+                cond = self.condition.eval(chunk_out)
+                import jax.numpy as jnp
+                keep = cond.data & cond.mask
+                chunk_out = chunk_out.mask_vis(keep)
+            yield chunk_out

@@ -1,0 +1,50 @@
+"""Pipeline introspection: the "what is my stream stuck on" tool.
+
+Counterpart of the reference's await-tree dumps
+(reference: src/stream/src/executor/wrapper/trace.rs + the await-tree
+registry served by MonitorService.stack_trace,
+src/compute/src/rpc/service/monitor_service.rs:46 — live async stack
+trees per actor shown in the dashboard / risectl trace). The analogue
+here walks each job's executor tree and reports, per executor: identity,
+message counters, barrier time, and source-queue depths — enough to see
+where an epoch is stuck without attaching a debugger.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def executor_tree(root, indent: int = 0) -> List[str]:
+    """Indented one-line-per-executor rendering of a pipeline."""
+    lines = []
+    ident = getattr(root, "identity", type(root).__name__)
+    stats = getattr(root, "stats", None)
+    extra = ""
+    if stats is not None:
+        extra = (f"  in={stats.chunks_in + stats.batch_chunks_in}"
+                 f" out={stats.chunks_out} barriers={stats.barriers}"
+                 f" barrier_s={stats.barrier_seconds:.3f}")
+    q = getattr(root, "queue", None)
+    if q is not None:
+        extra += f"  queued={q.qsize()}"
+    lines.append("  " * indent + ident + extra)
+    for attr in ("input", "left", "right"):
+        child = getattr(root, attr, None)
+        if child is not None:
+            lines.extend(executor_tree(child, indent + 1))
+    for child in getattr(root, "inputs", ()) or ():
+        lines.extend(executor_tree(child, indent + 1))
+    return lines
+
+
+def dump_session(session) -> str:
+    """Full session dump: per-job executor trees + barrier progress."""
+    lines = [
+        f"epoch: completed={session.epoch} injected={session._injected} "
+        f"in_flight={[e for e, _ in session._inflight]}",
+    ]
+    for name, job in session.jobs.items():
+        lines.append(f"job {name!r}:")
+        lines.extend(executor_tree(job.pipeline, indent=1))
+    return "\n".join(lines)

@@ -1,0 +1,84 @@
+"""Online reschedule (coverage #56/#78): rebuild a live MV job under a new
+BuildConfig — including onto a device mesh — from durable state, without
+losing or duplicating rows."""
+
+import jax
+import pytest
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.build import BuildConfig
+
+
+def _mesh(n):
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]), ("shard",))
+
+
+class TestReschedule:
+    def test_rescale_onto_mesh_continues_exactly(self, tmp_path):
+        s = Session(data_dir=str(tmp_path / "db"))
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW g AS "
+                  "SELECT k % 4 AS grp, sum(v) AS sv FROM t GROUP BY k % 4")
+        for i in range(8):
+            s.run_sql(f"INSERT INTO t VALUES ({i}, {i * 10})")
+        s.flush()
+        before = sorted(s.mv_rows("g"))
+
+        s.reschedule("g", BuildConfig(mesh=_mesh(4)))
+        assert sorted(s.mv_rows("g")) == before
+        # the rebuilt pipeline is the mesh-sharded executor
+        ex = s.jobs["g"].pipeline
+        names = set()
+        while ex is not None:
+            names.add(type(ex).__name__)
+            ex = getattr(ex, "input", None)
+        assert "ShardedHashAggExecutor" in names
+
+        for i in range(8, 12):
+            s.run_sql(f"INSERT INTO t VALUES ({i}, {i * 10})")
+        s.flush()
+        got = dict(s.mv_rows("g"))
+        expect = {}
+        for i in range(12):
+            expect[i % 4] = expect.get(i % 4, 0) + i * 10
+        assert got == expect
+
+    def test_reschedule_preserves_downstream_subscription(self, tmp_path):
+        s = Session(data_dir=str(tmp_path / "db"))
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW a AS SELECT k, v * 2 AS d FROM t")
+        s.run_sql("CREATE MATERIALIZED VIEW b AS SELECT sum(d) AS s FROM a")
+        s.run_sql("INSERT INTO t VALUES (1, 10)")
+        s.flush()
+        assert s.mv_rows("b") == [(20,)]
+        s.reschedule("a")          # same config; exercises the rebuild
+        s.run_sql("INSERT INTO t VALUES (2, 5)")
+        s.flush()
+        # downstream b kept receiving deltas through the rebuilt job's bus
+        assert s.mv_rows("b") == [(30,)]
+        assert sorted(s.mv_rows("a")) == [(1, 20), (2, 10)]
+
+    def test_reschedule_source_job_seeks_offsets(self, tmp_path):
+        s = Session(data_dir=str(tmp_path / "db"), source_chunk_capacity=4,
+                    checkpoint_frequency=1)
+        s.run_sql("""CREATE SOURCE g (k BIGINT)
+                     WITH (connector='datagen',
+                           'datagen.rows.per.chunk'=4)""")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM g")
+        for _ in range(3):
+            s.tick()
+        s._drain_inflight()
+        n0 = len(s.mv_rows("m"))
+        assert n0 == 12
+        s.reschedule("m")
+        s.tick()
+        s._drain_inflight()
+        rows = sorted(r[0] for r in s.mv_rows("m"))
+        # no duplicates, no gaps: the reader resumed at its offset
+        assert rows == list(range(len(rows)))
+        assert len(rows) == n0 + 4

@@ -1,0 +1,106 @@
+"""Temporal (process-time lookup) join — coverage #22.
+FOR SYSTEM_TIME AS OF PROCTIME(): enrichment against current table rows,
+no retractions when the table changes."""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+
+class TestTemporalJoin:
+    def _setup(self):
+        s = Session()
+        s.run_sql("CREATE TABLE price (item BIGINT PRIMARY KEY, p BIGINT)")
+        s.run_sql("CREATE TABLE orders (oid BIGINT PRIMARY KEY, "
+                  "item BIGINT, qty BIGINT)")
+        s.run_sql("INSERT INTO price VALUES (1, 100), (2, 200)")
+        s.flush()
+        return s
+
+    def test_enrichment_no_retraction(self):
+        s = self._setup()
+        s.run_sql("""CREATE MATERIALIZED VIEW enriched AS
+            SELECT oid, qty * p AS total
+            FROM orders JOIN price FOR SYSTEM_TIME AS OF PROCTIME()
+            ON orders.item = price.item""")
+        s.run_sql("INSERT INTO orders VALUES (10, 1, 3)")
+        s.flush()
+        assert s.mv_rows("enriched") == [(10, 300)]
+        # price change: existing output does NOT retract...
+        s.run_sql("INSERT INTO price VALUES (1, 999)")   # pk upsert
+        s.flush()
+        assert s.mv_rows("enriched") == [(10, 300)]
+        # ...but new orders see the current price
+        s.run_sql("INSERT INTO orders VALUES (11, 1, 1)")
+        s.flush()
+        assert sorted(s.mv_rows("enriched")) == [(10, 300), (11, 999)]
+
+    def test_left_temporal_join_pads_nulls(self):
+        s = self._setup()
+        s.run_sql("""CREATE MATERIALIZED VIEW e AS
+            SELECT oid, p
+            FROM orders LEFT JOIN price FOR SYSTEM_TIME AS OF PROCTIME()
+            ON orders.item = price.item""")
+        s.run_sql("INSERT INTO orders VALUES (10, 7, 1)")   # no price row
+        s.flush()
+        assert s.mv_rows("e") == [(10, None)]
+
+    def test_batch_select_temporal(self):
+        s = self._setup()
+        s.run_sql("INSERT INTO orders VALUES (10, 2, 4)")
+        s.flush()
+        rows = s.run_sql(
+            "SELECT oid, qty * p FROM orders "
+            "JOIN price FOR SYSTEM_TIME AS OF PROCTIME() "
+            "ON orders.item = price.item")
+        assert rows == [(10, 800)]
+
+    def test_requires_materialized_right(self):
+        s = Session()
+        s.run_sql("CREATE SOURCE src (k BIGINT) WITH (connector='datagen')")
+        s.run_sql("CREATE TABLE o (oid BIGINT PRIMARY KEY, k BIGINT)")
+        with pytest.raises(Exception, match="materialized"):
+            s.run_sql("SELECT * FROM o JOIN src FOR SYSTEM_TIME AS OF "
+                      "PROCTIME() ON o.k = src.k")
+
+
+class TestJoinWatermarkOrdering:
+    def test_watermark_does_not_overtake_pending_output(self):
+        """Optimistic batched join emission must flush before forwarding a
+        watermark (EOWC downstreams finalize windows on watermarks)."""
+        import asyncio
+        from risingwave_tpu.common.chunk import make_chunk
+        from risingwave_tpu.common.types import INT64, Field, Schema
+        from risingwave_tpu.stream.hash_join import HashJoinExecutor
+        from risingwave_tpu.stream.message import Barrier, Watermark
+        from risingwave_tpu.stream.source import MockSource
+        from risingwave_tpu.common.chunk import StreamChunk
+
+        S = Schema((Field("k", INT64), Field("ts", INT64)))
+        left = MockSource(S, [
+            Barrier.new(1),
+            make_chunk(S, [(1, 10)], capacity=2),
+            Watermark(1, 100),
+            Barrier.new(2),
+        ])
+        right = MockSource(S, [
+            Barrier.new(1),
+            make_chunk(S, [(1, 11)], capacity=2),
+            Barrier.new(2),
+        ])
+        join = HashJoinExecutor(left, right, [0], [0], out_capacity=8)
+
+        async def run():
+            seq = []
+            async for m in join.execute():
+                if isinstance(m, StreamChunk):
+                    import jax.numpy as jnp
+                    if bool(jnp.any(m.vis)):
+                        seq.append("chunk")
+                elif isinstance(m, Watermark):
+                    seq.append("wm")
+            return seq
+
+        seq = asyncio.run(run())
+        assert "wm" in seq and "chunk" in seq
+        assert seq.index("chunk") < seq.index("wm")
