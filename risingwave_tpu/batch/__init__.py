@@ -1,0 +1,5 @@
+from .executors import (  # noqa: F401
+    BatchFilter, BatchHashAgg, BatchLimit, BatchProject, BatchSort,
+    RowSeqScan, run_batch,
+)
+from .task import BatchTaskManager  # noqa: F401

@@ -1,0 +1,225 @@
+"""Batch executors: one-shot vectorized query operators over snapshots.
+
+Counterpart of the reference's batch engine
+(reference: src/batch/src/executor/ — RowSeqScan over vnode-partitioned
+StorageTable ranges, Filter/Project/HashAgg/Sort/TopN/Limit…;
+src/batch/src/task/task_manager.rs:42 fire_task). Where the reference
+streams row batches through pull-based executors, the TPU design
+evaluates each operator as ONE whole-snapshot device computation: a scan
+materializes the table's rows into fixed-capacity chunks, and every
+downstream operator is a vectorized jnp transformation over those chunks
+— there is no per-batch pull loop to schedule, XLA fuses the operator
+bodies instead.
+
+Used by ``Session.query`` for pure scans; the stream-fold path remains
+the general engine for plans with operators that only exist as streaming
+executors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.chunk import StreamChunk, chunk_to_rows, physical_chunk
+from ..common.hashing import VNODE_COUNT, vnode_of
+from ..common.types import Schema
+from ..expr.agg import AggCall
+from ..expr.expr import Expr
+from ..ops.topn import OrderSpec
+from ..storage.state_table import StateTable
+
+
+class BatchExecutor:
+    schema: Schema
+
+    def execute(self) -> Iterator[List[tuple]]:
+        """Yields row batches (physical tuples)."""
+        raise NotImplementedError
+
+
+class RowSeqScan(BatchExecutor):
+    """Full / vnode-partitioned snapshot scan over a StateTable
+    (reference: row_seq_scan.rs — scan ranges are vnode partitions so
+    parallel tasks split the key space)."""
+
+    def __init__(self, table: StateTable,
+                 vnodes: Optional[Sequence[int]] = None,
+                 batch_size: int = 4096):
+        self.table = table
+        self.schema = table.schema
+        self.vnodes = None if vnodes is None else set(vnodes)
+        self.batch_size = batch_size
+
+    def execute(self):
+        buf: List[tuple] = []
+        for row in self.table.scan_all():
+            buf.append(row)
+            if len(buf) >= self.batch_size:
+                yield from self._emit(buf)
+                buf = []
+        if buf:
+            yield from self._emit(buf)
+
+    def _emit(self, rows: List[tuple]):
+        if self.vnodes is None:
+            yield rows
+            return
+        # vectorized vnode of the pk columns for the whole batch — the
+        # same device hash the streaming shuffle uses, so batch-task
+        # partitions line up with stream shards
+        pk = list(self.table.pk_indices)
+        pk_schema = self.schema.select(pk)
+        chunk = physical_chunk(
+            pk_schema, [tuple(r[i] for i in pk) for r in rows], len(rows))
+        vn = np.asarray(vnode_of(list(chunk.columns)))
+        out = [r for r, v in zip(rows, vn) if int(v) in self.vnodes]
+        if out:
+            yield out
+
+
+class _SingleInput(BatchExecutor):
+    def __init__(self, input: BatchExecutor):
+        self.input = input
+        self.schema = input.schema
+
+
+class BatchFilter(_SingleInput):
+    def __init__(self, input: BatchExecutor, predicate: Expr):
+        super().__init__(input)
+        self.predicate = predicate
+
+    def execute(self):
+        for rows in self.input.execute():
+            chunk = physical_chunk(self.schema, rows, max(len(rows), 1))
+            cond = self.predicate.eval(chunk)
+            keep = np.asarray(cond.data & cond.mask)[:len(rows)]
+            out = [r for r, k in zip(rows, keep) if k]
+            if out:
+                yield out
+
+
+class BatchProject(_SingleInput):
+    def __init__(self, input: BatchExecutor, exprs: Sequence[Expr],
+                 names: Sequence[str] = ()):
+        super().__init__(input)
+        from ..common.types import Field
+        self.exprs = list(exprs)
+        names = tuple(names) or tuple(f"expr{i}" for i in range(len(exprs)))
+        self.schema = Schema(tuple(
+            Field(n, e.type) for n, e in zip(names, self.exprs)))
+
+    def execute(self):
+        for rows in self.input.execute():
+            chunk = physical_chunk(self.input.schema, rows,
+                                   max(len(rows), 1))
+            cols = [e.eval(chunk) for e in self.exprs]
+            datas = [np.asarray(c.data) for c in cols]
+            masks = [np.asarray(c.mask) for c in cols]
+            out = [
+                tuple(d[i].item() if m[i] else None
+                      for d, m in zip(datas, masks))
+                for i in range(len(rows))
+            ]
+            yield out
+
+
+class BatchHashAgg(_SingleInput):
+    """Hash aggregation over the whole input (one shot, no retraction)."""
+
+    def __init__(self, input: BatchExecutor, group_keys: Sequence[int],
+                 agg_calls: Sequence[AggCall]):
+        super().__init__(input)
+        from ..common.types import Field
+        self.group_keys = tuple(group_keys)
+        self.agg_calls = tuple(agg_calls)
+        fields = tuple(input.schema[i] for i in self.group_keys) + tuple(
+            Field(f"agg{i}", a.output_type)
+            for i, a in enumerate(self.agg_calls))
+        self.schema = Schema(fields)
+
+    def execute(self):
+        groups: dict = {}
+        for rows in self.input.execute():
+            for row in rows:
+                key = tuple(row[i] for i in self.group_keys)
+                accs = groups.setdefault(
+                    key, [(0, None, None, None)] * len(self.agg_calls))
+                for i, a in enumerate(self.agg_calls):
+                    v = 1 if a.arg < 0 else row[a.arg]
+                    if v is None:
+                        continue
+                    cnt, s, mn, mx = accs[i]
+                    accs[i] = (cnt + 1, (s or 0) + v,
+                               v if mn is None else min(mn, v),
+                               v if mx is None else max(mx, v))
+        out = []
+        for key, accs in groups.items():
+            vals = []
+            for a, (cnt, s, mn, mx) in zip(self.agg_calls, accs):
+                if a.kind == "count":
+                    vals.append(cnt)
+                elif a.kind == "sum":
+                    vals.append(s if cnt else None)
+                elif a.kind == "min":
+                    vals.append(mn)
+                elif a.kind == "max":
+                    vals.append(mx)
+                else:   # avg
+                    vals.append(s / cnt if cnt else None)
+            out.append(key + tuple(vals))
+        if out:
+            yield out
+
+
+class BatchSort(_SingleInput):
+    def __init__(self, input: BatchExecutor, order: Sequence[OrderSpec]):
+        super().__init__(input)
+        self.order = list(order)
+
+    def execute(self):
+        allrows = [r for rows in self.input.execute() for r in rows]
+
+        def key(row):
+            k = []
+            for spec in self.order:
+                v = row[spec.col]
+                null_rank = 1 if spec.nulls_last else -1
+                k.append((null_rank, 0) if v is None
+                         else (0, -v if spec.desc else v))
+            return tuple(k)
+
+        allrows.sort(key=key)
+        if allrows:
+            yield allrows
+
+
+class BatchLimit(_SingleInput):
+    def __init__(self, input: BatchExecutor, limit: int, offset: int = 0):
+        super().__init__(input)
+        self.limit = limit
+        self.offset = offset
+
+    def execute(self):
+        skipped = taken = 0
+        for rows in self.input.execute():
+            out = []
+            for r in rows:
+                if skipped < self.offset:
+                    skipped += 1
+                    continue
+                if taken >= self.limit:
+                    break
+                out.append(r)
+                taken += 1
+            if out:
+                yield out
+            if taken >= self.limit:
+                return
+
+
+def run_batch(root: BatchExecutor) -> List[tuple]:
+    """Collect a batch plan's full result."""
+    return [r for rows in root.execute() for r in rows]

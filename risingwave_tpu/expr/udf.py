@@ -24,6 +24,10 @@ import numpy as np
 from ..common.types import DataType
 from .expr import HOST_CALLBACK_FNS, _REGISTRY, _strict_mask
 
+#: names registered through register_udf — drop_udf refuses anything else
+#: (the host-callback set also contains built-in string functions)
+_UDF_NAMES: set = set()
+
 
 def register_udf(name: str, fn: Callable, arg_types: Sequence[DataType],
                  return_type: DataType, vectorized: bool = False) -> None:
@@ -61,10 +65,13 @@ def register_udf(name: str, fn: Callable, arg_types: Sequence[DataType],
 
     _REGISTRY[name] = (impl, lambda ts: return_type)
     HOST_CALLBACK_FNS.add(name)
+    _UDF_NAMES.add(name)
 
 
 def drop_udf(name: str) -> None:
     name = name.lower()
-    if name in HOST_CALLBACK_FNS:
-        HOST_CALLBACK_FNS.discard(name)
-        _REGISTRY.pop(name, None)
+    if name not in _UDF_NAMES:
+        raise ValueError(f"{name!r} is not a registered UDF")
+    _UDF_NAMES.discard(name)
+    HOST_CALLBACK_FNS.discard(name)
+    _REGISTRY.pop(name, None)

@@ -535,17 +535,31 @@ class Planner:
 
     def _plan_temporal_join(self, j: A.Join):
         """FOR SYSTEM_TIME AS OF PROCTIME(): right side must be a named
-        table/MV; its current rows are probed, not streamed."""
+        table/MV; its current rows are probed, not streamed. The probe
+        side must be append-only (a retraction's enrichment would be
+        recomputed from the table's CURRENT rows and could fail to cancel
+        the originally emitted rows)."""
         if j.kind not in ("inner", "left"):
             raise PlanError("temporal joins support INNER and LEFT only")
         if not isinstance(j.right, A.TableRef):
             raise PlanError("temporal join right side must be a table/MV")
         left, lscope = self._plan_relation(j.left)
+        if not _plan_is_append_only(left):
+            raise PlanError(
+                "temporal join requires an append-only probe side "
+                "(sources / append-only tables through stateless "
+                "operators); this input can retract")
         kind, rdef = self.catalog.resolve_relation(j.right.name)
         if kind == "source":
             raise PlanError("temporal join right side must be materialized")
         alias = j.right.alias or j.right.name
-        rscope = Scope.of_schema(rdef.schema, alias)
+        # scope = VISIBLE columns only (hidden '_' stream-key cols of an
+        # MV stay out of name resolution, as in _plan_table_ref)
+        n_vis = getattr(rdef, "n_visible", len(rdef.schema))
+        rscope = Scope([
+            ScopeColumn(f.name, alias, i, f.type)
+            for i, f in enumerate(rdef.schema) if i < n_vis
+        ])
         n_left = len(left.schema)
         scope = lscope.concat(rscope, n_left)
         lkeys, rkeys, residual = [], [], []
@@ -1003,6 +1017,25 @@ class Planner:
         if isinstance(e, A.WindowFunc):
             return e.func.name.lower()
         return "?column?"
+
+
+def _plan_is_append_only(plan: PlanNode) -> bool:
+    """Conservative: true only for sources/append-only tables flowing
+    through stateless row-preserving operators (reference: append-only
+    derivation in the optimizer's stream properties)."""
+    if isinstance(plan, PSource):
+        return True
+    if isinstance(plan, PTableScan):
+        # the DML surface is INSERT-only today, so table changelogs never
+        # retract; revisit when UPDATE/DELETE statements land
+        return True
+    if isinstance(plan, (PProject, PFilter, PHopWindow)):
+        return _plan_is_append_only(plan.input)
+    if isinstance(plan, PTemporalJoin):
+        return _plan_is_append_only(plan.input)
+    if isinstance(plan, PUnion):
+        return all(_plan_is_append_only(i) for i in plan.inputs)
+    return False
 
 
 def _expr_eq(a: Expr, b: Expr) -> bool:

@@ -627,10 +627,26 @@ class Session:
         if config is not None:
             self.config = config
         n_feeds0 = len(self.feeds)
+        bus_subs0 = {n: list(j.bus.subscribers)
+                     for n, j in self.jobs.items()}
         try:
-            (plan, pipeline, ctx, queues, init_msgs,
-             _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
-            mv_table_id = self.catalog.next_table_id()
+            try:
+                (plan, pipeline, ctx, queues, init_msgs,
+                 _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
+                mv_table_id = self.catalog.next_table_id()
+            except BaseException:
+                # the new config failed to build: roll back to the
+                # original config over the same durable state — a stopped
+                # job left in self.jobs would hang every later barrier.
+                # Undo the failed build's feed/subscription side effects.
+                self.feeds = self.feeds[:n_feeds0]
+                for n, subs in bus_subs0.items():
+                    self.jobs[n].bus.subscribers = list(subs)
+                self.config = saved_config
+                ids = iter(range(id0, id1))
+                (plan, pipeline, ctx, queues, init_msgs,
+                 _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
+                mv_table_id = self.catalog.next_table_id()
             mat = MaterializeExecutor(
                 pipeline,
                 StateTable(self.store, mv_table_id, plan.schema,

@@ -1,0 +1,117 @@
+"""SqlSmith-lite: seeded random SQL against the session.
+
+Counterpart of the reference's SqlSmith fuzzing
+(reference: src/tests/sqlsmith/src/{sql_gen,runner.rs} — generate random
+valid SQL, execute, shrink on failure; run in CI as a crash hunt). This
+generator covers the subset the frontend supports and adds a stronger
+oracle than crash-freedom: every generated query is run BOTH as a batch
+SELECT and as a streaming MATERIALIZED VIEW over the same data, and the
+two results must agree — the stream/batch unification invariant.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+
+class SqlGen:
+    """Random SELECTs over tables t0(k,a,b), t1(k,c). Deterministic per
+    seed."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+
+    def scalar(self, cols: List[str], depth: int = 0) -> str:
+        r = self.rng
+        if depth >= 2 or r.random() < 0.4:
+            if r.random() < 0.6:
+                return r.choice(cols)
+            return str(r.randint(-5, 20))
+        kind = r.choice(["arith", "case", "neg"])
+        if kind == "arith":
+            op = r.choice(["+", "-", "*"])
+            return (f"({self.scalar(cols, depth + 1)} {op} "
+                    f"{self.scalar(cols, depth + 1)})")
+        if kind == "neg":
+            return f"(- {self.scalar(cols, depth + 1)})"
+        return (f"(CASE WHEN {self.predicate(cols, depth + 1)} "
+                f"THEN {self.scalar(cols, depth + 1)} "
+                f"ELSE {self.scalar(cols, depth + 1)} END)")
+
+    def predicate(self, cols: List[str], depth: int = 0) -> str:
+        r = self.rng
+        cmp = r.choice(["<", "<=", ">", ">=", "=", "<>"])
+        left = f"{self.scalar(cols, depth + 1)} {cmp} " \
+               f"{self.scalar(cols, depth + 1)}"
+        if depth < 1 and r.random() < 0.3:
+            conj = r.choice(["AND", "OR"])
+            return f"({left}) {conj} ({self.predicate(cols, depth + 1)})"
+        return left
+
+    def query(self) -> str:
+        r = self.rng
+        joined = r.random() < 0.35
+        if joined:
+            frm = "t0 JOIN t1 ON t0.k = t1.k"
+            cols = ["a", "b", "c"]
+        else:
+            frm = "t0"
+            cols = ["k", "a", "b"]
+        where = (f" WHERE {self.predicate(cols)}"
+                 if r.random() < 0.6 else "")
+        if r.random() < 0.45:
+            gk = r.choice(cols)
+            aggs = r.sample(
+                [f"count(*)", f"sum({r.choice(cols)})",
+                 f"min({r.choice(cols)})", f"max({r.choice(cols)})"],
+                k=r.randint(1, 2))
+            items = [f"{gk} AS g"] + [
+                f"{a} AS x{i}" for i, a in enumerate(aggs)]
+            return (f"SELECT {', '.join(items)} FROM {frm}{where} "
+                    f"GROUP BY {gk}")
+        items = [f"{self.scalar(cols)} AS x{i}"
+                 for i in range(r.randint(1, 3))]
+        return f"SELECT {', '.join(items)} FROM {frm}{where}"
+
+
+def run_fuzz(n_queries: int = 40, seed: int = 0,
+             session=None) -> Tuple[int, List[str]]:
+    """Returns (n_checked, failures). A failure is a query whose MV result
+    diverged from its batch result, or that crashed the session."""
+    from .frontend.session import Session
+    s = session or Session()
+    rng = random.Random(seed ^ 0x5EED)
+    s.run_sql("CREATE TABLE t0 (k BIGINT PRIMARY KEY, a BIGINT, b BIGINT)")
+    s.run_sql("CREATE TABLE t1 (k BIGINT PRIMARY KEY, c BIGINT)")
+    for i in range(25):
+        s.run_sql(f"INSERT INTO t0 VALUES ({i}, {rng.randint(-9, 9)}, "
+                  f"{rng.randint(0, 5)})")
+    for i in range(0, 25, 2):
+        s.run_sql(f"INSERT INTO t1 VALUES ({i}, {rng.randint(-3, 12)})")
+    s.flush()
+
+    gen = SqlGen(seed)
+    failures: List[str] = []
+    checked = 0
+    for qi in range(n_queries):
+        sql = gen.query()
+        try:
+            batch = sorted(s.run_sql(sql))
+        except Exception as e:  # noqa: BLE001 - crash IS the finding
+            failures.append(f"batch crash: {sql!r}: {type(e).__name__} {e}")
+            continue
+        mv_name = f"fz{qi}"
+        try:
+            s.run_sql(f"CREATE MATERIALIZED VIEW {mv_name} AS {sql}")
+            s.flush()
+            mv = sorted(tuple(r) for r in s.mv_rows(mv_name))
+            s.run_sql(f"DROP MATERIALIZED VIEW {mv_name}")
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"mv crash: {sql!r}: {type(e).__name__} {e}")
+            continue
+        if mv != batch:
+            failures.append(
+                f"divergence: {sql!r}\n  batch={batch[:5]}\n  mv={mv[:5]}")
+        checked += 1
+    return checked, failures

@@ -53,18 +53,24 @@ class TemporalJoinExecutor(SingleInputExecutor):
         self._point_lookup = (self.right_keys
                               == tuple(right_table.pk_indices))
 
-    def _matches(self, key_vals) -> list:
+    def _matches(self, key_vals, index) -> list:
         if any(v is None for v in key_vals):
             return []
         if self._point_lookup:
             row = self.right_table.get_row(key_vals)
             return [row] if row is not None else []
-        return [
-            r for r in self.right_table.scan_all()
-            if tuple(r[i] for i in self.right_keys) == tuple(key_vals)
-        ]
+        return index.get(tuple(key_vals), [])
+
+    def _build_index(self) -> dict:
+        """Non-pk probe keys: one table pass per chunk, not per row."""
+        index: dict = {}
+        for r in self.right_table.scan_all():
+            index.setdefault(
+                tuple(r[i] for i in self.right_keys), []).append(r)
+        return index
 
     async def map_chunk(self, chunk: StreamChunk):
+        index = None if self._point_lookup else self._build_index()
         out_rows, out_ops = [], []
         nright = len(self.right_table.schema)
         for op, row in chunk_to_rows(chunk, self.in_schema, with_ops=True,
@@ -78,7 +84,7 @@ class TemporalJoinExecutor(SingleInputExecutor):
                     "temporal join requires an append-only probe side "
                     "(got a delete/update); join a snapshot instead")
             keys = [row[i] for i in self.left_keys]
-            matches = self._matches(keys)
+            matches = self._matches(keys, index)
             if not matches and self.outer:
                 out_rows.append(tuple(row) + (None,) * nright)
                 out_ops.append(op)

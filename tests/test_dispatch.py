@@ -1,0 +1,155 @@
+"""Dispatchers + permit exchange + merge (coverage #33/#35): hash split
+with update-pair degradation, backpressure, barrier-aligned fan-in."""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, chunk_to_rows,
+    make_chunk,
+)
+from risingwave_tpu.common.types import INT64, Field, Schema
+from risingwave_tpu.stream.dispatch import (
+    BroadcastDispatcher, ChannelSource, HashDispatcher, MergeExecutor,
+    PermitChannel, RoundRobinDispatcher,
+)
+from risingwave_tpu.stream.message import Barrier, Watermark
+
+S = Schema((Field("k", INT64), Field("v", INT64)))
+
+
+def _collect(ch, n):
+    async def go():
+        out = []
+        for _ in range(n):
+            out.append(await ch.recv())
+        return out
+    return asyncio.run(go())
+
+
+class TestHashDispatcher:
+    def test_rows_partition_and_barriers_broadcast(self):
+        outs = [PermitChannel(), PermitChannel(), PermitChannel()]
+        d = HashDispatcher(outs, [0], S)
+        rows = [(i, i * 10) for i in range(30)]
+        chunk = make_chunk(S, rows, capacity=32)
+
+        async def go():
+            await d.dispatch(chunk)
+            await d.dispatch(Barrier.new(1))
+
+        asyncio.run(go())
+        seen = []
+        for ch in outs:
+            msgs = _collect(ch, 2)
+            part = chunk_to_rows(msgs[0], S)
+            seen.extend(part)
+            assert isinstance(msgs[1], Barrier)
+        assert sorted(seen) == rows            # disjoint cover
+
+    def test_update_pair_split_across_shards_degrades(self):
+        outs = [PermitChannel(), PermitChannel()]
+        d = HashDispatcher(outs, [0], S)
+        # find two keys landing on different shards
+        import numpy as np
+        from risingwave_tpu.common.hashing import vnode_of, vnode_to_shard
+        probe = make_chunk(S, [(i, 0) for i in range(16)], capacity=16)
+        shards = np.asarray(vnode_to_shard(
+            vnode_of([probe.columns[0]]), 2))
+        a = 0
+        b = next(i for i in range(16) if shards[i] != shards[a])
+        chunk = make_chunk(S, [(a, 1), (b, 2)],
+                           ops=[OP_UPDATE_DELETE, OP_UPDATE_INSERT],
+                           capacity=4)
+
+        async def go():
+            await d.dispatch(chunk)
+
+        asyncio.run(go())
+        ops = []
+        for ch in outs:
+            msg = _collect(ch, 1)[0]
+            ops.extend(op for op, _ in chunk_to_rows(msg, S, with_ops=True))
+        # the pair crossed shards: U-/U+ became plain Delete/Insert
+        assert sorted(ops) == [OP_INSERT, OP_DELETE] or \
+            sorted(ops) == sorted([OP_DELETE, OP_INSERT])
+        assert OP_UPDATE_DELETE not in ops and OP_UPDATE_INSERT not in ops
+
+    def test_update_pair_same_shard_preserved(self):
+        outs = [PermitChannel(), PermitChannel()]
+        d = HashDispatcher(outs, [0], S)
+        chunk = make_chunk(S, [(5, 1), (5, 2)],
+                           ops=[OP_UPDATE_DELETE, OP_UPDATE_INSERT],
+                           capacity=4)
+        asyncio.run(d.dispatch(chunk))
+        ops = []
+        for ch in outs:
+            msg = _collect(ch, 1)[0]
+            ops.extend(op for op, _ in chunk_to_rows(msg, S, with_ops=True))
+        assert ops == [OP_UPDATE_DELETE, OP_UPDATE_INSERT]
+
+
+class TestPermits:
+    def test_backpressure_blocks_sender_not_barriers(self):
+        ch = PermitChannel(permits=2)
+        c1 = make_chunk(S, [(1, 1)], capacity=2)
+
+        async def go():
+            await ch.send(c1)
+            await ch.send(c1)
+            # 3rd data send must block until a recv releases a permit
+            blocked = asyncio.ensure_future(ch.send(c1))
+            await asyncio.sleep(0.01)
+            assert not blocked.done()
+            # barriers pass regardless of data budget
+            await asyncio.wait_for(ch.send(Barrier.new(1)), timeout=1)
+            await ch.recv()                     # releases one permit
+            await asyncio.wait_for(blocked, timeout=1)
+
+        asyncio.run(go())
+
+
+class TestMerge:
+    def test_barrier_alignment_across_upstreams(self):
+        chs = [PermitChannel(), PermitChannel()]
+        merge = MergeExecutor(chs, S)
+        c = make_chunk(S, [(1, 1)], capacity=2)
+
+        async def go():
+            order = []
+
+            async def consume():
+                async for m in merge.execute():
+                    order.append(type(m).__name__)
+
+            task = asyncio.ensure_future(consume())
+            await chs[0].send(c)
+            await chs[0].send(Barrier.new(1))   # held: ch1 not ready
+            await asyncio.sleep(0.01)
+            assert "Barrier" not in order
+            await chs[1].send(c)
+            await chs[1].send(Barrier.new(1))   # releases the barrier
+            await asyncio.sleep(0.05)
+            assert order.count("Barrier") == 1
+            from risingwave_tpu.stream.message import Mutation, MutationKind
+            stop = Barrier.new(
+                2, mutation=Mutation(MutationKind.STOP))
+            await chs[0].send(stop)
+            await chs[1].send(stop)
+            await asyncio.wait_for(task, timeout=2)
+            assert order[-1] == "Barrier"
+
+        asyncio.run(go())
+
+    def test_round_robin_and_broadcast(self):
+        outs = [PermitChannel(), PermitChannel()]
+        rr = RoundRobinDispatcher(outs)
+        c = make_chunk(S, [(1, 1)], capacity=2)
+        asyncio.run(rr.dispatch(c))
+        asyncio.run(rr.dispatch(c))
+        assert _collect(outs[0], 1) and _collect(outs[1], 1)
+        bc = BroadcastDispatcher(outs)
+        asyncio.run(bc.dispatch(Watermark(0, 5)))
+        assert isinstance(_collect(outs[0], 1)[0], Watermark)
+        assert isinstance(_collect(outs[1], 1)[0], Watermark)

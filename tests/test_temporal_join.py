@@ -104,3 +104,15 @@ class TestJoinWatermarkOrdering:
         seq = asyncio.run(run())
         assert "wm" in seq and "chunk" in seq
         assert seq.index("chunk") < seq.index("wm")
+
+
+class TestAppendOnlyGuard:
+    def test_retracting_probe_side_rejected_at_plan_time(self):
+        s = Session()
+        s.run_sql("CREATE TABLE price (item BIGINT PRIMARY KEY, p BIGINT)")
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, item BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW agg AS "
+                  "SELECT item, count(*) AS c FROM t GROUP BY item")
+        with pytest.raises(Exception, match="append-only"):
+            s.run_sql("SELECT * FROM agg JOIN price FOR SYSTEM_TIME AS OF "
+                      "PROCTIME() ON agg.item = price.item")

@@ -85,3 +85,9 @@ class TestTelemetry:
         r = tm.report(s)
         assert r["job_counts"]["tables"] == 1
         assert tm.reports == [r]
+
+
+class TestDropUdfGuard:
+    def test_drop_udf_refuses_builtins(self):
+        with pytest.raises(ValueError, match="not a registered UDF"):
+            drop_udf("upper")
