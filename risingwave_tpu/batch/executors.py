@@ -11,9 +11,9 @@ downstream operator is a vectorized jnp transformation over those chunks
 — there is no per-batch pull loop to schedule, XLA fuses the operator
 bodies instead.
 
-Used by ``Session.query`` for pure scans; the stream-fold path remains
-the general engine for plans with operators that only exist as streaming
-executors.
+Wired into ``Session.query`` via batch/lower.py: scan / filter / project
+/ agg / top-n plans run here; the stream-fold path remains the engine
+for plans with stream-only operators (joins, windows, EOWC).
 """
 
 from __future__ import annotations
@@ -142,6 +142,10 @@ class BatchHashAgg(_SingleInput):
 
     def execute(self):
         groups: dict = {}
+        if not self.group_keys:
+            # global agg emits one row even over empty input
+            # (count()=0, others NULL) — matching the streaming SimpleAgg
+            groups[()] = [(0, None, None, None)] * len(self.agg_calls)
         for rows in self.input.execute():
             for row in rows:
                 key = tuple(row[i] for i in self.group_keys)

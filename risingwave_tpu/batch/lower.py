@@ -1,0 +1,53 @@
+"""Plan lowering: stream plan tree → batch executor chain.
+
+Counterpart of the reference's to_batch optimizer phase
+(reference: src/frontend/src/optimizer/mod.rs — the same logical plan
+lowers to either stream or batch physical operators). ``lower_plan``
+returns None for shapes only the streaming engine supports (EOWC,
+DISTINCT aggs, WITH TIES, window functions, joins — those SELECTs keep
+using the session's stream-fold path), so it is always safe to try."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..frontend import planner as P
+from ..storage.state_table import StateTable
+from .executors import (
+    BatchExecutor, BatchFilter, BatchHashAgg, BatchLimit, BatchProject,
+    BatchSort, RowSeqScan,
+)
+
+
+def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
+    if isinstance(plan, (P.PTableScan, P.PMvScan)):
+        d = plan.table if isinstance(plan, P.PTableScan) else plan.mv
+        return RowSeqScan(StateTable(store, d.table_id, d.schema,
+                                     list(d.pk)))
+    if isinstance(plan, P.PProject):
+        inp = lower_plan(plan.input, store)
+        if inp is None:
+            return None
+        return BatchProject(inp, list(plan.exprs), names=plan.schema.names)
+    if isinstance(plan, P.PFilter):
+        inp = lower_plan(plan.input, store)
+        if inp is None:
+            return None
+        return BatchFilter(inp, plan.predicate)
+    if isinstance(plan, P.PAgg):
+        if plan.eowc or any(c.distinct for c in plan.agg_calls):
+            return None
+        inp = lower_plan(plan.input, store)
+        if inp is None:
+            return None
+        return BatchHashAgg(inp, list(plan.group_keys),
+                            list(plan.agg_calls))
+    if isinstance(plan, P.PTopN):
+        if plan.with_ties or plan.group_by:
+            return None
+        inp = lower_plan(plan.input, store)
+        if inp is None:
+            return None
+        return BatchLimit(BatchSort(inp, list(plan.order)),
+                          limit=plan.limit, offset=plan.offset)
+    return None

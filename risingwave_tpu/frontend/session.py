@@ -193,7 +193,10 @@ class Session:
         self.epoch = max(1, self.store.committed_epoch)  # last completed epoch
         self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
         self.feeds: list[_SourceFeed] = []
-        self.table_dml: dict[str, list[StreamChunk]] = {}
+        # DML rendezvous (reference: DmlManager, src/source/src/
+        # dml_manager.rs:44): INSERTs stage here and land in the next epoch
+        from ..stream.dml import DmlManager
+        self.dml = DmlManager()
         self._table_queues: dict[str, list[QueueSource]] = {}
         self._next_shard = 0
         self._recovering = False
@@ -418,7 +421,8 @@ class Session:
             src, StateTable(self.store, t.table_id, schema, list(pk)))
         job = StreamJob(stmt.name, mat, [q])
         self.jobs[stmt.name] = job
-        self.table_dml.setdefault(stmt.name, [])
+        from ..stream.dml import TableDmlHandle
+        self.dml.register(t.table_id, TableDmlHandle(q.push))
         self._table_queues.setdefault(stmt.name, []).append(q)
         job.start(self.loop)
         q.push(Barrier.new(self.epoch))
@@ -634,7 +638,7 @@ class Session:
                 (plan, pipeline, ctx, queues, init_msgs,
                  _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
                 mv_table_id = self.catalog.next_table_id()
-            except BaseException:
+            except Exception:
                 # the new config failed to build: roll back to the
                 # original config over the same durable state — a stopped
                 # job left in self.jobs would hang every later barrier.
@@ -644,9 +648,24 @@ class Session:
                     self.jobs[n].bus.subscribers = list(subs)
                 self.config = saved_config
                 ids = iter(range(id0, id1))
-                (plan, pipeline, ctx, queues, init_msgs,
-                 _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
-                mv_table_id = self.catalog.next_table_id()
+                try:
+                    (plan, pipeline, ctx, queues, init_msgs,
+                     _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
+                    mv_table_id = self.catalog.next_table_id()
+                except Exception as e2:
+                    # config-independent failure: even the original config
+                    # no longer builds. Deregister the job so the session
+                    # stays responsive (durable state + catalog remain; a
+                    # restart's recovery replay restores the job)
+                    self.feeds = self.feeds[:n_feeds0]
+                    for n, subs in bus_subs0.items():
+                        self.jobs[n].bus.subscribers = list(subs)
+                    self.jobs.pop(name, None)
+                    raise RuntimeError(
+                        f"reschedule of {name!r} failed and the rollback "
+                        "rebuild failed too; the job is stopped (state is "
+                        "durable — restart the session to restore it)"
+                    ) from e2
             mat = MaterializeExecutor(
                 pipeline,
                 StateTable(self.store, mv_table_id, plan.schema,
@@ -788,6 +807,7 @@ class Session:
                 sink.close()
             self._await(job.stop())
             self._unsubscribe_job(job)
+            self._table_queues.pop(stmt.name, None)   # stop barrier pushes
         if existed:
             # the job's source feeds die with it: stop generating, free
             # their split-state tables
@@ -799,6 +819,7 @@ class Session:
                 if f.state_table is not None:
                     self.store.drop_table(f.state_table.table_id)
         if existed and obj is not None:
+            self.dml.unregister_table(obj.table_id)
             for tid in ((obj.table_id,)
                         + tuple(getattr(obj, "state_table_ids", ()))):
                 if tid >= 0:
@@ -829,7 +850,7 @@ class Session:
             rows.append(tuple(by_name.get(n) for n in names))
         chunk = make_chunk(Schema(tuple(data_fields)), rows,
                            capacity=max(len(rows), 1))
-        self.table_dml[stmt.table].append(chunk)
+        self.dml.stage(t.table_id, chunk)
         return []
 
     # --------------------------------------------------------------- epochs --
@@ -856,11 +877,7 @@ class Session:
                     chunk = feed.generator()
                     if chunk is not None:
                         feed.queue.push(chunk)
-        for name, chunks in self.table_dml.items():
-            for q in self._table_queues.get(name, []):
-                for c in chunks:
-                    q.push(c)
-            chunks.clear()
+        self.dml.drain_into_epoch()
         for feed in self.feeds:
             if feed.reader is not None:
                 feed.offsets_at_epoch[epoch] = feed.reader.offsets
@@ -941,6 +958,22 @@ class Session:
         self.last_select_schema = [
             (f.name, f.type) for f in plan.schema
             if not f.name.startswith("_")]
+
+        # batch engine fast path (batch/): pure scan/filter/project/agg/
+        # top-n plans run as one-shot vectorized executors; stream-only
+        # shapes (joins, windows, EOWC, DISTINCT aggs) fall through to the
+        # stream-fold below
+        from ..batch.lower import lower_plan
+        lowered = lower_plan(plan, self.store)
+        if lowered is not None:
+            from ..batch.executors import run_batch
+            phys = run_batch(lowered)
+            out = [
+                tuple(None if v is None else plan.schema[i].type.to_python(v)
+                      for i, v in enumerate(r))
+                for r in phys
+            ]
+            return self._present(out, sel, plan)
 
         def factory(leaf) -> Executor:
             if isinstance(leaf, (PTableScan, PMvScan)):

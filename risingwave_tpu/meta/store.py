@@ -1,0 +1,108 @@
+"""MetaStore: transactional KV for cluster metadata.
+
+Counterpart of the reference's meta storage
+(reference: src/meta/src/storage/ — etcd-backed (or in-memory)
+transactional KV under every meta manager; docs/meta-service.md:21-27).
+Two backends: in-memory (playground/tests) and an append-only JSONL file
+log (durable single-node). Transactions are compare-and-swap batches:
+all preconditions checked against the current snapshot, then all ops
+applied atomically — the same primitive the reference's managers build
+catalogs and fragment maps on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class TxnConflict(Exception):
+    pass
+
+
+class MetaStore:
+    def __init__(self) -> None:
+        self._kv: Dict[str, str] = {}
+
+    # -- plain ops ------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[str]:
+        return self._kv.get(key)
+
+    def put(self, key: str, value: str) -> None:
+        self.txn([], [("put", key, value)])
+
+    def delete(self, key: str) -> None:
+        self.txn([], [("del", key, "")])
+
+    def list_prefix(self, prefix: str) -> List[Tuple[str, str]]:
+        return sorted(
+            (k, v) for k, v in self._kv.items() if k.startswith(prefix))
+
+    # -- transactions ---------------------------------------------------------
+
+    def txn(self, preconditions: List[Tuple[str, Optional[str]]],
+            ops: List[Tuple[str, str, str]]) -> None:
+        """``preconditions``: (key, expected_value_or_None-for-absent).
+        ``ops``: ("put"|"del", key, value). All-or-nothing."""
+        for key, expected in preconditions:
+            if self._kv.get(key) != expected:
+                raise TxnConflict(
+                    f"precondition failed on {key!r}: "
+                    f"expected {expected!r}, found {self._kv.get(key)!r}")
+        for op, key, value in ops:
+            if op == "put":
+                self._kv[key] = value
+            elif op == "del":
+                self._kv.pop(key, None)
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        self._persist(ops)
+
+    def _persist(self, ops) -> None:
+        pass
+
+
+class FileMetaStore(MetaStore):
+    """Durable backend: committed txns append to a JSONL log, replayed at
+    open (the etcd stand-in for single-node deployments)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    for op, key, value in json.loads(line):
+                        if op == "put":
+                            self._kv[key] = value
+                        else:
+                            self._kv.pop(key, None)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _persist(self, ops) -> None:
+        if not ops:
+            return
+        self._f.write(json.dumps(list(ops)) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def compact(self) -> None:
+        """Rewrite the log as one snapshot txn."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            snap = [["put", k, v] for k, v in sorted(self._kv.items())]
+            f.write(json.dumps(snap) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,61 @@
+"""DmlManager: the frontend↔stream rendezvous for table writes.
+
+Counterpart of the reference's DML plumbing
+(reference: src/source/src/dml_manager.rs:44 + src/source/src/table.rs:33
+TableDmlHandle — the DML batch executor hands INSERT chunks to the
+registered table's stream job through a channel; executor/dml.rs is the
+stream-side receiver). Here the registry maps table id → writer handles;
+a write fans out to every handle (a table rebuilt by reschedule registers
+a fresh handle under the same id). The Session's epoch loop drains staged
+chunks into the handles at tick time so DML lands inside exactly one
+epoch (atomic with that epoch's barrier).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..common.chunk import StreamChunk
+
+
+class TableDmlHandle:
+    """One registered writer endpoint of a table's stream job."""
+
+    def __init__(self, push: Callable[[StreamChunk], None]):
+        self._push = push
+
+    def write_chunk(self, chunk: StreamChunk) -> None:
+        self._push(chunk)
+
+
+class DmlManager:
+    def __init__(self) -> None:
+        self._handles: Dict[int, List[TableDmlHandle]] = {}
+        self._staged: Dict[int, List[StreamChunk]] = {}
+
+    def register(self, table_id: int, handle: TableDmlHandle) -> None:
+        self._handles.setdefault(table_id, []).append(handle)
+
+    def unregister_table(self, table_id: int) -> None:
+        self._handles.pop(table_id, None)
+        self._staged.pop(table_id, None)
+
+    def stage(self, table_id: int, chunk: StreamChunk) -> None:
+        """Buffer a DML chunk; it reaches the table inside the next epoch
+        (reference: DML batches rendezvous with the stream at the next
+        barrier boundary)."""
+        if table_id not in self._handles:
+            raise KeyError(f"no stream job registered for table {table_id}")
+        self._staged.setdefault(table_id, []).append(chunk)
+
+    def drain_into_epoch(self) -> int:
+        """Deliver all staged chunks to their handles; returns chunks
+        delivered. Called by the barrier conductor at tick time."""
+        n = 0
+        for table_id, chunks in self._staged.items():
+            for h in self._handles.get(table_id, []):
+                for c in chunks:
+                    h.write_chunk(c)
+                    n += 1
+        self._staged.clear()
+        return n

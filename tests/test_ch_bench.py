@@ -1,0 +1,112 @@
+"""CH-benCHmark-style mixed workload (BASELINE.md config 5): OLTP-ish
+DML churn against TPC-H-shaped tables while analytic MVs (join + agg +
+window + top-n) stay incrementally correct — single-chip and sharded over
+the virtual device mesh. Expected values recomputed by host models.
+Reference workload shape: /root/reference e2e_test/ch_benchmark/."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.build import BuildConfig
+
+
+def _mesh(n):
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]), ("shard",))
+
+
+DDL = [
+    """CREATE TABLE customer (c_id BIGINT PRIMARY KEY, c_state VARCHAR,
+       c_balance BIGINT)""",
+    """CREATE TABLE orders (o_id BIGINT PRIMARY KEY, o_c_id BIGINT,
+       o_carrier BIGINT)""",
+    """CREATE TABLE order_line (ol_o_id BIGINT, ol_number BIGINT,
+       ol_amount BIGINT, PRIMARY KEY (ol_o_id, ol_number))""",
+]
+
+MVS = [
+    # revenue per customer state (3-way join + group agg)
+    """CREATE MATERIALIZED VIEW rev_by_state AS
+       SELECT c_state, sum(ol_amount) AS revenue
+       FROM customer, orders, order_line
+       WHERE c_id = o_c_id AND o_id = ol_o_id
+       GROUP BY c_state""",
+    # top spender ranking (window over agg output via subquery)
+    """CREATE MATERIALIZED VIEW order_totals AS
+       SELECT ol_o_id, sum(ol_amount) AS total
+       FROM order_line GROUP BY ol_o_id""",
+    """CREATE MATERIALIZED VIEW top_orders AS
+       SELECT ol_o_id, total FROM order_totals
+       ORDER BY total DESC LIMIT 3""",
+]
+
+
+def _host_models(customers, orders, lines):
+    rev = {}
+    for c_id, state, _ in customers:
+        for o_id, o_c, _ in orders:
+            if o_c != c_id:
+                continue
+            for lo, ln, amt in lines:
+                if lo == o_id:
+                    rev[state] = rev.get(state, 0) + amt
+    totals = {}
+    for lo, ln, amt in lines:
+        totals[lo] = totals.get(lo, 0) + amt
+    top = sorted(totals.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    return rev, totals, set(top)
+
+
+def _run(session_config):
+    rng = random.Random(5)
+    s = Session(config=session_config)
+    for d in DDL:
+        s.run_sql(d)
+    for m in MVS:
+        s.run_sql(m)
+
+    customers, orders, lines = [], [], []
+    states = ["CA", "OR", "TX"]
+    oid = 0
+    for step in range(6):
+        # OLTP-ish churn: new customers, orders, order lines every "txn"
+        c_id = step
+        st = states[rng.randint(0, 2)]
+        customers.append((c_id, st, rng.randint(0, 999)))
+        s.run_sql(f"INSERT INTO customer VALUES ({c_id}, '{st}', "
+                  f"{customers[-1][2]})")
+        for _ in range(rng.randint(1, 2)):
+            oid += 1
+            orders.append((oid, c_id, rng.randint(1, 9)))
+            s.run_sql(f"INSERT INTO orders VALUES ({oid}, {c_id}, "
+                      f"{orders[-1][2]})")
+            for ln in range(1, rng.randint(2, 4)):
+                amt = rng.randint(10, 500)
+                lines.append((oid, ln, amt))
+                s.run_sql("INSERT INTO order_line VALUES "
+                          f"({oid}, {ln}, {amt})")
+        s.flush()
+
+        rev, totals, top = _host_models(customers, orders, lines)
+        got_rev = {r[0]: r[1] for r in s.mv_rows("rev_by_state")}
+        assert got_rev == rev, f"step {step}: {got_rev} != {rev}"
+        got_top = {(r[0], r[1]) for r in s.mv_rows("top_orders")}
+        assert got_top == top, f"step {step}: {got_top} != {top}"
+    return s
+
+
+class TestChBench:
+    def test_mixed_workload_single_chip(self):
+        _run(None)
+
+    def test_mixed_workload_sharded_mesh(self):
+        """The same workload with joins/aggs sharded over a 4-device mesh
+        (BASELINE config 5's scale-out shape)."""
+        _run(BuildConfig(mesh=_mesh(4)))

@@ -19,13 +19,11 @@ from risingwave_tpu.stream.message import Barrier, Watermark
 S = Schema((Field("k", INT64), Field("v", INT64)))
 
 
-def _collect(ch, n):
-    async def go():
-        out = []
-        for _ in range(n):
-            out.append(await ch.recv())
-        return out
-    return asyncio.run(go())
+async def _collect(ch, n):
+    out = []
+    for _ in range(n):
+        out.append(await ch.recv())
+    return out
 
 
 class TestHashDispatcher:
@@ -38,15 +36,15 @@ class TestHashDispatcher:
         async def go():
             await d.dispatch(chunk)
             await d.dispatch(Barrier.new(1))
+            seen = []
+            for ch in outs:
+                msgs = await _collect(ch, 2)
+                part = chunk_to_rows(msgs[0], S)
+                seen.extend(part)
+                assert isinstance(msgs[1], Barrier)
+            assert sorted(seen) == rows        # disjoint cover
 
         asyncio.run(go())
-        seen = []
-        for ch in outs:
-            msgs = _collect(ch, 2)
-            part = chunk_to_rows(msgs[0], S)
-            seen.extend(part)
-            assert isinstance(msgs[1], Barrier)
-        assert sorted(seen) == rows            # disjoint cover
 
     def test_update_pair_split_across_shards_degrades(self):
         outs = [PermitChannel(), PermitChannel()]
@@ -65,16 +63,17 @@ class TestHashDispatcher:
 
         async def go():
             await d.dispatch(chunk)
+            ops = []
+            for ch in outs:
+                msg = (await _collect(ch, 1))[0]
+                ops.extend(
+                    op for op, _ in chunk_to_rows(msg, S, with_ops=True))
+            # the pair crossed shards: U-/U+ became plain Delete/Insert
+            assert sorted(ops) == sorted([OP_DELETE, OP_INSERT])
+            assert OP_UPDATE_DELETE not in ops
+            assert OP_UPDATE_INSERT not in ops
 
         asyncio.run(go())
-        ops = []
-        for ch in outs:
-            msg = _collect(ch, 1)[0]
-            ops.extend(op for op, _ in chunk_to_rows(msg, S, with_ops=True))
-        # the pair crossed shards: U-/U+ became plain Delete/Insert
-        assert sorted(ops) == [OP_INSERT, OP_DELETE] or \
-            sorted(ops) == sorted([OP_DELETE, OP_INSERT])
-        assert OP_UPDATE_DELETE not in ops and OP_UPDATE_INSERT not in ops
 
     def test_update_pair_same_shard_preserved(self):
         outs = [PermitChannel(), PermitChannel()]
@@ -82,12 +81,16 @@ class TestHashDispatcher:
         chunk = make_chunk(S, [(5, 1), (5, 2)],
                            ops=[OP_UPDATE_DELETE, OP_UPDATE_INSERT],
                            capacity=4)
-        asyncio.run(d.dispatch(chunk))
-        ops = []
-        for ch in outs:
-            msg = _collect(ch, 1)[0]
-            ops.extend(op for op, _ in chunk_to_rows(msg, S, with_ops=True))
-        assert ops == [OP_UPDATE_DELETE, OP_UPDATE_INSERT]
+        async def go():
+            await d.dispatch(chunk)
+            ops = []
+            for ch in outs:
+                msg = (await _collect(ch, 1))[0]
+                ops.extend(
+                    op for op, _ in chunk_to_rows(msg, S, with_ops=True))
+            assert ops == [OP_UPDATE_DELETE, OP_UPDATE_INSERT]
+
+        asyncio.run(go())
 
 
 class TestPermits:
@@ -146,10 +149,15 @@ class TestMerge:
         outs = [PermitChannel(), PermitChannel()]
         rr = RoundRobinDispatcher(outs)
         c = make_chunk(S, [(1, 1)], capacity=2)
-        asyncio.run(rr.dispatch(c))
-        asyncio.run(rr.dispatch(c))
-        assert _collect(outs[0], 1) and _collect(outs[1], 1)
-        bc = BroadcastDispatcher(outs)
-        asyncio.run(bc.dispatch(Watermark(0, 5)))
-        assert isinstance(_collect(outs[0], 1)[0], Watermark)
-        assert isinstance(_collect(outs[1], 1)[0], Watermark)
+
+        async def go():
+            await rr.dispatch(c)
+            await rr.dispatch(c)
+            assert await _collect(outs[0], 1)
+            assert await _collect(outs[1], 1)
+            bc = BroadcastDispatcher(outs)
+            await bc.dispatch(Watermark(0, 5))
+            assert isinstance((await _collect(outs[0], 1))[0], Watermark)
+            assert isinstance((await _collect(outs[1], 1))[0], Watermark)
+
+        asyncio.run(go())

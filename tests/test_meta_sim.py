@@ -111,3 +111,52 @@ class TestChaosSim:
             chaos.maybe_kill()
         chaos.verify_against(control)
         assert chaos.kills > 0          # the harness actually killed
+
+
+class TestMetaStore:
+    def test_txn_cas_and_prefix(self):
+        from risingwave_tpu.meta.store import MetaStore, TxnConflict
+        ms = MetaStore()
+        ms.put("catalog/t1", "v1")
+        ms.txn([("catalog/t1", "v1")], [("put", "catalog/t1", "v2"),
+                                        ("put", "catalog/t2", "x")])
+        assert ms.get("catalog/t1") == "v2"
+        assert [k for k, _ in ms.list_prefix("catalog/")] == \
+            ["catalog/t1", "catalog/t2"]
+        with pytest.raises(TxnConflict):
+            ms.txn([("catalog/t1", "v1")], [("put", "catalog/t1", "v3")])
+        assert ms.get("catalog/t1") == "v2"     # atomic: nothing applied
+
+    def test_file_backend_replay_and_compact(self, tmp_path):
+        from risingwave_tpu.meta.store import FileMetaStore
+        p = str(tmp_path / "meta.jsonl")
+        ms = FileMetaStore(p)
+        ms.put("a", "1")
+        ms.put("b", "2")
+        ms.delete("a")
+        ms.close()
+        ms2 = FileMetaStore(p)
+        assert ms2.get("a") is None and ms2.get("b") == "2"
+        ms2.compact()
+        ms2.close()
+        ms3 = FileMetaStore(p)
+        assert ms3.get("b") == "2" and ms3.get("a") is None
+
+
+class TestDmlManager:
+    def test_rendezvous_and_unregister(self):
+        from risingwave_tpu.stream.dml import DmlManager, TableDmlHandle
+        dm = DmlManager()
+        got = []
+        dm.register(7, TableDmlHandle(got.append))
+        with pytest.raises(KeyError):
+            dm.stage(99, "chunk")
+        dm.stage(7, "c1")
+        dm.stage(7, "c2")
+        assert got == []                      # staged, not delivered
+        assert dm.drain_into_epoch() == 2
+        assert got == ["c1", "c2"]
+        assert dm.drain_into_epoch() == 0     # drained
+        dm.unregister_table(7)
+        with pytest.raises(KeyError):
+            dm.stage(7, "c3")
