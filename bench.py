@@ -174,6 +174,28 @@ def measure_q7(n_chunks: int) -> float:
     return n_chunks * CHUNK / elapsed
 
 
+def measure_barrier_latency() -> dict:
+    """p99 barrier latency under a live Session-driven NEXmark MV at the
+    reference's defaults (checkpoint every 10th barrier —
+    BASELINE.md methodology / docs/metrics.md semantics)."""
+    from risingwave_tpu.frontend import Session
+    s = Session(source_chunk_capacity=CHUNK, checkpoint_frequency=10)
+    s.run_sql("""CREATE SOURCE bid (auction BIGINT, price BIGINT)
+                 WITH (connector = 'nexmark', nexmark_table = 'bid')""")
+    s.run_sql("""CREATE MATERIALIZED VIEW m AS
+        SELECT auction, count(*) AS n FROM bid GROUP BY auction""")
+    for _ in range(5):
+        s.tick()                    # warmup: jit compiles land here
+    s._drain_inflight()
+    s.barrier_latency.samples.clear()
+    for _ in range(30):
+        s.tick()
+    s._drain_inflight()
+    snap = s.barrier_latency.snapshot()
+    s.close()
+    return snap
+
+
 def measure_cpu_standin() -> dict:
     """Run the same pipelines under JAX_PLATFORMS=cpu in a fresh subprocess
     (the in-process backend is already bound to the TPU)."""
@@ -202,6 +224,8 @@ def main(rearm=lambda: None):
     tpu_rps = measure_q5(N_CHUNKS)
     rearm()
     tpu_q7 = measure_q7(Q7_N_CHUNKS)
+    rearm()
+    lat = measure_barrier_latency()
     print(json.dumps({
         "metric": "nexmark_q5_core_throughput",
         "value": round(tpu_rps, 1),
@@ -213,6 +237,8 @@ def main(rearm=lambda: None):
         "q7_join_rows_per_sec": round(tpu_q7, 1),
         "q7_vs_baseline": round(tpu_q7 / cpu_q7, 2),
         "q7_cpu_standin_rows_per_sec": round(cpu_q7, 1),
+        "p99_barrier_ms": lat.get("p99_ms"),
+        "p50_barrier_ms": lat.get("p50_ms"),
     }))
 
 

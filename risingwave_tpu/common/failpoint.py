@@ -1,0 +1,55 @@
+"""Failpoints: named fault-injection sites on IO paths.
+
+Counterpart of the reference's ``fail_point!`` sites
+(reference: storage IO failpoints e.g.
+src/storage/src/hummock/sstable_store.rs:285,676 and the
+storage_failpoints test crate). Production cost is one dict lookup per
+site; tests arm sites with an exception (raise once or always) or a
+callable, to prove the durability contract holds when the disk misbehaves
+mid-checkpoint.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Optional
+
+_ARMED: Dict[str, Any] = {}
+
+
+def fail_point(name: str) -> None:
+    """Call at an IO site; raises/executes whatever the test armed."""
+    action = _ARMED.get(name)
+    if action is None:
+        return
+    if isinstance(action, tuple) and action[0] == "once":
+        _ARMED.pop(name, None)
+        action = action[1]
+    if isinstance(action, BaseException) or (
+            isinstance(action, type) and issubclass(action, BaseException)):
+        raise action if not isinstance(action, type) else action(name)
+    if callable(action):
+        action()
+
+
+def arm(name: str, action: Any, once: bool = False) -> None:
+    _ARMED[name] = ("once", action) if once else action
+
+
+def disarm(name: Optional[str] = None) -> None:
+    if name is None:
+        _ARMED.clear()
+    else:
+        _ARMED.pop(name, None)
+
+
+@contextlib.contextmanager
+def failpoints(**points: Any):
+    """with failpoints(**{"checkpoint.segment.write": OSError}): ..."""
+    for n, a in points.items():
+        arm(n, a)
+    try:
+        yield
+    finally:
+        for n in points:
+            disarm(n)

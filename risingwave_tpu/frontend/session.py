@@ -633,16 +633,19 @@ class Session:
         n_feeds0 = len(self.feeds)
         bus_subs0 = {n: list(j.bus.subscribers)
                      for n, j in self.jobs.items()}
+        rollback_error: Optional[BaseException] = None
         try:
             try:
                 (plan, pipeline, ctx, queues, init_msgs,
                  _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
                 mv_table_id = self.catalog.next_table_id()
-            except Exception:
-                # the new config failed to build: roll back to the
-                # original config over the same durable state — a stopped
-                # job left in self.jobs would hang every later barrier.
-                # Undo the failed build's feed/subscription side effects.
+            except BaseException as e1:
+                # the new config failed to build (incl. interrupts —
+                # rollback is fast): roll back to the original config over
+                # the same durable state. A stopped job left in self.jobs
+                # would hang every later barrier. Undo the failed build's
+                # feed/subscription side effects first.
+                rollback_error = e1
                 self.feeds = self.feeds[:n_feeds0]
                 for n, subs in bus_subs0.items():
                     self.jobs[n].bus.subscribers = list(subs)
@@ -652,20 +655,22 @@ class Session:
                     (plan, pipeline, ctx, queues, init_msgs,
                      _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
                     mv_table_id = self.catalog.next_table_id()
-                except Exception as e2:
+                except BaseException as e2:
                     # config-independent failure: even the original config
-                    # no longer builds. Deregister the job so the session
-                    # stays responsive (durable state + catalog remain; a
-                    # restart's recovery replay restores the job)
+                    # no longer builds. Deregister the job AND everything
+                    # transitively fed by it (barrier-starved otherwise);
+                    # durable state + catalog remain — a restart's
+                    # recovery replay restores the jobs.
                     self.feeds = self.feeds[:n_feeds0]
                     for n, subs in bus_subs0.items():
                         self.jobs[n].bus.subscribers = list(subs)
                     self.jobs.pop(name, None)
+                    self._pop_downstreams_of(old_job)
                     raise RuntimeError(
                         f"reschedule of {name!r} failed and the rollback "
-                        "rebuild failed too; the job is stopped (state is "
-                        "durable — restart the session to restore it)"
-                    ) from e2
+                        "rebuild failed too; the job (and its downstream "
+                        "MVs) are stopped — state is durable, restart the "
+                        "session to restore them") from e2
             mat = MaterializeExecutor(
                 pipeline,
                 StateTable(self.store, mv_table_id, plan.schema,
@@ -688,6 +693,21 @@ class Session:
                 q.push(m)
             q.push(Barrier.new(self.epoch))
         self._await(job.wait_barrier(self.epoch))
+        if rollback_error is not None:
+            # the job is healthy again under its ORIGINAL config, but the
+            # requested reschedule did NOT happen — surface that
+            raise RuntimeError(
+                f"reschedule of {name!r} failed; the job was restored "
+                "with its original config") from rollback_error
+
+    def _pop_downstreams_of(self, job: StreamJob) -> None:
+        """Remove jobs transitively fed by ``job``'s bus (they would wait
+        forever for barriers a stopped upstream can never send)."""
+        sub_queues = set(map(id, job.bus.subscribers))
+        for n, j in list(self.jobs.items()):
+            if any(id(q) in sub_queues for q in j.sources):
+                self.jobs.pop(n, None)
+                self._pop_downstreams_of(j)
 
     def sink_of(self, name: str):
         """The live Sink instance of a sink job (inspection/testing)."""
@@ -1091,9 +1111,14 @@ class Session:
             sink = getattr(job.pipeline, "sink", None)
             if sink is not None:
                 sink.close()
-        self._await(asyncio.gather(
-            *(job.stop() for job in self.jobs.values()),
-            return_exceptions=True))
+        jobs = list(self.jobs.values())
+
+        async def _stop_all():
+            # the gather future must be created INSIDE the session loop
+            await asyncio.gather(*(job.stop() for job in jobs),
+                                 return_exceptions=True)
+
+        self._await(_stop_all())
         self.jobs.clear()
         self.loop.close()
 

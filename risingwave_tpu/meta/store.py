@@ -51,14 +51,17 @@ class MetaStore:
                 raise TxnConflict(
                     f"precondition failed on {key!r}: "
                     f"expected {expected!r}, found {self._kv.get(key)!r}")
+        for op, _k, _v in ops:
+            if op not in ("put", "del"):
+                raise ValueError(f"unknown op {op!r}")
+        # durability first: if the log append fails, memory must not hold
+        # values the disk never saw (the all-or-nothing contract)
+        self._persist(ops)
         for op, key, value in ops:
             if op == "put":
                 self._kv[key] = value
-            elif op == "del":
-                self._kv.pop(key, None)
             else:
-                raise ValueError(f"unknown op {op!r}")
-        self._persist(ops)
+                self._kv.pop(key, None)
 
     def _persist(self, ops) -> None:
         pass
@@ -74,15 +77,32 @@ class FileMetaStore(MetaStore):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         if os.path.exists(path):
             with open(path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    for op, key, value in json.loads(line):
+                raw = f.read()
+            lines = raw.split("\n")
+            good_bytes = 0
+            for li, line in enumerate(lines):
+                stripped = line.strip()
+                if stripped:
+                    try:
+                        txn = json.loads(stripped)
+                    except json.JSONDecodeError:
+                        # a torn TAIL line is the normal crash-mid-append
+                        # case: truncate it away; torn MIDDLE lines mean
+                        # real corruption and must not be silently eaten
+                        if li == len(lines) - 1 or not any(
+                                l.strip() for l in lines[li + 1:]):
+                            break
+                        raise
+                    for op, key, value in txn:
                         if op == "put":
                             self._kv[key] = value
                         else:
                             self._kv.pop(key, None)
+                good_bytes += len(line.encode("utf-8")) + 1
+            good_bytes = min(good_bytes, len(raw.encode("utf-8")))
+            if good_bytes < len(raw.encode("utf-8")):
+                with open(path, "a+", encoding="utf-8") as f:
+                    f.truncate(good_bytes)
         self._f = open(path, "a", encoding="utf-8")
 
     def _persist(self, ops) -> None:

@@ -57,20 +57,31 @@ class CheckpointLog:
         return m
 
     def _write_manifest(self, manifest: dict) -> None:
+        from ..common.failpoint import fail_point
         tmp = self._manifest_path() + ".tmp"
+        fail_point("checkpoint.manifest.write")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        fail_point("checkpoint.manifest.rename")
         os.replace(tmp, self._manifest_path())
 
     # -- segments -------------------------------------------------------------
 
     def _write_segment(self, name: str,
                        deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
+        from ..common.failpoint import fail_point
+        fail_point("checkpoint.segment.write")
         path = os.path.join(self.dir, name)
         with open(path, "wb") as f:
             f.write(struct.pack("<I", len(deltas)))
+            f.flush()
+            # fires AFTER bytes hit the file: simulates a torn segment
+            # (crash mid-write). Safe because the manifest that would
+            # reference this segment is only written after the segment
+            # completes — recovery never reads an unreferenced file.
+            fail_point("checkpoint.segment.write.partial")
             for table_id, buf in sorted(deltas.items()):
                 f.write(struct.pack("<II", table_id, len(buf)))
                 for k, v in sorted(buf.items()):

@@ -160,3 +160,20 @@ class TestDmlManager:
         dm.unregister_table(7)
         with pytest.raises(KeyError):
             dm.stage(7, "c3")
+
+
+class TestMetaStoreTornTail:
+    def test_torn_tail_line_truncated_on_replay(self, tmp_path):
+        from risingwave_tpu.meta.store import FileMetaStore
+        p = str(tmp_path / "meta.jsonl")
+        ms = FileMetaStore(p)
+        ms.put("a", "1")
+        ms.close()
+        with open(p, "a") as f:
+            f.write('[["put", "b"')      # crash mid-append
+        ms2 = FileMetaStore(p)           # replay tolerates the torn tail
+        assert ms2.get("a") == "1" and ms2.get("b") is None
+        ms2.put("c", "3")                # and the log keeps working
+        ms2.close()
+        ms3 = FileMetaStore(p)
+        assert ms3.get("c") == "3"
