@@ -47,16 +47,60 @@ def main(argv=None) -> int:
     qf.add_argument("path")
     qf.add_argument("--data-dir", default=None)
 
+    ctl = sub.add_parser(
+        "ctl", help="admin inspection of a durable data dir "
+                    "(reference: risectl)")
+    ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
+                                      "metrics", "trace"])
+    ctl.add_argument("--data-dir", required=True)
+
     args = p.parse_args(argv)
 
     if args.command == "playground":
         return _playground(args)
+    if args.command == "ctl":
+        return _ctl(args)
     session = _build_session(args)
     sql = (args.statement if args.command == "sql"
            else open(args.path, "r", encoding="utf-8").read())
     rows = session.run_sql(sql)
     for row in rows:
         print("\t".join("" if v is None else str(v) for v in row))
+    return 0
+
+
+def _ctl(args) -> int:
+    """risectl-lite: recover a session from the data dir and inspect it
+    (reference: src/ctl/src/lib.rs:48-75 — cluster-info, table scan,
+    trace, profile)."""
+    import json as _json
+    session = _build_session(args)
+    if args.what == "jobs":
+        for kind, reg in (("TABLE", session.catalog.tables),
+                          ("MV", session.catalog.mvs),
+                          ("SOURCE", session.catalog.sources),
+                          ("SINK", session.catalog.sinks)):
+            for name in sorted(reg):
+                print(f"{kind}\t{name}")
+    elif args.what == "parameters":
+        for k, v in session.parameters():
+            print(f"{k}\t{v}")
+    elif args.what == "fragments":
+        from .frontend.planner import Planner
+        from .meta.fragment import fragment_plan
+        for name, mv in sorted(session.catalog.mvs.items()):
+            ast = getattr(mv, "query_ast", None)
+            if ast is None:
+                continue
+            plan = Planner(session.catalog).plan_select(ast)
+            print(f"-- {name}")
+            print(fragment_plan(plan).explain())
+    elif args.what == "metrics":
+        print(_json.dumps(session.metrics(), indent=2, default=str))
+    elif args.what == "trace":
+        from .stream.trace import dump_session
+        print(dump_session(session))
+    session.close()
     return 0
 
 

@@ -702,11 +702,17 @@ class Session:
 
     def _pop_downstreams_of(self, job: StreamJob) -> None:
         """Remove jobs transitively fed by ``job``'s bus (they would wait
-        forever for barriers a stopped upstream can never send)."""
+        forever for barriers a stopped upstream can never send). Full
+        teardown per job: stop the task, unsubscribe its queues from live
+        buses, drop its feeds and barrier queues."""
         sub_queues = set(map(id, job.bus.subscribers))
         for n, j in list(self.jobs.items()):
             if any(id(q) in sub_queues for q in j.sources):
                 self.jobs.pop(n, None)
+                self._await(j.stop())
+                self._unsubscribe_job(j)
+                self.feeds = [f for f in self.feeds if f.job != n]
+                self._table_queues.pop(n, None)
                 self._pop_downstreams_of(j)
 
     def sink_of(self, name: str):

@@ -76,33 +76,40 @@ class FileMetaStore(MetaStore):
         self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         if os.path.exists(path):
-            with open(path, "r", encoding="utf-8") as f:
-                raw = f.read()
-            lines = raw.split("\n")
-            good_bytes = 0
-            for li, line in enumerate(lines):
-                stripped = line.strip()
-                if stripped:
-                    try:
-                        txn = json.loads(stripped)
-                    except json.JSONDecodeError:
-                        # a torn TAIL line is the normal crash-mid-append
-                        # case: truncate it away; torn MIDDLE lines mean
-                        # real corruption and must not be silently eaten
-                        if li == len(lines) - 1 or not any(
-                                l.strip() for l in lines[li + 1:]):
+            size = os.path.getsize(path)
+            good = 0
+            last_line_open = False   # last replayed line lacked its '\n'
+            with open(path, "rb") as f:
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
+                    text = line.decode("utf-8", errors="replace").strip()
+                    if text:
+                        try:
+                            txn = json.loads(text)
+                        except json.JSONDecodeError:
+                            # a torn TAIL is the normal crash-mid-append
+                            # case (truncated below); torn MIDDLE lines
+                            # are real corruption — never eat those
+                            if f.read().strip():
+                                raise
                             break
-                        raise
-                    for op, key, value in txn:
-                        if op == "put":
-                            self._kv[key] = value
-                        else:
-                            self._kv.pop(key, None)
-                good_bytes += len(line.encode("utf-8")) + 1
-            good_bytes = min(good_bytes, len(raw.encode("utf-8")))
-            if good_bytes < len(raw.encode("utf-8")):
-                with open(path, "a+", encoding="utf-8") as f:
-                    f.truncate(good_bytes)
+                        for op, key, value in txn:
+                            if op == "put":
+                                self._kv[key] = value
+                            else:
+                                self._kv.pop(key, None)
+                    good += len(line)
+                    last_line_open = not line.endswith(b"\n")
+            if good < size:
+                os.truncate(path, good)
+            if last_line_open and good > 0:
+                # a valid line torn exactly before its newline: appending
+                # directly would CONCATENATE the next txn onto it and a
+                # later replay would truncate both — close the line first
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write("\n")
         self._f = open(path, "a", encoding="utf-8")
 
     def _persist(self, ops) -> None:

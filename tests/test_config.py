@@ -83,3 +83,30 @@ class TestCli:
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         assert res.returncode == 0, res.stderr[-2000:]
         assert res.stdout.strip().splitlines()[-1] == "42"
+
+
+class TestCtl:
+    def test_ctl_inspection(self, tmp_path):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        d = str(tmp_path / "db")
+        res = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "sql",
+             "CREATE TABLE t (k BIGINT PRIMARY KEY); "
+             "CREATE MATERIALIZED VIEW m AS SELECT count(*) AS c FROM t; "
+             "FLUSH", "--data-dir", d],
+            capture_output=True, text=True, timeout=600, env=env, cwd=cwd)
+        assert res.returncode == 0, res.stderr[-1500:]
+        res = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "ctl", "jobs",
+             "--data-dir", d],
+            capture_output=True, text=True, timeout=600, env=env, cwd=cwd)
+        assert res.returncode == 0, res.stderr[-1500:]
+        assert "TABLE\tt" in res.stdout and "MV\tm" in res.stdout
+        res = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "ctl", "trace",
+             "--data-dir", d],
+            capture_output=True, text=True, timeout=600, env=env, cwd=cwd)
+        assert res.returncode == 0 and "job 'm':" in res.stdout

@@ -177,3 +177,24 @@ class TestMetaStoreTornTail:
         ms2.close()
         ms3 = FileMetaStore(p)
         assert ms3.get("c") == "3"
+
+    def test_valid_tail_missing_newline_not_destroyed(self, tmp_path):
+        """A line torn exactly before its '\\n' must not cause a later
+        append to concatenate (and a later replay to truncate both)."""
+        from risingwave_tpu.meta.store import FileMetaStore
+        p = str(tmp_path / "meta2.jsonl")
+        ms = FileMetaStore(p)
+        ms.put("a", "1")
+        ms.close()
+        # tear the trailing newline off the (valid) last line
+        with open(p, "rb+") as f:
+            f.seek(-1, 2)
+            assert f.read(1) == b"\n"
+            f.seek(-1, 2)
+            f.truncate()
+        ms2 = FileMetaStore(p)
+        assert ms2.get("a") == "1"
+        ms2.put("b", "2")
+        ms2.close()
+        ms3 = FileMetaStore(p)       # BOTH transactions survive
+        assert ms3.get("a") == "1" and ms3.get("b") == "2"
