@@ -75,6 +75,14 @@ def _ctl(args) -> int:
     trace, profile)."""
     import json as _json
     session = _build_session(args)
+    try:
+        _ctl_dispatch(args, session, _json)
+    finally:
+        session.close()
+    return 0
+
+
+def _ctl_dispatch(args, session, _json) -> None:
     if args.what == "jobs":
         for kind, reg in (("TABLE", session.catalog.tables),
                           ("MV", session.catalog.mvs),
@@ -100,8 +108,6 @@ def _ctl(args) -> int:
     elif args.what == "trace":
         from .stream.trace import dump_session
         print(dump_session(session))
-    session.close()
-    return 0
 
 
 def _playground(args) -> int:

@@ -1,0 +1,91 @@
+"""Plan-graph serialization: plan tree ↔ JSON.
+
+Counterpart of the reference's proto plan boundary
+(reference: proto/stream_plan.proto + src/prost/ — the serialized plan
+graph is the ONLY contract between frontend, meta, and compute nodes;
+from_proto/mod.rs:119 rebuilds executors from it). Here the wire format
+is JSON over the same shapes: every plan node / expression dataclass
+round-trips, with catalog objects (tables/MVs/sources) carried as named
+references resolved against the receiving side's catalog — exactly how
+the reference ships table ids, not table contents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from ..common.types import DataType, Field, Schema, TypeKind
+from ..expr.agg import AggCall
+from ..expr.expr import Cast, Expr, FunctionCall, InputRef, Literal
+from ..ops.topn import OrderSpec
+from ..stream.over_window import WindowCall
+from ..stream.project_set import TableFuncCall
+from . import planner as P
+
+_PLAN_CLASSES = {
+    cls.__name__: cls for cls in [
+        P.PSource, P.PTableScan, P.PMvScan, P.PProject, P.PFilter,
+        P.PHopWindow, P.PAgg, P.PJoin, P.PTopN, P.PDynFilter, P.PUnion,
+        P.PValues, P.POverWindow, P.PProjectSet, P.PTemporalJoin,
+    ]
+}
+_AUX_CLASSES = {
+    cls.__name__: cls for cls in [
+        InputRef, Literal, FunctionCall, Cast, TableFuncCall, AggCall,
+        OrderSpec, WindowCall, Field,
+    ]
+}
+
+
+def _enc(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, DataType):
+        return {"__dt__": v.kind.name, "scale": v.scale}
+    if isinstance(v, Schema):
+        return {"__schema__": [_enc(f) for f in v]}
+    if isinstance(v, (tuple, list)):
+        return {"__seq__": [_enc(x) for x in v]}
+    cls = type(v).__name__
+    if cls in _PLAN_CLASSES or cls in _AUX_CLASSES:
+        out = {"__cls__": cls}
+        for f in dataclasses.fields(v):
+            out[f.name] = _enc(getattr(v, f.name))
+        return out
+    # catalog objects travel as named references (reference: plans carry
+    # table ids, the receiving node resolves them against its catalog)
+    for attr in ("name",):
+        if hasattr(v, attr) and hasattr(v, "schema"):
+            return {"__catalog__": getattr(v, attr)}
+    raise TypeError(f"cannot serialize {type(v).__name__}")
+
+
+def _dec(v: Any, catalog) -> Any:
+    if not isinstance(v, dict):
+        return v
+    if "__dt__" in v:
+        return DataType(TypeKind[v["__dt__"]], scale=v.get("scale", 0))
+    if "__schema__" in v:
+        return Schema(tuple(_dec(f, catalog) for f in v["__schema__"]))
+    if "__seq__" in v:
+        return tuple(_dec(x, catalog) for x in v["__seq__"])
+    if "__catalog__" in v:
+        name = v["__catalog__"]
+        _, d = catalog.resolve_relation(name)
+        return d
+    cls_name = v["__cls__"]
+    cls = _PLAN_CLASSES.get(cls_name) or _AUX_CLASSES[cls_name]
+    kwargs = {
+        k: _dec(val, catalog) for k, val in v.items() if k != "__cls__"
+    }
+    return cls(**kwargs)
+
+
+def plan_to_json(plan: P.PlanNode) -> str:
+    return json.dumps(_enc(plan))
+
+
+def plan_from_json(data: str, catalog) -> P.PlanNode:
+    return _dec(json.loads(data), catalog)

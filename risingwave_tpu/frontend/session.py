@@ -709,6 +709,9 @@ class Session:
         for n, j in list(self.jobs.items()):
             if any(id(q) in sub_queues for q in j.sources):
                 self.jobs.pop(n, None)
+                sink = getattr(j.pipeline, "sink", None)
+                if sink is not None:
+                    sink.close()
                 self._await(j.stop())
                 self._unsubscribe_job(j)
                 self.feeds = [f for f in self.feeds if f.job != n]

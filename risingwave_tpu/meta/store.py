@@ -84,17 +84,20 @@ class FileMetaStore(MetaStore):
                     line = f.readline()
                     if not line:
                         break
-                    text = line.decode("utf-8", errors="replace").strip()
-                    if text:
-                        try:
-                            txn = json.loads(text)
-                        except json.JSONDecodeError:
-                            # a torn TAIL is the normal crash-mid-append
-                            # case (truncated below); torn MIDDLE lines
-                            # are real corruption — never eat those
-                            if f.read().strip():
-                                raise
-                            break
+                    try:
+                        # strict: _persist writes ASCII json; any invalid
+                        # byte is corruption, same contract as the JSON
+                        # parse below
+                        text = line.decode("utf-8").strip()
+                        txn = json.loads(text) if text else None
+                    except (UnicodeDecodeError, json.JSONDecodeError):
+                        # a torn TAIL is the normal crash-mid-append case
+                        # (truncated below); torn MIDDLE lines are real
+                        # corruption — never eat those
+                        if f.read().strip():
+                            raise
+                        break
+                    if txn is not None:
                         for op, key, value in txn:
                             if op == "put":
                                 self._kv[key] = value

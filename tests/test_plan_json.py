@@ -1,0 +1,59 @@
+"""Plan-graph JSON boundary (coverage #79): plans round-trip through the
+wire format, rebuild into executors, and produce identical results."""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.parser import parse_one
+from risingwave_tpu.frontend.plan_json import plan_from_json, plan_to_json
+from risingwave_tpu.frontend.planner import Planner
+
+QUERIES = [
+    "SELECT k, v * 2 FROM t WHERE v > 5",
+    "SELECT k % 3 AS g, sum(v) AS s, count(*) AS c FROM t GROUP BY k % 3",
+    "SELECT a.k, b.v FROM t a JOIN t b ON a.k = b.k",
+    "SELECT k, v FROM t ORDER BY v DESC LIMIT 3",
+    "SELECT k, row_number() OVER (PARTITION BY k % 2 ORDER BY v) FROM t",
+    "SELECT k, generate_series(1, 2) FROM t",
+]
+
+
+def _session():
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.run_sql("INSERT INTO t VALUES (1, 3), (2, 8), (3, 12), (4, 1)")
+    s.flush()
+    return s
+
+
+class TestPlanJson:
+    @pytest.mark.parametrize("sql", QUERIES)
+    def test_roundtrip_stable_and_equivalent(self, sql):
+        s = _session()
+        plan = Planner(s.catalog).plan_select(parse_one(sql).select)
+        wire = plan_to_json(plan)
+        back = plan_from_json(wire, s.catalog)
+        # stable: a second serialization is byte-identical
+        assert plan_to_json(back) == wire
+        # structurally equal plans explain identically
+        assert back.explain() == plan.explain()
+
+    def test_roundtripped_plan_executes(self):
+        """The deserialized plan builds a live executor graph that
+        produces the same rows as the original (the from_proto path)."""
+        s = _session()
+        sql = "SELECT k % 2 AS g, sum(v) AS sv FROM t GROUP BY k % 2"
+        expected = sorted(s.run_sql(sql))
+        plan = Planner(s.catalog).plan_select(parse_one(sql).select)
+        back = plan_from_json(plan_to_json(plan), s.catalog)
+        # run the deserialized plan through the batch engine
+        from risingwave_tpu.batch.lower import lower_plan
+        from risingwave_tpu.batch.executors import run_batch
+        lowered = lower_plan(back, s.store)
+        assert lowered is not None
+        rows = sorted(
+            tuple(None if v is None else back.schema[i].type.to_python(v)
+                  for i, v in enumerate(r))
+            for r in run_batch(lowered))
+        got = [tuple(r[:2]) for r in rows]
+        assert sorted(got) == expected
