@@ -34,7 +34,7 @@ KEYWORDS = {
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
     "sink", "sinks", "over", "partition", "like", "extract", "set", "to",
-    "parameters",
+    "parameters", "delete", "update",
 }
 
 
@@ -157,6 +157,23 @@ class Parser:
             return self._insert()
         if self.at_kw("select"):
             return A.Query(self._select())
+        if self.eat_kw("delete"):
+            self.expect_kw("from")
+            table = self.ident()
+            where = self.parse_expr() if self.eat_kw("where") else None
+            return A.Delete(table, where)
+        if self.eat_kw("update"):
+            table = self.ident()
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                assigns.append((col, self.parse_expr()))
+                if not self.eat_op(","):
+                    break
+            where = self.parse_expr() if self.eat_kw("where") else None
+            return A.Update(table, tuple(assigns), where)
         if self.eat_kw("show"):
             what = self.ident()
             return A.ShowStatement(what)

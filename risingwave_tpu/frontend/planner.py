@@ -281,8 +281,11 @@ class Planner:
     """Plans one SELECT against the catalog. ``fresh`` — hidden-column name
     uniquifier shared across nested planners."""
 
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, lenient: bool = False):
+        # lenient = DDL replay during recovery: rules tightened after a
+        # statement was logged must WARN, not make the store unloadable
         self.catalog = catalog
+        self.lenient = lenient
 
     # -- entry ----------------------------------------------------------------
 
@@ -545,10 +548,17 @@ class Planner:
             raise PlanError("temporal join right side must be a table/MV")
         left, lscope = self._plan_relation(j.left)
         if not _plan_is_append_only(left):
-            raise PlanError(
-                "temporal join requires an append-only probe side "
-                "(sources / append-only tables through stateless "
-                "operators); this input can retract")
+            if self.lenient:
+                import warnings
+                warnings.warn(
+                    "temporal join probe side is not append-only; the "
+                    "job will fail at the first retraction (statement "
+                    "predates the append-only rule)")
+            else:
+                raise PlanError(
+                    "temporal join requires an append-only probe side "
+                    "(sources / append-only tables through stateless "
+                    "operators); this input can retract")
         kind, rdef = self.catalog.resolve_relation(j.right.name)
         if kind == "source":
             raise PlanError("temporal join right side must be materialized")
@@ -1026,9 +1036,9 @@ def _plan_is_append_only(plan: PlanNode) -> bool:
     if isinstance(plan, PSource):
         return True
     if isinstance(plan, PTableScan):
-        # the DML surface is INSERT-only today, so table changelogs never
-        # retract; revisit when UPDATE/DELETE statements land
-        return True
+        # DELETE/UPDATE DML can retract from ordinary tables; only
+        # declared APPEND ONLY tables are safe probe sides
+        return bool(getattr(plan.table, "append_only", False))
     if isinstance(plan, (PProject, PFilter, PHopWindow)):
         return _plan_is_append_only(plan.input)
     if isinstance(plan, PTemporalJoin):

@@ -290,6 +290,10 @@ class Session:
             return self._drop(stmt)
         if isinstance(stmt, A.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, A.Delete):
+            return self._delete_dml(stmt)
+        if isinstance(stmt, A.Update):
+            return self._update_dml(stmt)
         if isinstance(stmt, A.Query):
             return self.query(stmt.select)
         if isinstance(stmt, A.ShowStatement):
@@ -434,7 +438,7 @@ class Session:
         executors via the stream-leaf factory, collect session-driven
         queues + their init feeds and (under recovery) the scan leaves
         whose backfill may need re-running."""
-        plan = Planner(self.catalog).plan_select(query)
+        plan = Planner(self.catalog, lenient=self._recovering).plan_select(query)
         queues: list[QueueSource] = []
         init_msgs: list[tuple[QueueSource, list[Message]]] = []
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
@@ -881,6 +885,119 @@ class Session:
                            capacity=max(len(rows), 1))
         self.dml.stage(t.table_id, chunk)
         return []
+
+    def _dml_target(self, name: str):
+        """Resolve + preconditions shared by DELETE/UPDATE (reference:
+        batch Delete/Update executors via DmlManager)."""
+        t = self.catalog.tables.get(name)
+        if t is None:
+            raise SqlError(f"table {name!r} not found")
+        if t.append_only:
+            raise SqlError(f"table {name!r} is APPEND ONLY")
+        if len(t.pk) == 1 and t.schema[t.pk[0]].name == "_row_id":
+            raise SqlError(
+                "DELETE/UPDATE require a declared PRIMARY KEY "
+                "(hidden row-id tables are insert-only)")
+        # read-your-writes: staged DML must be visible to the match. A
+        # plain (non-checkpoint) epoch suffices — materialize ingests into
+        # the store's pending view; no durable commit per statement
+        if self.dml.has_staged():
+            self.tick(generate=False, checkpoint=False)
+        self._drain_inflight()
+        return t
+
+    def _match_rows(self, t, where) -> list:
+        """Physical rows of ``t`` matching ``where`` (vectorized eval)."""
+        import numpy as np
+        from ..common.chunk import physical_chunk
+        table = StateTable(self.store, t.table_id, t.schema, list(t.pk))
+        rows = list(table.scan_all())
+        if where is None or not rows:
+            return rows
+        pred = ExprBinder(Scope.of_schema(t.schema)).bind(where)
+        chunk = physical_chunk(t.schema, rows, len(rows))
+        cond = pred.eval(chunk)
+        keep = np.asarray(cond.data & cond.mask)[:len(rows)]
+        return [r for r, k in zip(rows, keep) if k]
+
+    def _delete_dml(self, stmt: A.Delete) -> list:
+        from ..common.chunk import OP_DELETE, make_chunk
+        t = self._dml_target(stmt.table)
+        rows = self._match_rows(t, stmt.where)
+        if rows:
+            chunk = make_chunk(t.schema, rows, ops=[OP_DELETE] * len(rows),
+                               capacity=len(rows), physical=True)
+            self.dml.stage(t.table_id, chunk)
+        return [("DELETE", len(rows))]
+
+    def _update_dml(self, stmt: A.Update) -> list:
+        import numpy as np
+        from ..common.chunk import (
+            OP_UPDATE_DELETE, OP_UPDATE_INSERT, make_chunk, physical_chunk,
+        )
+        t = self._dml_target(stmt.table)
+        names = list(t.schema.names)
+        assigns = []
+        for col, e in stmt.assignments:
+            if col not in names:
+                raise SqlError(f"column {col!r} not found")
+            assigns.append((names.index(col),
+                            ExprBinder(Scope.of_schema(t.schema)).bind(e)))
+        rows = self._match_rows(t, stmt.where)
+        if rows:
+            from ..expr.expr import cast as _cast
+            chunk = physical_chunk(t.schema, rows, len(rows))
+            new_cols = {}
+            for idx, e in assigns:
+                e2 = (e if e.type == t.schema[idx].type
+                      else _cast(e, t.schema[idx].type))
+                c = e2.eval(chunk)
+                new_cols[idx] = (np.asarray(c.data), np.asarray(c.mask))
+            new_rows = []
+            for r, old in enumerate(rows):
+                new = list(old)
+                for idx, _ in assigns:
+                    d, m = new_cols[idx]
+                    new[idx] = d[r].item() if m[r] else None
+                new_rows.append(tuple(new))
+            pk_cols = set(t.pk)
+            pk_changed = any(idx in pk_cols for idx, _ in assigns)
+            if not pk_changed:
+                # same-pk updates: adjacent U-/U+ pairs (order-safe — pks
+                # are unique within the statement)
+                pairs, ops = [], []
+                for old, new in zip(rows, new_rows):
+                    pairs.extend((tuple(old), new))
+                    ops.extend((OP_UPDATE_DELETE, OP_UPDATE_INSERT))
+            else:
+                # pk-moving updates: sequential pair application could
+                # delete a freshly-moved row (SET k = k + 1 over k=1,2).
+                # Emit ALL deletes before ALL inserts, and reject
+                # duplicate-key outcomes the way a database must.
+                from ..common.chunk import OP_DELETE, OP_INSERT
+                def pk_of(row):
+                    return tuple(row[i] for i in t.pk)
+                old_pks = {pk_of(r) for r in rows}
+                seen = set()
+                table = StateTable(self.store, t.table_id, t.schema,
+                                   list(t.pk))
+                for nr in new_rows:
+                    npk = pk_of(nr)
+                    if npk in seen:
+                        raise SqlError(
+                            f"UPDATE produces duplicate key {npk}")
+                    seen.add(npk)
+                    if npk not in old_pks and \
+                            table.get_row(list(npk)) is not None:
+                        raise SqlError(
+                            f"UPDATE key {npk} collides with an "
+                            "existing row")
+                pairs = [tuple(r) for r in rows] + new_rows
+                ops = [OP_DELETE] * len(rows) + [OP_INSERT] * len(new_rows)
+            out = make_chunk(t.schema, pairs, ops=ops,
+                             capacity=len(pairs), physical=True)
+            self.dml.stage(t.table_id, out)
+        return [("UPDATE", len(rows))]
 
     # --------------------------------------------------------------- epochs --
 

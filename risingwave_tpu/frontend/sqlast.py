@@ -253,6 +253,19 @@ class Insert:
 
 
 @dataclasses.dataclass(frozen=True)
+class Delete:
+    table: str
+    where: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Update:
+    table: str
+    assignments: tuple            # ((col, expr), ...)
+    where: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Query:
     """Top-level SELECT statement."""
 
@@ -278,5 +291,5 @@ class SetStatement:
 
 
 Statement = Union[CreateSink, CreateSource, CreateTable, CreateMaterializedView,
-                  CreateIndex, DropStatement, Insert, Query, ShowStatement,
-                  FlushStatement, SetStatement]
+                  CreateIndex, DropStatement, Insert, Delete, Update, Query,
+                  ShowStatement, FlushStatement, SetStatement]

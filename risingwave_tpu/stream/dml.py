@@ -40,6 +40,9 @@ class DmlManager:
         self._handles.pop(table_id, None)
         self._staged.pop(table_id, None)
 
+    def has_staged(self) -> bool:
+        return bool(self._staged)
+
     def stage(self, table_id: int, chunk: StreamChunk) -> None:
         """Buffer a DML chunk; it reaches the table inside the next epoch
         (reference: DML batches rendezvous with the stream at the next

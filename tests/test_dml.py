@@ -1,0 +1,108 @@
+"""DELETE / UPDATE DML (reference: batch Delete/Update executors through
+the DmlManager rendezvous): retractions flow through MVs incrementally."""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+
+def _setup():
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    s.run_sql("INSERT INTO t VALUES (1, 0, 10), (2, 1, 20), (3, 0, 30), "
+              "(4, 1, 40)")
+    s.flush()
+    return s
+
+
+class TestDelete:
+    def test_delete_where(self):
+        s = _setup()
+        out = s.run_sql("DELETE FROM t WHERE v > 25")
+        assert out == [("DELETE", 2)]
+        s.flush()
+        assert sorted(s.run_sql("SELECT k FROM t")) == [(1,), (2,)]
+
+    def test_delete_all_and_mv_retracts(self):
+        s = _setup()
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, sum(v) AS sv FROM t GROUP BY g")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(0, 40), (1, 60)]
+        s.run_sql("DELETE FROM t WHERE g = 0")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(1, 60)]
+        s.run_sql("DELETE FROM t")
+        s.flush()
+        assert s.mv_rows("m") == []
+        assert s.run_sql("SELECT k FROM t") == []
+
+    def test_requires_pk_and_not_append_only(self):
+        s = Session()
+        s.run_sql("CREATE TABLE noz (a BIGINT)")      # hidden row-id pk
+        with pytest.raises(Exception, match="PRIMARY KEY"):
+            s.run_sql("DELETE FROM noz")
+        s.run_sql("CREATE TABLE ao (a BIGINT PRIMARY KEY) "
+                  "WITH (appendonly = 'true')")
+        with pytest.raises(Exception, match="APPEND ONLY"):
+            s.run_sql("DELETE FROM ao")
+
+
+class TestUpdate:
+    def test_update_values_and_mv(self):
+        s = _setup()
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, sum(v) AS sv FROM t GROUP BY g")
+        s.flush()
+        out = s.run_sql("UPDATE t SET v = v + 100 WHERE g = 0")
+        assert out == [("UPDATE", 2)]
+        s.flush()
+        assert sorted(s.run_sql("SELECT k, v FROM t")) == [
+            (1, 110), (2, 20), (3, 130), (4, 40)]
+        assert sorted(s.mv_rows("m")) == [(0, 240), (1, 60)]
+
+    def test_update_pk_column(self):
+        s = _setup()
+        s.run_sql("UPDATE t SET k = k + 100 WHERE k = 1")
+        s.flush()
+        assert sorted(r[0] for r in s.run_sql("SELECT k FROM t")) == \
+            [2, 3, 4, 101]
+
+    def test_update_multiple_columns_and_unseen_insert(self):
+        s = _setup()
+        # an INSERT staged in the same epoch is visible to the UPDATE
+        s.run_sql("INSERT INTO t VALUES (5, 0, 50)")
+        s.run_sql("UPDATE t SET g = 9, v = 0 WHERE k = 5")
+        s.flush()
+        rows = dict((r[0], (r[1], r[2])) for r in
+                    s.run_sql("SELECT k, g, v FROM t"))
+        assert rows[5] == (9, 0)
+
+
+class TestPkUpdateCollisions:
+    def test_shift_all_keys(self):
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        s.run_sql("UPDATE t SET k = k + 1")
+        s.flush()
+        assert sorted(s.run_sql("SELECT k, v FROM t")) == [(2, 10), (3, 20)]
+
+    def test_collision_with_existing_row_rejected(self):
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        with pytest.raises(Exception, match="collides"):
+            s.run_sql("UPDATE t SET k = 2 WHERE k = 1")
+        s.flush()
+        assert sorted(s.run_sql("SELECT k, v FROM t")) == [(1, 10), (2, 20)]
+
+    def test_duplicate_within_update_rejected(self):
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        with pytest.raises(Exception, match="duplicate key"):
+            s.run_sql("UPDATE t SET k = 7")

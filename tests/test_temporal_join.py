@@ -12,7 +12,7 @@ class TestTemporalJoin:
         s = Session()
         s.run_sql("CREATE TABLE price (item BIGINT PRIMARY KEY, p BIGINT)")
         s.run_sql("CREATE TABLE orders (oid BIGINT PRIMARY KEY, "
-                  "item BIGINT, qty BIGINT)")
+                  "item BIGINT, qty BIGINT) WITH (appendonly = 'true')")
         s.run_sql("INSERT INTO price VALUES (1, 100), (2, 200)")
         s.flush()
         return s
@@ -58,7 +58,8 @@ class TestTemporalJoin:
     def test_requires_materialized_right(self):
         s = Session()
         s.run_sql("CREATE SOURCE src (k BIGINT) WITH (connector='datagen')")
-        s.run_sql("CREATE TABLE o (oid BIGINT PRIMARY KEY, k BIGINT)")
+        s.run_sql("CREATE TABLE o (oid BIGINT PRIMARY KEY, k BIGINT) "
+                  "WITH (appendonly = 'true')")
         with pytest.raises(Exception, match="materialized"):
             s.run_sql("SELECT * FROM o JOIN src FOR SYSTEM_TIME AS OF "
                       "PROCTIME() ON o.k = src.k")
