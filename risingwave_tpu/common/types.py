@@ -78,7 +78,8 @@ class StringDict:
     a capacity-planning error surfaced loudly, not silent growth.
     """
 
-    __slots__ = ("_to_id", "_to_str", "max_size")
+    __slots__ = ("_to_id", "_to_str", "max_size", "_sorted", "_unsorted",
+                 "_rank_version", "_ranks", "_device_version", "_device_ranks")
 
     DEFAULT_MAX = 1 << 22          # 4M distinct strings
 
@@ -86,6 +87,15 @@ class StringDict:
         self._to_id: dict[str, int] = {"": 0}
         self._to_str: list[str] = [""]
         self.max_size = max_size
+        # sorted prefix + unsorted suffix: intern stays O(1) (append), and
+        # a rank refresh merges the suffix in — O(n + k log k), never a
+        # full re-sort, and no cost at all for workloads that never order
+        self._sorted: list[str] = [""]
+        self._unsorted: list[str] = []
+        self._rank_version = -1
+        self._ranks: Optional[np.ndarray] = None
+        self._device_version = -1
+        self._device_ranks = None
 
     def intern(self, s: str) -> int:
         i = self._to_id.get(s)
@@ -98,6 +108,7 @@ class StringDict:
             i = len(self._to_str)
             self._to_id[s] = i
             self._to_str.append(s)
+            self._unsorted.append(s)
         return i
 
     def lookup(self, i: int) -> str:
@@ -105,6 +116,57 @@ class StringDict:
 
     def __len__(self) -> int:
         return len(self._to_str)
+
+    # -- ordering --------------------------------------------------------------
+    # Dictionary ids are insertion-ordered, so raw ids must never feed an
+    # ordering operation (reference semantics: memcomparable order,
+    # src/common/src/util/memcmp_encoding.rs). Every device ordering path
+    # (comparisons, sort keys, MIN/MAX) maps id -> lexicographic rank through
+    # this side table instead. State always STORES ids (stable under dict
+    # growth); ranks are looked up fresh at comparison time, so a table
+    # refresh never invalidates persisted state.
+
+    @property
+    def version(self) -> int:
+        """Monotone counter: bumps exactly when a new string is interned."""
+        return len(self._to_str)
+
+    def ranks(self) -> np.ndarray:
+        """int64[version] table: ranks()[id] = rank of string id in
+        lexicographic (codepoint) order. Refresh merges the unsorted
+        suffix of newly-interned strings into the sorted prefix
+        (O(n + k log k)); cached per version in between; interning itself
+        stays O(1)."""
+        n = len(self._to_str)
+        if self._rank_version != n:
+            if self._unsorted:
+                import heapq
+                self._sorted = list(heapq.merge(
+                    self._sorted, sorted(self._unsorted)))
+                self._unsorted = []
+            pos = {s: i for i, s in enumerate(self._sorted)}
+            r = np.empty(n, np.int64)
+            for i, s in enumerate(self._to_str):
+                r[i] = pos[s]
+            self._ranks = r
+            self._rank_version = n
+        return self._ranks
+
+    def device_ranks(self):
+        """Device-resident rank table, padded to a power of two (padding
+        maps to rank == version, above every live rank) so jitted consumers
+        retrace only on capacity doublings, not on every intern."""
+        n = len(self._to_str)
+        if self._device_version != n:
+            import jax.numpy as jnp
+            cap = 8
+            while cap < n:
+                cap *= 2
+            t = np.full(cap, n, np.int64)
+            t[:n] = self.ranks()
+            self._device_ranks = jnp.asarray(t)
+            self._device_version = n
+        return self._device_ranks
 
 
 # A single process-wide dictionary keeps VARCHAR ids comparable across

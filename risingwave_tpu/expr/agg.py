@@ -37,9 +37,16 @@ class AggCall:
     arg_type: Optional[DataType] = None
     distinct: bool = False
 
+    #: HLL registers for approx_count_distinct: m=16 → ~26% rel. error,
+    #: 16 int64 lanes per group (reference capability:
+    #: src/expr/src/agg/approx_count_distinct.rs — register semantics,
+    #: TPU-first layout: registers are lanes so the update is the same
+    #: scatter-max kernel every other agg uses)
+    HLL_M = 16
+
     @property
     def output_type(self) -> DataType:
-        if self.kind == "count":
+        if self.kind in ("count", "approx_count_distinct"):
             return INT64
         if self.kind == "avg":
             return FLOAT64
@@ -48,7 +55,19 @@ class AggCall:
 
     @property
     def needs_append_only(self) -> bool:
-        return self.kind in ("min", "max")
+        # HLL registers are monotone maxima — deletes cannot retract them
+        return self.kind in ("min", "max", "approx_count_distinct")
+
+    @property
+    def is_string_minmax(self) -> bool:
+        """MIN/MAX over VARCHAR/BYTEA: the lane stores the dictionary *id*
+        (stable under dictionary growth), but comparisons happen in packed
+        ``rank<<32 | id`` space using the dictionary's lexicographic rank
+        table fetched fresh at update time (reference order semantics:
+        src/common/src/util/memcmp_encoding.rs). Executors pass ``str_ranks``
+        to contributions() and wrap reduces in pack_lane()/unpack_lane()."""
+        return (self.kind in ("min", "max") and self.arg_type is not None
+                and self.arg_type.is_string)
 
     # ---- state layout -------------------------------------------------------
     # Every agg state is a fixed number of float64/int64 lanes so the group
@@ -62,7 +81,11 @@ class AggCall:
 
     @property
     def num_lanes(self) -> int:
-        return 2 if self.kind == "avg" else 1
+        if self.kind == "avg":
+            return 2
+        if self.kind == "approx_count_distinct":
+            return self.HLL_M
+        return 1
 
     def init_lanes(self):
         """Initial per-lane values (python scalars, cast by the table)."""
@@ -86,8 +109,8 @@ class AggCall:
 
     def _minmax_sentinel(self):
         """Identity element for min/max lanes; int64 extrema for integral
-        args (exact for full-range ids/timestamps), ±inf for floats."""
-        if self._integral_arg():
+        and string (dictionary-id) args, ±inf for floats."""
+        if self._integral_arg() or self.is_string_minmax:
             big = jnp.iinfo(jnp.int64).max
             return big if self.kind == "min" else -big
         return jnp.inf if self.kind == "min" else -jnp.inf
@@ -95,9 +118,24 @@ class AggCall:
     def _integral_arg(self) -> bool:
         return self.arg_type is not None and self.arg_type.is_integral
 
-    def contributions(self, value, vmask, signs):
-        """Per-row contribution arrays, one per lane ([N] each)."""
+    def contributions(self, value, vmask, signs, str_ranks=None):
+        """Per-row contribution arrays, one per lane ([N] each).
+
+        ``str_ranks``: dictionary rank table, required iff
+        ``is_string_minmax`` — contributions are then packed
+        ``rank<<32 | id`` values comparable by lexicographic order."""
         s = signs
+        if self.is_string_minmax:
+            if str_ranks is None:
+                raise ValueError(
+                    "MIN/MAX over VARCHAR requires the dictionary rank "
+                    "table (str_ranks)")
+            ids = value.astype(jnp.int64)
+            rank = str_ranks[jnp.clip(value.astype(jnp.int32), 0,
+                                      str_ranks.shape[0] - 1)]
+            packed = (rank << 32) | ids
+            v = jnp.where(vmask & (s > 0), packed, self._minmax_sentinel())
+            return [v]
         if self.kind == "count":
             if self.arg < 0:
                 return [s.astype(jnp.int64)]
@@ -114,26 +152,72 @@ class AggCall:
             dt = self.state_dtypes()[0]
             v = jnp.where(vmask & (s > 0), value, self._minmax_sentinel())
             return [v.astype(dt)]
+        if self.kind == "approx_count_distinct":
+            return self._hll_contributions(value, vmask & (s > 0))
         raise ValueError(self.kind)
+
+    def _hll_contributions(self, value, contributing):
+        """HyperLogLog register updates: hash the value, low bits pick a
+        register, rho = leading-zero run of the rest + 1; each lane j gets
+        max(rho where register==j). All lanes reduce with max."""
+        import jax
+        from ..common.hashing import _splitmix64
+        if value.dtype in (jnp.float32, jnp.float64):
+            vi = jax.lax.bitcast_convert_type(
+                value.astype(jnp.float64), jnp.int64)
+        else:
+            vi = value.astype(jnp.int64)
+        h = _splitmix64(vi.astype(jnp.uint64))
+        m = self.HLL_M
+        reg = (h & jnp.uint64(m - 1)).astype(jnp.int32)
+        w = h >> jnp.uint64(4)          # top 4 bits now zero
+        rho = (jax.lax.clz(w.astype(jnp.int64)) - 4 + 1).astype(jnp.int64)
+        out = []
+        for j in range(m):
+            out.append(jnp.where(contributing & (reg == j), rho, 0))
+        return out
+
+    def pack_lane(self, lane, str_ranks):
+        """Lift a stored string-minmax lane (ids) into packed comparison
+        space before a min/max reduce; identity for every other agg.
+        Sentinels pass through unchanged."""
+        if not self.is_string_minmax:
+            return lane
+        sent = self._minmax_sentinel()
+        ids = jnp.clip(lane, 0, str_ranks.shape[0] - 1).astype(jnp.int32)
+        packed = (str_ranks[ids] << 32) | lane
+        return jnp.where(lane == sent, lane, packed)
+
+    def unpack_lane(self, lane):
+        """Drop the rank component after a reduce, leaving the stable id."""
+        if not self.is_string_minmax:
+            return lane
+        sent = self._minmax_sentinel()
+        return jnp.where(lane == sent, lane, lane & 0xFFFFFFFF)
 
     def reduce_ops(self) -> list[str]:
         if self.kind == "min":
             return ["min"]
         if self.kind == "max":
             return ["max"]
+        if self.kind == "approx_count_distinct":
+            return ["max"] * self.HLL_M
         return ["add"] * self.num_lanes
 
     def state_dtypes(self):
         if self.kind == "count":
             return [jnp.int64]
+        if self.kind == "approx_count_distinct":
+            return [jnp.int64] * self.HLL_M
         if self.kind == "sum":
             if self.arg_type is not None and self.arg_type.is_float:
                 return [jnp.float64]
             return [jnp.int64]
         if self.kind == "avg":
             return [jnp.float64, jnp.int64]
-        # min/max: exact int64 lanes for integral args, f64 otherwise
-        return [jnp.int64 if self._integral_arg() else jnp.float64]
+        # min/max: exact int64 lanes for integral/string args, f64 otherwise
+        return [jnp.int64 if (self._integral_arg() or self.is_string_minmax)
+                else jnp.float64]
 
     def output(self, lanes, count_nonzero):
         """Project state lanes ([G] arrays) to (data, mask) output columns.
@@ -150,12 +234,22 @@ class AggCall:
             return lanes[0] / safe, cnt != 0
         if self.kind in ("min", "max"):
             sent = self._minmax_sentinel()
-            if self._integral_arg():
+            if self._integral_arg() or self.is_string_minmax:
                 valid = lanes[0] != sent
             else:
                 valid = jnp.isfinite(lanes[0])
             out = jnp.where(valid, lanes[0], 0)
             return out.astype(self.output_type.dtype), valid
+        if self.kind == "approx_count_distinct":
+            m = float(self.HLL_M)
+            regs = jnp.stack(lanes)                        # [m, G]
+            s = jnp.sum(2.0 ** (-regs.astype(jnp.float64)), axis=0)
+            raw = 0.673 * m * m / s                        # alpha_16
+            zeros = jnp.sum(regs == 0, axis=0)
+            small = m * jnp.log(m / jnp.maximum(zeros, 1))
+            est = jnp.where((raw <= 2.5 * m) & (zeros > 0), small, raw)
+            return (jnp.round(est).astype(jnp.int64),
+                    jnp.ones_like(count_nonzero))
         raise ValueError(self.kind)
 
 

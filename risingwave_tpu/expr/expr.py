@@ -165,10 +165,25 @@ def _decimal_fixup(name: str, args: tuple) -> tuple:
     return args
 
 
+_STR_ORDER_FNS = {
+    "less_than", "less_than_or_equal", "greater_than",
+    "greater_than_or_equal",
+}
+
+
 def call(name: str, *args: Expr) -> FunctionCall:
     if name not in _REGISTRY:
         raise KeyError(f"unknown function {name!r}")
     args = _decimal_fixup(name, tuple(args))
+    # Ordering comparisons on VARCHAR/BYTEA compare lexicographic *ranks*,
+    # never raw dictionary ids (ids are insertion-ordered — reference order
+    # semantics: src/common/src/util/memcmp_encoding.rs). The str_ variant
+    # fetches ONE rank table after both operands are evaluated, so operand
+    # evaluation that interns new strings (literals, string functions)
+    # cannot skew the two sides' rank spaces. Equality stays on ids
+    # (bijective with strings).
+    if name in _STR_ORDER_FNS and all(a.type.is_string for a in args):
+        name = "str_" + name
     _, infer = _REGISTRY[name]
     out_type = infer([a.type for a in args])
     return FunctionCall(name, tuple(args), out_type)
@@ -595,6 +610,88 @@ def _length(datas, masks, out_type):
     return jnp.asarray(results[inverse]), masks[0]
 
 
+# regexp functions (reference: src/expr/src/vector_op/regexp.rs). Host
+# impls over UNIQUE id tuples (dictionary-sized work), compiled patterns
+# cached; eager-only like every dictionary-reading function.
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=256)
+def _compile_re(pattern: str):
+    import re
+    return re.compile(pattern)
+
+
+def _register_regexp(name: str, pyfn, type_infer):
+    def impl(datas, masks, out_type):
+        import numpy as np
+        cols = [np.asarray(d).astype(np.int64) for d in datas]
+        stacked = np.stack(cols, axis=1)
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        results = np.zeros(len(uniq), out_type.np_dtype)
+        valid = np.ones(len(uniq), bool)
+        for u, tup in enumerate(uniq):
+            strs = [_lookup_str(int(i)) for i in tup]
+            r = pyfn(*strs)
+            if r is None:                      # SQL NULL (e.g. no match)
+                valid[u] = False
+            else:
+                results[u] = _intern_str(r) if out_type.is_string else r
+        return (jnp.asarray(results[inverse]),
+                _strict_mask(masks) & jnp.asarray(valid[inverse]))
+    _REGISTRY[name] = (impl, type_infer)
+
+
+_register_regexp("regexp_like",
+                 lambda s, p: _compile_re(p).search(s) is not None,
+                 _t_bool)
+_register_regexp("regexp_count",
+                 lambda s, p: len(_compile_re(p).findall(s)),
+                 _t_int64)
+_register_regexp("regexp_replace",
+                 lambda s, p, r: _compile_re(p).sub(r, s),
+                 lambda ts: T.VARCHAR)
+_register_regexp("regexp_match",
+                 lambda s, p: (lambda m: m.group(0) if m else None)(
+                     _compile_re(p).search(s)),
+                 lambda ts: T.VARCHAR)
+
+
+@register("str_rank", _t_int64)
+def _str_rank(datas, masks, out_type):
+    """id -> lexicographic rank via the dictionary's rank side table.
+
+    Eager-only (in HOST_CALLBACK_FNS): the table refreshes as strings are
+    interned, so it must be fetched fresh per evaluation — baked into a jit
+    trace it would go stale and silently mis-order."""
+    from ..common.types import GLOBAL_STRING_DICT
+    table = GLOBAL_STRING_DICT.device_ranks()
+    ids = jnp.clip(datas[0].astype(jnp.int32), 0, table.shape[0] - 1)
+    return table[ids], masks[0]
+
+
+def _str_cmp(fn):
+    """String ordering comparison: both ids map through a SINGLE rank-table
+    fetch taken after operand evaluation, so in-evaluation interning (a
+    literal's first eval, upper()/substr() products) can never put the two
+    sides in different rank spaces. Eager-only, like str_rank."""
+    def impl(datas, masks, out_type):
+        from ..common.types import GLOBAL_STRING_DICT
+        table = GLOBAL_STRING_DICT.device_ranks()
+        n = table.shape[0]
+        a = table[jnp.clip(datas[0].astype(jnp.int32), 0, n - 1)]
+        b = table[jnp.clip(datas[1].astype(jnp.int32), 0, n - 1)]
+        return fn(a, b), _strict_mask(masks)
+    return impl
+
+
+register("str_less_than", _t_bool)(_str_cmp(jnp.less))
+register("str_less_than_or_equal", _t_bool)(_str_cmp(jnp.less_equal))
+register("str_greater_than", _t_bool)(_str_cmp(jnp.greater))
+register("str_greater_than_or_equal", _t_bool)(_str_cmp(jnp.greater_equal))
+
+
 @register("concat_op", lambda ts: T.VARCHAR)
 def _concat_op(datas, masks, out_type):
     import numpy as np
@@ -655,6 +752,10 @@ _make_like(True, "not_like")
 HOST_CALLBACK_FNS = {
     "lower", "upper", "trim", "ltrim", "rtrim", "substr", "substring",
     "length", "concat_op", "like", "not_like",
+    "regexp_like", "regexp_count", "regexp_replace", "regexp_match",
+    # not host callbacks, but must run eagerly: they read the live rank table
+    "str_rank", "str_less_than", "str_less_than_or_equal",
+    "str_greater_than", "str_greater_than_or_equal",
 }
 
 

@@ -95,7 +95,8 @@ _BINOP_FN = {
     "||": "concat_op", "LIKE": "like", "NOT LIKE": "not_like",
 }
 
-AGG_KINDS = {"count", "sum", "min", "max", "avg"}
+AGG_KINDS = {"count", "sum", "min", "max", "avg",
+             "approx_count_distinct"}
 
 RANK_FUNC_KINDS = {"row_number", "rank", "dense_rank"}
 WINDOW_ONLY_KINDS = RANK_FUNC_KINDS | {"lag", "lead"}
@@ -244,7 +245,9 @@ class ExprBinder:
         from ..stream.project_set import TABLE_FUNC_KINDS, TableFuncCall
         if name in TABLE_FUNC_KINDS:
             args = tuple(self.bind(a) for a in node.args)
-            return TableFuncCall(name, args, INT64)
+            from ..common.types import VARCHAR as _VC
+            out_t = _VC if name == "regexp_split_to_table" else INT64
+            return TableFuncCall(name, args, out_t)
         if name == "extract":
             from ..expr.expr import make_extract
             field = node.args[0]

@@ -427,10 +427,14 @@ class Planner:
                 raise PlanError(
                     f"FROM {name}(...) requires constant arguments")
             args.append(b.value)
-        from ..common.types import INT64 as _I64
-        rows = tuple((Literal(v, _I64),) for v in series_values(name, args))
+        from ..common.types import GLOBAL_STRING_DICT, INT64 as _I64, VARCHAR
+        out_t = VARCHAR if name == "regexp_split_to_table" else _I64
+        vals = series_values(name, args)
+        if out_t.is_string:
+            vals = [GLOBAL_STRING_DICT.lookup(int(v)) for v in vals]
+        rows = tuple((Literal(v, out_t),) for v in vals)
         alias = ref.alias or name
-        schema = Schema((Field(alias, _I64),))
+        schema = Schema((Field(alias, out_t),))
         node = PValues(schema=schema, pk=(), rows=rows)
         return node, Scope.of_schema(schema, alias)
 
@@ -794,7 +798,8 @@ class Planner:
 
         part_idx = tuple(col_of(p) for p in first.partition_exprs)
         order_specs = tuple(
-            OrderSpec(col_of(oe), desc, nulls_last)
+            OrderSpec(col_of(oe), desc, nulls_last,
+                      is_string=oe.type.is_string)
             for (oe, desc, nulls_last) in first.order_exprs)
         calls = tuple(
             WindowCall(
@@ -866,7 +871,8 @@ class Planner:
             nulls_last = oi.nulls_last
             if nulls_last is None:
                 nulls_last = not oi.desc     # PG default
-            order.append(OrderSpec(b.index, oi.desc, nulls_last))
+            order.append(OrderSpec(b.index, oi.desc, nulls_last,
+                                   is_string=b.type.is_string))
         if sel.limit is None:
             # bare ORDER BY on an MV is a presentation property; keep plan
             return node

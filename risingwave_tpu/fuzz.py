@@ -64,7 +64,8 @@ class SqlGen:
             gk = r.choice(cols)
             aggs = r.sample(
                 [f"count(*)", f"sum({r.choice(cols)})",
-                 f"min({r.choice(cols)})", f"max({r.choice(cols)})"],
+                 f"min({r.choice(cols)})", f"max({r.choice(cols)})",
+                 f"approx_count_distinct({r.choice(cols)})"],
                 k=r.randint(1, 2))
             items = [f"{gk} AS g"] + [
                 f"{a} AS x{i}" for i, a in enumerate(aggs)]

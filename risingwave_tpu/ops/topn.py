@@ -31,14 +31,27 @@ class OrderSpec:
     col: int
     desc: bool = False
     nulls_last: bool = True
+    # VARCHAR/BYTEA columns: physical data is insertion-ordered dictionary
+    # ids, so the sort key is the id's lexicographic rank looked up in the
+    # dictionary's rank table (``str_ranks``), never the raw id. Executors
+    # set this from the column's logical type.
+    is_string: bool = False
 
 
-def _sort_key(c: Column, spec: OrderSpec) -> jax.Array:
+def _sort_key(c: Column, spec: OrderSpec,
+              str_ranks: jax.Array | None = None) -> jax.Array:
     """Column → ascending-sortable f64/i64 key honoring desc/nulls order.
 
     int64 keys stay int64 (exact); everything else lowers to float64
     (float32/bool/int32 fit exactly)."""
     d = c.data
+    if spec.is_string:
+        if str_ranks is None:
+            raise ValueError(
+                "ordering on a VARCHAR column requires the dictionary rank "
+                "table (str_ranks)")
+        d = str_ranks[jnp.clip(d.astype(jnp.int32), 0,
+                               str_ranks.shape[0] - 1)]
     if d.dtype == jnp.int64:
         k = d
         big = jnp.iinfo(jnp.int64).max
@@ -56,7 +69,8 @@ def _sort_key(c: Column, spec: OrderSpec) -> jax.Array:
 
 
 def topn_order(state: RowSetState, gid: jax.Array,
-               order: Sequence[OrderSpec]) -> jax.Array:
+               order: Sequence[OrderSpec],
+               str_ranks: jax.Array | None = None) -> jax.Array:
     """Stable lexicographic permutation: (live-first is NOT applied here;
     dead slots are routed to the end via gid), gid, then order keys, then
     slot index (total order tiebreak via stable sort)."""
@@ -65,7 +79,7 @@ def topn_order(state: RowSetState, gid: jax.Array,
     gid_eff = jnp.where(state.live, gid.astype(jnp.int64), dead_gid)
     perm = jnp.arange(cap, dtype=jnp.int32)
     for spec in reversed(list(order)):
-        key = _sort_key(state.cols[spec.col], spec)
+        key = _sort_key(state.cols[spec.col], spec, str_ranks)
         perm = perm[jnp.argsort(key[perm], stable=True)]
     perm = perm[jnp.argsort(gid_eff[perm], stable=True)]
     return perm
@@ -81,6 +95,8 @@ def _key_sentinels(dtype):
 
 def key0_dtype(state: RowSetState, spec: OrderSpec):
     """Dtype of the leading sort key (threshold scalar storage)."""
+    if spec.is_string:
+        return jnp.int64          # rank-table keys are int64
     return (jnp.int64 if state.cols[spec.col].data.dtype == jnp.int64
             else jnp.float64)
 
@@ -94,6 +110,7 @@ def topn_candidate_flush(
     cand_cap: int,            # compact buffer size (static)
     cand_keep: int,           # candidates retained after shrink
     t1: jax.Array,            # scalar: best leading key among forgotten rows
+    str_ranks: jax.Array | None = None,
 ):
     """Incremental TopN flush (plain TopN fast path): sort only the
     candidate subset, O(cand_cap log cand_cap) instead of a full-capacity
@@ -119,7 +136,7 @@ def topn_candidate_flush(
 
     perm = jnp.arange(cand_cap, dtype=jnp.int32)
     for spec in reversed(list(order)):
-        keyf = _sort_key(state.cols[spec.col], spec)
+        keyf = _sort_key(state.cols[spec.col], spec, str_ranks)
         big, _ = _key_sentinels(keyf.dtype)
         keym = jnp.where(valid, keyf[safe], big)
         perm = perm[jnp.argsort(keym[perm], stable=True)]
@@ -137,7 +154,8 @@ def topn_candidate_flush(
     overflow = n_cand > cand_cap
     underflow = (n_live_cand < offset + limit) & (n_live > n_live_cand)
 
-    key0_full = _sort_key(state.cols[spec0.col], spec0).astype(big0.dtype)
+    key0_full = _sort_key(state.cols[spec0.col], spec0,
+                          str_ranks).astype(big0.dtype)
     key0_sorted = jnp.where(valid, key0_full[safe], big0)[perm]
     nwin = jnp.minimum(offset + limit, n_live_cand)
     worst_win = jnp.where(
@@ -163,6 +181,7 @@ def topn_refill(
     offset: int,
     limit: int,
     cand_keep: int,
+    str_ranks: jax.Array | None = None,
 ):
     """Full-sort recompute + candidate reseed: one permutation yields the
     rank window, the new candidate set (global top-``cand_keep``), and the
@@ -170,13 +189,14 @@ def topn_refill(
     cap = state.live.shape[0]
     spec0 = order[0]
     big0, _ = _key_sentinels(key0_dtype(state, spec0))
-    perm = topn_order(state, gid, order)
+    perm = topn_order(state, gid, order, str_ranks)
     live_sorted = state.live[perm]
     # dead slots were routed last by topn_order's gid pass (gid=0 for plain)
     rank = jnp.arange(cap, dtype=jnp.int64)
     in_win_sorted = live_sorted & (rank >= offset) & (rank < offset + limit)
     keep_sorted = live_sorted & (rank < cand_keep)
-    key0 = _sort_key(state.cols[spec0.col], spec0).astype(big0.dtype)[perm]
+    key0 = _sort_key(state.cols[spec0.col], spec0,
+                     str_ranks).astype(big0.dtype)[perm]
     n_live = jnp.sum(state.live)
     t1 = jnp.where(n_live > cand_keep,
                    key0[jnp.clip(cand_keep, 0, cap - 1)], big0)
@@ -193,6 +213,7 @@ def topn_in_set(
     limit: int,
     with_ties: bool = False,
     n_tie_keys: int | None = None,
+    str_ranks: jax.Array | None = None,
 ) -> jax.Array:
     """bool[cap]: slot is in its group's [offset, offset+limit) rank window
     (plus ties with the window's last row when ``with_ties``).
@@ -201,7 +222,7 @@ def topn_in_set(
     callers append pk tiebreak keys to ``order`` for deterministic totality,
     and those must NOT participate in tie equality (default: all keys)."""
     cap = state.live.shape[0]
-    perm = topn_order(state, gid, order)
+    perm = topn_order(state, gid, order, str_ranks)
     dead_gid = jnp.iinfo(jnp.int64).max
     gid_eff = jnp.where(state.live, gid.astype(jnp.int64), dead_gid)
     sgid = gid_eff[perm]
@@ -220,7 +241,7 @@ def topn_in_set(
         tie_specs = list(order)[: (len(order) if n_tie_keys is None
                                    else n_tie_keys)]
         for spec in tie_specs:
-            key = _sort_key(state.cols[spec.col], spec)[perm]
+            key = _sort_key(state.cols[spec.col], spec, str_ranks)[perm]
             tie = tie & (key == key[bpos])
         in_win = in_win | tie
     return jnp.zeros(cap, jnp.bool_).at[perm].set(in_win)

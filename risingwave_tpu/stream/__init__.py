@@ -2,7 +2,8 @@ from .message import (  # noqa: F401
     Barrier, EpochPair, Message, Mutation, MutationKind, Watermark, is_chunk,
 )
 from .executor import (  # noqa: F401
-    EpochCheckExecutor, Executor, SingleInputExecutor, UpdateCheckExecutor,
+    EpochCheckExecutor, Executor, SchemaCheckExecutor, SingleInputExecutor,
+    UpdateCheckExecutor,
     collect_until_barrier, wrap_debug,
 )
 from .source import MockSource, ScheduledSource  # noqa: F401

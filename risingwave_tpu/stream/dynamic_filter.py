@@ -91,6 +91,8 @@ class DynamicFilterExecutor(Executor):
         self.bound_valid = jnp.zeros((), jnp.bool_)
         self._staged_bound: tuple = ()  # () = no update; (v,) = set to v (may be None)
 
+        # VARCHAR bounds compare by dictionary rank, never raw id
+        self._is_string_key = left.schema[key_col].type.is_string
         self._apply = jax.jit(
             lambda st, ch: rs_apply_chunk(st, ch, self.pk_indices))
         self._compute_flush = jax.jit(self._compute_flush_impl)
@@ -99,9 +101,14 @@ class DynamicFilterExecutor(Executor):
         if state_table is not None:
             self._load_from_state_table()
 
-    def _compute_flush_impl(self, rows, bound, bound_valid):
+    def _compute_flush_impl(self, rows, bound, bound_valid, str_ranks=None):
         col = rows.cols[self.key_col]
-        passes = _CMP_FNS[self.cmp](col.data, bound)
+        data, b = col.data, bound
+        if self._is_string_key:
+            n = str_ranks.shape[0]
+            data = str_ranks[jnp.clip(data.astype(jnp.int32), 0, n - 1)]
+            b = str_ranks[jnp.clip(bound.astype(jnp.int32), 0, n - 1)]
+        passes = _CMP_FNS[self.cmp](data, b)
         in_set = rows.live & col.mask & passes & bound_valid
         changed = rs_changed(rows, in_set)
         return in_set, changed, jnp.sum(changed)
@@ -153,7 +160,7 @@ class DynamicFilterExecutor(Executor):
                 self.bound_valid = jnp.ones((), jnp.bool_)
             self._staged_bound = ()
         in_set, changed, n_changed = self._compute_flush(
-            self.rows, self.bound, self.bound_valid)
+            self.rows, self.bound, self.bound_valid, self._cur_ranks())
         lo, n = 0, int(n_changed)
         while lo < n:
             chunk = self._gather(self.rows, in_set, changed, jnp.int64(lo),
@@ -192,6 +199,13 @@ class DynamicFilterExecutor(Executor):
         # post-recovery flush emits only genuine deltas (downstream restored
         # from the same checkpoint and already holds the old passing set)
         in_set, _, _ = self._compute_flush(self.rows, self.bound,
-                                           self.bound_valid)
+                                           self.bound_valid,
+                                           self._cur_ranks())
         self.rows = self._finish(self.rows, in_set).replace(
             ckpt_dirty=jnp.zeros_like(self.rows.ckpt_dirty))
+
+    def _cur_ranks(self):
+        if not self._is_string_key:
+            return None
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks()

@@ -121,6 +121,33 @@ class EpochCheckExecutor(SingleInputExecutor):
             yield
 
 
+class SchemaCheckExecutor(SingleInputExecutor):
+    """Every chunk's column count + physical dtypes must match the
+    executor's declared schema (wrapper/schema_check.rs) — catches
+    builder wiring bugs before they corrupt downstream state."""
+
+    def __init__(self, input: Executor):
+        super().__init__(input)
+        self.schema = input.schema
+        self.identity = input.identity
+
+    async def map_chunk(self, chunk: StreamChunk):
+        if len(chunk.columns) != len(self.schema):
+            raise AssertionError(
+                f"schema check at {self.identity}: chunk has "
+                f"{len(chunk.columns)} columns, schema has "
+                f"{len(self.schema)}")
+        for i, (col, field) in enumerate(zip(chunk.columns, self.schema)):
+            want = field.type.dtype
+            import jax.numpy as jnp
+            if jnp.dtype(col.data.dtype) != jnp.dtype(want):
+                raise AssertionError(
+                    f"schema check at {self.identity}: column {i} "
+                    f"({field.name}) is {col.data.dtype}, schema says "
+                    f"{jnp.dtype(want)}")
+        yield chunk
+
+
 class UpdateCheckExecutor(SingleInputExecutor):
     """UpdateDelete must be immediately followed by UpdateInsert within a
     chunk (wrapper/update_check.rs)."""

@@ -58,7 +58,9 @@ class WindowCall:
 
 
 def _order_key(row, order_by: Sequence[OrderSpec]):
-    """Sortable key implementing desc + nulls placement per spec."""
+    """Sortable key implementing desc + nulls placement per spec. VARCHAR
+    order columns compare by dictionary rank, never raw id (ranks() is
+    cached per dictionary version, so the per-row call is O(1))."""
     key = []
     for spec in order_by:
         v = row[spec.col] if spec.col < len(row) else None
@@ -66,6 +68,9 @@ def _order_key(row, order_by: Sequence[OrderSpec]):
         if v is None:
             key.append((null_rank, 0))
         else:
+            if spec.is_string:
+                from ..common.types import GLOBAL_STRING_DICT
+                v = int(GLOBAL_STRING_DICT.ranks()[v])
             key.append((0, -v if spec.desc else v))
     return tuple(key)
 

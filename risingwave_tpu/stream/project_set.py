@@ -28,7 +28,7 @@ from ..common.types import DataType, Field, INT64, Schema
 from ..expr.expr import Expr
 from .executor import Executor, SingleInputExecutor
 
-TABLE_FUNC_KINDS = {"generate_series"}
+TABLE_FUNC_KINDS = {"generate_series", "regexp_split_to_table"}
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -55,6 +55,21 @@ def series_values(name: str, args: Sequence) -> list:
             return []
         return list(range(int(lo), int(hi) + (1 if step > 0 else -1),
                           int(step)))
+    if name == "regexp_split_to_table":
+        # args arrive as dictionary ids (ProjectSet path) or python
+        # strings (constant FROM position); elements return as ids
+        # (reference: src/expr/src/table_function/ set-returning regexp)
+        import re
+        from ..common.types import GLOBAL_STRING_DICT as D
+
+        def as_str(v):
+            return v if isinstance(v, str) else D.lookup(int(v))
+
+        s, p = args
+        if s is None or p is None:
+            return []
+        parts = re.split(as_str(p), as_str(s))
+        return [D.intern(x) for x in parts]
     raise ValueError(f"unknown table function {name}")
 
 

