@@ -73,18 +73,36 @@ class StreamJob:
     """One materialized view job: executor pipeline → Materialize → bus."""
 
     def __init__(self, name: str, pipeline: MaterializeExecutor,
-                 sources: list[QueueSource]):
+                 sources: list[QueueSource], actors: list = ()):
         self.name = name
         self.pipeline = pipeline
         self.sources = sources
+        # extra fragment actors (multi-fragment builds, frontend/fragments):
+        # coroutine factories spawned alongside the root pipeline task
+        self.actors = list(actors)
         self.bus = ChangelogBus()
         self.table: StateTable = pipeline.table
         self._barrier_events: dict[int, asyncio.Event] = {}
         self._task: Optional[asyncio.Task] = None
+        self._actor_tasks: list[asyncio.Task] = []
         self._failure: Optional[BaseException] = None
 
     def start(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        for factory in self.actors:
+            self._actor_tasks.append(
+                asyncio.ensure_future(self._run_actor(factory), loop=loop))
         self._task = asyncio.ensure_future(self._run(), loop=loop)
+
+    async def _run_actor(self, factory) -> None:
+        try:
+            await factory()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:   # noqa: BLE001 - surfaced on next await
+            self._failure = e
+            for ev in self._barrier_events.values():
+                ev.set()
+            raise
 
     async def _run(self) -> None:
         try:
@@ -101,6 +119,11 @@ class StreamJob:
             raise
 
     async def wait_barrier(self, epoch: int) -> None:
+        if self._failure is not None:
+            # already dead: epochs injected after the failure have no event
+            # to set — waiting would hang the conductor forever
+            raise RuntimeError(
+                f"stream job {self.name!r} failed") from self._failure
         ev = self._barrier_events.setdefault(epoch, asyncio.Event())
         await ev.wait()
         self._barrier_events.pop(epoch, None)
@@ -120,6 +143,14 @@ class StreamJob:
         return msgs
 
     async def stop(self) -> None:
+        for t in self._actor_tasks:
+            t.cancel()
+        for t in self._actor_tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._actor_tasks.clear()
         if self._task is not None:
             self._task.cancel()
             try:

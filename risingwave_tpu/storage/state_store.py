@@ -35,6 +35,10 @@ class MemoryStateStore:
         self._committed: dict[int, dict[bytes, tuple]] = {}
         self._pending: dict[int, dict[int, dict[bytes, Optional[tuple]]]] = {}
         self.committed_epoch: int = 0
+        # per-table sorted committed-key cache (range scans / backfill):
+        # rebuilt lazily after a commit touches the table
+        self._sorted_keys: dict[int, list] = {}
+        self._keys_dirty: set[int] = set()
 
     # -- write path -----------------------------------------------------------
 
@@ -61,6 +65,7 @@ class MemoryStateStore:
         for e in sorted(k for k in self._pending if k <= epoch):
             for table_id, buf in self._pending.pop(e).items():
                 tbl = self._committed.setdefault(table_id, {})
+                self._keys_dirty.add(table_id)
                 for k, v in buf.items():
                     if v is None:
                         tbl.pop(k, None)
@@ -94,6 +99,21 @@ class MemoryStateStore:
     def iter_table(self, table_id: int) -> Iterator[tuple[bytes, tuple]]:
         yield from sorted(self._merged_view(table_id).items())
 
+    def committed_view(self, table_id: int) -> dict:
+        """The committed (checkpointed) rows of a table — the backfill
+        range-scan base (staged overlays are applied by the caller)."""
+        return self._committed.get(table_id, {})
+
+    def sorted_committed_keys(self, table_id: int) -> list:
+        """Sorted committed keys, cached per table and rebuilt only after
+        a commit touched the table — keeps range scans O(log n + batch)
+        instead of O(n log n) per call."""
+        if table_id in self._keys_dirty or table_id not in self._sorted_keys:
+            self._sorted_keys[table_id] = sorted(
+                self._committed.get(table_id, {}))
+            self._keys_dirty.discard(table_id)
+        return self._sorted_keys[table_id]
+
     def iter_prefix(self, table_id: int, prefix: bytes) -> Iterator[tuple[bytes, tuple]]:
         for k, v in self.iter_table(table_id):
             if k.startswith(prefix):
@@ -105,8 +125,23 @@ class MemoryStateStore:
     def drop_table(self, table_id: int) -> None:
         """Free a dropped object's state (committed + pending)."""
         self._committed.pop(table_id, None)
+        self._sorted_keys.pop(table_id, None)
+        self._keys_dirty.discard(table_id)
         for buf in self._pending.values():
             buf.pop(table_id, None)
+
+    def discard_pending_tables(self, table_ids) -> None:
+        """Drop staged-uncommitted buffers for ``table_ids`` only.
+
+        The scoped-recovery primitive (reference: reset_compute_nodes
+        clearing the shared buffer, recovery.rs:140): a dead job may have
+        staged a torn subset of its tables for an epoch whose checkpoint it
+        never completed — those buffers must not ride a later epoch's
+        commit. Committed state is untouched."""
+        ids = set(table_ids)
+        for buf in self._pending.values():
+            for tid in ids:
+                buf.pop(tid, None)
 
     # -- snapshot (checkpoint/restore hooks) ----------------------------------
 
@@ -120,3 +155,5 @@ class MemoryStateStore:
         self.committed_epoch = snap["committed_epoch"]
         self._committed = copy.deepcopy(snap["tables"])
         self._pending.clear()
+        self._sorted_keys.clear()
+        self._keys_dirty.clear()

@@ -1,0 +1,166 @@
+"""The meta tier as the live control plane (VERDICT r3 item 3).
+
+Covers the three integration points: catalog mutations write through to
+the MetaStore + versioned notifications, barrier conduction publishes,
+and — the headline — the heartbeat detector notices a killed job's actor
+task and scoped recovery restores it without restarting the session
+(reference: manager/cluster.rs:320-344 heartbeat expiry →
+barrier/recovery.rs:110 orchestrated recovery).
+"""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+NEXMARK_DDL = """CREATE SOURCE bid (auction BIGINT, price BIGINT)
+    WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+
+class TestCatalogWriteThrough:
+    def test_ddl_lands_in_meta_store_and_notifies(self):
+        s = Session()
+        seen = []
+        s.meta.notifications.subscribe(
+            "catalog", lambda v, info: seen.append((v, info)))
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v FROM t")
+        assert s.meta.store.get("catalog/table/t") is not None
+        assert s.meta.store.get("catalog/materialized_view/m") is not None
+        ops = [(i["op"], i["kind"], i["name"]) for _, i in seen]
+        assert ("create", "table", "t") in ops
+        assert ("create", "materialized_view", "m") in ops
+        # versions are ordered + monotone
+        assert [v for v, _ in seen] == sorted(v for v, _ in seen)
+        s.run_sql("DROP MATERIALIZED VIEW m")
+        assert s.meta.store.get("catalog/materialized_view/m") is None
+        assert ("drop", "materialized_view", "m") in [
+            (i["op"], i["kind"], i["name"]) for _, i in seen]
+
+    def test_barrier_conduction_publishes(self):
+        s = Session(checkpoint_frequency=2)
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY)")
+        barriers, ckpts = [], []
+        s.meta.notifications.subscribe(
+            "barrier", lambda v, i: barriers.append(i))
+        s.meta.notifications.subscribe(
+            "checkpoint", lambda v, i: ckpts.append(i))
+        for _ in range(4):
+            s.tick()
+        s._drain_inflight()
+        assert len(barriers) >= 4
+        epochs = [b["epoch"] for b in barriers]
+        assert epochs == sorted(epochs)
+        # the last checkpoint may trail the newest (non-checkpoint) epoch
+        assert ckpts and s.epoch - 2 <= ckpts[-1]["committed_epoch"] <= s.epoch
+
+
+class TestHeartbeatRecovery:
+    def test_killed_job_detected_and_recovered(self):
+        """Kill an MV job's actor task mid-stream; the heartbeat detector
+        declares it DOWN after the TTL; scoped recovery rebuilds it from
+        the last checkpoint and re-seeks its source — the session itself
+        never restarts and the MV converges to the correct totals."""
+        s = Session(checkpoint_frequency=2, source_chunk_capacity=64)
+        s.run_sql(NEXMARK_DDL)
+        s.run_sql("""CREATE MATERIALIZED VIEW m AS
+            SELECT auction, count(*) AS n FROM bid GROUP BY auction""")
+        for _ in range(4):
+            s.tick()
+        s._drain_inflight()
+
+        # the worker registry tracks the job and it is heartbeating
+        workers = {w.host: w for w in s.meta.cluster.workers.values()}
+        assert workers["m"].state == "RUNNING"
+
+        s.kill_job("m")
+        recovered = []
+        s.meta.notifications.subscribe(
+            "recovery", lambda v, i: recovered.append(i))
+        # TTL epochs must elapse with no heartbeat before expiry fires;
+        # ticks keep flowing — the session never stalls on the dead job
+        for _ in range(s.meta.HEARTBEAT_TTL_EPOCHS + 2):
+            s.tick()
+        s._drain_inflight()
+        assert recovered and recovered[0]["jobs"] == ["m"]
+        workers = {w.host: w for w in s.meta.cluster.workers.values()}
+        assert workers["m"].state == "RUNNING"
+
+        # the recovered MV keeps maintaining. Oracle: the MV must equal a
+        # fresh session whose deterministic source generated the same
+        # number of windows the recovered reader actually reached —
+        # replay-from-offset means the MV content is exactly the
+        # aggregation of windows [0, final_offset), with the death
+        # window's lost rows regenerated, none skipped, none doubled.
+        for _ in range(3):
+            s.tick()
+        s.flush()
+        got = sorted(s.mv_rows("m"))
+        feed = next(f for f in s.feeds if f.job == "m")
+        n_windows = sum(feed.reader.offsets.values())
+        assert n_windows > 0
+
+        ref = Session(checkpoint_frequency=2, source_chunk_capacity=64)
+        ref.run_sql(NEXMARK_DDL)
+        ref.run_sql("""CREATE MATERIALIZED VIEW m AS
+            SELECT auction, count(*) AS n FROM bid GROUP BY auction""")
+        while sum(next(f for f in ref.feeds if f.job == "m")
+                  .reader.offsets.values()) < n_windows:
+            ref.tick()
+        ref.flush()
+        want = sorted(ref.mv_rows("m"))
+        assert got == want
+
+    def test_killed_job_with_downstream_mv_recovers_subtree(self):
+        """A dead job starves its downstream MVs of barriers: collect must
+        skip them (not deadlock), the detector expires the whole subtree,
+        and scoped recovery rebuilds it together — found by driving the
+        public API end to end (r4)."""
+        s = Session(checkpoint_frequency=2)
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW up AS "
+                  "SELECT g, count(*) AS n FROM t GROUP BY g")
+        s.run_sql("CREATE MATERIALIZED VIEW down AS SELECT g, n FROM up")
+        s.run_sql("INSERT INTO t VALUES (1, 0), (2, 1), (3, 0)")
+        s.flush()
+        assert sorted(s.mv_rows("down")) == [(0, 2), (1, 1)]
+        s.kill_job("up")
+        recovered = []
+        s.meta.notifications.subscribe(
+            "recovery", lambda v, i: recovered.append(i))
+        for _ in range(s.meta.HEARTBEAT_TTL_EPOCHS + 2):
+            s.tick()          # must not deadlock on the starved 'down'
+        s.flush()
+        assert recovered and recovered[0]["jobs"] == ["up", "down"]
+        assert {w.host: w.state for w in s.meta.cluster.workers.values()} \
+            == {"t": "RUNNING", "up": "RUNNING", "down": "RUNNING"}
+        s.run_sql("INSERT INTO t VALUES (4, 1)")
+        s.flush()
+        assert sorted(s.mv_rows("up")) == [(0, 2), (1, 2)]
+        assert sorted(s.mv_rows("down")) == [(0, 2), (1, 2)]
+
+    def test_other_jobs_unaffected_during_death_window(self):
+        s = Session(checkpoint_frequency=2, source_chunk_capacity=64)
+        s.run_sql(NEXMARK_DDL)
+        s.run_sql("CREATE MATERIALIZED VIEW victim AS "
+                  "SELECT auction, count(*) AS n FROM bid GROUP BY auction")
+        s.run_sql("CREATE MATERIALIZED VIEW healthy AS "
+                  "SELECT auction, max(price) AS p FROM bid GROUP BY auction")
+        for _ in range(2):
+            s.tick()
+        s._drain_inflight()
+        healthy_before = len(s.mv_rows("healthy"))
+        s.kill_job("victim")
+        for _ in range(s.meta.HEARTBEAT_TTL_EPOCHS + 2):
+            s.tick()
+        s.flush()
+        # healthy job kept processing throughout the victim's death window
+        assert len(s.mv_rows("healthy")) >= healthy_before
+        assert {w.host: w.state for w in s.meta.cluster.workers.values()} \
+            == {"victim": "RUNNING", "healthy": "RUNNING"}
+        # both read the same deterministic stream; the victim is a prefix
+        # (its reader froze during the death window), so its auction set
+        # is contained in the healthy job's
+        assert set(r[0] for r in s.mv_rows("victim")) <= \
+            set(r[0] for r in s.mv_rows("healthy"))
+        assert len(s.mv_rows("victim")) > 0
