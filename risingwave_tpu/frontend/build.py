@@ -50,6 +50,25 @@ class BuildConfig:
     # through the mesh-sharded executors (parallel/executors.py); None keeps
     # every operator single-chip. Capacities above are per shard when set.
     mesh: Optional[object] = None
+    # Pipeline parallelism via the dispatch fabric (stream/dispatch.py):
+    # >1 builds grouped aggs as MULTI-FRAGMENT jobs — the upstream fragment
+    # hash-dispatches over PermitChannels to N parallel agg actors whose
+    # outputs merge-fan-in (reference: fragments + exchanges,
+    # dispatch.rs:532 / merge.rs:114). Orthogonal to ``mesh`` (host actor
+    # concurrency vs device sharding); ignored for batch builds.
+    fragment_parallelism: int = 1
+    exchange_permits: int = 32
+    # HBM pressure: cap on live groups per grouped-agg executor; coldest
+    # groups evict to the state table at checkpoints and fault back in on
+    # access (reference: cache/managed_lru.rs). None = grow-or-raise.
+    agg_hbm_budget: Optional[int] = None
+    # max snapshot rows per barrier during concurrent backfill
+    # (stream/backfill.py); None = max(4 * chunk capacity, 4096)
+    backfill_batch_rows: Optional[int] = None
+    # wrap every built executor with the logical sanitizers (schema /
+    # epoch / update-pair checks — reference:
+    # src/stream/src/executor/wrapper/); debug & sim runs, off in prod
+    sanity_checks: bool = False
 
 
 class BuildContext:
@@ -73,6 +92,9 @@ class BuildContext:
         self.config = config or BuildConfig()
         self.durable = durable
         self.state_table_ids: list[int] = []
+        # actor coroutine factories for multi-fragment builds; the
+        # StreamJob spawns one task per entry alongside the root pipeline
+        self.actors: list = []
 
     def state_table(self, schema: Schema, pk) -> Optional[StateTable]:
         if not self.durable:
@@ -83,6 +105,21 @@ class BuildContext:
 
 
 def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
+    """Build one plan node (recursively); with ``cfg.sanity_checks`` every
+    built executor is wrapped in the logical sanitizers, mirroring the
+    reference's WrapperExecutor around every actor node
+    (src/stream/src/task/stream_manager.rs WrapperExecutor +
+    executor/wrapper/{schema_check,epoch_check,update_check}.rs)."""
+    ex = _build_plan(plan, ctx)
+    if ctx.config.sanity_checks:
+        from ..stream.executor import (
+            EpochCheckExecutor, SchemaCheckExecutor, UpdateCheckExecutor,
+        )
+        ex = SchemaCheckExecutor(UpdateCheckExecutor(EpochCheckExecutor(ex)))
+    return ex
+
+
+def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
     cfg = ctx.config
     if isinstance(plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)):
         return ctx.source_factory(plan)
@@ -101,6 +138,12 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         return HopWindowExecutor(inp, plan.time_col, plan.slide, plan.size)
 
     if isinstance(plan, P.PAgg):
+        if (plan.group_keys and cfg.fragment_parallelism > 1
+                and cfg.mesh is None and ctx.durable):
+            # multi-fragment build over the dispatch fabric; batch builds
+            # (durable=False) have no actor runtime and stay fused
+            from .fragments import build_fragmented_agg
+            return build_fragmented_agg(plan, ctx)
         inp = build_plan(plan.input, ctx)
         if plan.group_keys:
             key_fields = [plan.input.schema[i] for i in plan.group_keys]
@@ -117,7 +160,8 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             return HashAggExecutor(
                 inp, list(plan.group_keys), list(plan.agg_calls),
                 state_table=st, table_capacity=cfg.agg_table_capacity,
-                out_capacity=cfg.chunk_capacity)
+                out_capacity=cfg.chunk_capacity,
+                hbm_group_budget=cfg.agg_hbm_budget)
         from ..stream.simple_agg import simple_agg_state_schema
         st = ctx.state_table(simple_agg_state_schema(plan.agg_calls), [0])
         return SimpleAggExecutor(inp, list(plan.agg_calls), state_table=st)

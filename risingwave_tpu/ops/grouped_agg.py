@@ -31,6 +31,7 @@ class AggState:
     dirty: jax.Array                   # bool[cap] since last barrier flush
     ckpt_dirty: jax.Array              # bool[cap] since last checkpoint
     overflow: jax.Array                # bool scalar, sticky
+    last_used: jax.Array               # int32[cap]: step of last touch (LRU)
 
 
 class AggCore:
@@ -64,11 +65,16 @@ class AggCore:
             dirty=jnp.zeros(cap, jnp.bool_),
             ckpt_dirty=jnp.zeros(cap, jnp.bool_),
             overflow=jnp.zeros((), jnp.bool_),
+            last_used=jnp.zeros(cap, jnp.int32),
         )
 
     # -- pure steps -----------------------------------------------------------
 
-    def apply_chunk(self, state: AggState, chunk: StreamChunk) -> AggState:
+    def apply_chunk(self, state: AggState, chunk: StreamChunk,
+                    str_ranks=None, step=None) -> AggState:
+        """``step``: monotone host counter stamped onto touched slots for
+        LRU eviction ordering (None = no tracking; the sharded path and
+        budget-less executors skip it)."""
         key_cols = [chunk.columns[i] for i in self.group_keys]
         table, slots, _is_new, ovf = ht_lookup_or_insert(
             state.table, key_cols, chunk.vis
@@ -83,15 +89,23 @@ class AggCore:
             else:
                 value = jnp.zeros_like(signs)
                 vmask = chunk.vis
-            contribs = call.contributions(value, vmask, signs)
+            contribs = call.contributions(value, vmask, signs, str_ranks)
             for j, (contrib, op) in enumerate(zip(contribs, call.reduce_ops())):
-                lanes[ofs + j] = scatter_reduce(lanes[ofs + j], slots, contrib, op)
+                # string MIN/MAX: reduce in packed rank|id space, store ids
+                lane = call.pack_lane(lanes[ofs + j], str_ranks)
+                lanes[ofs + j] = call.unpack_lane(
+                    scatter_reduce(lane, slots, contrib, op))
         mark = jnp.where(chunk.vis, slots, self.capacity)
         dirty = state.dirty.at[mark].set(True, mode="drop")
         ckpt_dirty = state.ckpt_dirty.at[mark].set(True, mode="drop")
+        last_used = state.last_used
+        if step is not None:
+            last_used = last_used.at[mark].set(
+                jnp.asarray(step, jnp.int32), mode="drop")
         return state.replace(
             table=table, lanes=tuple(lanes), dirty=dirty,
             ckpt_dirty=ckpt_dirty, overflow=state.overflow | ovf,
+            last_used=last_used,
         )
 
     def outputs(self, lanes) -> list[tuple[jax.Array, jax.Array]]:
@@ -209,4 +223,86 @@ class AggCore:
             # a group that exhausts probing during rebuild would be silently
             # dropped by mode="drop" — surface it like every overflow path
             overflow=state.overflow | rebuild_ovf,
+            last_used=move(state.last_used, init.last_used),
         )
+
+    # -- HBM eviction to the cold tier ----------------------------------------
+    # (reference: ManagedLruCache over StateTables under memory pressure,
+    #  src/stream/src/cache/managed_lru.rs; JoinHashMap LRU,
+    #  executor/managed_state/join/mod.rs:228-258. Device state is a CACHE
+    #  over the state table: eviction frees slots whose durable copy is
+    #  current, absorb() faults a key's stored value back in on access.)
+
+    def evict_plan(self, state: AggState, keep: int):
+        """Pick cold live slots to evict so ~``keep`` hottest remain.
+
+        Returns (mask bool[cap], n_evicted). Threshold-based on the LRU
+        step stamp: ties at the threshold may evict slightly more than
+        asked — correctness is unaffected (cold copies are current)."""
+        cap = self.capacity
+        live = state.table.occupied & (state.lanes[0] > 0)
+        n_live = jnp.sum(live)
+        big = jnp.iinfo(jnp.int32).max
+        key = jnp.where(live, state.last_used, big)
+        skey = jnp.sort(key)
+        k = jnp.clip(n_live - keep, 0, cap - 1)
+        thr = skey[jnp.maximum(k - 1, 0)]
+        mask = live & (state.last_used <= thr) & (k > 0)
+        return mask, jnp.sum(mask)
+
+    def apply_evict(self, state: AggState, mask: jax.Array) -> AggState:
+        """Reset evicted slots to init WITHOUT marking ckpt_dirty: the
+        durable row (just flushed by this barrier's checkpoint) IS the
+        cold copy — a dirty mark would overwrite it with zeros. Call only
+        at a checkpoint barrier, AFTER the flush, BEFORE compact()."""
+        init = self.init_state()
+        lanes = tuple(
+            jnp.where(mask, il, l) for l, il in zip(state.lanes, init.lanes))
+        prev = tuple(
+            jnp.where(mask, il, l)
+            for l, il in zip(state.prev_lanes, init.lanes))
+        return state.replace(lanes=lanes, prev_lanes=prev,
+                             dirty=state.dirty & ~mask,
+                             ckpt_dirty=state.ckpt_dirty & ~mask)
+
+    def absorb(self, state: AggState, key_cols, stored_lanes, valid,
+               str_ranks=None) -> AggState:
+        """Fault evicted groups back in: merge each stored lane into the
+        (possibly freshly re-created) slot with the lane's reduce op, and
+        set prev_lanes to the stored value — the value downstream last saw
+        — so the next flush emits an exact U-/U+ pair, not a duplicate
+        insert. ``stored_lanes``: one array per lane, [n] rows aligned
+        with ``key_cols``; ``valid``: bool[n]."""
+        table, slots, _, ovf = ht_lookup_or_insert(
+            state.table, key_cols, valid)
+        idx = jnp.where(valid, slots, self.capacity)
+        lanes = list(state.lanes)
+        prev = list(state.prev_lanes)
+
+        def merge(lane, stored, op, call=None):
+            if call is not None and call.is_string_minmax:
+                cur = call.pack_lane(lane, str_ranks)
+                sv = call.pack_lane(stored, str_ranks)
+                merged = cur.at[idx].min(sv, mode="drop") if op == "min" \
+                    else cur.at[idx].max(sv, mode="drop")
+                return call.unpack_lane(merged)
+            if op == "add":
+                return lane.at[idx].add(stored, mode="drop")
+            if op == "min":
+                return lane.at[idx].min(stored, mode="drop")
+            return lane.at[idx].max(stored, mode="drop")
+
+        lanes[0] = merge(lanes[0], stored_lanes[0], "add")
+        prev[0] = prev[0].at[idx].set(stored_lanes[0], mode="drop")
+        for call, ofs in zip(self.agg_calls, self.call_lane_ofs):
+            for j, op in enumerate(call.reduce_ops()):
+                lanes[ofs + j] = merge(lanes[ofs + j], stored_lanes[ofs + j],
+                                       op, call)
+                prev[ofs + j] = prev[ofs + j].at[idx].set(
+                    stored_lanes[ofs + j], mode="drop")
+        dirty = state.dirty.at[idx].set(True, mode="drop")
+        ckpt_dirty = state.ckpt_dirty.at[idx].set(True, mode="drop")
+        return state.replace(
+            table=table, lanes=tuple(lanes), prev_lanes=tuple(prev),
+            dirty=dirty, ckpt_dirty=ckpt_dirty,
+            overflow=state.overflow | ovf)

@@ -122,9 +122,15 @@ class HashDispatcher:
             rebased = msg.replace(ops=new_ops)
             for out, mask in zip(self.outputs, masks):
                 await out.send(rebased.with_vis(mask))
-        else:
-            for out in self.outputs:
-                await out.send(msg)
+            return
+        from ..common.chunk import ChunkBatch
+        if isinstance(msg, ChunkBatch):
+            # data must be split, never broadcast: unpack the batch
+            for i in range(msg.num_chunks):
+                await self.dispatch(msg.at(i))
+            return
+        for out in self.outputs:
+            await out.send(msg)
 
 
 class BroadcastDispatcher:

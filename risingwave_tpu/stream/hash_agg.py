@@ -58,8 +58,34 @@ class HashAggExecutor(SingleInputExecutor):
         state_table: Optional[StateTable] = None,
         table_capacity: int = 1 << 16,
         out_capacity: int = DEFAULT_CHUNK_CAPACITY,
+        load_shard: Optional[tuple] = None,
+        hbm_group_budget: Optional[int] = None,
     ):
+        """``load_shard``: (shard_idx, n_shards) for fragmented builds —
+        this actor shares its state table with its sibling shards and on
+        recovery keeps only the rows whose group key hashes to its shard
+        (vnode reassignment across a parallelism change, reference:
+        stream/scale.rs:657 vnode-bitmap updates).
+
+        ``hbm_group_budget``: cap on LIVE groups held in device memory.
+        When a checkpoint finds more, the coldest (LRU by touch step) are
+        evicted to the state table and faulted back in on access
+        (reference: ManagedLruCache over StateTables,
+        src/stream/src/cache/managed_lru.rs) — device state becomes a
+        cache over the durable tier instead of grow-or-raise. Requires a
+        state_table; must be < table_capacity (headroom for growth
+        between checkpoints)."""
         super().__init__(input)
+        self.load_shard = load_shard
+        if hbm_group_budget is not None:
+            if state_table is None:
+                hbm_group_budget = None       # no cold tier to evict to
+            elif hbm_group_budget >= table_capacity:
+                raise ValueError(
+                    "hbm_group_budget must be < table_capacity")
+        self.hbm_group_budget = hbm_group_budget
+        self._evicted: set = set()
+        self._lru_step = 0
         in_schema = input.schema
         key_types = tuple(in_schema[i].type for i in group_keys)
         self.core = AggCore(key_types, group_keys, agg_calls, table_capacity,
@@ -75,10 +101,12 @@ class HashAggExecutor(SingleInputExecutor):
         # cannot honor donation and warns; keep it for the TPU hot path only.
         donate = (0,) if jax.default_backend() == "tpu" else ()
         self._apply = jax.jit(self.core.apply_chunk, donate_argnums=donate)
+        # string MIN/MAX compares dictionary ranks, fetched fresh per apply
+        self._needs_ranks = any(c.is_string_minmax for c in self.core.agg_calls)
 
-        def _apply_batch(state, batched_chunk):
+        def _apply_batch(state, batched_chunk, str_ranks=None, step=None):
             def body(st, ch):
-                return self.core.apply_chunk(st, ch), None
+                return self.core.apply_chunk(st, ch, str_ranks, step), None
             state, _ = jax.lax.scan(body, state, batched_chunk)
             return state
 
@@ -95,12 +123,18 @@ class HashAggExecutor(SingleInputExecutor):
         # are shared by all flush windows of the barrier.
         def _probe(st):
             rank = self.core.flush_rank(st)
-            packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32)])
+            n_live = jnp.sum(st.table.occupied & (st.lanes[0] > 0))
+            packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32),
+                                n_live.astype(jnp.int32)])
             return packed, rank
 
         self._probe = jax.jit(_probe)
         self._clean = jax.jit(self.core.clean_below, static_argnums=(1,))
         self._compact = jax.jit(self.core.compact)
+        self._evict_plan = jax.jit(self.core.evict_plan,
+                                   static_argnums=(1,))
+        self._apply_evict = jax.jit(self.core.apply_evict)
+        self._absorb = jax.jit(self.core.absorb)
         # group-key watermark state cleaning (reference: hash_agg group-key
         # watermarks + state_table.rs:885 update_watermark)
         self._pending_clean: dict[int, Any] = {}
@@ -118,19 +152,90 @@ class HashAggExecutor(SingleInputExecutor):
 
     # -- host control ---------------------------------------------------------
 
+    def _str_ranks(self):
+        if not self._needs_ranks:
+            return None
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks()
+
+    def _pykey(self, values) -> tuple:
+        """np key scalars → canonical python values (identity-preserving:
+        float group keys MUST NOT round-trip through int())."""
+        out = []
+        for v, t in zip(values, self.core.key_types):
+            out.append(float(v) if t.is_float else int(v))
+        return tuple(out)
+
+    def _lru(self):
+        """Per-chunk LRU stamp (None when no budget: a static no-op)."""
+        if self.hbm_group_budget is None:
+            return None
+        self._lru_step += 1
+        return jnp.asarray(self._lru_step, jnp.int32)
+
     async def map_chunk(self, chunk: StreamChunk):
-        self.state = self._apply(self.state, chunk)
+        self.state = self._apply(self.state, chunk, self._str_ranks(),
+                                 self._lru())
+        if self._evicted:
+            self._fault_in(chunk.columns, chunk.vis)
         if False:
             yield
 
     async def map_chunk_batch(self, batch):
-        self.state = self._apply_batch(self.state, batch.chunk)
+        self.state = self._apply_batch(self.state, batch.chunk,
+                                       self._str_ranks(), self._lru())
+        if self._evicted:
+            self._fault_in(batch.chunk.columns, batch.chunk.vis)
         if False:
             yield
 
+    # -- eviction / fault-in ---------------------------------------------------
+
+    def _fault_in(self, columns, vis) -> None:
+        """Reload any evicted group keys present in this chunk/batch from
+        the cold tier and merge their stored lanes into device state
+        (one host sync per chunk, paid only while evicted keys exist)."""
+        nk = len(self.core.group_keys)
+        key_np = [np.asarray(columns[i].data).ravel()
+                  for i in self.core.group_keys]
+        vis_np = np.asarray(vis).ravel()
+        present = set(zip(*(k[vis_np] for k in key_np))) if nk else set()
+        hits = [k for k in present if self._pykey(k) in self._evicted]
+        if not hits:
+            return
+        rows = []
+        keys = []
+        for k in hits:
+            pk = self._pykey(k)
+            row = self.state_table.get_row(pk)
+            if row is not None:
+                rows.append(row)
+                keys.append(k)
+            self._evicted.discard(pk)
+        if not rows:
+            return
+        n = len(rows)
+        cap = 1
+        while cap < n:
+            cap *= 2
+        valid = jnp.arange(cap) < n
+        key_cols = []
+        for c in range(nk):
+            data = np.zeros(cap, self.core.key_types[c].np_dtype)
+            data[:n] = [k[c] for k in keys]
+            key_cols.append(Column(jnp.asarray(data),
+                                   jnp.asarray(np.arange(cap) < n)))
+        stored = []
+        for j, dt in enumerate(self.core.lane_dtypes):
+            arr = np.zeros(cap, np.dtype(dt))
+            arr[:n] = [r[nk + j] for r in rows]
+            stored.append(jnp.asarray(arr))
+        self.state = self._absorb(self.state, key_cols, tuple(stored),
+                                  valid, self._str_ranks())
+
     async def on_barrier(self, barrier: Barrier):
         packed, rank = self._probe(self.state)
-        n_dirty, overflow = (int(x) for x in jax.device_get(packed))
+        n_dirty, overflow, n_live = (int(x) for x in jax.device_get(packed))
         if overflow:
             raise RuntimeError(
                 f"{self.identity}: group table overflow (capacity "
@@ -153,9 +258,35 @@ class HashAggExecutor(SingleInputExecutor):
             cleaned = True
         if barrier.checkpoint and self.state_table is not None:
             self._checkpoint_to_state_table(barrier.epoch.curr)
+            if (self.hbm_group_budget is not None
+                    and n_live > self.hbm_group_budget):
+                self._evict_cold()
+                cleaned = True
         if cleaned:
             self.state = self._compact(self.state)
         self.state = self._finish(self.state)
+
+    def _evict_cold(self) -> None:
+        """Evict the coldest live groups down to 3/4 of the budget (their
+        durable rows were just written by this barrier's checkpoint).
+        Null-keyed groups are never evicted (the fault-in key path carries
+        no null masks)."""
+        keep = max(self.hbm_group_budget * 3 // 4, 1)
+        mask, _n = self._evict_plan(self.state, keep)
+        all_keys_valid = None
+        for km in self.state.table.key_mask:
+            all_keys_valid = km if all_keys_valid is None \
+                else (all_keys_valid & km)
+        if all_keys_valid is not None:
+            mask = mask & all_keys_valid
+        nm = np.asarray(mask)
+        idx = np.nonzero(nm)[0]
+        if not len(idx):
+            return
+        key_np = [np.asarray(kd)[idx] for kd in self.state.table.key_data]
+        for row in zip(*key_np):
+            self._evicted.add(self._pykey(row))
+        self.state = self._apply_evict(self.state, jnp.asarray(nm))
 
     async def on_watermark(self, watermark):
         """Watermark on a group-key column: remap to the output position and
@@ -216,9 +347,52 @@ class HashAggExecutor(SingleInputExecutor):
             self.state_table.commit(epoch)
         self.state = st.replace(ckpt_dirty=jnp.zeros_like(st.ckpt_dirty))
 
+    def _filter_shard(self, rows: list) -> list:
+        """Keep rows whose group key hashes to this actor's shard — the
+        same device hash the dispatcher routes live rows with, so reload
+        placement always matches routing, for ANY shard count."""
+        from ..common.hashing import vnode_of, vnode_to_shard
+        idx, n_shards = self.load_shard
+        nk = len(self.core.group_keys)
+        out = []
+        bs = 1024
+        for i in range(0, len(rows), bs):
+            batch = rows[i:i + bs]
+            cols = []
+            for c in range(nk):
+                vals = [r[c] for r in batch]
+                data = np.array(
+                    [v if v is not None else 0 for v in vals],
+                    dtype=self.core.key_types[c].np_dtype)
+                mask = np.array([v is not None for v in vals])
+                cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+            shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
+            out.extend(r for r, s in zip(batch, shard) if int(s) == idx)
+        return out
+
     def _load_from_state_table(self) -> None:
         """Recovery: reload committed groups into the device table."""
         rows = list(self.state_table.scan_all())
+        if rows and self.load_shard is not None:
+            rows = self._filter_shard(rows)
+        if (self.hbm_group_budget is not None
+                and len(rows) > self.hbm_group_budget):
+            # under eviction the durable tier legitimately holds more
+            # groups than the device budget: load up to the budget, leave
+            # the rest cold (null-keyed rows always load — the fault-in
+            # key path carries no null masks)
+            nk0 = len(self.core.group_keys)
+            hot, cold = [], []
+            for r in rows:
+                key = r[:nk0]
+                if len(hot) < self.hbm_group_budget or any(
+                        v is None for v in key):
+                    hot.append(r)
+                else:
+                    cold.append(r)
+            for r in cold:
+                self._evicted.add(self._pykey(r[:nk0]))
+            rows = hot
         if not rows:
             return
         nk = len(self.core.group_keys)

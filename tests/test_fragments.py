@@ -1,0 +1,113 @@
+"""Multi-fragment pipelines over the dispatch fabric (VERDICT r3 item 4).
+
+A grouped-agg MV built with ``fragment_parallelism > 1`` runs as real
+fragments: upstream → HashDispatcher → PermitChannels → N agg actors →
+MergeExecutor → Materialize. Equivalence vs the fused single-fragment
+build is the oracle; the update-pair splitting rule, permit backpressure,
+and recovery across a parallelism change are each exercised end-to-end
+(reference: dispatch.rs:532,635-650; merge.rs:114; exchange/permit.rs:35).
+"""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.build import BuildConfig
+
+NEXMARK_DDL = """CREATE SOURCE bid (auction BIGINT, price BIGINT)
+    WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+MV_SQL = ("CREATE MATERIALIZED VIEW m AS "
+          "SELECT auction, count(*) AS n, sum(price) AS s, max(price) AS p "
+          "FROM bid GROUP BY auction")
+
+
+def _frag_cfg(n=2, permits=32):
+    return BuildConfig(fragment_parallelism=n, exchange_permits=permits)
+
+
+def _run(cfg, ticks=6):
+    s = Session(config=cfg, source_chunk_capacity=128,
+                checkpoint_frequency=3)
+    s.run_sql(NEXMARK_DDL)
+    s.run_sql(MV_SQL)
+    for _ in range(ticks):
+        s.tick()
+    s.flush()
+    rows = sorted(s.mv_rows("m"))
+    s.close()
+    return rows
+
+
+class TestFragmentedAgg:
+    def test_two_fragments_equal_fused(self):
+        fused = _run(BuildConfig())
+        frag = _run(_frag_cfg(2))
+        assert frag == fused and len(fused) > 0
+
+    def test_four_fragments_equal_fused(self):
+        fused = _run(BuildConfig())
+        frag = _run(_frag_cfg(4))
+        assert frag == fused
+
+    def test_permit_backpressure_tight_budget(self):
+        """permits=1 forces the upstream actor to block on channel credit
+        every chunk; the job must still complete correctly (barriers never
+        queue behind data — exchange/permit.rs:35 contract)."""
+        fused = _run(BuildConfig())
+        frag = _run(_frag_cfg(2, permits=1))
+        assert frag == fused
+
+    def test_update_pair_splitting_end_to_end(self):
+        """An UPDATE that moves a row's group key across shards sends the
+        U-/U+ pair to different agg actors as plain Delete+Insert
+        (dispatch.rs:635-650); totals stay exact."""
+        def run(cfg):
+            s = Session(config=cfg)
+            s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, cat BIGINT, "
+                      "v BIGINT)")
+            s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                      "SELECT cat, count(*) AS n, sum(v) AS sv "
+                      "FROM t GROUP BY cat")
+            s.run_sql("INSERT INTO t VALUES (1, 0, 10), (2, 1, 20), "
+                      "(3, 2, 30), (4, 3, 40), (5, 0, 50)")
+            s.flush()
+            # move k=1 and k=3 into other groups: U-/U+ pairs whose keys
+            # hash to different shards must split
+            s.run_sql("UPDATE t SET cat = 3 WHERE k = 1")
+            s.run_sql("UPDATE t SET cat = 1 WHERE k = 3")
+            s.flush()
+            rows = sorted(s.mv_rows("m"))
+            s.close()
+            return rows
+
+        fused = run(BuildConfig())
+        frag = run(_frag_cfg(2))
+        assert frag == fused
+        assert fused == [(0, 1, 50), (1, 2, 50), (3, 2, 50)]
+
+    def test_recovery_across_parallelism_change(self, tmp_path):
+        """Fragmented MV state persists through a crash and reloads under a
+        DIFFERENT fragment parallelism: every actor filters the shared
+        state table by its shard (the vnode-reassignment reload)."""
+        d = str(tmp_path / "db")
+        s = Session(config=_frag_cfg(2), data_dir=d,
+                    source_chunk_capacity=128, checkpoint_frequency=2)
+        s.run_sql(NEXMARK_DDL)
+        s.run_sql(MV_SQL)
+        for _ in range(4):
+            s.tick()
+        s.flush()
+        want = sorted(s.mv_rows("m"))
+        s.close()
+
+        # recover under parallelism 3 (recovery rebuilds with the
+        # session's config — shard layout changes; reload must follow)
+        s2 = Session(config=_frag_cfg(3), data_dir=d,
+                     source_chunk_capacity=128, checkpoint_frequency=2)
+        assert sorted(s2.mv_rows("m")) == want
+        # and it keeps maintaining incrementally after recovery
+        s2.tick()
+        s2.flush()
+        after = sorted(s2.mv_rows("m"))
+        assert sum(r[1] for r in after) > sum(r[1] for r in want)
+        s2.close()
