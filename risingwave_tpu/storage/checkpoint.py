@@ -1,4 +1,5 @@
-"""Durable checkpoint log: epoch-delta segments + manifest on local disk.
+"""Durable checkpoint log: epoch-delta segments + manifest on an
+ObjectStore (local FS by default — storage/object_store.py).
 
 The durable tier under MemoryStateStore — the role Hummock's SST upload +
 version manifest plays in the reference (reference:
@@ -9,11 +10,15 @@ executor state is already merged in device HBM, so each checkpoint writes
 one compact *delta segment* (the rows dirtied since the previous checkpoint,
 already deduplicated per key) and recovery is a linear replay of segments —
 compaction pressure, which Hummock exists to manage, does not arise until
-segment counts grow, at which point ``compact()`` folds them into one.
+segment counts grow, at which point segments fold into one. Folding runs on
+a BACKGROUND thread, off the barrier path (reference: standalone compactor,
+src/storage/compactor/src/server.rs:57): the fold reads a snapshot of the
+segment list, writes the folded segment, then swaps the manifest under the
+lock — barrier-path appends interleave freely because they only append.
 
 Write discipline (crash-safe at every point):
-  1. append the segment file (fsync'd),
-  2. rewrite the manifest via tmp-file + atomic rename (fsync'd).
+  1. put the segment object (fsync'd by the FS backend),
+  2. publish the manifest via atomic_put (tmp + atomic rename).
 A crash between 1 and 2 leaves an orphan segment the manifest never
 references — ignored on recovery.
 
@@ -27,78 +32,104 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 from typing import Optional
 
+from .object_store import LocalFsObjectStore, ObjectStore
 from .state_store import MemoryStateStore
 
 _MANIFEST = "manifest.json"
 
 
 class CheckpointLog:
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: Optional[str] = None,
+                 object_store: Optional[ObjectStore] = None,
+                 compact_after: Optional[int] = None):
+        if object_store is None:
+            if data_dir is None:
+                raise ValueError("need data_dir or object_store")
+            object_store = LocalFsObjectStore(data_dir)
         self.dir = data_dir
-        os.makedirs(data_dir, exist_ok=True)
+        self.store = object_store
+        if compact_after is not None:
+            self.COMPACT_AFTER = compact_after
+        # serializes manifest read-modify-write cycles between the barrier
+        # path and the background compactor
+        self._mlock = threading.RLock()
+        # one fold at a time: an explicit compact() call must not overlap
+        # the background thread's (overlapping folds would double-delete
+        # and race the folded-segment sequence number)
+        self._fold_lock = threading.Lock()
+        self._compact_thread: Optional[threading.Thread] = None
+        self._compact_seq = 0
 
     # -- manifest -------------------------------------------------------------
 
-    def _manifest_path(self) -> str:
-        return os.path.join(self.dir, _MANIFEST)
-
     def exists(self) -> bool:
-        return os.path.exists(self._manifest_path())
+        return self.store.exists(_MANIFEST)
 
     def _read_manifest(self) -> dict:
-        if not self.exists():
+        raw = self.store.get(_MANIFEST)
+        if raw is None:
             return {"committed_epoch": 0, "segments": [], "ddl": [],
                     "dropped_tables": []}
-        with open(self._manifest_path()) as f:
-            m = json.load(f)
+        m = json.loads(raw)
         m.setdefault("dropped_tables", [])
         return m
 
     def _write_manifest(self, manifest: dict) -> None:
         from ..common.failpoint import fail_point
-        tmp = self._manifest_path() + ".tmp"
         fail_point("checkpoint.manifest.write")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        fail_point("checkpoint.manifest.rename")
-        os.replace(tmp, self._manifest_path())
+        payload = json.dumps(manifest).encode()
+        try:
+            fail_point("checkpoint.manifest.rename")
+        except BaseException:
+            # torn publish: the tmp object exists, the manifest does not
+            # change — recovery ignores *.tmp (the pre-refactor on-disk
+            # shape of a crash between tmp write and rename)
+            self.store.put(_MANIFEST + ".tmp2", payload)
+            raise
+        self.store.atomic_put(_MANIFEST, payload)
 
     # -- segments -------------------------------------------------------------
+
+    @staticmethod
+    def _encode_segment(
+            deltas: dict[int, dict[bytes, Optional[bytes]]]) -> bytes:
+        parts = [struct.pack("<I", len(deltas))]
+        for table_id, buf in sorted(deltas.items()):
+            parts.append(struct.pack("<II", table_id, len(buf)))
+            for k, v in sorted(buf.items()):
+                parts.append(struct.pack("<H", len(k)))
+                parts.append(k)
+                if v is None:
+                    parts.append(b"\x00")
+                else:
+                    parts.append(b"\x01")
+                    parts.append(struct.pack("<I", len(v)))
+                    parts.append(v)
+        return b"".join(parts)
 
     def _write_segment(self, name: str,
                        deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
         from ..common.failpoint import fail_point
         fail_point("checkpoint.segment.write")
-        path = os.path.join(self.dir, name)
-        with open(path, "wb") as f:
-            f.write(struct.pack("<I", len(deltas)))
-            f.flush()
-            # fires AFTER bytes hit the file: simulates a torn segment
-            # (crash mid-write). Safe because the manifest that would
+        payload = self._encode_segment(deltas)
+        try:
+            # simulates a torn segment (crash mid-write): a truncated
+            # object lands on disk. Safe because the manifest that would
             # reference this segment is only written after the segment
-            # completes — recovery never reads an unreferenced file.
+            # completes — recovery never reads an unreferenced object.
             fail_point("checkpoint.segment.write.partial")
-            for table_id, buf in sorted(deltas.items()):
-                f.write(struct.pack("<II", table_id, len(buf)))
-                for k, v in sorted(buf.items()):
-                    f.write(struct.pack("<H", len(k)))
-                    f.write(k)
-                    if v is None:
-                        f.write(b"\x00")
-                    else:
-                        f.write(b"\x01")
-                        f.write(struct.pack("<I", len(v)))
-                        f.write(v)
-            f.flush()
-            os.fsync(f.fileno())
+        except BaseException:
+            self.store.put(name, payload[:4])
+            raise
+        self.store.put(name, payload)
 
     def _read_segment(self, name: str) -> dict[int, dict[bytes, Optional[bytes]]]:
-        with open(os.path.join(self.dir, name), "rb") as f:
-            data = f.read()
+        data = self.store.get(name)
+        if data is None:
+            raise FileNotFoundError(name)
         pos = 0
         (n_tables,) = struct.unpack_from("<I", data, pos)
         pos += 4
@@ -132,40 +163,43 @@ class CheckpointLog:
 
     def append_epoch(self, epoch: int,
                      deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
-        manifest = self._read_manifest()
         if deltas:
             name = f"epoch_{epoch:012d}.seg"
             self._write_segment(name, deltas)
-            manifest["segments"].append(name)
-        # empty delta: bump the committed epoch only (idle FLUSH ticks must
-        # not grow the segment list)
-        manifest["committed_epoch"] = epoch
-        self._write_manifest(manifest)
-        if len(manifest["segments"]) > self.COMPACT_AFTER:
-            self.compact()
+        with self._mlock:
+            manifest = self._read_manifest()
+            if deltas:
+                manifest["segments"].append(name)
+            # empty delta: bump the committed epoch only (idle FLUSH ticks
+            # must not grow the segment list)
+            manifest["committed_epoch"] = epoch
+            self._write_manifest(manifest)
+            n_segments = len(manifest["segments"])
+        if n_segments > self.COMPACT_AFTER:
+            self._spawn_compact()
 
     def log_ddl(self, sql: str) -> None:
-        manifest = self._read_manifest()
-        manifest["ddl"].append(sql)
-        self._write_manifest(manifest)
+        with self._mlock:
+            manifest = self._read_manifest()
+            manifest["ddl"].append(sql)
+            self._write_manifest(manifest)
 
     def drop_table(self, table_id: int) -> None:
         """Tombstone a table id: recovery and compaction skip its rows
         (the durable analogue of dropping the object's state)."""
-        manifest = self._read_manifest()
-        if table_id not in manifest["dropped_tables"]:
-            manifest["dropped_tables"].append(table_id)
-            self._write_manifest(manifest)
+        with self._mlock:
+            manifest = self._read_manifest()
+            if table_id not in manifest["dropped_tables"]:
+                manifest["dropped_tables"].append(table_id)
+                self._write_manifest(manifest)
 
     def ddl(self) -> list[str]:
-        return list(self._read_manifest().get("ddl", []))
+        with self._mlock:
+            return list(self._read_manifest().get("ddl", []))
 
-    def load_tables(self) -> tuple[int, dict[int, dict[bytes, bytes]]]:
-        """Replay all manifest-referenced segments in commit order."""
-        manifest = self._read_manifest()
-        dropped = set(manifest["dropped_tables"])
+    def _fold(self, segments: list, dropped: set) -> dict:
         tables: dict[int, dict[bytes, bytes]] = {}
-        for name in manifest["segments"]:
+        for name in segments:
             for table_id, buf in self._read_segment(name).items():
                 if table_id in dropped:
                     continue
@@ -175,26 +209,76 @@ class CheckpointLog:
                         tbl.pop(k, None)
                     else:
                         tbl[k] = v
+        return tables
+
+    def load_tables(self) -> tuple[int, dict[int, dict[bytes, bytes]]]:
+        """Replay all manifest-referenced segments in commit order."""
+        with self._mlock:
+            manifest = self._read_manifest()
+        tables = self._fold(manifest["segments"],
+                            set(manifest["dropped_tables"]))
         return manifest["committed_epoch"], tables
 
-    def compact(self) -> None:
-        """Fold all segments into one (the stand-in for LSM compaction);
-        dropped tables' rows are discarded in the fold."""
-        manifest = self._read_manifest()
-        if len(manifest["segments"]) <= 1:
+    # -- compaction (background, off the barrier path) ------------------------
+    # (reference: the standalone compactor worker; compaction tasks run
+    #  concurrently with checkpoints, src/storage/compactor/src/server.rs:57)
+
+    def _spawn_compact(self) -> None:
+        t = self._compact_thread
+        if t is not None and t.is_alive():
             return
-        epoch, tables = self.load_tables()   # already filters dropped ids
-        name = f"epoch_{epoch:012d}.compacted.seg"
+        t = threading.Thread(target=self._compact_guarded, daemon=True,
+                             name="checkpoint-compactor")
+        self._compact_thread = t
+        t.start()
+
+    def _compact_guarded(self) -> None:
+        try:
+            self.compact()
+        except Exception as e:   # never fatal: old segments remain valid,
+            import sys           # but a persistent failure must be visible
+            sys.stderr.write(
+                f"checkpoint compaction failed (segments keep "
+                f"accumulating until it succeeds): {e!r}\n")
+
+    def wait_compaction(self) -> None:
+        """Join any in-flight background fold (tests / orderly shutdown)."""
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            t.join()
+
+    def compact(self) -> None:
+        """Fold segments into one (the stand-in for LSM compaction);
+        dropped tables' rows are discarded in the fold.
+
+        Safe concurrently with ``append_epoch``: the fold works on a
+        SNAPSHOT of the segment list (segments are immutable and appends
+        only add), and the manifest swap under the lock keeps any segments
+        appended meanwhile."""
+        with self._fold_lock:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        with self._mlock:
+            manifest = self._read_manifest()
+            base = list(manifest["segments"])
+            dropped = set(manifest["dropped_tables"])
+            epoch = manifest["committed_epoch"]
+        if len(base) <= 1:
+            return
+        tables = self._fold(base, dropped)
+        self._compact_seq += 1
+        name = f"epoch_{epoch:012d}.c{self._compact_seq}.compacted.seg"
         self._write_segment(name, {t: dict(b) for t, b in tables.items()})
-        old = manifest["segments"]
-        manifest["segments"] = [name]
-        self._write_manifest(manifest)
-        for n in old:
+        with self._mlock:
+            manifest = self._read_manifest()
+            base_set = set(base)
+            manifest["segments"] = [name] + [
+                s for s in manifest["segments"] if s not in base_set]
+            self._write_manifest(manifest)
+        for n in base:
             if n != name:
-                try:
-                    os.remove(os.path.join(self.dir, n))
-                except OSError:
-                    pass
+                self.store.delete(n)
 
 
 class DurableStateStore(MemoryStateStore):
@@ -203,9 +287,12 @@ class DurableStateStore(MemoryStateStore):
     committed state (reference: StateStoreImpl selecting the Hummock backend,
     src/storage/src/store_impl.rs:49-64)."""
 
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: Optional[str] = None,
+                 object_store: Optional[ObjectStore] = None,
+                 compact_after: Optional[int] = None):
         super().__init__()
-        self.log = CheckpointLog(data_dir)
+        self.log = CheckpointLog(data_dir, object_store=object_store,
+                                 compact_after=compact_after)
         if self.log.exists():
             epoch, tables = self.log.load_tables()
             self._committed = tables

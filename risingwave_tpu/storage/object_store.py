@@ -1,0 +1,129 @@
+"""ObjectStore: the blob layer under the durable checkpoint tier.
+
+Counterpart of the reference's ``ObjectStore`` trait
+(reference: src/object_store/src/object/mod.rs:93-136 —
+upload/read/delete/list over S3/OpenDAL/in-mem backends). The checkpoint
+log (storage/checkpoint.py) is parameterized by this interface, so the
+durable tier is one backend swap away from an object-storage service; the
+implementations here are local-FS (fsync + atomic-rename discipline) and
+in-memory (tests/sim).
+
+Only whole-object operations: segments are written once and read whole —
+the streaming/range reads the reference needs for LSM blocks do not arise
+(device state is merged in HBM; a segment is one compact delta).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+class ObjectStore:
+    """put/get/list/delete + atomic_put (read-modify-write safe publish)."""
+
+    def put(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def atomic_put(self, path: str, data: bytes) -> None:
+        """Readers see the old object or the new one, never a torn mix
+        (manifest publication; local FS: tmp file + rename)."""
+        raise NotImplementedError
+
+    def get(self, path: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        return self.get(path) is not None
+
+
+class LocalFsObjectStore(ObjectStore):
+    """Objects are files under ``root``; durability via fsync, atomicity
+    via tmp + os.replace (the discipline the checkpoint log relied on
+    before this layer was factored out)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, path: str) -> str:
+        return os.path.join(self.root, path)
+
+    def put(self, path: str, data: bytes) -> None:
+        full = self._p(path)
+        os.makedirs(os.path.dirname(full), exist_ok=True) \
+            if os.path.dirname(path) else None
+        with open(full, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def atomic_put(self, path: str, data: bytes) -> None:
+        full = self._p(path)
+        tmp = full + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, full)
+
+    def get(self, path: str) -> Optional[bytes]:
+        try:
+            with open(self._p(path), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def list(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            rel = os.path.relpath(dirpath, self.root)
+            for fn in files:
+                p = fn if rel == "." else os.path.join(rel, fn)
+                if p.startswith(prefix) and not p.endswith(".tmp"):
+                    out.append(p)
+        return sorted(out)
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(self._p(path))
+        except OSError:
+            pass
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._p(path))
+
+
+class MemObjectStore(ObjectStore):
+    """In-memory backend (the reference's InMemObjectStore) — tests and
+    the deterministic sim. Thread-safe: the background compactor reads
+    concurrently with barrier-path appends."""
+
+    def __init__(self) -> None:
+        self._objects: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._objects[path] = bytes(data)
+
+    atomic_put = put    # dict assignment is already atomic
+
+    def get(self, path: str) -> Optional[bytes]:
+        with self._lock:
+            return self._objects.get(path)
+
+    def list(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return sorted(p for p in self._objects if p.startswith(prefix))
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._objects.pop(path, None)
