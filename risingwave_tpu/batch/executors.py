@@ -2,18 +2,23 @@
 
 Counterpart of the reference's batch engine
 (reference: src/batch/src/executor/ — RowSeqScan over vnode-partitioned
-StorageTable ranges, Filter/Project/HashAgg/Sort/TopN/Limit…;
+StorageTable ranges, Filter/Project/HashAgg/HashJoin/Sort/TopN/Limit…;
 src/batch/src/task/task_manager.rs:42 fire_task). Where the reference
-streams row batches through pull-based executors, the TPU design
-evaluates each operator as ONE whole-snapshot device computation: a scan
-materializes the table's rows into fixed-capacity chunks, and every
-downstream operator is a vectorized jnp transformation over those chunks
-— there is no per-batch pull loop to schedule, XLA fuses the operator
-bodies instead.
+streams row batches through pull-based executors, the TPU design moves
+DEVICE CHUNKS through the operator chain: a scan materializes the table's
+rows into fixed-capacity chunks once (the host-decode edge), and every
+downstream operator — filter, project, hash agg, hash join — is a jitted
+device computation over those chunks. Rows reappear only at the
+presentation edge (sort/limit/output), which is output-sized, not
+input-sized.
 
-Wired into ``Session.query`` via batch/lower.py: scan / filter / project
-/ agg / top-n plans run here; the stream-fold path remains the engine
-for plans with stream-only operators (joins, windows, EOWC).
+The hash agg reuses the streaming engine's AggCore (one scatter-reduce
+kernel, shared with stream/hash_agg.py); the hash join is a one-shot
+build-and-gather over a DeviceHashTable (reference:
+src/batch/src/executor/join/hash_join.rs). The join requires UNIQUE build
+keys (the TPC-H shapes: joins against a pk side); duplicate build keys
+raise ``BatchFallback`` and the session re-runs the SELECT through the
+streaming fold, which handles arbitrary multiplicity.
 """
 
 from __future__ import annotations
@@ -21,63 +26,41 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Iterator, List, Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from ..common.chunk import StreamChunk, chunk_to_rows, physical_chunk
+from ..common.chunk import (
+    Column, StreamChunk, chunk_to_rows, physical_chunk,
+)
 from ..common.hashing import VNODE_COUNT, vnode_of
-from ..common.types import Schema
+from ..common.types import Field, Schema
 from ..expr.agg import AggCall
-from ..expr.expr import Expr
+from ..expr.expr import Expr, uses_host_callback
+from ..ops.grouped_agg import AggCore
+from ..ops.hash_table import ht_lookup, ht_lookup_or_insert, ht_new
 from ..ops.topn import OrderSpec
 from ..storage.state_table import StateTable
+
+
+class BatchFallback(Exception):
+    """Raised at run time when a plan shape needs the streaming fold
+    (e.g. duplicate build keys in a batch hash join)."""
 
 
 class BatchExecutor:
     schema: Schema
 
-    def execute(self) -> Iterator[List[tuple]]:
-        """Yields row batches (physical tuples)."""
+    def execute_chunks(self) -> Iterator[StreamChunk]:
+        """Yields device chunks (visibility-masked)."""
         raise NotImplementedError
 
-
-class RowSeqScan(BatchExecutor):
-    """Full / vnode-partitioned snapshot scan over a StateTable
-    (reference: row_seq_scan.rs — scan ranges are vnode partitions so
-    parallel tasks split the key space)."""
-
-    def __init__(self, table: StateTable,
-                 vnodes: Optional[Sequence[int]] = None,
-                 batch_size: int = 4096):
-        self.table = table
-        self.schema = table.schema
-        self.vnodes = None if vnodes is None else set(vnodes)
-        self.batch_size = batch_size
-
-    def execute(self):
-        buf: List[tuple] = []
-        for row in self.table.scan_all():
-            buf.append(row)
-            if len(buf) >= self.batch_size:
-                yield from self._emit(buf)
-                buf = []
-        if buf:
-            yield from self._emit(buf)
-
-    def _emit(self, rows: List[tuple]):
-        if self.vnodes is None:
-            yield rows
-            return
-        # vectorized vnode of the pk columns for the whole batch — the
-        # same device hash the streaming shuffle uses, so batch-task
-        # partitions line up with stream shards
-        pk = list(self.table.pk_indices)
-        pk_schema = self.schema.select(pk)
-        chunk = physical_chunk(
-            pk_schema, [tuple(r[i] for i in pk) for r in rows], len(rows))
-        vn = np.asarray(vnode_of(list(chunk.columns)))
-        out = [r for r, v in zip(rows, vn) if int(v) in self.vnodes]
-        if out:
-            yield out
+    def execute(self) -> Iterator[List[tuple]]:
+        """Row view (physical tuples) — the presentation edge."""
+        for chunk in self.execute_chunks():
+            rows = chunk_to_rows(chunk, self.schema, physical=True)
+            if rows:
+                yield rows
 
 
 class _SingleInput(BatchExecutor):
@@ -86,113 +69,320 @@ class _SingleInput(BatchExecutor):
         self.schema = input.schema
 
 
+class RowSeqScan(BatchExecutor):
+    """Full / vnode-partitioned snapshot scan over a StateTable
+    (reference: row_seq_scan.rs — scan ranges are vnode partitions so
+    parallel tasks split the key space). The one host-decode edge: rows
+    become device chunks here and stay on device through the plan."""
+
+    def __init__(self, table: StateTable,
+                 vnodes: Optional[Sequence[int]] = None,
+                 batch_size: int = 4096):
+        self.table = table
+        self.schema = table.schema
+        self.vnodes = None if vnodes is None else sorted(set(vnodes))
+        self.batch_size = batch_size
+
+    def execute_chunks(self):
+        buf: List[tuple] = []
+        for row in self.table.scan_all():
+            buf.append(row)
+            if len(buf) >= self.batch_size:
+                yield self._chunk(buf)
+                buf = []
+        if buf:
+            yield self._chunk(buf)
+
+    def _chunk(self, rows: List[tuple]) -> StreamChunk:
+        chunk = physical_chunk(self.schema, rows, max(len(rows), 1))
+        if self.vnodes is None:
+            return chunk
+        # device vnode mask over the pk columns — the same hash the
+        # streaming shuffle uses, so batch partitions line up with shards
+        pk_cols = [chunk.columns[i] for i in self.table.pk_indices]
+        vn = vnode_of(pk_cols)
+        sel = jnp.zeros(VNODE_COUNT, jnp.bool_).at[
+            jnp.asarray(self.vnodes, jnp.int32)].set(True)
+        return chunk.with_vis(chunk.vis & sel[vn])
+
+
 class BatchFilter(_SingleInput):
     def __init__(self, input: BatchExecutor, predicate: Expr):
         super().__init__(input)
         self.predicate = predicate
 
-    def execute(self):
-        for rows in self.input.execute():
-            chunk = physical_chunk(self.schema, rows, max(len(rows), 1))
-            cond = self.predicate.eval(chunk)
-            keep = np.asarray(cond.data & cond.mask)[:len(rows)]
-            out = [r for r, k in zip(rows, keep) if k]
-            if out:
-                yield out
+        def _step(chunk: StreamChunk) -> StreamChunk:
+            cond = predicate.eval(chunk)
+            return chunk.with_vis(chunk.vis & cond.data & cond.mask)
+
+        self._step = _step if uses_host_callback(predicate) \
+            else jax.jit(_step)
+
+    def execute_chunks(self):
+        for chunk in self.input.execute_chunks():
+            yield self._step(chunk)
 
 
 class BatchProject(_SingleInput):
     def __init__(self, input: BatchExecutor, exprs: Sequence[Expr],
                  names: Sequence[str] = ()):
         super().__init__(input)
-        from ..common.types import Field
         self.exprs = list(exprs)
         names = tuple(names) or tuple(f"expr{i}" for i in range(len(exprs)))
         self.schema = Schema(tuple(
             Field(n, e.type) for n, e in zip(names, self.exprs)))
 
-    def execute(self):
-        for rows in self.input.execute():
-            chunk = physical_chunk(self.input.schema, rows,
-                                   max(len(rows), 1))
-            cols = [e.eval(chunk) for e in self.exprs]
-            datas = [np.asarray(c.data) for c in cols]
-            masks = [np.asarray(c.mask) for c in cols]
-            out = [
-                tuple(d[i].item() if m[i] else None
-                      for d, m in zip(datas, masks))
-                for i in range(len(rows))
-            ]
-            yield out
+        def _step(chunk: StreamChunk) -> StreamChunk:
+            cols = tuple(e.eval(chunk) for e in self.exprs)
+            return chunk.with_columns(cols)
+
+        self._step = _step if any(uses_host_callback(e) for e in exprs) \
+            else jax.jit(_step)
+
+    def execute_chunks(self):
+        for chunk in self.input.execute_chunks():
+            yield self._step(chunk)
 
 
 class BatchHashAgg(_SingleInput):
-    """Hash aggregation over the whole input (one shot, no retraction)."""
+    """One-shot grouped/global aggregation — the streaming AggCore's
+    scatter-reduce kernel applied over the whole snapshot, then one
+    output materialization of the (small) group set."""
 
     def __init__(self, input: BatchExecutor, group_keys: Sequence[int],
-                 agg_calls: Sequence[AggCall]):
+                 agg_calls: Sequence[AggCall],
+                 table_capacity: int = 1 << 16):
         super().__init__(input)
-        from ..common.types import Field
         self.group_keys = tuple(group_keys)
         self.agg_calls = tuple(agg_calls)
         fields = tuple(input.schema[i] for i in self.group_keys) + tuple(
             Field(f"agg{i}", a.output_type)
             for i, a in enumerate(self.agg_calls))
         self.schema = Schema(fields)
+        self.capacity = table_capacity
+        self._needs_ranks = any(c.is_string_minmax for c in self.agg_calls)
+        if self.group_keys:
+            key_types = tuple(
+                input.schema[i].type for i in self.group_keys)
+            self.core = AggCore(key_types, self.group_keys, self.agg_calls,
+                                table_capacity, out_capacity=1024)
+            self._apply = jax.jit(self.core.apply_chunk)
+        else:
+            # global agg: scalar lanes folded per chunk (the streaming
+            # SimpleAgg's lane algebra, one reduction per chunk)
+            from ..stream.simple_agg import _AggLanes
+            self.lanes_def = _AggLanes(self.agg_calls)
 
-    def execute(self):
-        groups: dict = {}
+            def _fold(lanes, chunk, str_ranks=None):
+                deltas = self.lanes_def.chunk_deltas(chunk, str_ranks)
+                return self.lanes_def.merge(lanes, deltas, str_ranks)
+
+            self._fold = jax.jit(_fold)
+
+    def _ranks(self):
+        if not self._needs_ranks:
+            return None
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks()
+
+    def execute_chunks(self):
         if not self.group_keys:
-            # global agg emits one row even over empty input
-            # (count()=0, others NULL) — matching the streaming SimpleAgg
-            groups[()] = [(0, None, None, None)] * len(self.agg_calls)
-        for rows in self.input.execute():
-            for row in rows:
-                key = tuple(row[i] for i in self.group_keys)
-                accs = groups.setdefault(
-                    key, [(0, None, None, None)] * len(self.agg_calls))
-                for i, a in enumerate(self.agg_calls):
-                    v = 1 if a.arg < 0 else row[a.arg]
-                    if v is None:
-                        continue
-                    cnt, s, mn, mx = accs[i]
-                    accs[i] = (cnt + 1, (s or 0) + v,
-                               v if mn is None else min(mn, v),
-                               v if mx is None else max(mx, v))
-        out = []
-        for key, accs in groups.items():
-            vals = []
-            for a, (cnt, s, mn, mx) in zip(self.agg_calls, accs):
-                if a.kind == "count":
-                    vals.append(cnt)
-                elif a.kind == "sum":
-                    vals.append(s if cnt else None)
-                elif a.kind == "min":
-                    vals.append(mn)
-                elif a.kind == "max":
-                    vals.append(mx)
-                else:   # avg
-                    vals.append(s / cnt if cnt else None)
-            out.append(key + tuple(vals))
-        if out:
-            yield out
+            lanes = self.lanes_def.init_lanes()
+            for chunk in self.input.execute_chunks():
+                lanes = self._fold(lanes, chunk, self._ranks())
+            # one row always, even over empty input (count()=0, others
+            # NULL — PG semantics, matching the streaming SimpleAgg)
+            outs = self.lanes_def.outputs(lanes)
+            cols = tuple(
+                Column(jnp.asarray(d).reshape(1),
+                       jnp.asarray(m).reshape(1))
+                for d, m in outs)
+            yield StreamChunk(jnp.zeros(1, jnp.int8),
+                              jnp.ones(1, jnp.bool_), cols)
+            return
+        state = self.core.init_state()
+        for chunk in self.input.execute_chunks():
+            state = self._apply(state, chunk, self._ranks())
+        if bool(state.overflow):
+            raise BatchFallback(
+                f"batch agg table overflow (capacity {self.capacity})")
+        live = np.asarray(state.table.occupied & (state.lanes[0] > 0))
+        idx = np.nonzero(live)[0]
+        if not len(idx):
+            return
+        outs = self.core.outputs(state.lanes)
+        key_data = [np.asarray(kd)[idx] for kd in state.table.key_data]
+        key_mask = [np.asarray(km)[idx] for km in state.table.key_mask]
+        out_data = [np.asarray(d)[idx] for d, _ in outs]
+        out_mask = [np.asarray(m)[idx] for _, m in outs]
+        n = len(idx)
+        cols = tuple(
+            Column(jnp.asarray(d), jnp.asarray(m))
+            for d, m in zip(key_data + out_data, key_mask + out_mask))
+        yield StreamChunk(jnp.zeros(n, jnp.int8),
+                          jnp.ones(n, jnp.bool_), cols)
+
+
+class BatchHashJoin(BatchExecutor):
+    """One-shot hash join with a UNIQUE-keyed build side (reference:
+    src/batch/src/executor/join/hash_join.rs; the TPC-H q3/q10 shapes
+    join against a pk side). Build: scatter build columns into slot
+    arrays keyed by the join key. Probe: lookup + gather — both phases
+    are jitted device steps.
+
+    Inner joins auto-pick the build side: the right side is built first
+    and, if its keys are not unique, the left side is tried (q3's
+    customer⋈orders builds on customer's pk). When NEITHER side is
+    unique, BatchFallback sends the query to the streaming join, which
+    handles arbitrary multiplicity."""
+
+    def __init__(self, left: BatchExecutor, right: BatchExecutor,
+                 left_keys: Sequence[int], right_keys: Sequence[int],
+                 join_type: str = "inner",
+                 condition: Optional[Expr] = None,
+                 table_capacity: int = 1 << 16):
+        if join_type not in ("inner", "left"):
+            raise BatchFallback(f"batch join type {join_type!r}")
+        self.left, self.right = left, right
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        self.capacity = table_capacity
+        self.schema = Schema(tuple(left.schema) + tuple(right.schema))
+        self._eager = condition is not None and uses_host_callback(condition)
+        self._steps = {}    # swapped -> (build_step, probe_step)
+
+    def _mk_steps(self, swapped: bool):
+        if swapped in self._steps:
+            return self._steps[swapped]
+        build_keys = self.left_keys if swapped else self.right_keys
+        probe_keys = self.right_keys if swapped else self.left_keys
+        cap = self.capacity
+        cond = self.condition
+        join_type = self.join_type
+
+        def _build_step(table, cols_acc, masks_acc, chunk):
+            key_cols = [chunk.columns[i] for i in build_keys]
+            # SQL semantics: NULL join keys never match (the streaming
+            # join enforces the same) — null-keyed build rows are skipped
+            keyed = chunk.vis
+            for c in key_cols:
+                keyed = keyed & c.mask
+            table, slots, is_new, ovf = ht_lookup_or_insert(
+                table, key_cols, keyed)
+            dup = jnp.any(keyed & ~is_new)
+            idx = jnp.where(keyed, slots, cap)
+            cols_acc = tuple(
+                acc.at[idx].set(c.data, mode="drop")
+                for acc, c in zip(cols_acc, chunk.columns))
+            masks_acc = tuple(
+                acc.at[idx].set(c.mask, mode="drop")
+                for acc, c in zip(masks_acc, chunk.columns))
+            return table, cols_acc, masks_acc, dup | ovf
+
+        def _probe_step(table, cols_acc, masks_acc, chunk):
+            key_cols = [chunk.columns[i] for i in probe_keys]
+            keyed = chunk.vis
+            for c in key_cols:
+                keyed = keyed & c.mask
+            slots, found = ht_lookup(table, key_cols, keyed)
+            found = found & keyed          # NULL probe keys never match
+            safe = jnp.clip(slots, 0, cap - 1)
+            bcols = tuple(
+                Column(acc[safe], m[safe] & found)
+                for acc, m in zip(cols_acc, masks_acc))
+            # output columns in schema order (left ++ right) regardless
+            # of which side was built — the condition indexes into it
+            if swapped:
+                all_cols = bcols + tuple(chunk.columns)
+            else:
+                all_cols = tuple(chunk.columns) + bcols
+            out = StreamChunk(chunk.ops, chunk.vis, all_cols)
+            if cond is not None:
+                c = cond.eval(out)
+                match = found & c.data & c.mask
+            else:
+                match = found
+            if join_type == "inner":
+                return out.with_vis(chunk.vis & match)
+            # left join (never swapped): unmatched probe rows keep NULL
+            # build columns
+            bcols = tuple(Column(c.data, c.mask & match) for c in bcols)
+            return StreamChunk(chunk.ops, chunk.vis,
+                               tuple(chunk.columns) + bcols)
+
+        pair = ((_build_step, _probe_step) if self._eager
+                else (jax.jit(_build_step), jax.jit(_probe_step)))
+        self._steps[swapped] = pair
+        return pair
+
+    def _try_build(self, side: BatchExecutor, swapped: bool):
+        build_keys = self.left_keys if swapped else self.right_keys
+        key_types = tuple(side.schema[i].type for i in build_keys)
+        build_step, _ = self._mk_steps(swapped)
+        table = ht_new(key_types, self.capacity)
+        cols_acc = tuple(
+            jnp.zeros(self.capacity, f.type.dtype) for f in side.schema)
+        masks_acc = tuple(
+            jnp.zeros(self.capacity, jnp.bool_) for _ in side.schema)
+        bad = jnp.zeros((), jnp.bool_)
+        for chunk in side.execute_chunks():
+            table, cols_acc, masks_acc, step_bad = build_step(
+                table, cols_acc, masks_acc, chunk)
+            bad = bad | step_bad
+        return (None if bool(bad) else (table, cols_acc, masks_acc))
+
+    def execute_chunks(self):
+        built = self._try_build(self.right, swapped=False)
+        swapped = False
+        if built is None and self.join_type == "inner":
+            built = self._try_build(self.left, swapped=True)
+            swapped = True
+        if built is None:
+            raise BatchFallback(
+                "batch hash join needs a unique-keyed build side within "
+                "capacity; falling back to the streaming join")
+        table, cols_acc, masks_acc = built
+        _, probe_step = self._mk_steps(swapped)
+        probe_side = self.right if swapped else self.left
+        for chunk in probe_side.execute_chunks():
+            yield probe_step(table, cols_acc, masks_acc, chunk)
+
+
+def _host_order_key(t):
+    """Host-side orderable key for one physical value of type ``t``:
+    identity for numerics, dictionary-rank lookup for VARCHAR/BYTEA (raw
+    ids are insertion-ordered and must never feed an ordering op)."""
+    if t is None or not t.is_string:
+        return lambda v: v
+    from ..common.types import GLOBAL_STRING_DICT
+    ranks = GLOBAL_STRING_DICT.ranks()
+    return lambda v: int(ranks[v])
 
 
 class BatchSort(_SingleInput):
+    """Presentation edge: output-sized host sort over the row view."""
+
     def __init__(self, input: BatchExecutor, order: Sequence[OrderSpec]):
         super().__init__(input)
         self.order = list(order)
 
+    def execute_chunks(self):  # pragma: no cover - row-based operator
+        raise NotImplementedError("BatchSort is a row-edge operator")
+
     def execute(self):
         allrows = [r for rows in self.input.execute() for r in rows]
+        keyfns = [_host_order_key(self.input.schema[s.col].type)
+                  for s in self.order]
 
         def key(row):
             k = []
-            for spec in self.order:
+            for spec, kf in zip(self.order, keyfns):
                 v = row[spec.col]
                 null_rank = 1 if spec.nulls_last else -1
                 k.append((null_rank, 0) if v is None
-                         else (0, -v if spec.desc else v))
+                         else (0, -kf(v) if spec.desc else kf(v)))
             return tuple(k)
 
         allrows.sort(key=key)
@@ -205,6 +395,9 @@ class BatchLimit(_SingleInput):
         super().__init__(input)
         self.limit = limit
         self.offset = offset
+
+    def execute_chunks(self):  # pragma: no cover - row-based operator
+        raise NotImplementedError("BatchLimit is a row-edge operator")
 
     def execute(self):
         skipped = taken = 0

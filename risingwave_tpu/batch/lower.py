@@ -4,8 +4,11 @@ Counterpart of the reference's to_batch optimizer phase
 (reference: src/frontend/src/optimizer/mod.rs — the same logical plan
 lowers to either stream or batch physical operators). ``lower_plan``
 returns None for shapes only the streaming engine supports (EOWC,
-DISTINCT aggs, WITH TIES, window functions, joins — those SELECTs keep
-using the session's stream-fold path), so it is always safe to try."""
+DISTINCT aggs, WITH TIES, window functions — those SELECTs keep using
+the session's stream-fold path), so it is always safe to try. Joined
+SELECTs lower to the one-shot BatchHashJoin; joins it cannot serve
+(non-unique build keys, outer-right shapes) raise BatchFallback at run
+time and the session re-runs through the streaming fold."""
 
 from __future__ import annotations
 
@@ -14,8 +17,8 @@ from typing import Optional
 from ..frontend import planner as P
 from ..storage.state_table import StateTable
 from .executors import (
-    BatchExecutor, BatchFilter, BatchHashAgg, BatchLimit, BatchProject,
-    BatchSort, RowSeqScan,
+    BatchExecutor, BatchFilter, BatchHashAgg, BatchHashJoin, BatchLimit,
+    BatchProject, BatchSort, RowSeqScan,
 )
 
 
@@ -42,6 +45,16 @@ def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
             return None
         return BatchHashAgg(inp, list(plan.group_keys),
                             list(plan.agg_calls))
+    if isinstance(plan, P.PJoin):
+        if plan.kind not in ("inner", "left"):
+            return None
+        left = lower_plan(plan.left, store)
+        right = lower_plan(plan.right, store)
+        if left is None or right is None:
+            return None
+        return BatchHashJoin(left, right, list(plan.left_keys),
+                             list(plan.right_keys), join_type=plan.kind,
+                             condition=plan.condition)
     if isinstance(plan, P.PTopN):
         if plan.with_ties or plan.group_by:
             return None

@@ -260,6 +260,16 @@ def gather_units_window(chunk: StreamChunk, lo: jax.Array, out_capacity: int) ->
     return StreamChunk(ops, vis, cols)
 
 
+def flatten_shards(chunk: StreamChunk) -> StreamChunk:
+    """A shard-batched chunk ([n, cap, ...] arrays) → ONE chunk of
+    n*cap rows (row-major concat; vis already masks invalid rows). The
+    sharded executors' egress path: one device op replaces the per-shard
+    host slicing loop (VERDICT r3 item 9)."""
+    def f(x):
+        return x.reshape((-1,) + x.shape[2:])
+    return jax.tree_util.tree_map(f, chunk)
+
+
 def pad_chunk(chunk: StreamChunk, new_capacity: int) -> StreamChunk:
     """Grow a chunk's capacity with invisible padding rows (no-op if already
     at least ``new_capacity``)."""

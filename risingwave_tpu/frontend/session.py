@@ -73,6 +73,14 @@ def _values_chunk(leaf: PValues) -> StreamChunk:
 
 
 @dataclasses.dataclass
+class _BackfillRef:
+    """A live BackfillExecutor and its owning job (for teardown)."""
+
+    bf: Any
+    job: str = ""
+
+
+@dataclasses.dataclass
 class _SourceFeed:
     """A connector instance feeding one job's source leaf.
 
@@ -177,6 +185,19 @@ class Session:
             self.store: MemoryStateStore = DurableStateStore(data_dir)
         else:
             self.store = MemoryStateStore()
+        # meta tier as the control plane (VERDICT r3 item 3): catalog
+        # mutations write through to the MetaStore + notifications; barrier
+        # conduction publishes; the heartbeat detector drives scoped job
+        # recovery (reference: meta managers, src/meta/src/manager/)
+        import os as _os
+        from ..meta.service import MetaBackedCatalog, MetaService
+        self.meta = MetaService(
+            data_dir=_os.path.join(data_dir, "meta")
+            if data_dir is not None else None)
+        self.catalog_writer = MetaBackedCatalog(self.catalog, self.meta)
+        self._jobs_to_recover: list[str] = []
+        self._dead_jobs: set[str] = set()
+        self.meta.on_job_failure(self._jobs_to_recover.append)
         self.config = config or BuildConfig()
         self.checkpoint_frequency = checkpoint_frequency
         # barrier cadence for interval-driven drivers (CLI ticker); mutable
@@ -191,8 +212,13 @@ class Session:
         self.source_chunk_capacity = source_chunk_capacity
         self.seed = seed
         self.epoch = max(1, self.store.committed_epoch)  # last completed epoch
+        # the failure detector's clock is the epoch counter: align it with
+        # the session's starting epoch or a recovered session (epoch >> 0)
+        # would instantly expire every worker registered at clock 0
+        self.meta.advance_epoch_clock(self.epoch)
         self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
         self.feeds: list[_SourceFeed] = []
+        self.backfills: list[_BackfillRef] = []
         # DML rendezvous (reference: DmlManager, src/source/src/
         # dml_manager.rs:44): INSERTs stage here and land in the next epoch
         from ..stream.dml import DmlManager
@@ -366,7 +392,7 @@ class Session:
         watermark = None
         if stmt.watermark is not None:
             watermark = self._bind_watermark(stmt.watermark, schema)
-        self.catalog.add_source(SourceDef(
+        self.catalog_writer.add_source(SourceDef(
             stmt.name, schema, connector, dict(stmt.with_options),
             watermark=watermark))
         return []
@@ -404,7 +430,7 @@ class Session:
         t = TableDef(stmt.name, schema, pk,
                      table_id=self.catalog.next_table_id(),
                      append_only=stmt.append_only)
-        self.catalog.add_table(t)
+        self.catalog_writer.add_table(t)
         # the table IS a stream job: DML queue -> (row id gen) -> materialize
         q = QueueSource(Schema(fields))
         src: Executor = q
@@ -444,14 +470,14 @@ class Session:
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
 
         def factory(leaf) -> Executor:
+            # scan leaves backfill concurrently through their progress
+            # tables (stream/backfill.py) — no init-snapshot replay here;
+            # scan_leaf_queues remains only for CREATE SINK FROM <mv>,
+            # which subscribes outside this factory
             ex, q, init = self._stream_leaf(leaf)
             if q is not None:
                 queues.append(q)
                 init_msgs.append((q, init))
-                if self._recovering and isinstance(leaf, (PTableScan, PMvScan)):
-                    name = (leaf.table.name if isinstance(leaf, PTableScan)
-                            else leaf.mv.name)
-                    scan_leaf_queues.append((init, self.jobs[name]))
             return ex
 
         ctx = BuildContext(self.store, self.catalog.next_table_id, factory,
@@ -479,6 +505,7 @@ class Session:
         self._drain_inflight()   # subscribe at a quiesced epoch boundary
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
         n_feeds0 = len(self.feeds)
+        n_bf0 = len(self.backfills)
         id0 = self.catalog._next_table_id   # for reschedule id replay
         (plan, pipeline, ctx, queues, init_msgs,
          scan_leaf_queues) = self._build_query_pipeline(stmt.query)
@@ -486,8 +513,9 @@ class Session:
         mat = MaterializeExecutor(
             pipeline,
             StateTable(self.store, mv_table_id, plan.schema, list(plan.pk)))
-        self._maybe_rebackfill((mv_table_id,) + tuple(ctx.state_table_ids),
-                               scan_leaf_queues)
+        # (no _maybe_rebackfill here: scan leaves re-run their own backfill
+        # from the persisted cursor — created-but-never-checkpointed
+        # recovery is the empty-progress case of stream/backfill.py)
         n_visible = sum(1 for f in plan.schema if not f.name.startswith("_"))
         mv = MaterializedViewDef(
             stmt.name, plan.schema, tuple(plan.pk), table_id=mv_table_id,
@@ -499,10 +527,12 @@ class Session:
         # replay the same ids over the same durable state tables)
         mv.query_ast = stmt.query  # type: ignore[attr-defined]
         mv.table_id_range = (id0, self.catalog._next_table_id)  # type: ignore[attr-defined]
-        self.catalog.add_mv(mv)
+        self.catalog_writer.add_mv(mv)
         for f in self.feeds[n_feeds0:]:
             f.job = stmt.name
-        job = StreamJob(stmt.name, mat, queues)
+        for b in self.backfills[n_bf0:]:
+            b.job = stmt.name
+        job = StreamJob(stmt.name, mat, queues, actors=ctx.actors)
         self.jobs[stmt.name] = job
         job.start(self.loop)
         # the next barrier announces the new downstream to the graph
@@ -529,8 +559,10 @@ class Session:
         from ..stream.sink import PROGRESS_SCHEMA, SinkExecutor, log_table_schema
         connector = str(stmt.with_options.get("connector", "blackhole"))
         n_feeds0 = len(self.feeds)
+        n_bf0 = len(self.backfills)
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
         ctx_tids: tuple = ()
+        actors: list = []
         if stmt.from_name is not None:
             kind, obj = self.catalog.resolve_relation(stmt.from_name)
             if kind == "source":
@@ -552,6 +584,7 @@ class Session:
             (plan, pipeline, ctx, queues, init_msgs,
              scan_leaf_queues) = self._build_query_pipeline(stmt.query)
             ctx_tids = tuple(ctx.state_table_ids)
+            actors = ctx.actors
             schema = plan.schema
             n_visible = sum(1 for f in schema if not f.name.startswith("_"))
         log_tid = self.catalog.next_table_id()
@@ -575,10 +608,12 @@ class Session:
                        from_name=stmt.from_name or "", table_id=log_tid,
                        progress_table_id=prog_tid)
         sdef.state_table_ids = ctx_tids + (prog_tid,)  # type: ignore[attr-defined]
-        self.catalog.add_sink(sdef)
+        self.catalog_writer.add_sink(sdef)
         for f in self.feeds[n_feeds0:]:
             f.job = stmt.name
-        job = StreamJob(stmt.name, ex, queues)
+        for b in self.backfills[n_bf0:]:
+            b.job = stmt.name
+        job = StreamJob(stmt.name, ex, queues, actors=actors)
         self.jobs[stmt.name] = job
         job.start(self.loop)
         self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
@@ -611,6 +646,7 @@ class Session:
         # this job's source feeds are recreated (sought to their offsets)
         live = [f for f in self.feeds if f.job != name]
         self.feeds = live
+        self.backfills = [b for b in self.backfills if b.job != name]
         # durable note: a BuildConfig (mesh = live device handles) cannot
         # be persisted; recovery rebuilds with the session's default
         # config. Record the fact so recovery can WARN instead of
@@ -635,6 +671,7 @@ class Session:
         if config is not None:
             self.config = config
         n_feeds0 = len(self.feeds)
+        n_bf0 = len(self.backfills)
         bus_subs0 = {n: list(j.bus.subscribers)
                      for n, j in self.jobs.items()}
         rollback_error: Optional[BaseException] = None
@@ -685,7 +722,9 @@ class Session:
             self.config = saved_config
         for f in self.feeds[n_feeds0:]:
             f.job = name
-        job = StreamJob(name, mat, queues)
+        for b in self.backfills[n_bf0:]:
+            b.job = name
+        job = StreamJob(name, mat, queues, actors=ctx.actors)
         job.bus.subscribers = old_job.bus.subscribers   # downstreams keep
         self.jobs[name] = job
         job.start(self.loop)
@@ -713,19 +752,146 @@ class Session:
         for n, j in list(self.jobs.items()):
             if any(id(q) in sub_queues for q in j.sources):
                 self.jobs.pop(n, None)
-                sink = getattr(j.pipeline, "sink", None)
-                if sink is not None:
-                    sink.close()
-                self._await(j.stop())
-                self._unsubscribe_job(j)
-                self.feeds = [f for f in self.feeds if f.job != n]
-                self._table_queues.pop(n, None)
+                self._teardown_job(n, j)
                 self._pop_downstreams_of(j)
+
+    def _teardown_job(self, name: str, j: StreamJob) -> None:
+        """Full per-job teardown shared by drop-downstreams and scoped
+        recovery: stop the task (and fragment actors), unsubscribe its
+        queues from live buses, drop feeds/backfills/barrier queues, close
+        its sink, deregister its worker."""
+        sink = getattr(j.pipeline, "sink", None)
+        if sink is not None:
+            sink.close()
+        self._await(j.stop())
+        self._unsubscribe_job(j)
+        self.feeds = [f for f in self.feeds if f.job != name]
+        self.backfills = [b for b in self.backfills if b.job != name]
+        self._table_queues.pop(name, None)
+        self.meta.deregister_job(name)
+        self._dead_jobs.discard(name)
 
     def sink_of(self, name: str):
         """The live Sink instance of a sink job (inspection/testing)."""
         job = self.jobs.get(name)
         return getattr(job.pipeline, "sink", None) if job else None
+
+    # ------------------------------------------------- scoped job recovery --
+
+    def kill_job(self, name: str) -> None:
+        """Chaos/test hook: hard-kill a job's actor task mid-flight (the
+        madsim node-kill analogue). Nothing is cleaned up here — detection
+        is the heartbeat detector's duty and restoration is
+        ``_recover_job``'s (reference: madsim kill,
+        src/tests/simulation/src/cluster.rs:498-510)."""
+        job = self.jobs[name]
+        if job._task is not None:
+            job._task.cancel()
+
+    def _downstream_names(self, job: StreamJob) -> list[str]:
+        """Names of jobs transitively fed by ``job``'s bus."""
+        sub_queues = set(map(id, job.bus.subscribers))
+        out: list[str] = []
+        for n, j in self.jobs.items():
+            if any(id(q) in sub_queues for q in j.sources):
+                if n not in out:
+                    out.append(n)
+                    for m in self._downstream_names(j):
+                        if m not in out:
+                            out.append(m)
+        return out
+
+    def _recover_job(self, name: str) -> list[str]:
+        """Scoped recovery: rebuild a dead job (and its transitive
+        downstream MVs) from durable state at the last committed epoch,
+        WITHOUT restarting the session or touching unrelated jobs.
+
+        Mirrors the reference's recovery sequence
+        (src/meta/src/barrier/recovery.rs:110 — clean dirty state, rebuild
+        actors, re-seek sources) scoped to one job subtree: torn staged
+        writes are discarded, executors reload state tables at the last
+        commit, and source readers seek their checkpointed offsets, so the
+        rebuilt subtree replays exactly the rows lost since that commit.
+        Only MV jobs are scoped-recoverable; a subtree containing a table
+        or sink job falls back to requiring a session restart (state is
+        durable). Returns the recovered subtree's job names (the caller
+        dedups overlapping recovery requests with it)."""
+        job = self.jobs.get(name)
+        if job is None:
+            return [name]
+        # drain pipelined epochs first: the rebuilt jobs will only see
+        # barriers from the NEXT injection on, so nothing may stay in
+        # flight across the rebuild (dead jobs are tolerated by collect)
+        self._drain_inflight()
+        subtree = [name] + self._downstream_names(job)
+        non_mv = [n for n in subtree if n not in self.catalog.mvs]
+        if non_mv:
+            raise RuntimeError(
+                f"job {name!r} died and its subtree {subtree} contains "
+                f"non-MV jobs {non_mv}; scoped recovery covers MV jobs — "
+                "restart the session to restore from durable state")
+        for n in subtree:
+            j = self.jobs.pop(n, None)
+            if j is None:
+                continue
+            self._teardown_job(n, j)
+            mv = self.catalog.mvs[n]
+            rng = getattr(mv, "table_id_range", None)
+            if rng is not None:
+                self.store.discard_pending_tables(range(*rng))
+        # rebuild in creation order (upstream MVs before their readers)
+        for n in [m for m in self.catalog.mvs if m in subtree]:
+            self._rebuild_mv_job(n)
+        self.meta.notifications.notify(
+            "recovery", {"jobs": subtree, "epoch": self.epoch})
+        return subtree
+
+    def _rebuild_mv_job(self, name: str) -> None:
+        """Rebuild one MV job from its catalog definition over existing
+        durable state (the reschedule rebuild core, without a config
+        change): table ids replay deterministically, ``_recovering`` makes
+        executors reload state instead of snapshotting upstreams, and
+        source readers seek their checkpointed offsets."""
+        mv = self.catalog.mvs[name]
+        id0, id1 = mv.table_id_range  # type: ignore[attr-defined]
+        ids = iter(range(id0, id1))
+        saved_alloc = self.catalog.next_table_id
+        saved_recovering = self._recovering
+
+        def replay_id() -> int:
+            try:
+                return next(ids)
+            except StopIteration:
+                raise RuntimeError(
+                    "recovery id replay diverged from the original build")
+
+        self.catalog.next_table_id = replay_id  # type: ignore[assignment]
+        self._recovering = True
+        n_feeds0 = len(self.feeds)
+        n_bf0 = len(self.backfills)
+        try:
+            (plan, pipeline, ctx, queues, init_msgs,
+             _slq) = self._build_query_pipeline(mv.query_ast)  # type: ignore[attr-defined]
+            mv_table_id = self.catalog.next_table_id()
+            mat = MaterializeExecutor(
+                pipeline,
+                StateTable(self.store, mv_table_id, plan.schema,
+                           list(plan.pk)))
+        finally:
+            self.catalog.next_table_id = saved_alloc  # type: ignore[assignment]
+            self._recovering = saved_recovering
+        for f in self.feeds[n_feeds0:]:
+            f.job = name
+        for b in self.backfills[n_bf0:]:
+            b.job = name
+        job = StreamJob(name, mat, queues, actors=ctx.actors)
+        self.jobs[name] = job
+        job.start(self.loop)
+        for q, init in init_msgs:
+            for m in init:
+                q.push(m)
+            q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
 
     def _stream_leaf(self, leaf):
         """-> (executor, session_driven_queue_or_None, init_messages)"""
@@ -769,16 +935,35 @@ class Session:
             up_job = self.jobs[name]
             q = QueueSource(leaf.schema)
             up_job.bus.subscribe(q)
-            if self._recovering:
-                # recovered executor state already reflects the upstream
-                # through the committed epoch — no backfill snapshot
-                snapshot = []
-            else:
-                snapshot = up_job.snapshot_messages(
-                    Barrier.new(self.epoch), self.source_chunk_capacity)
+            # CONCURRENT backfill (reference: executor/backfill.rs:48-69):
+            # the upstream's durable table is snapshot-read in bounded
+            # batches across barriers while live deltas keep flowing —
+            # creating an MV over a huge upstream never stalls an epoch.
+            # The progress table makes it crash-resumable; on recovery the
+            # persisted cursor/done flag decides (done => pass-through,
+            # matching the old recovered-state semantics; empty progress
+            # after a create-but-never-checkpointed crash => fresh
+            # backfill, subsuming _maybe_rebackfill for scan leaves).
+            from ..stream.backfill import BackfillExecutor
+            from ..stream.backfill import PROGRESS_SCHEMA as BF_PROGRESS
+            prog = StateTable(self.store, self.catalog.next_table_id(),
+                              BF_PROGRESS, [0])
+            meta = self.meta
+
+            def report(p, _name=name):
+                meta.notifications.notify(
+                    "backfill", {"job": _name, **p})
+
+            batch_rows = (self.config.backfill_batch_rows
+                          or max(self.source_chunk_capacity * 4, 4096))
+            bf = BackfillExecutor(
+                q, up_job.table, batch_rows=batch_rows,
+                chunk_capacity=self.source_chunk_capacity,
+                progress_table=prog, on_progress=report)
+            self.backfills.append(_BackfillRef(bf))
             # session does NOT drive this queue; upstream bus does. The
-            # snapshot + init barrier are pushed at creation.
-            return q, q, snapshot
+            # init barrier is pushed at creation (empty init list).
+            return bf, q, []
         if isinstance(leaf, PValues):
             q = QueueSource(leaf.schema)
             chunk = _values_chunk(leaf)
@@ -832,23 +1017,18 @@ class Session:
         obj = (self.catalog.tables.get(stmt.name)
                or self.catalog.mvs.get(stmt.name)
                or self.catalog.sinks.get(stmt.name))
-        existed = self.catalog.drop(stmt.kind, stmt.name, stmt.if_exists)
-        if existed and stmt.name in self.jobs:
-            job = self.jobs.pop(stmt.name)
-            sink = getattr(job.pipeline, "sink", None)
-            if sink is not None:
-                sink.close()
-            self._await(job.stop())
-            self._unsubscribe_job(job)
-            self._table_queues.pop(stmt.name, None)   # stop barrier pushes
+        existed = self.catalog_writer.drop(stmt.kind, stmt.name, stmt.if_exists)
         if existed:
-            # the job's source feeds die with it: stop generating, free
-            # their split-state tables
-            live, dead = [], []
-            for f in self.feeds:
-                (dead if f.job == stmt.name else live).append(f)
-            self.feeds = live
-            for f in dead:
+            # the job's source feeds die with it: free their split-state
+            # tables (collect BEFORE teardown filters them away)
+            dead_feeds = [f for f in self.feeds if f.job == stmt.name]
+            if stmt.name in self.jobs:
+                job = self.jobs.pop(stmt.name)
+                # full shared teardown: also clears _dead_jobs / worker
+                # registry — a dropped dead job's name must not poison a
+                # future job of the same name
+                self._teardown_job(stmt.name, job)
+            for f in dead_feeds:
                 if f.state_table is not None:
                     self.store.drop_table(f.state_table.table_id)
         if existed and obj is not None:
@@ -1013,12 +1193,19 @@ class Session:
         epoch = self._injected + 1
         if checkpoint is None:
             checkpoint = epoch % self.checkpoint_frequency == 0
+        # keep the worker registry in sync with the live job set (workers
+        # register with last_heartbeat = the current epoch clock)
+        self.meta.sync_jobs(self.jobs.keys())
         if mutation is None and self._pending_mutation is not None:
             mutation = self._pending_mutation
             self._pending_mutation = None
         barrier = Barrier.new(epoch, checkpoint=checkpoint, mutation=mutation)
         if generate and not self.paused:
             for feed in self.feeds:
+                if feed.job in self._dead_jobs:
+                    # a dead job consumes nothing: advancing its reader
+                    # would move offsets past rows it never processed
+                    continue
                 for _ in range(self.chunks_per_tick):
                     chunk = feed.generator()
                     if chunk is not None:
@@ -1035,19 +1222,66 @@ class Session:
         self._inflight.append((epoch, checkpoint))
         import time as _time
         self._inject_time[epoch] = _time.perf_counter()
-        while len(self._inflight) >= self.in_flight_barriers:
+        # pipelined barriers would let an upstream run AHEAD of an active
+        # backfill's snapshot reads (the scan would see a later epoch's
+        # staged rows and the same update would also arrive as a delta —
+        # double-apply). While any backfill is in flight, barriers
+        # complete synchronously; completed backfills free pipelining.
+        self.backfills = [b for b in self.backfills if not b.bf.done]
+        limit = 1 if self.backfills else self.in_flight_barriers
+        while len(self._inflight) >= limit:
             self._complete_oldest()
+        # failure detection + scoped recovery (reference: heartbeat expiry
+        # manager/cluster.rs:320-344 → recovery barrier/recovery.rs:110):
+        # the TTL detector declares jobs that stopped heartbeating DOWN;
+        # its listeners queue them and recovery runs here, outside the
+        # collect path
+        if not self._recovering:
+            self.meta.check_job_failures()
+            if self._jobs_to_recover:
+                # a dead job's downstreams expire with it (barrier
+                # starvation). Recover only subtree ROOTS — each root's
+                # recovery rebuilds its whole downstream subtree, and
+                # expiry order is not topological (the detector iterates a
+                # registry), so covered names must be dropped, not just
+                # deduped after the fact.
+                pending = list(dict.fromkeys(self._jobs_to_recover))
+                self._jobs_to_recover.clear()
+                covered: set[str] = set()
+                for m in pending:
+                    j = self.jobs.get(m)
+                    if j is not None:
+                        covered.update(self._downstream_names(j))
+                recovered: set[str] = set()
+                for n in pending:
+                    if n in covered or n in recovered:
+                        continue
+                    recovered.update(self._recover_job(n))
         return self.epoch
 
     def _complete_oldest(self) -> None:
         e, ckpt = self._inflight.pop(0)
         self._await(self._collect_barrier(e))
+        if ckpt and self._dead_jobs:
+            # a dead job may have staged a torn subset of its tables for an
+            # epoch whose checkpoint it never finished — keep those buffers
+            # out of this commit (recovery reloads from the last good one)
+            for n in self._dead_jobs:
+                mv = self.catalog.mvs.get(n)
+                rng = getattr(mv, "table_id_range", None) if mv else None
+                if rng is not None:
+                    self.store.discard_pending_tables(range(*rng))
         if ckpt:
             # persist source split offsets atomically with the epoch commit
             # (reference: split state committed with the checkpoint barrier)
             from ..common.types import VARCHAR
             for feed in self.feeds:
                 if feed.state_table is None:
+                    continue
+                if feed.job in self._dead_jobs:
+                    # freeze the dead job's offsets at its last completed
+                    # checkpoint: its state did not advance, so persisting
+                    # newer offsets would silently skip the rows in between
                     continue
                 latest = None
                 for oe in sorted(list(feed.offsets_at_epoch)):
@@ -1064,6 +1298,12 @@ class Session:
         if t0 is not None:
             self.barrier_latency.record(_time.perf_counter() - t0)
         self.epoch = e
+        # control-plane publication (reference: barrier_complete responses +
+        # hummock version notifications, SURVEY.md §3.2 tail)
+        self.meta.advance_epoch_clock(e)
+        self.meta.publish_barrier(e, ckpt)
+        if ckpt:
+            self.meta.publish_checkpoint(e)
 
     def _drain_inflight(self) -> None:
         while self._inflight:
@@ -1071,9 +1311,39 @@ class Session:
 
     async def _collect_barrier(self, epoch: int) -> None:
         # gather must be created inside the session loop (it binds futures
-        # to the running loop)
+        # to the running loop). Each job that reports the barrier heartbeats
+        # its worker entry; a job whose actor task was KILLED (cancelled —
+        # the madsim node-kill analogue) stops heartbeating and is left to
+        # the TTL detector + scoped recovery, while executor logic errors
+        # keep propagating to the caller as before.
+        #
+        # Downstreams of a dead job are BARRIER-STARVED (nothing upstream
+        # will ever forward this epoch's barrier): waiting on them would
+        # deadlock the conductor, so they are skipped — and since skipping
+        # also withholds their heartbeat, the TTL detector declares the
+        # whole subtree DOWN and scoped recovery rebuilds it together.
+        dead = {n for n, j in self.jobs.items()
+                if isinstance(j._failure, asyncio.CancelledError)}
+        self._dead_jobs |= dead
+        starved: set[str] = set()
+        for n in dead:
+            starved.update(self._downstream_names(self.jobs[n]))
+        starved -= dead
+
+        async def one(name: str, job: StreamJob) -> None:
+            if name in starved:
+                return
+            try:
+                await job.wait_barrier(epoch)
+            except BaseException:
+                if isinstance(job._failure, asyncio.CancelledError):
+                    self._dead_jobs.add(name)
+                    return
+                raise
+            self.meta.job_heartbeat(name)
+
         await asyncio.gather(
-            *(job.wait_barrier(epoch) for job in self.jobs.values()))
+            *(one(n, j) for n, j in self.jobs.items()))
 
     def flush(self) -> None:
         """FLUSH: complete a checkpoint epoch (DML + state made durable)."""
@@ -1109,17 +1379,27 @@ class Session:
         # top-n plans run as one-shot vectorized executors; stream-only
         # shapes (joins, windows, EOWC, DISTINCT aggs) fall through to the
         # stream-fold below
+        from ..batch.executors import BatchFallback, run_batch
         from ..batch.lower import lower_plan
-        lowered = lower_plan(plan, self.store)
+        try:
+            lowered = lower_plan(plan, self.store)
+        except BatchFallback:
+            lowered = None
         if lowered is not None:
-            from ..batch.executors import run_batch
-            phys = run_batch(lowered)
-            out = [
-                tuple(None if v is None else plan.schema[i].type.to_python(v)
-                      for i, v in enumerate(r))
-                for r in phys
-            ]
-            return self._present(out, sel, plan)
+            try:
+                phys = run_batch(lowered)
+            except BatchFallback:
+                # run-time shape the one-shot executors cannot serve
+                # (e.g. duplicate join build keys) — stream-fold below
+                phys = None
+            if phys is not None:
+                out = [
+                    tuple(None if v is None
+                          else plan.schema[i].type.to_python(v)
+                          for i, v in enumerate(r))
+                    for r in phys
+                ]
+                return self._present(out, sel, plan)
 
         def factory(leaf) -> Executor:
             if isinstance(leaf, (PTableScan, PMvScan)):

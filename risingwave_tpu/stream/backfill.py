@@ -1,0 +1,182 @@
+"""Concurrent backfill for MV-on-MV creation (VERDICT r3 item 8).
+
+Counterpart of the reference's BackfillExecutor
+(reference: src/stream/src/executor/backfill.rs:48-69 — snapshot-read the
+upstream in chunks while live deltas keep flowing, forward deltas only for
+the already-backfilled pk range, switch over when the snapshot is
+exhausted; progress reported to meta, src/meta/src/barrier/progress.rs).
+
+TPU-first shape: the upstream's durable StateTable is the snapshot source
+(its merged view advances with every commit, giving the per-epoch re-read
+the reference gets from Hummock epochs), the backfill cursor is the
+upstream's memcomparable pk key, and the delta filter is ONE vectorized
+mask per chunk — a lexicographic pk-tuple compare against the cursor
+values, evaluated on device, identical in order to the encoded-key cursor
+(common/row.py key encoding is order-preserving; VARCHAR pk columns
+compare by dictionary rank).
+
+Per barrier at most ``batch_rows`` snapshot rows are emitted, so creating
+an MV over a huge upstream never stalls the barrier loop for more than one
+batch. The cursor + done flag persist in a progress state table at
+checkpoints; recovery resumes mid-backfill (or passes straight through
+when done).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import StreamChunk, physical_chunk
+from ..common.types import Field, INT64, Schema, VARCHAR
+from ..storage.state_table import StateTable
+from .executor import Executor
+from .message import Barrier, Watermark
+
+#: progress row: (id, done, cursor-hex, rows_done)
+PROGRESS_SCHEMA = Schema((
+    Field("id", INT64), Field("done", INT64),
+    Field("cursor", VARCHAR), Field("rows_done", INT64),
+))
+
+
+class BackfillExecutor(Executor):
+    """``input``: the live-delta queue subscribed to the upstream bus.
+    ``upstream_table``: the upstream MV/table's durable StateTable."""
+
+    identity = "Backfill"
+
+    def __init__(
+        self,
+        input: Executor,
+        upstream_table: StateTable,
+        batch_rows: int = 4096,
+        chunk_capacity: int = 1024,
+        progress_table: Optional[StateTable] = None,
+        on_progress=None,
+    ):
+        self.input = input
+        self.schema = upstream_table.schema
+        self.upstream = upstream_table
+        self.pk_indices = tuple(upstream_table.pk_indices)
+        self.batch_rows = batch_rows
+        self.chunk_capacity = chunk_capacity
+        self.progress_table = progress_table
+        self.on_progress = on_progress
+        self.cursor: Optional[bytes] = None
+        self.cursor_row: Optional[tuple] = None   # pk values at the cursor
+        self.done = False
+        self.rows_done = 0
+        self._pk_is_string = tuple(
+            self.schema[i].type.is_string for i in self.pk_indices)
+        if progress_table is not None:
+            rows = list(progress_table.scan_all())
+            if rows:
+                _id, done, cur_hex, rows_done = rows[0]
+                self.done = bool(done)
+                self.rows_done = int(rows_done)
+                cur = VARCHAR.to_python(cur_hex)
+                self.cursor = bytes.fromhex(cur) if cur else None
+
+    # -- delta filtering -------------------------------------------------------
+
+    def _filter_delta(self, chunk: StreamChunk) -> StreamChunk:
+        """Visibility-mask rows whose pk is beyond the backfill cursor —
+        their current value will be read by a later snapshot batch
+        (backfill.rs "mark chunk" filtering)."""
+        if self.cursor_row is None:
+            if self.cursor is not None:
+                # resumed from a persisted cursor: its pk VALUES are not
+                # recoverable from the hex key, so re-read them lazily
+                self.cursor_row = self._decode_cursor()
+            if self.cursor_row is None:
+                return chunk.with_vis(jnp.zeros_like(chunk.vis))
+        le = jnp.zeros_like(chunk.vis)
+        eq = jnp.ones_like(chunk.vis)
+        for pos, i in enumerate(self.pk_indices):
+            col = chunk.columns[i]
+            d = col.data
+            cur = self.cursor_row[pos]
+            if self._pk_is_string[pos]:
+                from ..common.types import GLOBAL_STRING_DICT
+                t = GLOBAL_STRING_DICT.device_ranks()
+                n = t.shape[0]
+                d = t[jnp.clip(d.astype(jnp.int32), 0, n - 1)]
+                cur = int(t[min(int(cur), n - 1)])
+            le = le | (eq & (d < cur))
+            eq = eq & (d == cur)
+        mask = le | eq
+        return chunk.with_vis(chunk.vis & mask)
+
+    def _decode_cursor(self) -> Optional[tuple]:
+        """pk values at the persisted cursor key: scan one row up to the
+        cursor (the row AT the cursor may have been deleted since — any
+        row with key <= cursor gives a safe, possibly tighter bound)."""
+        if self.cursor is None:
+            return None
+        rows, last = self.upstream.scan_after(None, self.batch_rows)
+        best = None
+        while rows:
+            for r in rows:
+                if self.upstream.key_of(r) <= self.cursor:
+                    best = tuple(r[i] for i in self.pk_indices)
+                else:
+                    return best
+            rows, last = self.upstream.scan_after(last, self.batch_rows)
+        return best
+
+    # -- snapshot batches ------------------------------------------------------
+
+    def _emit_batch(self):
+        rows, last = self.upstream.scan_after(self.cursor, self.batch_rows)
+        if rows:
+            self.cursor = last
+            self.cursor_row = tuple(
+                rows[-1][i] for i in self.pk_indices)
+            self.rows_done += len(rows)
+        if len(rows) < self.batch_rows:
+            self.done = True
+        cap = self.chunk_capacity
+        for i in range(0, len(rows), cap):
+            yield physical_chunk(self.schema, rows[i:i + cap], cap)
+
+    def _persist(self, epoch: int) -> None:
+        if self.progress_table is None:
+            return
+        cur_hex = self.cursor.hex() if self.cursor is not None else ""
+        self.progress_table.insert(
+            (0, int(self.done), VARCHAR.to_physical(cur_hex),
+             self.rows_done))
+        self.progress_table.commit(epoch)
+
+    @property
+    def progress(self) -> dict:
+        return {"rows_done": self.rows_done, "done": self.done,
+                "total_estimate": len(self.upstream)}
+
+    # -- main loop -------------------------------------------------------------
+
+    async def execute(self):
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                if self.done:
+                    yield msg
+                else:
+                    filtered = self._filter_delta(msg)
+                    if bool(jnp.any(filtered.vis)):
+                        yield filtered
+            elif isinstance(msg, Barrier):
+                if not self.done:
+                    for out in self._emit_batch():
+                        yield out
+                    if self.on_progress is not None:
+                        self.on_progress(self.progress)
+                if msg.checkpoint:
+                    self._persist(msg.epoch.curr)
+                yield msg
+                if msg.is_stop():
+                    return
+            elif isinstance(msg, Watermark):
+                yield msg

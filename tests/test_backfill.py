@@ -1,0 +1,126 @@
+"""Concurrent backfill with progress (VERDICT r3 item 8).
+
+Creating an MV over a large upstream proceeds in bounded per-barrier
+batches while the upstream keeps ticking; live deltas for already-
+backfilled pks flow immediately; progress is published via meta
+notifications; a crash mid-backfill resumes from the persisted cursor
+(reference: executor/backfill.rs:48-69, barrier/progress.rs).
+"""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+
+def _big_table_session(n_rows=2000, **kw):
+    from risingwave_tpu.frontend.build import BuildConfig
+    s = Session(source_chunk_capacity=64, checkpoint_frequency=2,
+                config=BuildConfig(backfill_batch_rows=256), **kw)
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    for lo in range(0, n_rows, 500):
+        vals = ", ".join(f"({k}, {k % 7}, {k})"
+                         for k in range(lo, min(lo + 500, n_rows)))
+        s.run_sql(f"INSERT INTO t VALUES {vals}")
+        s.flush()
+    return s
+
+
+class TestConcurrentBackfill:
+    def test_large_upstream_backfills_across_barriers(self):
+        s = _big_table_session()
+        progress = []
+        s.meta.notifications.subscribe(
+            "backfill", lambda v, i: progress.append(i))
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, count(*) AS n, sum(v) AS sv FROM t GROUP BY g")
+        # batch_rows = 4*64 = 256 < 2000 rows: backfill MUST span barriers
+        assert progress and not progress[-1]["done"]
+        while not progress[-1]["done"]:
+            s.tick()
+        s.flush()
+        got = {r[0]: r for r in s.mv_rows("m")}
+        for g in range(7):
+            ks = [k for k in range(2000) if k % 7 == g]
+            assert got[g] == (g, len(ks), sum(ks))
+        # multiple bounded batches were reported, monotonically
+        dones = [p["rows_done"] for p in progress]
+        assert len(dones) >= 4 and dones == sorted(dones)
+        s.close()
+
+    def test_live_deltas_during_backfill_are_exact(self):
+        s = _big_table_session()
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, count(*) AS n, sum(v) AS sv FROM t GROUP BY g")
+        # mutate rows at BOTH ends of the key space mid-backfill: k=0 is
+        # already backfilled (delta must flow), k=1999 is not yet (its new
+        # value must arrive via a later snapshot batch, not twice)
+        s.run_sql("UPDATE t SET v = 100000 WHERE k = 0")
+        s.run_sql("UPDATE t SET v = 200000 WHERE k = 1999")
+        for _ in range(12):
+            s.tick()
+        s.flush()
+        got = {r[0]: r for r in s.mv_rows("m")}
+        ks0 = [k for k in range(2000) if k % 7 == 0]
+        want_sv0 = sum(ks0) - 0 + 100000
+        g1999 = 1999 % 7
+        ks1 = [k for k in range(2000) if k % 7 == g1999]
+        want_sv1 = sum(ks1) - 1999 + 200000
+        assert got[0] == (0, len(ks0), want_sv0)
+        assert got[g1999] == (g1999, len(ks1), want_sv1)
+        s.close()
+
+    def test_pipelined_barriers_with_live_updates_stay_exact(self):
+        """With in_flight_barriers > 1 an upstream could run ahead of the
+        backfill's snapshot reads (double-apply hazard, r4 review): the
+        session pins barriers to synchronous completion while a backfill
+        is active, so updates land exactly once."""
+        from risingwave_tpu.frontend.build import BuildConfig
+        s = Session(source_chunk_capacity=64, checkpoint_frequency=2,
+                    in_flight_barriers=4,
+                    config=BuildConfig(backfill_batch_rows=128))
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT, "
+                  "v BIGINT)")
+        vals = ", ".join(f"({k}, {k % 5}, {k})" for k in range(800))
+        s.run_sql(f"INSERT INTO t VALUES {vals}")
+        s.flush()
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, count(*) AS n, sum(v) AS sv FROM t GROUP BY g")
+        # mutate while backfilling, under a pipelined barrier budget
+        s.run_sql("UPDATE t SET v = 1000000 WHERE k = 0")
+        s.run_sql("UPDATE t SET v = 2000000 WHERE k = 799")
+        for _ in range(12):
+            s.tick()
+        s.flush()
+        got = {r[0]: r for r in s.mv_rows("m")}
+        ks0 = [k for k in range(800) if k % 5 == 0]
+        ks4 = [k for k in range(800) if k % 5 == 799 % 5]
+        assert got[0] == (0, len(ks0), sum(ks0) - 0 + 1000000)
+        assert got[799 % 5] == (799 % 5, len(ks4),
+                                sum(ks4) - 799 + 2000000)
+        s.close()
+
+    def test_crash_mid_backfill_resumes_from_cursor(self, tmp_path):
+        d = str(tmp_path / "db")
+        s = _big_table_session(n_rows=1500, data_dir=d)
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT g, count(*) AS n, sum(v) AS sv FROM t GROUP BY g")
+        # advance a couple of checkpoints so a mid-backfill cursor persists
+        s.tick()
+        s.tick()
+        s._drain_inflight()
+        s.close()
+
+        from risingwave_tpu.frontend.build import BuildConfig
+        s2 = Session(source_chunk_capacity=64, checkpoint_frequency=2,
+                     config=BuildConfig(backfill_batch_rows=256), data_dir=d)
+        progress = []
+        s2.meta.notifications.subscribe(
+            "backfill", lambda v, i: progress.append(i))
+        for _ in range(30):
+            s2.tick()
+        s2.flush()
+        got = {r[0]: r for r in s2.mv_rows("m")}
+        for g in range(7):
+            ks = [k for k in range(1500) if k % 7 == g]
+            assert got[g] == (g, len(ks), sum(ks))
+        s2.close()
