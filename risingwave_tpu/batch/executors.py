@@ -240,7 +240,8 @@ class BatchHashJoin(BatchExecutor):
                  left_keys: Sequence[int], right_keys: Sequence[int],
                  join_type: str = "inner",
                  condition: Optional[Expr] = None,
-                 table_capacity: int = 1 << 16):
+                 table_capacity: int = 1 << 16,
+                 prefer_build: str = "right"):
         if join_type not in ("inner", "left"):
             raise BatchFallback(f"batch join type {join_type!r}")
         self.left, self.right = left, right
@@ -249,6 +250,10 @@ class BatchHashJoin(BatchExecutor):
         self.join_type = join_type
         self.condition = condition
         self.capacity = table_capacity
+        # plan-time hint (pk covers the join key ⇒ provably unique):
+        # avoids a wasted trial build; left joins always build right
+        self.prefer_build = (prefer_build if join_type == "inner"
+                             else "right")
         self.schema = Schema(tuple(left.schema) + tuple(right.schema))
         self._eager = condition is not None and uses_host_callback(condition)
         self._steps = {}    # swapped -> (build_step, probe_step)
@@ -334,11 +339,14 @@ class BatchHashJoin(BatchExecutor):
         return (None if bool(bad) else (table, cols_acc, masks_acc))
 
     def execute_chunks(self):
-        built = self._try_build(self.right, swapped=False)
-        swapped = False
+        first_swapped = self.prefer_build == "left"
+        swapped = first_swapped
+        built = self._try_build(
+            self.left if first_swapped else self.right, swapped)
         if built is None and self.join_type == "inner":
-            built = self._try_build(self.left, swapped=True)
-            swapped = True
+            swapped = not first_swapped
+            built = self._try_build(
+                self.left if swapped else self.right, swapped)
         if built is None:
             raise BatchFallback(
                 "batch hash join needs a unique-keyed build side within "

@@ -52,9 +52,20 @@ def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
         right = lower_plan(plan.right, store)
         if left is None or right is None:
             return None
+        # pick the build side STATICALLY when pk metadata proves
+        # uniqueness, so no trial build is wasted at run time (the
+        # runtime dup check stays as a safety net)
+        r_unique = bool(plan.right.pk) and \
+            set(plan.right.pk) <= set(plan.right_keys)
+        l_unique = bool(plan.left.pk) and \
+            set(plan.left.pk) <= set(plan.left_keys)
+        prefer = ("left" if (plan.kind == "inner"
+                             and not r_unique and l_unique)
+                  else "right")
         return BatchHashJoin(left, right, list(plan.left_keys),
                              list(plan.right_keys), join_type=plan.kind,
-                             condition=plan.condition)
+                             condition=plan.condition,
+                             prefer_build=prefer)
     if isinstance(plan, P.PTopN):
         if plan.with_ties or plan.group_by:
             return None

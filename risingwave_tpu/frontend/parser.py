@@ -509,6 +509,11 @@ class Parser:
                 alias = self.next().value
             return A.SubqueryRef(q, alias)
         name = self.ident()
+        # qualified relation names (pg_catalog.pg_tables,
+        # information_schema.columns, …)
+        while self.at_op("."):
+            self.next()
+            name += "." + self.ident()
         if self.at_op("("):
             # FROM table_function(args), e.g. generate_series(1, 10)
             self.next()

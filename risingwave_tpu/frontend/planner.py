@@ -385,6 +385,19 @@ class Planner:
         raise PlanError(f"unsupported relation {type(rel).__name__}")
 
     def _plan_table_ref(self, ref: A.TableRef):
+        # system catalogs (pg_catalog / information_schema / rw_catalog)
+        # resolve before user relations, served as constant VALUES from
+        # the live catalog (reference: frontend system_catalog/)
+        from .system_catalog import system_relation
+        sysrel = system_relation(self.catalog, ref.name)
+        if sysrel is not None:
+            schema, rows = sysrel
+            lit_rows = tuple(
+                tuple(Literal(v, f.type) for v, f in zip(r, schema))
+                for r in rows)
+            alias = ref.alias or ref.name.rsplit(".", 1)[-1]
+            node = PValues(schema=schema, pk=(), rows=lit_rows)
+            return node, Scope.of_schema(schema, alias)
         kind, d = self.catalog.resolve_relation(ref.name)
         alias = ref.alias or ref.name
         if kind == "source":

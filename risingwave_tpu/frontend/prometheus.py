@@ -1,0 +1,108 @@
+"""Prometheus text-format metrics endpoint.
+
+Counterpart of the reference's per-node Prometheus exporters
+(reference: src/stream/src/executor/monitor/streaming_stats.rs:27-88 —
+barrier latency / actor exec counters scraped by the generated Grafana
+dashboards, docs/metrics.md semantics). ``render_metrics`` flattens
+``Session.metrics()`` into the exposition format; ``serve_metrics``
+mounts it on a tiny threaded HTTP server at ``/metrics`` so a stock
+Prometheus scrape config works against a playground session.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Optional
+
+
+def _sanitize(s: str) -> str:
+    out = []
+    for ch in str(s):
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    return "".join(out)
+
+
+def render_metrics(session) -> str:
+    """Session.metrics() → Prometheus exposition text."""
+    m = session.metrics()
+    lines = [
+        "# HELP rw_epoch Last completed epoch.",
+        "# TYPE rw_epoch counter",
+        f"rw_epoch {m['epoch']}",
+    ]
+    lat = m.get("barrier_latency") or {}
+    lines += ["# HELP rw_barrier_latency_ms Barrier inject-to-collect "
+              "latency percentile (windowed).",
+              "# TYPE rw_barrier_latency_ms gauge"]
+    for key, q in (("p50_ms", "0.5"), ("p90_ms", "0.9"), ("p99_ms", "0.99")):
+        v = lat.get(key)
+        if v is not None:
+            lines.append(
+                f'rw_barrier_latency_ms{{quantile="{q}"}} {v}')
+    lines += ["# HELP rw_executor_counter Per-executor streaming counters.",
+              "# TYPE rw_executor_counter counter"]
+    for job, pipeline in (m.get("jobs") or {}).items():
+        for ident, stats in pipeline.items():
+            for name, value in stats.items():
+                if not isinstance(value, (int, float)):
+                    continue
+                lines.append(
+                    f'rw_executor_counter{{job="{_sanitize(job)}",'
+                    f'executor="{_sanitize(ident)}",'
+                    f'counter="{_sanitize(name)}"}} {value}')
+    lines += ["# HELP rw_state_bytes Device-state bytes per job.",
+              "# TYPE rw_state_bytes gauge"]
+    for job, nbytes in (m.get("state_bytes") or {}).items():
+        total = nbytes if isinstance(nbytes, (int, float)) else \
+            sum(v for v in nbytes.values()
+                if isinstance(v, (int, float)))
+        lines.append(f'rw_state_bytes{{job="{_sanitize(job)}"}} {total}')
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Threaded /metrics endpoint over a live Session."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        sess = session
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):       # noqa: N802 - stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = render_metrics(sess).encode()
+                except Exception as e:   # session mid-shutdown
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-endpoint")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_metrics(session, host: str = "127.0.0.1",
+                  port: int = 0) -> MetricsServer:
+    return MetricsServer(session, host, port)

@@ -688,6 +688,7 @@ class Session:
                 # feed/subscription side effects first.
                 rollback_error = e1
                 self.feeds = self.feeds[:n_feeds0]
+                self.backfills = self.backfills[:n_bf0]
                 for n, subs in bus_subs0.items():
                     self.jobs[n].bus.subscribers = list(subs)
                 self.config = saved_config
@@ -703,6 +704,7 @@ class Session:
                     # durable state + catalog remain — a restart's
                     # recovery replay restores the jobs.
                     self.feeds = self.feeds[:n_feeds0]
+                    self.backfills = self.backfills[:n_bf0]
                     for n, subs in bus_subs0.items():
                         self.jobs[n].bus.subscribers = list(subs)
                     self.jobs.pop(name, None)
@@ -787,6 +789,25 @@ class Session:
         job = self.jobs[name]
         if job._task is not None:
             job._task.cancel()
+
+    def _job_state_ids(self, name: str) -> list[int]:
+        """Every state-table id a job (MV / table / sink) writes."""
+        mv = self.catalog.mvs.get(name)
+        if mv is not None:
+            rng = getattr(mv, "table_id_range", None)
+            if rng is not None:
+                return list(range(*rng))
+        obj = (self.catalog.tables.get(name)
+               or self.catalog.sinks.get(name))
+        if obj is None:
+            return []
+        ids = [obj.table_id]
+        ids += [tid for tid in getattr(obj, "state_table_ids", ())
+                if tid >= 0]
+        prog = getattr(obj, "progress_table_id", -1)
+        if prog >= 0:
+            ids.append(prog)
+        return ids
 
     def _downstream_names(self, job: StreamJob) -> list[str]:
         """Names of jobs transitively fed by ``job``'s bus."""
@@ -1265,12 +1286,11 @@ class Session:
         if ckpt and self._dead_jobs:
             # a dead job may have staged a torn subset of its tables for an
             # epoch whose checkpoint it never finished — keep those buffers
-            # out of this commit (recovery reloads from the last good one)
+            # out of this commit (recovery reloads from the last good one).
+            # Covers EVERY job kind: a killed table/sink job's torn epoch
+            # must not become durable either.
             for n in self._dead_jobs:
-                mv = self.catalog.mvs.get(n)
-                rng = getattr(mv, "table_id_range", None) if mv else None
-                if rng is not None:
-                    self.store.discard_pending_tables(range(*rng))
+                self.store.discard_pending_tables(self._job_state_ids(n))
         if ckpt:
             # persist source split offsets atomically with the epoch commit
             # (reference: split state committed with the checkpoint barrier)

@@ -1,0 +1,90 @@
+"""System catalogs: pg_catalog / information_schema / rw_catalog views.
+
+Counterpart of the reference's frontend system catalogs
+(reference: src/frontend/src/catalog/system_catalog/ — pg_catalog,
+information_schema and rw_catalog tables BI tools introspect through).
+Served as constant VALUES plans materialized from the live catalog at
+plan time — a batch SELECT over them reads a consistent snapshot, the
+same way the reference serves them from the frontend catalog cache.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.types import INT64, Field, Schema, VARCHAR
+
+#: relation name (lowercase, optionally qualified) → builder(catalog)
+_SCHEMA_STR = "public"
+
+
+def _pg_tables(catalog):
+    schema = Schema.of(("schemaname", VARCHAR), ("tablename", VARCHAR),
+                       ("tableowner", VARCHAR))
+    rows = [(_SCHEMA_STR, name, "root") for name in catalog.tables]
+    rows += [(_SCHEMA_STR, name, "root") for name in catalog.sources]
+    return schema, rows
+
+
+def _pg_matviews(catalog):
+    schema = Schema.of(("schemaname", VARCHAR), ("matviewname", VARCHAR),
+                       ("definition", VARCHAR))
+    rows = [(_SCHEMA_STR, name, mv.definition or "")
+            for name, mv in catalog.mvs.items()]
+    return schema, rows
+
+
+def _info_tables(catalog):
+    schema = Schema.of(("table_schema", VARCHAR), ("table_name", VARCHAR),
+                       ("table_type", VARCHAR))
+    rows = [(_SCHEMA_STR, n, "BASE TABLE") for n in catalog.tables]
+    rows += [(_SCHEMA_STR, n, "SYSTEM SOURCE") for n in catalog.sources]
+    rows += [(_SCHEMA_STR, n, "MATERIALIZED VIEW") for n in catalog.mvs]
+    return schema, rows
+
+
+def _info_columns(catalog):
+    schema = Schema.of(
+        ("table_schema", VARCHAR), ("table_name", VARCHAR),
+        ("column_name", VARCHAR), ("ordinal_position", INT64),
+        ("data_type", VARCHAR))
+    rows = []
+    for reg in (catalog.tables, catalog.sources, catalog.mvs):
+        for name, d in reg.items():
+            n_vis = getattr(d, "n_visible", len(d.schema))
+            for i, f in enumerate(d.schema):
+                if i >= n_vis or f.name.startswith("_"):
+                    continue
+                rows.append((_SCHEMA_STR, name, f.name, i + 1,
+                             f.type.kind.value))
+    return schema, rows
+
+
+def _rw_relations(catalog):
+    schema = Schema.of(("name", VARCHAR), ("kind", VARCHAR))
+    rows = [(n, "table") for n in catalog.tables]
+    rows += [(n, "source") for n in catalog.sources]
+    rows += [(n, "materialized view") for n in catalog.mvs]
+    rows += [(n, "sink") for n in catalog.sinks]
+    rows += [(n, "index") for n in catalog.indexes]
+    return schema, rows
+
+
+_RELATIONS = {
+    "pg_tables": _pg_tables,
+    "pg_catalog.pg_tables": _pg_tables,
+    "pg_matviews": _pg_matviews,
+    "pg_catalog.pg_matviews": _pg_matviews,
+    "information_schema.tables": _info_tables,
+    "information_schema.columns": _info_columns,
+    "rw_relations": _rw_relations,
+    "rw_catalog.rw_relations": _rw_relations,
+}
+
+
+def system_relation(catalog, name: str) -> Optional[tuple]:
+    """(Schema, rows) for a system view name, or None."""
+    builder = _RELATIONS.get(name.lower())
+    if builder is None:
+        return None
+    return builder(catalog)

@@ -35,10 +35,15 @@ from ..storage.state_table import StateTable
 from .executor import Executor
 from .message import Barrier, Watermark
 
-#: progress row: (id, done, cursor-hex, rows_done)
+#: progress row: (id, done, cursor-hex, cursor-pk-values-json, rows_done).
+#: The pk VALUES are persisted alongside the encoded cursor so a resumed
+#: backfill filters deltas at EXACTLY the snapshot boundary — re-deriving
+#: them from surviving rows would drift below the cursor when the row at
+#: the cursor was deleted, silently masking deltas in the gap.
 PROGRESS_SCHEMA = Schema((
     Field("id", INT64), Field("done", INT64),
-    Field("cursor", VARCHAR), Field("rows_done", INT64),
+    Field("cursor", VARCHAR), Field("cursor_pks", VARCHAR),
+    Field("rows_done", INT64),
 ))
 
 
@@ -74,11 +79,20 @@ class BackfillExecutor(Executor):
         if progress_table is not None:
             rows = list(progress_table.scan_all())
             if rows:
-                _id, done, cur_hex, rows_done = rows[0]
+                import json
+                _id, done, cur_hex, cur_pks, rows_done = rows[0]
                 self.done = bool(done)
                 self.rows_done = int(rows_done)
                 cur = VARCHAR.to_python(cur_hex)
                 self.cursor = bytes.fromhex(cur) if cur else None
+                pks = VARCHAR.to_python(cur_pks)
+                if pks:
+                    # persisted as LOGICAL values (dictionary ids are not
+                    # process-stable); re-encode into this process
+                    pk_types = [self.schema[i].type for i in self.pk_indices]
+                    self.cursor_row = tuple(
+                        t.to_physical(v)
+                        for t, v in zip(pk_types, json.loads(pks)))
 
     # -- delta filtering -------------------------------------------------------
 
@@ -87,12 +101,7 @@ class BackfillExecutor(Executor):
         their current value will be read by a later snapshot batch
         (backfill.rs "mark chunk" filtering)."""
         if self.cursor_row is None:
-            if self.cursor is not None:
-                # resumed from a persisted cursor: its pk VALUES are not
-                # recoverable from the hex key, so re-read them lazily
-                self.cursor_row = self._decode_cursor()
-            if self.cursor_row is None:
-                return chunk.with_vis(jnp.zeros_like(chunk.vis))
+            return chunk.with_vis(jnp.zeros_like(chunk.vis))
         le = jnp.zeros_like(chunk.vis)
         eq = jnp.ones_like(chunk.vis)
         for pos, i in enumerate(self.pk_indices):
@@ -109,23 +118,6 @@ class BackfillExecutor(Executor):
             eq = eq & (d == cur)
         mask = le | eq
         return chunk.with_vis(chunk.vis & mask)
-
-    def _decode_cursor(self) -> Optional[tuple]:
-        """pk values at the persisted cursor key: scan one row up to the
-        cursor (the row AT the cursor may have been deleted since — any
-        row with key <= cursor gives a safe, possibly tighter bound)."""
-        if self.cursor is None:
-            return None
-        rows, last = self.upstream.scan_after(None, self.batch_rows)
-        best = None
-        while rows:
-            for r in rows:
-                if self.upstream.key_of(r) <= self.cursor:
-                    best = tuple(r[i] for i in self.pk_indices)
-                else:
-                    return best
-            rows, last = self.upstream.scan_after(last, self.batch_rows)
-        return best
 
     # -- snapshot batches ------------------------------------------------------
 
@@ -145,10 +137,18 @@ class BackfillExecutor(Executor):
     def _persist(self, epoch: int) -> None:
         if self.progress_table is None:
             return
+        import json
         cur_hex = self.cursor.hex() if self.cursor is not None else ""
+        if self.cursor_row is not None:
+            pk_types = [self.schema[i].type for i in self.pk_indices]
+            pks = json.dumps([
+                t.to_python(v)
+                for t, v in zip(pk_types, self.cursor_row)])
+        else:
+            pks = ""
         self.progress_table.insert(
             (0, int(self.done), VARCHAR.to_physical(cur_hex),
-             self.rows_done))
+             VARCHAR.to_physical(pks), self.rows_done))
         self.progress_table.commit(epoch)
 
     @property

@@ -1,0 +1,54 @@
+"""Prometheus metrics endpoint (observability export — VERDICT r3
+missing #9): Session.metrics() rendered in exposition format and served
+over HTTP for a stock scrape config.
+"""
+
+import urllib.request
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.prometheus import render_metrics, serve_metrics
+
+DDL = """CREATE SOURCE bid (auction BIGINT, price BIGINT)
+    WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+
+def _session():
+    s = Session(source_chunk_capacity=64, checkpoint_frequency=2)
+    s.run_sql(DDL)
+    s.run_sql("CREATE MATERIALIZED VIEW m AS "
+              "SELECT auction, count(*) AS n FROM bid GROUP BY auction")
+    for _ in range(3):
+        s.tick()
+    s._drain_inflight()
+    return s
+
+
+def test_render_exposition_format():
+    s = _session()
+    text = render_metrics(s)
+    assert "rw_epoch " in text
+    assert 'rw_barrier_latency_ms{quantile="0.99"}' in text
+    assert 'rw_executor_counter{job="m"' in text
+    assert 'rw_state_bytes{job="m"}' in text
+    # every sample line is "name{labels} value" or "name value"
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        parts = line.rsplit(" ", 1)
+        assert len(parts) == 2 and float(parts[1]) >= 0
+    s.close()
+
+
+def test_http_scrape():
+    s = _session()
+    srv = serve_metrics(s)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "rw_epoch" in body and "rw_executor_counter" in body
+    finally:
+        srv.close()
+        s.close()

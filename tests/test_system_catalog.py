@@ -1,0 +1,49 @@
+"""System catalogs (pg_catalog / information_schema / rw_catalog —
+VERDICT r3 missing #8): BI-tool introspection over the live catalog.
+"""
+
+from risingwave_tpu.frontend import Session
+
+
+def _session():
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, name VARCHAR)")
+    s.run_sql("CREATE SOURCE src (auction BIGINT, price BIGINT) "
+              "WITH (connector = 'nexmark', nexmark_table = 'bid')")
+    s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k, name FROM t")
+    s.flush()
+    return s
+
+
+def test_pg_tables_and_matviews():
+    s = _session()
+    tables = sorted(r[1] for r in s.run_sql("SELECT * FROM pg_tables"))
+    assert tables == ["src", "t"]
+    mvs = [r[1] for r in s.run_sql("SELECT * FROM pg_catalog.pg_matviews")]
+    assert mvs == ["m"]
+    s.close()
+
+
+def test_information_schema():
+    s = _session()
+    kinds = dict(
+        (r[0], r[1]) for r in s.run_sql(
+            "SELECT table_name, table_type FROM information_schema.tables"))
+    assert kinds["t"] == "BASE TABLE"
+    assert kinds["m"] == "MATERIALIZED VIEW"
+    cols = sorted(s.run_sql(
+        "SELECT column_name, ordinal_position, data_type "
+        "FROM information_schema.columns WHERE table_name = 't'"))
+    assert cols == [("k", 1, "bigint"), ("name", 2, "varchar")]
+    s.close()
+
+
+def test_rw_relations_and_filtering():
+    s = _session()
+    got = dict(s.run_sql("SELECT name, kind FROM rw_catalog.rw_relations"))
+    assert got == {"t": "table", "src": "source",
+                   "m": "materialized view"}
+    only_mv = [r[0] for r in s.run_sql(
+        "SELECT name FROM rw_relations WHERE kind = 'materialized view'")]
+    assert only_mv == ["m"]
+    s.close()
