@@ -13,10 +13,10 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
-from ..common.chunk import StreamChunk, physical_chunk
+from ..common.chunk import OP_INSERT, StreamChunk, make_chunk
 from ..common.types import Schema
 from .base import SplitReader
-from .parsers import parse_csv_lines, parse_json_line
+from .parsers import parse_csv_lines, parse_debezium_line, parse_json_line
 
 
 class FileSourceReader(SplitReader):
@@ -79,7 +79,10 @@ class FileSourceReader(SplitReader):
             self._cache[split] = cached
         return cached[1]
 
-    def _read_split(self, split: str) -> List[tuple]:
+    def _read_split(self, split: str) -> tuple:
+        """-> (ops, rows): a changelog slice of the split. JSONL/CSV are
+        append-only (all Insert); debezium_json carries the CDC envelope's
+        ops (reference: src/connector/src/parser/debezium/)."""
         start = self._offsets[split]
         lines = self._lines(split)
         if self.fmt == "csv":
@@ -90,6 +93,18 @@ class FileSourceReader(SplitReader):
             header = lines[0] if lines else ""
             rows = parse_csv_lines("\n".join([header] + body), self.schema,
                                    has_header=True)
+            ops = [OP_INSERT] * len(rows)
+        elif self.fmt in ("debezium", "debezium_json"):
+            body = lines[start:start + self.rows_per_chunk]
+            ops, rows = [], []
+            for ln in body:
+                try:
+                    entries = parse_debezium_line(ln, self.schema)
+                except (ValueError, TypeError, KeyError):
+                    continue     # poisoned line: skip, still advance
+                for op, r in entries:
+                    ops.append(op)
+                    rows.append(r)
         else:
             body = lines[start:start + self.rows_per_chunk]
             rows = []
@@ -102,19 +117,22 @@ class FileSourceReader(SplitReader):
                     continue
                 if r is not None:
                     rows.append(r)
+            ops = [OP_INSERT] * len(rows)
         if body:
             self._offsets[split] = start + len(body)
-        return rows
+        return ops, rows
 
     def next_chunk(self) -> Optional[StreamChunk]:
         self._discover()
         # most-behind split first: deterministic given offsets alone
         for split in sorted(self._offsets,
                             key=lambda s: (self._offsets[s], s)):
-            rows = self._read_split(split)
+            ops, rows = self._read_split(split)
             if rows:
                 phys = [tuple(f.type.to_physical(v) if v is not None else None
                               for f, v in zip(self.schema, r)) for r in rows]
-                return physical_chunk(self.schema, phys,
-                                      max(self.rows_per_chunk, len(phys)))
+                return make_chunk(self.schema, phys, ops=ops,
+                                  capacity=max(self.rows_per_chunk,
+                                               len(phys)),
+                                  physical=True)
         return None

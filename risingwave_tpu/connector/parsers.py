@@ -78,3 +78,60 @@ def parse_csv_lines(text: str, schema: Schema,
             vals.append(None if raw in (None, "") else _coerce(raw, f.type.kind))
         rows.append(tuple(vals))
     return rows
+
+
+def parse_debezium_line(line: str,
+                        schema: Schema) -> List[tuple]:
+    """One Debezium-JSON change event → [(op, row), ...] changelog entries
+    (reference: src/connector/src/parser/debezium/ — the CDC envelope
+    {before, after, op}).
+
+    op mapping: c/r (create/snapshot-read) → Insert(after);
+    u (update) → UpdateDelete(before) + UpdateInsert(after);
+    d (delete) → Delete(before). Both the flat envelope and the Kafka
+    Connect wrapper ({"payload": {...}}) are accepted."""
+    from ..common.chunk import (
+        OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+    )
+    line = line.strip()
+    if not line:
+        return []
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError(f"debezium event is not an object: {line[:40]!r}")
+    payload = obj.get("payload", obj)
+    if not isinstance(payload, dict):
+        raise ValueError("debezium payload is not an object")
+
+    def row_of(img):
+        if not isinstance(img, dict):
+            raise ValueError("debezium row image is not an object")
+        return tuple(
+            _coerce(img.get(f.name), f.type.kind) for f in schema)
+
+    op = payload.get("op")
+    before, after = payload.get("before"), payload.get("after")
+    if op in ("c", "r") and after is not None:
+        return [(OP_INSERT, row_of(after))]
+    if op == "u" and after is not None:
+        if before is None:
+            # REPLICA IDENTITY DEFAULT emits updates without a before
+            # image: surface as an upsert insert (the reference's
+            # debezium-upsert mode; pk-keyed downstream dedups)
+            return [(OP_INSERT, row_of(after))]
+        return [(OP_UPDATE_DELETE, row_of(before)),
+                (OP_UPDATE_INSERT, row_of(after))]
+    if op == "d" and before is not None:
+        return [(OP_DELETE, row_of(before))]
+    raise ValueError(
+        f"malformed debezium event: op={op!r}, "
+        f"before={'set' if before is not None else None}, "
+        f"after={'set' if after is not None else None}")
+
+
+def parse_debezium_lines(text: str, schema: Schema) -> List[tuple]:
+    """Debezium-JSON lines → [(op, row), ...] changelog."""
+    out: List[tuple] = []
+    for line in text.splitlines():
+        out.extend(parse_debezium_line(line, schema))
+    return out

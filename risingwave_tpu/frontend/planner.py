@@ -398,8 +398,13 @@ class Planner:
             alias = ref.alias or ref.name.rsplit(".", 1)[-1]
             node = PValues(schema=schema, pk=(), rows=lit_rows)
             return node, Scope.of_schema(schema, alias)
-        kind, d = self.catalog.resolve_relation(ref.name)
-        alias = ref.alias or ref.name
+        # BI tools qualify user relations with the schema pg_tables
+        # reports ('public.t'): the catalog is keyed on bare names
+        name = ref.name
+        if name.startswith("public."):
+            name = name[len("public."):]
+        kind, d = self.catalog.resolve_relation(name)
+        alias = ref.alias or name
         if kind == "source":
             # hidden _row_id appended: the stream key of a keyless source
             # (reference: row_id_gen.rs + logical source planning)

@@ -373,6 +373,14 @@ class Session:
         if stmt.if_not_exists and stmt.name in self.catalog.sources:
             return []
         connector = str(stmt.with_options.get("connector", ""))
+        fmt = str(stmt.with_options.get("format", "")).lower()
+        if fmt in ("debezium", "debezium_json"):
+            # fail at DDL time, not first-MV-build time (same gate as
+            # _connector_reader — see the rationale there)
+            raise SqlError(
+                "format 'debezium_json' requires a source PRIMARY KEY, "
+                "which sources do not support yet; the parser is "
+                "available via connector.parsers/FileSourceReader")
         if connector == "nexmark":
             table = str(stmt.with_options.get("nexmark_table",
                                               stmt.with_options.get("table", "bid")))
@@ -1014,9 +1022,18 @@ class Session:
             path = src.options.get("path", src.options.get("posix_fs.root"))
             if not path:
                 raise SqlError("file source requires path option")
+            fmt = str(src.options.get("format", "jsonl")).lower()
+            if fmt in ("debezium", "debezium_json"):
+                # the parser/reader layer handles the CDC envelope, but
+                # routing its retractions needs a pk-keyed source stream —
+                # the session's sources are keyed by a GENERATED row id,
+                # so a Delete would target a key that was never inserted
+                raise SqlError(
+                    "format 'debezium_json' requires a source PRIMARY "
+                    "KEY, which sources do not support yet; the parser "
+                    "is available via connector.parsers/FileSourceReader")
             return FileSourceReader(
-                src.schema, str(path),
-                fmt=str(src.options.get("format", "jsonl")),
+                src.schema, str(path), fmt=fmt,
                 rows_per_chunk=self.source_chunk_capacity)
         if src.connector == "":
             return None

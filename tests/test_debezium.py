@@ -1,0 +1,106 @@
+"""Debezium-JSON CDC parsing (format-parser layer — VERDICT r3 missing
+#7): the {before, after, op} envelope becomes changelog entries, and the
+file source emits them as op-carrying chunks.
+
+pk-aware CDC sources (required to route these retractions through an MV)
+are follow-up work; the parser + reader layer here is the reference's
+src/connector/src/parser/debezium/ counterpart.
+"""
+
+import json
+
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, chunk_to_rows,
+)
+from risingwave_tpu.common.types import INT64, Schema, VARCHAR
+from risingwave_tpu.connector.filesource import FileSourceReader
+from risingwave_tpu.connector.parsers import (
+    parse_debezium_line, parse_debezium_lines,
+)
+
+SCHEMA = Schema.of(("id", INT64), ("name", VARCHAR))
+
+
+def _ev(op, before=None, after=None, wrap=False):
+    payload = {"op": op, "before": before, "after": after}
+    return json.dumps({"payload": payload} if wrap else payload)
+
+
+class TestEnvelope:
+    def test_create_read_update_delete(self):
+        out = parse_debezium_lines("\n".join([
+            _ev("c", after={"id": 1, "name": "a"}),
+            _ev("r", after={"id": 2, "name": "b"}),
+            _ev("u", before={"id": 1, "name": "a"},
+                after={"id": 1, "name": "a2"}),
+            _ev("d", before={"id": 2, "name": "b"}),
+        ]), SCHEMA)
+        ops = [op for op, _ in out]
+        assert ops == [OP_INSERT, OP_INSERT, OP_UPDATE_DELETE,
+                       OP_UPDATE_INSERT, OP_DELETE]
+        assert out[2][1][0] == 1 and out[3][1][0] == 1
+
+    def test_kafka_connect_wrapper_and_malformed(self):
+        (op, row), = parse_debezium_line(
+            _ev("c", after={"id": 7, "name": "x"}, wrap=True), SCHEMA)
+        assert op == OP_INSERT and row[0] == 7
+        import pytest
+        with pytest.raises(ValueError, match="malformed"):
+            parse_debezium_line(_ev("u", before=None, after=None), SCHEMA)
+
+    def test_beforeless_update_is_upsert_insert(self):
+        """REPLICA IDENTITY DEFAULT: op=u with before=null must not be
+        dropped — it surfaces as an upsert insert."""
+        (op, row), = parse_debezium_line(
+            _ev("u", before=None, after={"id": 3, "name": "n"}), SCHEMA)
+        assert op == OP_INSERT and row == (3, "n")
+
+    def test_non_object_lines_raise_value_error(self):
+        """Poisoned lines must raise the error class the file source
+        catches (never AttributeError, which would wedge the source)."""
+        import pytest
+        for bad in ("[1,2]", "123",
+                    '{"payload": {"op": "c", "after": "oops"}}'):
+            with pytest.raises(ValueError):
+                parse_debezium_line(bad, SCHEMA)
+
+    def test_create_source_gates_debezium_format(self, tmp_path):
+        from risingwave_tpu.frontend import Session
+        from risingwave_tpu.frontend.session import SqlError
+        import pytest
+        s = Session()
+        with pytest.raises(SqlError, match="PRIMARY KEY"):
+            s.run_sql(
+                "CREATE SOURCE c (id BIGINT, name VARCHAR) WITH ("
+                f"connector = 'file', path = '{tmp_path}', "
+                "format = 'debezium_json')")
+        s.close()
+
+    def test_public_qualified_relation_resolves(self):
+        from risingwave_tpu.frontend import Session
+        s = Session()
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY)")
+        s.run_sql("INSERT INTO t VALUES (1), (2)")
+        s.flush()
+        assert sorted(s.run_sql("SELECT k FROM public.t")) == [(1,), (2,)]
+        s.close()
+
+
+class TestFileSourceDebezium:
+    def test_reader_emits_changelog_ops(self, tmp_path):
+        p = tmp_path / "cdc.jsonl"
+        p.write_text("\n".join([
+            _ev("c", after={"id": 1, "name": "a"}),
+            _ev("u", before={"id": 1, "name": "a"},
+                after={"id": 1, "name": "a2"}),
+            _ev("d", before={"id": 1, "name": "a2"}),
+        ]) + "\n")
+        r = FileSourceReader(SCHEMA, str(p), fmt="debezium_json")
+        chunk = r.next_chunk()
+        rows = chunk_to_rows(chunk, SCHEMA, with_ops=True)
+        assert [op for op, _ in rows] == [
+            OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, OP_DELETE]
+        assert rows[1][1] == (1, "a") and rows[2][1] == (1, "a2")
+        # offsets are line-based: 3 lines consumed, replay-safe
+        assert sum(r.offsets.values()) == 3
+        assert r.next_chunk() is None
