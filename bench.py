@@ -39,9 +39,15 @@ import threading
 import time
 
 WATCHDOG_SECS = 1500
+# backend init either completes in seconds or is wedged on the tunnel —
+# a short init watchdog keeps a dead-tunnel retry cycle to minutes, not
+# 3 x 25 min
+INIT_WATCHDOG_SECS = 300
 TPU_ATTEMPTS = 3
 TPU_BACKOFFS = (60, 120)          # sleep between attempts
-PHASE_TIMEOUT = 1800              # per-subprocess wall clock
+# must exceed INIT_WATCHDOG_SECS + WATCHDOG_SECS with slack so the
+# child's diagnostic fail line always beats the parent's kill
+PHASE_TIMEOUT = 2100              # per-subprocess wall clock
 
 CHUNK = 4096
 WINDOW_US = 10_000_000  # 10s tumble as the q5 core window
@@ -339,7 +345,7 @@ if __name__ == "__main__":
         n = int(sys.argv[2])
         n7 = int(sys.argv[3])
         with_lat = len(sys.argv) > 4 and sys.argv[4] == "1"
-        watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+        watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
         import jax
@@ -348,6 +354,10 @@ if __name__ == "__main__":
         except Exception as e:
             _emit(_fail_line(f"jax backend init failed: {e!r}"))
             raise SystemExit(2)
+        watchdog.cancel()
+        watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+        watchdog.daemon = True
+        watchdog.start()
         try:
             run_phase(n, n7, with_lat)
         except Exception as e:

@@ -29,6 +29,7 @@ class FileSourceReader(SplitReader):
         self.rows_per_chunk = rows_per_chunk
         self.match_pattern = match_pattern
         self._offsets: Dict[str, int] = {}
+        self.dropped_events = 0      # unparseable debezium lines skipped
         # split → ((mtime_ns, size), line list): re-read only when the
         # file changed, not on every chunk
         self._cache: Dict[str, tuple] = {}
@@ -100,8 +101,16 @@ class FileSourceReader(SplitReader):
             for ln in body:
                 try:
                     entries = parse_debezium_line(ln, self.schema)
-                except (ValueError, TypeError, KeyError):
-                    continue     # poisoned line: skip, still advance
+                except (ValueError, TypeError, KeyError) as e:
+                    # poisoned line: skip, still advance — but LOUDLY:
+                    # a dropped changelog event (unlike a dropped insert
+                    # line) diverges downstream state from the upstream
+                    self.dropped_events += 1
+                    import sys
+                    sys.stderr.write(
+                        f"debezium: dropped unparseable event in "
+                        f"{split}: {e}\n")
+                    continue
                 for op, r in entries:
                     ops.append(op)
                     rows.append(r)

@@ -94,6 +94,13 @@ class CatalogError(ValueError):
     pass
 
 
+def strip_schema(name: str) -> str:
+    """Normalize a possibly schema-qualified relation name: the catalog
+    is keyed on bare names and everything lives in 'public' (BI tools
+    qualify with the schema pg_tables reports)."""
+    return name[len("public."):] if name.startswith("public.") else name
+
+
 class Catalog:
     def __init__(self) -> None:
         self.sources: dict[str, SourceDef] = {}
@@ -144,6 +151,7 @@ class Catalog:
 
     def resolve_relation(self, name: str):
         """-> ("source"|"table"|"mv", def)"""
+        name = strip_schema(name)
         if name in self.sources:
             return "source", self.sources[name]
         if name in self.tables:
@@ -153,6 +161,7 @@ class Catalog:
         raise CatalogError(f"relation {name!r} not found")
 
     def drop(self, kind: str, name: str, if_exists: bool = False) -> bool:
+        name = strip_schema(name)
         reg = {
             "source": self.sources, "table": self.tables,
             "materialized_view": self.mvs, "sink": self.sinks,

@@ -52,6 +52,12 @@ class SqlError(ValueError):
     pass
 
 
+_DEBEZIUM_NEEDS_PK = (
+    "format 'debezium_json' requires a source PRIMARY KEY, which "
+    "sources do not support yet; the parser is available via "
+    "connector.parsers/FileSourceReader")
+
+
 def _values_chunk(leaf: PValues) -> StreamChunk:
     """Constant-fold VALUES expressions into one chunk (row-less exprs are
     evaluated over a dummy 1-row chunk — the frontend's eval_const)."""
@@ -377,10 +383,7 @@ class Session:
         if fmt in ("debezium", "debezium_json"):
             # fail at DDL time, not first-MV-build time (same gate as
             # _connector_reader — see the rationale there)
-            raise SqlError(
-                "format 'debezium_json' requires a source PRIMARY KEY, "
-                "which sources do not support yet; the parser is "
-                "available via connector.parsers/FileSourceReader")
+            raise SqlError(_DEBEZIUM_NEEDS_PK)
         if connector == "nexmark":
             table = str(stmt.with_options.get("nexmark_table",
                                               stmt.with_options.get("table", "bid")))
@@ -1028,10 +1031,7 @@ class Session:
                 # routing its retractions needs a pk-keyed source stream —
                 # the session's sources are keyed by a GENERATED row id,
                 # so a Delete would target a key that was never inserted
-                raise SqlError(
-                    "format 'debezium_json' requires a source PRIMARY "
-                    "KEY, which sources do not support yet; the parser "
-                    "is available via connector.parsers/FileSourceReader")
+                raise SqlError(_DEBEZIUM_NEEDS_PK)
             return FileSourceReader(
                 src.schema, str(path), fmt=fmt,
                 rows_per_chunk=self.source_chunk_capacity)
@@ -1080,7 +1080,8 @@ class Session:
     # ----------------------------------------------------------------- DML --
 
     def _insert(self, stmt: A.Insert) -> list:
-        t = self.catalog.tables.get(stmt.table)
+        from .catalog import strip_schema
+        t = self.catalog.tables.get(strip_schema(stmt.table))
         if t is None:
             raise SqlError(f"table {stmt.table!r} not found")
         binder = ExprBinder(Scope([]))
@@ -1107,7 +1108,8 @@ class Session:
     def _dml_target(self, name: str):
         """Resolve + preconditions shared by DELETE/UPDATE (reference:
         batch Delete/Update executors via DmlManager)."""
-        t = self.catalog.tables.get(name)
+        from .catalog import strip_schema
+        t = self.catalog.tables.get(strip_schema(name))
         if t is None:
             raise SqlError(f"table {name!r} not found")
         if t.append_only:
