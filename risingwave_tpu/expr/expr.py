@@ -618,9 +618,30 @@ import functools as _functools
 
 
 @_functools.lru_cache(maxsize=256)
-def _compile_re(pattern: str):
+def _compile_re(pattern: str, py_flags: int = 0):
     import re
-    return re.compile(pattern)
+    return re.compile(pattern, py_flags)
+
+
+def _re_flags(flags: str) -> int:
+    # PG flag letters (ref src/expr/src/vector_op/regexp.rs options parse).
+    # 'g' is handled by callers (it selects replace-all, not a re flag).
+    import re
+    f = 0
+    for ch in flags:
+        if ch == "i":
+            f |= re.IGNORECASE
+        elif ch in ("n", "m"):     # PG: newline-sensitive matching
+            f |= re.MULTILINE
+        elif ch == "s":            # PG: '.' matches newline
+            f |= re.DOTALL
+        elif ch == "x":
+            f |= re.VERBOSE
+        elif ch in ("c", "g"):     # 'c' = case-sensitive (the default)
+            pass
+        else:
+            raise ValueError(f"invalid regexp flag: {ch!r}")
+    return f
 
 
 def _register_regexp(name: str, pyfn, type_infer):
@@ -649,13 +670,56 @@ _register_regexp("regexp_like",
 _register_regexp("regexp_count",
                  lambda s, p: len(_compile_re(p).findall(s)),
                  _t_int64)
-_register_regexp("regexp_replace",
-                 lambda s, p, r: _compile_re(p).sub(r, s),
-                 lambda ts: T.VARCHAR)
-_register_regexp("regexp_match",
-                 lambda s, p: (lambda m: m.group(0) if m else None)(
-                     _compile_re(p).search(s)),
-                 lambda ts: T.VARCHAR)
+def _pg_replacement_template(r: str) -> str:
+    """Translate a PG replacement string to a Python re.sub template by a
+    left-to-right escape scan: \\& (whole match) -> \\g<0>, \\1..\\9 kept,
+    \\\\ kept as literal backslash, any other escape taken as the literal
+    character (Python's template parser would reject e.g. \\g)."""
+    out = []
+    i = 0
+    while i < len(r):
+        c = r[i]
+        if c == "\\" and i + 1 < len(r):
+            n = r[i + 1]
+            if n == "&":
+                out.append("\\g<0>")
+            elif n.isdigit() or n == "\\":
+                out.append(c + n)
+            else:
+                out.append(n)
+            i += 2
+        elif c == "\\":                   # trailing lone backslash
+            out.append("\\\\")
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _pg_regexp_replace(s, p, r, flags=""):
+    # PG semantics (ref src/expr/src/vector_op/regexp.rs): replace only
+    # the FIRST match unless the 'g' flag is given; 'i' = case-insensitive.
+    count = 0 if "g" in flags else 1
+    return _compile_re(p, _re_flags(flags)).sub(
+        _pg_replacement_template(r), s, count=count)
+
+
+def _pg_regexp_match(s, p, flags=""):
+    # PG regexp_match returns text[] of captures; until array types exist
+    # we return the first capture group when the pattern has groups, else
+    # the whole match (closest scalar approximation — divergence documented).
+    if "g" in flags:
+        raise ValueError(
+            "regexp_match does not support the global option")  # as in PG
+    m = _compile_re(p, _re_flags(flags)).search(s)
+    if m is None:
+        return None
+    return m.group(1) if m.re.groups else m.group(0)
+
+
+_register_regexp("regexp_replace", _pg_regexp_replace, lambda ts: T.VARCHAR)
+_register_regexp("regexp_match", _pg_regexp_match, lambda ts: T.VARCHAR)
 
 
 @register("str_rank", _t_int64)

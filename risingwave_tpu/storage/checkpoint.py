@@ -212,12 +212,24 @@ class CheckpointLog:
         return tables
 
     def load_tables(self) -> tuple[int, dict[int, dict[bytes, bytes]]]:
-        """Replay all manifest-referenced segments in commit order."""
-        with self._mlock:
-            manifest = self._read_manifest()
-        tables = self._fold(manifest["segments"],
-                            set(manifest["dropped_tables"]))
-        return manifest["committed_epoch"], tables
+        """Replay all manifest-referenced segments in commit order.
+
+        A concurrent compactor (this process's or another reader-turned-
+        writer on the same directory) may delete a base segment between our
+        manifest read and the segment read. Segments are immutable and the
+        manifest swap is atomic, so re-reading the manifest and replaying
+        converges — retry instead of surfacing FileNotFoundError."""
+        for attempt in range(8):
+            with self._mlock:
+                manifest = self._read_manifest()
+            try:
+                tables = self._fold(manifest["segments"],
+                                    set(manifest["dropped_tables"]))
+                return manifest["committed_epoch"], tables
+            except FileNotFoundError:
+                if attempt == 7:   # still racing: surface the real error
+                    raise
+        raise AssertionError("unreachable")
 
     # -- compaction (background, off the barrier path) ------------------------
     # (reference: the standalone compactor worker; compaction tasks run
@@ -259,16 +271,31 @@ class CheckpointLog:
             self._compact_locked()
 
     def _compact_locked(self) -> None:
-        with self._mlock:
-            manifest = self._read_manifest()
-            base = list(manifest["segments"])
-            dropped = set(manifest["dropped_tables"])
-            epoch = manifest["committed_epoch"]
-        if len(base) <= 1:
-            return
-        tables = self._fold(base, dropped)
+        # Like load_tables, the fold can race a CROSS-process compactor
+        # deleting base segments after our manifest read — re-read and
+        # retry; segments are immutable so a retry converges.
+        for attempt in range(8):
+            with self._mlock:
+                manifest = self._read_manifest()
+                base = list(manifest["segments"])
+                dropped = set(manifest["dropped_tables"])
+                epoch = manifest["committed_epoch"]
+            if len(base) <= 1:
+                return
+            try:
+                tables = self._fold(base, dropped)
+                break
+            except FileNotFoundError:
+                if attempt == 7:
+                    raise
+        # _compact_seq is process-local and resets on restart, and a plain
+        # exists-probe would be check-then-write racy across processes: a
+        # per-process random token makes the folded name unique, so no fold
+        # (post-restart or concurrent) can overwrite a live segment.
         self._compact_seq += 1
-        name = f"epoch_{epoch:012d}.c{self._compact_seq}.compacted.seg"
+        import uuid
+        name = (f"epoch_{epoch:012d}.c{self._compact_seq}"
+                f"-{uuid.uuid4().hex[:8]}.compacted.seg")
         self._write_segment(name, {t: dict(b) for t, b in tables.items()})
         with self._mlock:
             manifest = self._read_manifest()

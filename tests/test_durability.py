@@ -176,3 +176,51 @@ def test_crash_recovery_e2e(tmp_path):
     assert sorted(s2.mv_rows("agg")) == sorted(s.mv_rows("agg"))
     # row ids continued above the recovered ones: all 6 rows distinct
     assert len(s2.run_sql("SELECT k, cat, v FROM events")) == 6
+
+
+def test_folded_segment_name_never_collides_across_restart(tmp_path):
+    """Advisor r4: _compact_seq is process-local; a fold after restart must
+    not regenerate (and overwrite) an existing folded segment's name."""
+    log = CheckpointLog(str(tmp_path), compact_after=1000)
+    log.append_epoch(1, {7: {b"a": b"1"}})
+    log.append_epoch(2, {7: {b"b": b"2"}})
+    log.compact()
+    first = log._read_manifest()["segments"]
+    assert len(first) == 1 and ".c1-" in first[0]
+
+    # fresh process: seq resets to 0; same committed epoch gets new segments
+    log2 = CheckpointLog(str(tmp_path), compact_after=1000)
+    log2.append_epoch(2, {7: {b"c": b"3"}})
+    log2.compact()
+    folded = log2._read_manifest()["segments"]
+    assert len(folded) == 1
+    # the per-process uuid token keeps the new fold's name distinct from
+    # the still-live pre-restart fold
+    assert folded[0] != first[0]
+    _, tables = log2.load_tables()
+    assert tables[7] == {b"a": b"1", b"b": b"2", b"c": b"3"}
+
+
+def test_load_tables_retries_when_compactor_deletes_segment(tmp_path):
+    """Advisor r4: a reader that fetched the manifest just before a
+    compaction swap must converge by re-reading, not raise FileNotFound."""
+    log = CheckpointLog(str(tmp_path), compact_after=1000)
+    log.append_epoch(1, {7: {b"a": b"1"}})
+    log.append_epoch(2, {7: {b"b": b"2"}})
+
+    reader = CheckpointLog(str(tmp_path), compact_after=1000)
+    stale = reader._read_manifest()
+    log.compact()  # deletes the base segments the stale manifest references
+
+    # simulate the race: first manifest read returns the stale snapshot
+    calls = {"n": 0}
+    real = reader._read_manifest
+
+    def flaky():
+        calls["n"] += 1
+        return stale if calls["n"] == 1 else real()
+
+    reader._read_manifest = flaky
+    epoch, tables = reader.load_tables()
+    assert epoch == 2
+    assert tables[7] == {b"a": b"1", b"b": b"2"}

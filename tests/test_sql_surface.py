@@ -84,6 +84,29 @@ class TestRegexp:
             "SELECT k, regexp_match(u, 'example[.]com') FROM t"))
         assert got == {1: "example.com", 2: None, 3: None}
 
+    def test_regexp_replace_first_match_only_by_default(self):
+        # PG semantics: without the 'g' flag only the FIRST match is
+        # replaced (advisor r4 medium finding)
+        s = Session()
+        got = s.run_sql("SELECT regexp_replace('aaa', 'a', 'b')")[0][0]
+        assert got == "baa"
+
+    def test_regexp_replace_g_and_i_flags(self):
+        s = Session()
+        assert s.run_sql(
+            "SELECT regexp_replace('aaa', 'a', 'b', 'g')")[0][0] == "bbb"
+        assert s.run_sql(
+            "SELECT regexp_replace('AaA', 'a', 'b', 'gi')")[0][0] == "bbb"
+        # first case-insensitive match is the leading 'A'
+        assert s.run_sql(
+            "SELECT regexp_replace('AaA', 'a', 'b', 'i')")[0][0] == "baA"
+
+    def test_regexp_match_returns_first_capture_group(self):
+        s = Session()
+        got = s.run_sql(
+            "SELECT regexp_match('https://a.io/x', '^([a-z]+)://')")[0][0]
+        assert got == "https"
+
     def test_regexp_in_streaming_mv(self):
         s = self._t()
         s.run_sql("CREATE MATERIALIZED VIEW secure AS "
