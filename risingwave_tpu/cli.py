@@ -22,6 +22,8 @@ def _build_session(args):
         kwargs["data_dir"] = args.data_dir
     if getattr(args, "checkpoint_frequency", None):
         kwargs["checkpoint_frequency"] = args.checkpoint_frequency
+    if getattr(args, "workers", 0):
+        kwargs["workers"] = args.workers
     return Session(**kwargs)
 
 
@@ -38,6 +40,9 @@ def main(argv=None) -> int:
     pg.add_argument("--checkpoint-frequency", type=int, default=10)
     pg.add_argument("--tick-interval-ms", type=int, default=1000,
                     help="barrier interval (reference default 1000ms)")
+    pg.add_argument("--workers", type=int, default=0,
+                    help="worker PROCESSES hosting MV jobs (reference: "
+                    "compute nodes; 0 = everything in-process)")
 
     q = sub.add_parser("sql", help="run SQL statements and print results")
     q.add_argument("statement")

@@ -1,0 +1,52 @@
+"""Connector reader factory shared by the frontend session and worker
+processes (reference: SplitReaderImpl dispatch,
+src/connector/src/source/base.rs:326 — one construction point per
+connector, used by every compute node)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ConnectorError(ValueError):
+    pass
+
+
+DEBEZIUM_NEEDS_PK = (
+    "format 'debezium_json' requires a source PRIMARY KEY, which "
+    "sources do not support yet; the parser is available via "
+    "connector.parsers/FileSourceReader")
+
+
+def make_reader(connector: str, options: dict, schema,
+                chunk_capacity: int, seed: int = 42) -> Optional[object]:
+    """Instantiate a connector's SplitReader; None for declared-schema
+    sources fed only by tests (empty connector string)."""
+    if connector == "nexmark":
+        from .nexmark_split import NexmarkReader
+        table = str(options.get("nexmark_table",
+                                options.get("table", "bid"))).lower()
+        rate = options.get("rows_per_chunk")
+        cap = int(rate) if rate else chunk_capacity
+        return NexmarkReader(table, chunk_capacity=cap, seed=seed)
+    if connector == "datagen":
+        from .datagen import DatagenReader
+        opts = dict(options)
+        opts.setdefault("datagen.rows.per.chunk",
+                        opts.get("rows_per_chunk", chunk_capacity))
+        return DatagenReader(schema, opts)
+    if connector in ("file", "posix_fs", "fs"):
+        from .filesource import FileSourceReader
+        path = options.get("path", options.get("posix_fs.root"))
+        if not path:
+            raise ConnectorError("file source requires path option")
+        fmt = str(options.get("format", "jsonl")).lower()
+        if fmt in ("debezium", "debezium_json"):
+            # CDC retractions need a pk-keyed source stream; generated
+            # row-id sources cannot route Deletes
+            raise ConnectorError(DEBEZIUM_NEEDS_PK)
+        return FileSourceReader(schema, str(path), fmt=fmt,
+                                rows_per_chunk=chunk_capacity)
+    if connector == "":
+        return None
+    raise ConnectorError(f"unsupported connector {connector!r}")

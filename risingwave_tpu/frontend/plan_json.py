@@ -46,6 +46,8 @@ def _enc(v: Any) -> Any:
         return {"__dt__": v.kind.name, "scale": v.scale}
     if isinstance(v, Schema):
         return {"__schema__": [_enc(f) for f in v]}
+    if isinstance(v, dict):
+        return {"__map__": [[_enc(k), _enc(val)] for k, val in v.items()]}
     if isinstance(v, (tuple, list)):
         return {"__seq__": [_enc(x) for x in v]}
     cls = type(v).__name__
@@ -69,6 +71,9 @@ def _dec(v: Any, catalog) -> Any:
         return DataType(TypeKind[v["__dt__"]], scale=v.get("scale", 0))
     if "__schema__" in v:
         return Schema(tuple(_dec(f, catalog) for f in v["__schema__"]))
+    if "__map__" in v:
+        return {_dec(k, catalog): _dec(val, catalog)
+                for k, val in v["__map__"]}
     if "__seq__" in v:
         return tuple(_dec(x, catalog) for x in v["__seq__"])
     if "__catalog__" in v:
@@ -89,3 +94,34 @@ def plan_to_json(plan: P.PlanNode) -> str:
 
 def plan_from_json(data: str, catalog) -> P.PlanNode:
     return _dec(json.loads(data), catalog)
+
+
+# -- catalog-def shipping -----------------------------------------------------
+# Plans carry catalog objects as NAMED references (above); a remote worker
+# therefore needs the referenced definitions delivered out-of-band — the
+# reference ships catalog snapshots to compute nodes via meta notifications
+# (src/meta/src/manager/notification.rs); here the session sends the defs
+# a job's plan closes over, right before the plan itself.
+
+def defs_to_json(defs: list) -> str:
+    from .catalog import MaterializedViewDef, SourceDef, TableDef
+    kinds = {SourceDef: "source", TableDef: "table",
+             MaterializedViewDef: "mv"}
+    out = []
+    for d in defs:
+        kind = kinds[type(d)]
+        enc = {f.name: _enc(getattr(d, f.name))
+               for f in dataclasses.fields(d)}
+        out.append({"__def__": kind, **enc})
+    return json.dumps(out)
+
+
+def defs_from_json(data: str) -> list:
+    from .catalog import MaterializedViewDef, SourceDef, TableDef
+    kinds = {"source": SourceDef, "table": TableDef, "mv": MaterializedViewDef}
+    out = []
+    for item in json.loads(data):
+        cls = kinds[item.pop("__def__")]
+        kwargs = {k: _dec(v, None) for k, v in item.items()}
+        out.append(cls(**kwargs))
+    return out

@@ -1,0 +1,358 @@
+"""Session-side handles for remote worker processes.
+
+Counterpart of the reference's rpc_client pools + stream client
+(reference: src/rpc_client/src/meta_client.rs:92, stream_client.rs — the
+frontend/meta side of the compute-node RPC boundary). One
+``RemoteWorker`` per worker process: it owns the subprocess, the
+multiplexed socket, permit accounting for outbound data channels, and
+the per-epoch barrier-completion events. ``RemoteJob`` adapts a
+worker-hosted job to the StreamJob surface the Session's conduction loop
+drives (wait_barrier / stop / sources / bus).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from ..rpc.wire import message_to_wire, read_frame, write_frame
+from ..stream.message import Message
+from .runtime import ChangelogBus, QueueSource
+
+
+class WorkerDied(RuntimeError):
+    pass
+
+
+class RemoteWorker:
+    """Spawn + drive one worker process over a multiplexed socket."""
+
+    SPAWN_TIMEOUT_S = 60.0
+
+    def __init__(self, data_dir: str, worker_id: int, loop,
+                 permits: int = 32):
+        self.data_dir = data_dir
+        self.worker_id = worker_id
+        self.loop = loop
+        self.permits = permits
+        self.dead = False
+        self.proc: Optional[subprocess.Popen] = None
+        self._rid = itertools.count(1)
+        self._chan = itertools.count(worker_id * 100_000 + 1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._epoch_events: dict[int, asyncio.Event] = {}
+        self._epoch_errors: dict[int, str] = {}
+        self._init_fut: Optional[asyncio.Future] = None
+        self._sems: dict[int, asyncio.Semaphore] = {}
+        self._forwarders: dict[str, list[asyncio.Task]] = {}
+        self._wlock: Optional[asyncio.Lock] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._writer = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def spawn(self) -> None:
+        env = dict(os.environ)
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # a wedged TPU tunnel must not hang a CPU-mode worker
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.worker",
+             "--data-dir", self.data_dir,
+             "--worker-id", str(self.worker_id), "--port", "0"],
+            stdout=subprocess.PIPE, stderr=None, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        deadline = time.monotonic() + self.SPAWN_TIMEOUT_S
+        port = None
+        assert self.proc.stdout is not None
+        import select
+        buf = b""
+        fd = self.proc.stdout.fileno()
+        while time.monotonic() < deadline:
+            # select-bounded read: a worker that hangs during startup
+            # WITHOUT printing (wedged accelerator init) must still trip
+            # the timeout instead of blocking readline forever
+            ready, _, _ = select.select([fd], [], [],
+                                        max(0.05, deadline - time.monotonic()))
+            if not ready:
+                continue
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                raise WorkerDied(
+                    f"worker {self.worker_id} exited during startup "
+                    f"(rc={self.proc.poll()})")
+            buf += chunk
+            for line in buf.decode(errors="replace").splitlines():
+                if line.startswith("WORKER_READY"):
+                    port = int(line.split()[1])
+                    break
+            if port is not None:
+                break
+        if port is None:
+            self.proc.kill()
+            raise WorkerDied(f"worker {self.worker_id} startup timed out")
+        self.port = port
+        self.dead = False
+
+    async def connect(self) -> None:
+        reader, writer = await asyncio.open_connection("127.0.0.1", self.port)
+        self._writer = writer
+        self._wlock = asyncio.Lock()
+        self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def aclose(self) -> None:
+        """Tear down the socket INSIDE the loop (cancelled reader awaited,
+        writer closed) so no task or transport outlives the session."""
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._reader_task = None
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None
+
+    def respawn(self, connect_await) -> None:
+        """Fresh process over the SAME durable directory (state + offsets
+        recover from the last committed checkpoint)."""
+        connect_await(self.aclose())
+        self.terminate()
+        self._pending.clear()
+        self._epoch_events.clear()
+        self._epoch_errors.clear()
+        self._sems.clear()
+        # sibling jobs' forwarders feed a process that no longer exists;
+        # cancel (not just forget) so they cannot leak across recoveries
+        for tasks in self._forwarders.values():
+            for t in tasks:
+                t.cancel()
+        self._forwarders.clear()
+        self.spawn()
+        connect_await(self.connect())
+
+    def terminate(self) -> None:
+        if self._reader_task is not None:   # not yet aclosed
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self.dead = True
+
+    def kill9(self) -> None:
+        """Chaos hook: SIGKILL the worker process (the madsim node-kill
+        analogue across a REAL process boundary)."""
+        if self.proc is not None and self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+            self.proc.wait()
+
+    # -- socket ----------------------------------------------------------------
+
+    async def _read_loop(self, reader) -> None:
+        while True:
+            frame = await read_frame(reader)
+            if frame is None:
+                self._mark_dead()
+                return
+            t = frame.get("type")
+            if t == "reply":
+                fut = self._pending.pop(frame.get("rid"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(frame)
+            elif t == "ack":
+                sem = self._sems.get(frame["chan"])
+                if sem is not None:
+                    sem.release()
+            elif t == "barrier_complete":
+                if frame.get("ok", True) is False:
+                    self._epoch_errors[frame["epoch"]] = frame.get(
+                        "error", "worker job failed")
+                if frame.get("init") and self._init_fut is not None:
+                    if not self._init_fut.done():
+                        self._init_fut.set_result(frame)
+                else:
+                    ev = self._epoch_events.setdefault(
+                        frame["epoch"], asyncio.Event())
+                    ev.set()
+
+    def _mark_dead(self) -> None:
+        self.dead = True
+        for ev in self._epoch_events.values():
+            ev.set()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(WorkerDied("worker connection lost"))
+        self._pending.clear()
+        if self._init_fut is not None and not self._init_fut.done():
+            self._init_fut.set_exception(WorkerDied("worker connection lost"))
+        for sem in self._sems.values():
+            sem.release()          # unblock forwarders; send() will raise
+
+    async def send(self, obj: dict) -> None:
+        if self.dead or self._writer is None:
+            raise WorkerDied("worker is down")
+        try:
+            await write_frame(self._writer, obj, self._wlock)
+        except (ConnectionError, BrokenPipeError, OSError):
+            self._mark_dead()
+            raise WorkerDied("worker connection lost") from None
+
+    async def request(self, obj: dict) -> dict:
+        rid = next(self._rid)
+        obj = {**obj, "rid": rid}
+        fut = self.loop.create_future()
+        self._pending[rid] = fut
+        await self.send(obj)
+        resp = await fut
+        if resp.get("ok") is False:
+            raise RuntimeError(
+                f"worker {self.worker_id}: {resp.get('error')}")
+        return resp
+
+    # -- data channels ---------------------------------------------------------
+
+    def alloc_chan(self) -> int:
+        chan = next(self._chan)
+        self._sems[chan] = asyncio.Semaphore(self.permits)
+        return chan
+
+    async def send_data(self, chan: int, msg: Message, schema) -> None:
+        from ..common.chunk import StreamChunk
+        if isinstance(msg, StreamChunk):
+            sem = self._sems.get(chan)
+            if sem is not None:
+                await sem.acquire()
+            if self.dead:
+                raise WorkerDied("worker is down")
+        await self.send({"type": "data", "chan": chan,
+                         "msg": message_to_wire(msg, schema)})
+
+    def start_forwarder(self, job: str, q: QueueSource, chan: int,
+                        schema) -> None:
+        """Forward an upstream bus subscription over a data channel —
+        the session side of the remote exchange edge."""
+
+        async def run() -> None:
+            try:
+                async for msg in q.execute():
+                    await self.send_data(chan, msg, schema)
+            except WorkerDied:
+                pass                      # recovery re-wires the edge
+            except Exception as e:        # noqa: BLE001 - must be LOUD:
+                import sys                # a dead forwarder starves the job
+                sys.stderr.write(
+                    f"exchange forwarder {job!r}/chan {chan} died: "
+                    f"{e!r}\n")
+                raise
+
+        self._forwarders.setdefault(job, []).append(
+            asyncio.ensure_future(run(), loop=self.loop))
+
+    def stop_forwarders(self, job: str) -> list[asyncio.Task]:
+        tasks = self._forwarders.pop(job, [])
+        for t in tasks:
+            t.cancel()
+        return tasks
+
+    # -- barrier conduction ----------------------------------------------------
+
+    async def inject_barrier(self, epoch: int, checkpoint: bool,
+                             generate: bool, mutation=None) -> None:
+        for old in [e for e in self._epoch_events if e < epoch - 64]:
+            self._epoch_events.pop(old, None)
+            self._epoch_errors.pop(old, None)
+        frame = {"type": "barrier", "epoch": epoch, "checkpoint": checkpoint,
+                 "generate": generate}
+        if mutation is not None:
+            frame["mutation"] = mutation.kind.value
+            if isinstance(mutation.payload, str):
+                frame["mutation_payload"] = mutation.payload
+        await self.send(frame)
+
+    async def init_barrier(self, name: str, epoch: int) -> None:
+        """Init cut for a just-created job (replaces the local path's
+        direct queue push)."""
+        self._init_fut = self.loop.create_future()
+        await self.send({"type": "barrier", "epoch": epoch,
+                         "checkpoint": False, "generate": False,
+                         "only": [name], "init": True})
+        frame = await self._init_fut
+        self._init_fut = None
+        if frame.get("ok", True) is False:
+            raise RuntimeError(
+                f"remote job {name!r} failed at init: {frame.get('error')}")
+
+    async def wait_epoch(self, epoch: int) -> bool:
+        """True iff the worker collected the epoch cleanly."""
+        if self.dead:
+            return False
+        err = self._epoch_errors.get(epoch)
+        if err:
+            raise RuntimeError(f"remote job failed: {err}")
+        ev = self._epoch_events.setdefault(epoch, asyncio.Event())
+        await ev.wait()
+        # NOT popped here: several RemoteJobs on this worker wait the same
+        # epoch; entries are pruned by inject_barrier's horizon instead
+        err = self._epoch_errors.get(epoch)
+        if err:
+            raise RuntimeError(f"remote job failed: {err}")
+        return not self.dead
+
+    async def commit(self, epoch: int) -> None:
+        await self.send({"type": "commit", "epoch": epoch})
+
+    async def shutdown(self) -> None:
+        try:
+            await asyncio.wait_for(self.request({"type": "shutdown"}), 5.0)
+        except (WorkerDied, RuntimeError, asyncio.TimeoutError):
+            pass
+
+
+class RemoteJob:
+    """StreamJob-shaped adapter for a worker-hosted job: the conduction
+    loop waits on the worker's epoch acks; ``sources`` are the
+    session-side queues subscribed to upstream buses (feeding the
+    forwarders); the bus is empty (downstream MVs on remote MVs are not
+    supported yet)."""
+
+    def __init__(self, name: str, worker: RemoteWorker):
+        self.name = name
+        self.worker = worker
+        self.sources: list[QueueSource] = []
+        self.bus = ChangelogBus()
+        self.pipeline = None
+        self.table = None
+        self._failure: Optional[BaseException] = None
+        self._task = None
+
+    async def wait_barrier(self, epoch: int) -> None:
+        try:
+            ok = await self.worker.wait_epoch(epoch)
+        except RuntimeError:
+            self._failure = self._failure or RuntimeError("remote job failed")
+            raise
+        if not ok:
+            # worker process died: present as a killed actor so the
+            # session's TTL detector + scoped recovery machinery takes over
+            self._failure = asyncio.CancelledError()
+            raise RuntimeError(f"worker of remote job {self.name!r} died")
+
+    async def stop(self) -> None:
+        for t in self.worker.stop_forwarders(self.name):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass

@@ -52,10 +52,7 @@ class SqlError(ValueError):
     pass
 
 
-_DEBEZIUM_NEEDS_PK = (
-    "format 'debezium_json' requires a source PRIMARY KEY, which "
-    "sources do not support yet; the parser is available via "
-    "connector.parsers/FileSourceReader")
+from ..connector.factory import DEBEZIUM_NEEDS_PK as _DEBEZIUM_NEEDS_PK
 
 
 def _values_chunk(leaf: PValues) -> StreamChunk:
@@ -168,6 +165,7 @@ class Session:
                  config: Optional[BuildConfig] = None, seed: int = 42,
                  data_dir: Optional[str] = None,
                  in_flight_barriers: int = 1,
+                 workers: int = 0,
                  rw_config=None):
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
@@ -253,6 +251,24 @@ class Session:
         import threading
         from ..native import codec as _native_codec
         threading.Thread(target=_native_codec, daemon=True).start()
+        # remote worker processes (reference: compute nodes; the session
+        # doubles as meta + frontend — playground --workers N). MV jobs are
+        # placed round-robin on workers; tables/sinks/batch stay local.
+        self.workers: list = []
+        self._remote_specs: dict[str, dict] = {}
+        self._next_remote = 0
+        if workers:
+            import tempfile
+            from .remote import RemoteWorker
+            base = data_dir or tempfile.mkdtemp(prefix="rwtpu_cluster_")
+            self._workers_base = base
+            for k in range(workers):
+                w = RemoteWorker(_os.path.join(base, f"worker_{k}"), k,
+                                 self.loop,
+                                 permits=self.config.exchange_permits)
+                w.spawn()
+                self._await(w.connect())
+                self.workers.append(w)
         if data_dir is not None:
             self._recover()
 
@@ -515,6 +531,8 @@ class Session:
             return []
         self._drain_inflight()   # subscribe at a quiesced epoch boundary
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
+        if self.workers:
+            return self._create_mv_remote(stmt)
         n_feeds0 = len(self.feeds)
         n_bf0 = len(self.backfills)
         id0 = self.catalog._next_table_id   # for reschedule id replay
@@ -557,6 +575,164 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    # ------------------------------------------------------ remote MV jobs --
+
+    def _plan_remote_mv(self, query: A.Select, worker):
+        """Plan + classify leaves for a worker-hosted MV: connector
+        sources run worker-side; table/MV scans become remote exchange
+        channels fed by the session (the upstream jobs are local)."""
+        plan = Planner(self.catalog,
+                       lenient=self._recovering).plan_select(query)
+        leaves = collect_leaves(plan)
+        defs, channels, ups = [], {}, {}
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, PSource):
+                defs.append(leaf.source)
+            elif isinstance(leaf, PTableScan):
+                defs.append(leaf.table)
+                channels[i] = worker.alloc_chan()
+                ups[i] = (leaf.table.name, leaf.schema)
+            elif isinstance(leaf, PMvScan):
+                if leaf.mv.name in self._remote_specs:
+                    raise SqlError(
+                        "an MV over a worker-hosted MV is not supported "
+                        "yet; chain MVs in-process or via a table")
+                defs.append(leaf.mv)
+                channels[i] = worker.alloc_chan()
+                ups[i] = (leaf.mv.name, leaf.schema)
+            else:
+                raise SqlError(
+                    f"cannot place {type(leaf).__name__} on a worker")
+        return plan, defs, channels, ups
+
+    def _create_mv_remote(self, stmt: A.CreateMaterializedView) -> list:
+        """CREATE MATERIALIZED VIEW on a worker process (reference: the
+        meta DdlController building actors on compute nodes,
+        src/meta/src/rpc/ddl_controller.rs + stream_service.rs:46-233)."""
+        from .plan_json import defs_to_json, plan_to_json
+        from .remote import RemoteJob
+        worker = self.workers[self._next_remote % len(self.workers)]
+        self._next_remote += 1
+        plan, defs, channels, ups = self._plan_remote_mv(stmt.query, worker)
+        # id allocation must stay replay-deterministic: a FAILED create
+        # must roll the counter back, or every later object shifts ids
+        # relative to the DDL replay that skips the failure
+        id_rollback = self.catalog._next_table_id
+        mv_table_id = self.catalog.next_table_id()
+        id_start = self.catalog._next_table_id
+        cfg = self.config
+        req = {
+            "type": "create_job", "name": stmt.name,
+            "plan": plan_to_json(plan), "defs": defs_to_json(defs),
+            "mv_table_id": mv_table_id, "id_start": id_start,
+            "channels": {str(i): c for i, c in channels.items()},
+            "config": {
+                "chunk_capacity": cfg.chunk_capacity,
+                "agg_table_capacity": cfg.agg_table_capacity,
+                "join_key_capacity": cfg.join_key_capacity,
+                "join_bucket_width": cfg.join_bucket_width,
+                "topn_table_capacity": cfg.topn_table_capacity,
+                "agg_hbm_budget": cfg.agg_hbm_budget,
+            },
+            "chunks_per_tick": self.chunks_per_tick,
+            "chunk_capacity": self.source_chunk_capacity,
+            "seed": self.seed,
+            # session-restart replay of a channel-fed job rebuilds fresh
+            # from the upstream snapshot (the changelog between the
+            # worker's and the session's last commits is unrecoverable);
+            # source-fed jobs resume from worker-durable state + offsets
+            "fresh": bool(channels) and self._recovering,
+        }
+        try:
+            resp = self._await(worker.request(req))
+        except BaseException:
+            self.catalog._next_table_id = id_rollback
+            raise
+        self.catalog._next_table_id = max(self.catalog._next_table_id,
+                                          resp["ids_end"])
+        n_visible = sum(1 for f in plan.schema
+                        if not f.name.startswith("_"))
+        mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
+                                 table_id=mv_table_id, definition="")
+        mv.n_visible = n_visible  # type: ignore[attr-defined]
+        mv.state_table_ids = tuple(resp["state_table_ids"])  # type: ignore[attr-defined]
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (id_start, resp["ids_end"])  # type: ignore[attr-defined]
+        mv.remote_worker = worker.worker_id  # type: ignore[attr-defined]
+        self.catalog_writer.add_mv(mv)
+        job = RemoteJob(stmt.name, worker)
+        self.jobs[stmt.name] = job
+        self._remote_specs[stmt.name] = {
+            "worker": worker, "channels": channels, "ups": ups, "req": req}
+        self._wire_remote_channels(stmt.name)
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+        self._await(worker.init_barrier(stmt.name, self.epoch))
+        return []
+
+    def _wire_remote_channels(self, name: str) -> None:
+        """Build the session side of each remote exchange edge: subscribe
+        to the upstream bus, ship the backfill snapshot, start the
+        permit-metered forwarder (reference: exchange_service.rs:74-133 +
+        backfill snapshot-then-deltas)."""
+        spec = self._remote_specs[name]
+        worker = spec["worker"]
+        job = self.jobs[name]
+        for i, chan in spec["channels"].items():
+            up_name, leaf_schema = spec["ups"][i]
+            up_job = self.jobs[up_name]
+            snap = up_job.snapshot_messages(Barrier.new(self.epoch),
+                                            self.source_chunk_capacity)
+            q = QueueSource(leaf_schema)
+            up_job.bus.subscribe(q)
+            job.sources.append(q)
+
+            async def _ship(snap=snap, chan=chan, schema=leaf_schema):
+                for m in snap:
+                    await worker.send_data(chan, m, schema)
+
+            self._await(_ship())
+            worker.start_forwarder(name, q, chan, leaf_schema)
+
+    def _recover_remote_job(self, name: str) -> list[str]:
+        """Scoped recovery of a worker-hosted job across the process
+        boundary: respawn the worker if its process died, re-create the
+        job (fresh-from-snapshot for channel-fed, durable-resume for
+        source-fed), re-wire exchange edges (reference: recovery.rs:110
+        rebuilding actors on a replacement worker)."""
+        self._drain_inflight()
+        spec = self._remote_specs[name]
+        worker = spec["worker"]
+        job = self.jobs.pop(name, None)
+        if job is not None:
+            self._await(job.stop())
+            self._unsubscribe_job(job)
+            self.meta.deregister_job(name)
+            self._dead_jobs.discard(name)
+        if worker.dead:
+            worker.respawn(self._await)
+        from .remote import RemoteJob
+        req = dict(spec["req"])
+        if spec["channels"]:
+            # fresh rebuild from the upstream's CURRENT state: the deltas
+            # the dead worker consumed past its last commit are gone with
+            # its bus subscription, so resuming from worker state would
+            # fork history — snapshot-rebuild is the consistent cut
+            req["fresh"] = True
+            new_channels = {i: worker.alloc_chan()
+                            for i in spec["channels"]}
+            spec["channels"] = new_channels
+            req["channels"] = {str(i): c for i, c in new_channels.items()}
+        else:
+            req["fresh"] = False
+        spec["req"] = req
+        self._await(worker.request(req))
+        self.jobs[name] = RemoteJob(name, worker)
+        self._wire_remote_channels(name)
+        self._await(worker.init_barrier(name, self.epoch))
+        self.meta.notifications.notify(
+            "recovery", {"jobs": [name], "epoch": self.epoch})
+        return [name]
+
     def _create_sink(self, stmt: A.CreateSink) -> list:
         """CREATE SINK: a stream job whose terminal is a SinkExecutor over
         a log store instead of a MaterializeExecutor (reference:
@@ -579,6 +755,10 @@ class Session:
             if kind == "source":
                 raise SqlError("CREATE SINK FROM a source is not supported; "
                                "use CREATE SINK ... AS SELECT")
+            if stmt.from_name in self._remote_specs:
+                raise SqlError(
+                    f"CREATE SINK FROM worker-hosted MV "
+                    f"{stmt.from_name!r} is not supported yet")
             up_job = self.jobs[stmt.from_name]
             q = QueueSource(obj.schema)
             up_job.bus.subscribe(q)
@@ -650,6 +830,9 @@ class Session:
         if mv is None:
             raise SqlError(f"materialized view {name!r} not found "
                            "(only MV jobs reschedule)")
+        if name in self._remote_specs:
+            raise SqlError("reschedule of a worker-hosted MV is not "
+                           "supported yet; drop and re-create it")
         self.flush()                       # all state durable + quiesced
         old_job = self.jobs[name]
         self._await(old_job.stop())
@@ -848,6 +1031,8 @@ class Session:
         or sink job falls back to requiring a session restart (state is
         durable). Returns the recovered subtree's job names (the caller
         dedups overlapping recovery requests with it)."""
+        if name in self._remote_specs:
+            return self._recover_remote_job(name)
         job = self.jobs.get(name)
         if job is None:
             return [name]
@@ -964,6 +1149,10 @@ class Session:
             return ex, q, []
         if isinstance(leaf, (PTableScan, PMvScan)):
             name = leaf.table.name if isinstance(leaf, PTableScan) else leaf.mv.name
+            if name in self._remote_specs:
+                raise SqlError(
+                    f"{name!r} is a worker-hosted MV; jobs consuming it "
+                    "must also be worker-hosted (not supported yet)")
             up_job = self.jobs[name]
             q = QueueSource(leaf.schema)
             up_job.bus.subscribe(q)
@@ -1003,41 +1192,15 @@ class Session:
         raise PlanError(f"cannot stream {type(leaf).__name__}")
 
     def _connector_reader(self, src: SourceDef):
-        """Instantiate the connector's SplitReader (reference:
-        SplitReaderImpl dispatch, src/connector/src/source/base.rs:326);
-        None for declared-schema sources fed only by tests."""
-        if src.connector == "nexmark":
-            from ..connector.nexmark_split import NexmarkReader
-            table = str(src.options.get("nexmark_table",
-                                        src.options.get("table", "bid"))).lower()
-            rate = src.options.get("rows_per_chunk")
-            cap = int(rate) if rate else self.source_chunk_capacity
-            return NexmarkReader(table, chunk_capacity=cap, seed=self.seed)
-        if src.connector == "datagen":
-            from ..connector.datagen import DatagenReader
-            opts = dict(src.options)
-            opts.setdefault("datagen.rows.per.chunk",
-                            opts.get("rows_per_chunk",
-                                     self.source_chunk_capacity))
-            return DatagenReader(src.schema, opts)
-        if src.connector in ("file", "posix_fs", "fs"):
-            from ..connector.filesource import FileSourceReader
-            path = src.options.get("path", src.options.get("posix_fs.root"))
-            if not path:
-                raise SqlError("file source requires path option")
-            fmt = str(src.options.get("format", "jsonl")).lower()
-            if fmt in ("debezium", "debezium_json"):
-                # the parser/reader layer handles the CDC envelope, but
-                # routing its retractions needs a pk-keyed source stream —
-                # the session's sources are keyed by a GENERATED row id,
-                # so a Delete would target a key that was never inserted
-                raise SqlError(_DEBEZIUM_NEEDS_PK)
-            return FileSourceReader(
-                src.schema, str(path), fmt=fmt,
-                rows_per_chunk=self.source_chunk_capacity)
-        if src.connector == "":
-            return None
-        raise SqlError(f"unsupported connector {src.connector!r}")
+        """Instantiate the connector's SplitReader via the shared factory
+        (connector/factory.py); None for declared-schema sources fed only
+        by tests."""
+        from ..connector.factory import ConnectorError, make_reader
+        try:
+            return make_reader(src.connector, src.options, src.schema,
+                               self.source_chunk_capacity, self.seed)
+        except ConnectorError as e:
+            raise SqlError(str(e)) from None
 
     def _unsubscribe_job(self, job: StreamJob) -> None:
         """Remove a stopped job's input queues from every upstream bus —
@@ -1069,6 +1232,15 @@ class Session:
             for f in dead_feeds:
                 if f.state_table is not None:
                     self.store.drop_table(f.state_table.table_id)
+            spec = self._remote_specs.pop(stmt.name, None)
+            if spec is not None and not spec["worker"].dead:
+                from .remote import WorkerDied
+                try:
+                    self._await(spec["worker"].request(
+                        {"type": "drop_job", "name": stmt.name,
+                         "epoch": self._injected + 1}))
+                except (WorkerDied, RuntimeError):
+                    pass             # worker gone; its state dir is stale
         if existed and obj is not None:
             self.dml.unregister_table(obj.table_id)
             for tid in ((obj.table_id,)
@@ -1258,6 +1430,20 @@ class Session:
         for queues in self._table_queues.values():
             for q in queues:
                 q.push(barrier)
+        if self.workers:
+            from .remote import WorkerDied
+
+            async def _inject_remote() -> None:
+                for w in self.workers:
+                    if w.dead:
+                        continue
+                    try:
+                        await w.inject_barrier(
+                            epoch, checkpoint,
+                            generate and not self.paused, mutation)
+                    except WorkerDied:
+                        pass        # collect marks its jobs dead
+            self._await(_inject_remote())
         self._injected = epoch
         self._inflight.append((epoch, checkpoint))
         import time as _time
@@ -1332,6 +1518,22 @@ class Session:
                             (VARCHAR.to_physical(sid), int(off)))
                     feed.state_table.commit(e)
             self.store.commit(e)
+            if self.workers:
+                # phase 2 of the cluster checkpoint: workers sealed and
+                # acked; only now may their staged epochs become durable
+                # (a worker killed before this frame recovers one
+                # checkpoint back and its deterministic sources replay)
+                from .remote import WorkerDied
+
+                async def _commit_remote() -> None:
+                    for w in self.workers:
+                        if w.dead:
+                            continue
+                        try:
+                            await w.commit(e)
+                        except WorkerDied:
+                            pass
+                self._await(_commit_remote())
         import time as _time
         t0 = self._inject_time.pop(e, None)
         if t0 is not None:
@@ -1406,6 +1608,26 @@ class Session:
 
     # ---------------------------------------------------------------- query --
 
+    def describe(self, sql: str):
+        """Output schema of ``sql``'s LAST statement WITHOUT executing it
+        — the extended-protocol Describe contract (reference: pgwire
+        Describe → frontend infer_return_type,
+        src/utils/pgwire/src/pg_protocol.rs:220-259). None = no rows."""
+        stmts = parse_sql(sql)
+        if not stmts:
+            return None
+        last = stmts[-1]
+        from ..common.types import VARCHAR
+        if isinstance(last, A.ShowStatement):
+            if last.what == "parameters":
+                return [("Name", VARCHAR), ("Value", VARCHAR)]
+            return [("Name", VARCHAR)]
+        if isinstance(last, A.Query):
+            plan = Planner(self.catalog).plan_select(last.select)
+            return [(f.name, f.type) for f in plan.schema
+                    if not f.name.startswith("_")]
+        return None
+
     def query(self, sel: A.Select) -> list:
         """Batch SELECT: run the stream plan over snapshot sources."""
         self._drain_inflight()   # read-your-writes snapshot
@@ -1420,8 +1642,13 @@ class Session:
         # stream-fold below
         from ..batch.executors import BatchFallback, run_batch
         from ..batch.lower import lower_plan
+        remote_mvs = {l.mv.name for l in collect_leaves(plan)
+                      if isinstance(l, PMvScan)
+                      and l.mv.name in self._remote_specs}
         try:
-            lowered = lower_plan(plan, self.store)
+            # a remote MV's rows live in the worker's store, not ours —
+            # the local-scan fast path would silently read empty tables
+            lowered = None if remote_mvs else lower_plan(plan, self.store)
         except BatchFallback:
             lowered = None
         if lowered is not None:
@@ -1446,8 +1673,13 @@ class Session:
                     tid, schema = leaf.table.table_id, leaf.table.schema
                 else:
                     tid, schema = leaf.mv.table_id, leaf.mv.schema
-                table = StateTable(self.store, tid, schema, [])
-                rows = list(table.scan_all())
+                if (isinstance(leaf, PMvScan)
+                        and leaf.mv.name in self._remote_specs):
+                    rows = self._remote_scan(leaf.mv.name, schema,
+                                             physical=True)
+                else:
+                    table = StateTable(self.store, tid, schema, [])
+                    rows = list(table.scan_all())
                 msgs: list[Message] = [Barrier.new(1)]
                 from ..common.chunk import physical_chunk
                 cap = self.source_chunk_capacity
@@ -1518,14 +1750,38 @@ class Session:
         mv = self.catalog.mvs.get(name)
         if mv is None:
             raise SqlError(f"materialized view {name!r} not found")
+        n_vis = getattr(mv, "n_visible", len(mv.schema))
+        if name in self._remote_specs:
+            return [tuple(r[:n_vis])
+                    for r in self._remote_scan(name, mv.schema)]
         job = self.jobs[name]
         rows = []
-        n_vis = getattr(mv, "n_visible", len(mv.schema))
         for phys in job.table.scan_all():
             rows.append(tuple(
                 None if v is None else mv.schema[i].type.to_python(v)
                 for i, v in enumerate(phys[:n_vis])))
         return rows
+
+    def _remote_scan(self, name: str, schema: Schema,
+                     physical: bool = False) -> list:
+        """Fetch a worker-hosted MV's rows over the scan RPC."""
+        import base64
+
+        from ..common.row import decode_value_row
+        spec = self._remote_specs[name]
+        resp = self._await(
+            spec["worker"].request({"type": "scan", "name": name}))
+        types = [f.type for f in schema]
+        out = []
+        for b in resp["rows"]:
+            phys = decode_value_row(base64.b64decode(b), types)
+            if physical:
+                out.append(phys)
+            else:
+                out.append(tuple(
+                    None if v is None else schema[i].type.to_python(v)
+                    for i, v in enumerate(phys)))
+        return out
 
     def metrics(self) -> dict:
         """Observability dump: per-job per-executor counters + session
@@ -1539,10 +1795,12 @@ class Session:
             "jobs": {
                 name: pipeline_metrics(job.pipeline)
                 for name, job in self.jobs.items()
+                if job.pipeline is not None
             },
             "state_bytes": {
                 name: pipeline_state_bytes(job.pipeline)
                 for name, job in self.jobs.items()
+                if job.pipeline is not None
             },
         }
 
@@ -1565,6 +1823,14 @@ class Session:
 
         self._await(_stop_all())
         self.jobs.clear()
+        for w in self.workers:
+            try:
+                self._await(w.shutdown())
+                self._await(w.aclose())
+            except Exception:  # noqa: BLE001 - already dying
+                pass
+            w.terminate()
+        self.workers = []
         self.loop.close()
 
     def _alloc_shard(self) -> int:

@@ -1,0 +1,4 @@
+from .wire import (  # noqa: F401
+    chunk_to_wire, message_from_wire, message_to_wire, read_frame,
+    wire_to_chunk, write_frame,
+)

@@ -122,6 +122,7 @@ class MemoryStateStore:
     def table_len(self, table_id: int) -> int:
         return len(self._merged_view(table_id))
 
+
     def drop_table(self, table_id: int) -> None:
         """Free a dropped object's state (committed + pending)."""
         self._committed.pop(table_id, None)

@@ -1,0 +1,1 @@
+from .host import WorkerHost  # noqa: F401

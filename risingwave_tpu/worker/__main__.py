@@ -1,0 +1,12 @@
+"""``python -m risingwave_tpu.worker`` — worker-node entry point
+(reference: the compute-node binary, src/cmd/src/bin/compute_node.rs)."""
+
+import os
+
+# a worker spawned for a CPU session must not touch the TPU tunnel
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+from .host import main
+
+main()

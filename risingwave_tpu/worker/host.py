@@ -1,0 +1,460 @@
+"""Worker host: a compute-node process executing stream jobs shipped as
+serialized plans.
+
+Counterpart of the reference's compute node (reference:
+src/compute/src/server.rs node bring-up; StreamService handlers
+src/compute/src/rpc/service/stream_service.rs:46-233 build/drop actors +
+barrier inject/collect; ExchangeService exchange_service.rs:74-133 moves
+permit-metered data between processes). TPU-first scaling: ONE worker
+process owns one accelerator's executors (device parallelism inside the
+process rides the jax mesh), so the cross-process fabric only needs a
+single multiplexed socket per worker, carrying:
+
+  control   create_job / drop_job / barrier / commit / scan / shutdown
+  data      channel frames (DML deltas, upstream changelogs) with
+            consumption-acked permit flow (exchange/permit.rs:35-107)
+
+Durability: the worker owns a DurableStateStore under its own directory.
+Checkpointing is TWO-PHASE across the cluster: a checkpoint barrier seals
+and stages worker state (ack = this worker's state for the epoch is
+staged), and the session's later ``commit`` frame — sent only after every
+worker acked and the session committed its own tier — makes it durable.
+A worker killed between ack and commit recovers at the previous
+checkpoint and its deterministic sources replay the gap (the reference
+gets the same property from meta-owned Hummock version bumps:
+src/meta/src/hummock/manager/ commit_epoch).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import sys
+from typing import AsyncIterator, Optional
+
+from ..common.chunk import StreamChunk
+from ..common.row import encode_value_row
+from ..common.types import Field, INT64, Schema, VARCHAR
+from ..frontend.build import BuildConfig, BuildContext, build_plan
+from ..frontend.catalog import Catalog
+from ..frontend.plan_json import defs_from_json, plan_from_json
+from ..frontend.planner import PMvScan, PSource, PTableScan
+from ..frontend.runtime import QueueSource, StreamJob
+from ..rpc.wire import message_from_wire, read_frame, write_frame
+from ..storage.checkpoint import DurableStateStore
+from ..storage.state_table import StateTable
+from ..stream.eowc import WatermarkFilterExecutor
+from ..stream.executor import Executor
+from ..stream.materialize import MaterializeExecutor
+from ..stream.message import Barrier, Message, Mutation, MutationKind
+from ..stream.row_id_gen import RowIdGenExecutor
+
+
+class _Feed:
+    """Worker-side source feed: connector reader + split-state table
+    (mirrors the session's _SourceFeed; offsets persist with checkpoints
+    and recovery seeks them)."""
+
+    def __init__(self, queue: QueueSource, reader, state_table: StateTable,
+                 job: str):
+        self.queue = queue
+        self.reader = reader
+        self.state_table = state_table
+        self.offsets_at_epoch: dict[int, dict] = {}
+        self.job = job
+
+
+class _ChannelSource(Executor):
+    """Executor view of a wire data channel: frames decode lazily and the
+    permit ack is sent only when the consumer TAKES a chunk — end-to-end
+    consumption-based flow control (reference: permit.rs — data consumes
+    credits, control always passes)."""
+
+    identity = "RemoteExchangeSource"
+
+    def __init__(self, host: "WorkerHost", chan: int, schema: Schema,
+                 capacity: int):
+        self.host = host
+        self.chan = chan
+        self.schema = schema
+        self.capacity = capacity
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    async def execute(self) -> AsyncIterator[Message]:
+        while True:
+            d = await self.queue.get()
+            if d is None:
+                return
+            if isinstance(d, Message):        # locally injected (init cut)
+                msg = d
+            else:
+                msg = message_from_wire(d, self.schema, self.capacity)
+                if isinstance(msg, StreamChunk):
+                    await self.host.send({"type": "ack", "chan": self.chan})
+            yield msg
+            if isinstance(msg, Barrier) and msg.is_stop():
+                return
+
+
+class _RowIdAppend(Executor):
+    """Append the hidden _row_id column slot to connector chunks (the
+    session's _RowIdAppendSource, worker-side)."""
+
+    def __init__(self, inner: QueueSource, out_schema: Schema):
+        self.inner = inner
+        self.schema = out_schema
+
+    async def execute(self) -> AsyncIterator[Message]:
+        import jax.numpy as jnp
+
+        from ..common.chunk import Column
+        async for msg in self.inner.execute():
+            if isinstance(msg, StreamChunk):
+                zero = Column(jnp.zeros(msg.capacity, jnp.int64),
+                              jnp.ones(msg.capacity, jnp.bool_))
+                msg = StreamChunk(msg.ops, msg.vis, msg.columns + (zero,))
+            yield msg
+
+
+class WorkerHost:
+    """One worker process: jobs + durable store + the session socket."""
+
+    def __init__(self, data_dir: str, worker_id: int = 0):
+        self.data_dir = data_dir
+        self.worker_id = worker_id
+        # one durable store per JOB: recovery scope and id space are both
+        # per-job, so a fresh rebuild wipes one directory without
+        # tombstone bookkeeping leaking across incarnations
+        self.stores: dict[str, DurableStateStore] = {}
+        self.catalog = Catalog()
+        self.jobs: dict[str, StreamJob] = {}
+        self.feeds: list[_Feed] = []
+        self.channels: dict[int, _ChannelSource] = {}
+        self.chunks_per_tick = 1
+        self.chunk_capacity = 1024
+        self.seed = 42
+        self._next_shard = worker_id * 4096 + 1
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+
+    async def send(self, obj: dict) -> None:
+        if self._writer is not None:
+            await write_frame(self._writer, obj, self._wlock)
+
+    # -- job construction ------------------------------------------------------
+
+    def _source_leaf(self, leaf: PSource, job_name: str, store,
+                     next_table_id) -> Executor:
+        src = leaf.source
+        q = QueueSource(src.schema)
+        from ..connector.factory import make_reader
+        reader = make_reader(src.connector, src.options, src.schema,
+                             self.chunk_capacity, self.seed)
+        start_seq = 0
+        if reader is not None:
+            st = StateTable(store, next_table_id(),
+                            Schema((Field("split_id", VARCHAR),
+                                    Field("next_offset", INT64))), [0])
+            offsets = {VARCHAR.to_python(r[0]): int(r[1])
+                       for r in st.scan_all()}
+            if offsets:           # recovered split state: seek
+                reader.seek(offsets)
+                start_seq = reader.rows_emitted()
+            self.feeds.append(_Feed(q, reader, st, job_name))
+        ex: Executor = _RowIdAppend(q, leaf.schema)
+        ex = RowIdGenExecutor(ex, row_id_index=leaf.row_id_index,
+                              shard_id=self._alloc_shard(),
+                              start_seq=start_seq)
+        if src.watermark is not None:
+            col, delay = src.watermark
+            ex = WatermarkFilterExecutor(ex, time_col=col, delay=delay)
+        return ex
+
+    def _alloc_shard(self) -> int:
+        self._next_shard += 1
+        return self._next_shard - 1
+
+    def _job_dir(self, name: str) -> str:
+        import os
+        return os.path.join(self.data_dir, "jobs", name)
+
+    async def handle_create_job(self, req: dict) -> dict:
+        name = req["name"]
+        if req.get("fresh"):
+            # table-fed jobs rebuild from the upstream snapshot: wipe any
+            # prior incarnation's durable state wholesale (in-memory AND
+            # on-disk — the store object must not outlive the wipe)
+            import shutil
+            shutil.rmtree(self._job_dir(name), ignore_errors=True)
+            self.stores.pop(name, None)
+        store = self.stores.get(name)
+        if store is None:
+            store = DurableStateStore(self._job_dir(name))
+            self.stores[name] = store
+        for d in defs_from_json(req["defs"]):
+            kind = type(d).__name__
+            reg = {"SourceDef": self.catalog.sources,
+                   "TableDef": self.catalog.tables,
+                   "MaterializedViewDef": self.catalog.mvs}[kind]
+            reg[d.name] = d                      # replica upsert
+        self.chunks_per_tick = req.get("chunks_per_tick", 1)
+        self.chunk_capacity = req.get("chunk_capacity", 1024)
+        self.seed = req.get("seed", 42)
+        plan = plan_from_json(req["plan"], self.catalog)
+        chan_of_leaf = {int(k): v for k, v in req.get("channels", {}).items()}
+        ids = iter(range(req["id_start"], req["id_start"] + 10_000))
+        leaf_i = [0]
+        queues: list[QueueSource] = []
+
+        def next_table_id() -> int:
+            return next(ids)
+
+        def factory(leaf) -> Executor:
+            i = leaf_i[0]
+            leaf_i[0] += 1
+            if isinstance(leaf, PSource):
+                ex = self._source_leaf(leaf, name, store, next_table_id)
+                # find the root queue for barrier injection
+                inner = ex
+                while not isinstance(inner, QueueSource):
+                    inner = getattr(inner, "inner", None) or inner.input
+                queues.append(inner)
+                return ex
+            if isinstance(leaf, (PTableScan, PMvScan)):
+                chan = chan_of_leaf.get(i)
+                if chan is None:
+                    raise ValueError(
+                        f"scan leaf {i} of remote job {name!r} has no "
+                        "exchange channel")
+                ch = _ChannelSource(self, chan, leaf.schema,
+                                    self.chunk_capacity)
+                self.channels[chan] = ch
+                return ch
+            raise ValueError(
+                f"cannot build remote leaf {type(leaf).__name__}")
+
+        cfg = BuildConfig(**req.get("config", {}))
+        ctx = BuildContext(store, next_table_id, factory, cfg,
+                           durable=True)
+        chans_before = set(self.channels)
+        try:
+            pipeline = build_plan(plan, ctx)
+        except Exception:
+            # half-built job: release anything the factory registered
+            for c in set(self.channels) - chans_before:
+                self.channels.pop(c, None)
+            self.feeds = [f for f in self.feeds if f.job != name]
+            raise
+        mat = MaterializeExecutor(
+            pipeline, StateTable(store, req["mv_table_id"],
+                                 plan.schema, list(plan.pk)))
+        job = StreamJob(name, mat, queues, actors=ctx.actors)
+        self.jobs[name] = job
+        job.start()                          # current (running) loop
+        return {"ok": True, "state_table_ids": ctx.state_table_ids,
+                "ids_end": next(ids)}
+
+    async def handle_drop_job(self, req: dict) -> dict:
+        name = req["name"]
+        job = self.jobs.pop(name, None)
+        if job is None:
+            return {"ok": True}
+        stop = Barrier.new(req["epoch"],
+                           mutation=Mutation(MutationKind.STOP))
+        for q in job.sources:
+            q.push(stop)
+        for ch in _channel_roots(job):
+            ch.queue.put_nowait(stop)
+            self.channels.pop(ch.chan, None)
+        await job.stop()
+        self.feeds = [f for f in self.feeds if f.job != name]
+        self.stores.pop(name, None)
+        if req.get("drop_state", True):
+            import shutil
+            shutil.rmtree(self._job_dir(name), ignore_errors=True)
+        return {"ok": True}
+
+    # -- barrier conduction ----------------------------------------------------
+
+    async def handle_barrier(self, req: dict) -> None:
+        """Inject this epoch into worker-driven roots, then collect all
+        in-scope jobs and ack. Runs as its own task so data frames keep
+        flowing while executors work (barrier pipelining)."""
+        epoch = int(req["epoch"])
+        checkpoint = bool(req.get("checkpoint", False))
+        only = req.get("only")
+        scope = set(only) if only is not None else set(self.jobs)
+        mut = None
+        if req.get("mutation"):
+            mut = Mutation(MutationKind(req["mutation"]),
+                           req.get("mutation_payload"))
+        barrier = Barrier.new(epoch, checkpoint=checkpoint, mutation=mut)
+        if req.get("generate", False):
+            for feed in self.feeds:
+                if feed.job not in scope:
+                    continue
+                for _ in range(self.chunks_per_tick):
+                    chunk = feed.reader.next_chunk()
+                    if chunk is not None:
+                        feed.queue.push(chunk)
+        for feed in self.feeds:
+            if feed.job in scope:
+                feed.offsets_at_epoch[epoch] = feed.reader.offsets
+                feed.queue.push(barrier)
+        if req.get("init", False):
+            # init cut for a just-created job: its channel roots have no
+            # live upstream stream yet, so the barrier is injected locally
+            for name in scope:
+                job = self.jobs.get(name)
+                if job is not None:
+                    for ch in _channel_roots(job):
+                        ch.queue.put_nowait(barrier)
+        try:
+            for name in scope:
+                job = self.jobs.get(name)
+                if job is not None:
+                    await job.wait_barrier(epoch)
+        except BaseException as e:   # noqa: BLE001 - surfaced to the session
+            await self.send({"type": "barrier_complete", "epoch": epoch,
+                             "ok": False, "error": repr(e)})
+            raise
+        if checkpoint:
+            for feed in self.feeds:
+                if feed.job not in scope:
+                    continue
+                latest = None
+                for oe in sorted(list(feed.offsets_at_epoch)):
+                    if oe <= epoch:
+                        latest = feed.offsets_at_epoch.pop(oe)
+                if latest is not None:
+                    for sid, off in latest.items():
+                        feed.state_table.insert(
+                            (VARCHAR.to_physical(sid), int(off)))
+                    feed.state_table.commit(epoch)
+        await self.send({"type": "barrier_complete", "epoch": epoch,
+                         "init": bool(req.get("init", False))})
+
+    # -- scan ------------------------------------------------------------------
+
+    def handle_scan(self, req: dict) -> dict:
+        name = req["name"]
+        job = self.jobs.get(name)
+        if job is None:
+            return {"ok": False, "error": f"job {name!r} not found"}
+        schema = job.pipeline.schema
+        types = [f.type for f in schema]
+        rows = [base64.b64encode(encode_value_row(r, types)).decode()
+                for r in job.table.scan_all()]
+        return {"ok": True, "rows": rows}
+
+    # -- serve -----------------------------------------------------------------
+
+    async def _reply(self, frame: dict, handler) -> None:
+        """Per-request error isolation: a failing handler (bad plan,
+        unknown connector, missing file) answers THIS request with the
+        error — it must never tear down the worker and its other jobs
+        (the local path surfaces the same failures as per-statement
+        SqlErrors)."""
+        try:
+            resp = await handler(frame)
+        except Exception as e:  # noqa: BLE001 - shipped to the session
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        resp.update({"type": "reply", "rid": frame["rid"]})
+        await self.send(resp)
+
+    async def handle_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+        tasks: list[asyncio.Task] = []
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break                        # session died: exit
+                t = frame["type"]
+                if t == "data":
+                    ch = self.channels.get(frame["chan"])
+                    if ch is not None:
+                        ch.queue.put_nowait(frame["msg"])
+                elif t == "barrier":
+                    tasks.append(
+                        asyncio.ensure_future(self.handle_barrier(frame)))
+                elif t == "commit":
+                    # phase 2 of the cluster checkpoint: every job's
+                    # staged state for the epoch becomes durable
+                    for store in self.stores.values():
+                        store.commit(int(frame["epoch"]))
+                elif t == "create_job":
+                    await self._reply(frame, self.handle_create_job)
+                elif t == "drop_job":
+                    await self._reply(frame, self.handle_drop_job)
+                elif t == "scan":
+                    async def _scan(f):
+                        return self.handle_scan(f)
+                    await self._reply(frame, _scan)
+                elif t == "shutdown":
+                    await self.send({"type": "reply", "rid": frame["rid"],
+                                     "ok": True})
+                    break
+                else:
+                    await self.send({"type": "reply",
+                                     "rid": frame.get("rid"),
+                                     "ok": False,
+                                     "error": f"unknown frame {t!r}"})
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            for job in self.jobs.values():
+                await job.stop()
+            writer.close()
+
+
+def _channel_roots(job: StreamJob):
+    """The _ChannelSource leaves of a job's pipeline (walked, not
+    registered: channels are created inside the build factory)."""
+    out = []
+    stack = [job.pipeline]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _ChannelSource):
+            out.append(node)
+            continue
+        for attr in ("input", "inner", "left", "right"):
+            child = getattr(node, attr, None)
+            if isinstance(child, Executor):
+                stack.append(child)
+        for child in getattr(node, "inputs", ()):
+            stack.append(child)
+    return out
+
+
+async def amain(data_dir: str, worker_id: int, port: int) -> None:
+    host = WorkerHost(data_dir, worker_id)
+    done = asyncio.Event()
+
+    async def conn(reader, writer):
+        try:
+            await host.handle_conn(reader, writer)
+        finally:
+            done.set()
+
+    server = await asyncio.start_server(conn, "127.0.0.1", port)
+    actual = server.sockets[0].getsockname()[1]
+    print(f"WORKER_READY {actual}", flush=True)
+    async with server:
+        await done.wait()
+
+
+def main(argv=None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--worker-id", type=int, default=0)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    asyncio.run(amain(args.data_dir, args.worker_id, args.port))
+
+
+if __name__ == "__main__":
+    main()

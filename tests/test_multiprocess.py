@@ -1,0 +1,237 @@
+"""Multi-process execution: worker processes hosting MV jobs behind the
+session's meta/frontend process (VERDICT r4 item 2).
+
+What crosses the REAL process boundary here:
+  * serialized plans + catalog defs (create_job),
+  * permit-metered exchange frames (DML deltas / backfill snapshots),
+  * barrier inject / collect / two-phase checkpoint commit,
+  * kill -9 of a worker driving scoped recovery end-to-end.
+
+Reference: src/compute/src/rpc/service/stream_service.rs:46-233,
+exchange_service.rs:74-133, recovery src/meta/src/barrier/recovery.rs:110.
+"""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+BID_DDL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,
+channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)
+WITH (connector='nexmark', nexmark_table='bid', rows_per_chunk='128')"""
+
+Q5ISH = ("CREATE MATERIALIZED VIEW q AS SELECT auction, count(*) AS n, "
+         "max(price) AS mx FROM bid GROUP BY auction")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    s = Session(workers=1, seed=11, data_dir=str(tmp_path / "cluster"))
+    yield s
+    s.close()
+
+
+class TestRemoteExchange:
+    def test_table_fed_mv_over_the_wire(self, cluster):
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v * 2 AS d FROM t")
+        assert "m" in s._remote_specs          # placed on the worker
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(1, 20), (2, 40)]
+        s.run_sql("INSERT INTO t VALUES (3, 30)")
+        s.run_sql("DELETE FROM t WHERE k = 1")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(2, 40), (3, 60)]
+
+    def test_snapshot_backfill_of_existing_table(self, cluster):
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("INSERT INTO t VALUES (1, 1), (2, 2), (3, 3)")
+        s.flush()
+        # MV created AFTER data exists: snapshot ships over the channel
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v + 100 AS v FROM t")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(1, 101), (2, 102), (3, 103)]
+
+    def test_backpressure_permits_bound_outstanding_chunks(self, tmp_path):
+        from risingwave_tpu.frontend.build import BuildConfig
+        s = Session(workers=1, seed=3,
+                    config=BuildConfig(exchange_permits=2))
+        try:
+            s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+            s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                      "SELECT sum(v) AS s FROM t")
+            # many separate chunks through a 2-permit channel: the
+            # forwarder must block on acks, never lose or reorder
+            for i in range(30):
+                s.run_sql(f"INSERT INTO t VALUES ({i}, {i})")
+                if i % 5 == 0:
+                    s.tick(generate=False)
+            s.flush()
+            assert s.mv_rows("m") == [(sum(range(30)),)]
+            sem = s.workers[0]._sems[
+                next(iter(s._remote_specs["m"]["channels"].values()))]
+            assert sem._value <= 2             # permits never over-release
+        finally:
+            s.close()
+
+    def test_batch_select_reads_worker_state(self, cluster):
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v FROM t WHERE v >= 20")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+        s.flush()
+        got = sorted(s.run_sql("SELECT k FROM m"))
+        assert got == [(2,), (3,)]
+
+    def test_drop_remote_mv(self, cluster):
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k FROM t")
+        s.run_sql("DROP MATERIALIZED VIEW m")
+        assert "m" not in s._remote_specs
+        assert "m" not in s.jobs
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t")
+        s.run_sql("INSERT INTO t VALUES (7, 70)")
+        s.flush()
+        assert s.mv_rows("m") == [(7, 70)]
+
+
+class TestRemoteSource:
+    def test_source_fed_mv_matches_local(self, tmp_path):
+        remote = Session(workers=1, seed=7)
+        remote.run_sql(BID_DDL)
+        remote.run_sql(Q5ISH)
+        for _ in range(6):
+            remote.tick()
+        remote.flush()
+        r_rows = sorted(remote.mv_rows("q"))
+        remote.close()
+
+        local = Session(seed=7)
+        local.run_sql(BID_DDL)
+        local.run_sql(Q5ISH)
+        for _ in range(6):
+            local.tick()
+        local.flush()
+        l_rows = sorted(local.mv_rows("q"))
+        local.close()
+        assert r_rows == l_rows and len(r_rows) > 10
+
+
+class TestWorkerKillRecovery:
+    def test_kill9_source_fed_exactly_once(self, tmp_path):
+        """SIGKILL the worker mid-stream; the heartbeat detector declares
+        its jobs dead, scoped recovery respawns the process over the same
+        durable directory, offsets seek, and the deterministic source
+        replays the uncommitted gap — final state identical to an
+        uninterrupted run with the same generated epochs."""
+        s = Session(workers=1, seed=11,
+                    data_dir=str(tmp_path / "cluster"))
+        s.run_sql(BID_DDL)
+        s.run_sql(Q5ISH)
+        for _ in range(5):
+            s.tick()
+        s.flush()
+        _ = s.mv_rows("q")        # round-trip: phase-2 commit processed
+        pid0 = s.workers[0].proc.pid
+        s.workers[0].kill9()
+        for _ in range(10):       # TTL = 3 epochs, then recovery in-tick
+            s.tick()
+            if not s.workers[0].dead:
+                break
+        assert not s.workers[0].dead, "worker was not recovered"
+        assert s.workers[0].proc.pid != pid0
+        for _ in range(5):
+            s.tick()
+        s.flush()
+        r_rows = sorted(s.mv_rows("q"))
+        s.close()
+
+        local = Session(seed=11)
+        local.run_sql(BID_DDL)
+        local.run_sql(Q5ISH)
+        for _ in range(10):       # 5 pre-kill + 5 post-recovery generates
+            local.tick()
+        local.flush()
+        l_rows = sorted(local.mv_rows("q"))
+        local.close()
+        assert r_rows == l_rows
+
+    def test_kill9_table_fed_rebuilds_from_snapshot(self, tmp_path):
+        """A channel-fed job killed mid-stream rebuilds FRESH from the
+        upstream table's current state — including rows inserted while
+        the worker was down."""
+        s = Session(workers=1, seed=5, data_dir=str(tmp_path / "cluster"))
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v * 10 AS d FROM t")
+        s.run_sql("INSERT INTO t VALUES (1, 1), (2, 2)")
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [(1, 10), (2, 20)]
+        s.workers[0].kill9()
+        s.run_sql("INSERT INTO t VALUES (3, 3)")   # while worker is dead
+        for _ in range(10):
+            s.tick(generate=False)
+            if not s.workers[0].dead:
+                break
+        assert not s.workers[0].dead
+        s.run_sql("INSERT INTO t VALUES (4, 4)")   # after recovery
+        s.flush()
+        assert sorted(s.mv_rows("m")) == [
+            (1, 10), (2, 20), (3, 30), (4, 40)]
+
+    def test_session_restart_replays_remote_jobs(self, tmp_path):
+        d = str(tmp_path / "cluster")
+        s = Session(workers=1, seed=9, data_dir=d)
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, v + 1 AS v1 FROM t")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        s.close()
+        # fresh session over the same dir: DDL replays, the remote job is
+        # re-created on a fresh worker and rebuilt from the recovered table
+        s2 = Session(workers=1, seed=9, data_dir=d)
+        try:
+            assert sorted(s2.mv_rows("m")) == [(1, 11), (2, 21)]
+            s2.run_sql("INSERT INTO t VALUES (3, 30)")
+            s2.flush()
+            assert sorted(s2.mv_rows("m")) == [(1, 11), (2, 21), (3, 31)]
+        finally:
+            s2.close()
+
+
+class TestRemoteGuards:
+    def test_mv_on_remote_mv_rejected(self, cluster):
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t")
+        from risingwave_tpu.frontend.session import SqlError
+        with pytest.raises(SqlError, match="worker-hosted"):
+            s.run_sql("CREATE MATERIALIZED VIEW m2 AS SELECT k FROM m")
+
+    def test_worker_side_failure_isolated(self, cluster):
+        """A create_job that fails ON THE WORKER (bad connector options)
+        surfaces as a per-statement error, keeps the worker and its other
+        jobs alive, and rolls the id counter back (replay determinism)."""
+        s = cluster
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW ok AS SELECT k, v FROM t")
+        s.run_sql("INSERT INTO t VALUES (1, 1)")
+        s.flush()
+        s.run_sql("CREATE SOURCE badsrc (x BIGINT) "
+                  "WITH (connector='file')")      # no path: reader fails
+        id_before = s.catalog._next_table_id
+        with pytest.raises(Exception, match="path"):
+            s.run_sql("CREATE MATERIALIZED VIEW bad AS "
+                      "SELECT x FROM badsrc")
+        assert s.catalog._next_table_id == id_before
+        assert not s.workers[0].dead              # worker survived
+        s.run_sql("INSERT INTO t VALUES (2, 2)")
+        s.flush()
+        assert sorted(s.mv_rows("ok")) == [(1, 1), (2, 2)]
