@@ -2,10 +2,21 @@
 
 Counterpart of the reference's pgwire crate
 (reference: src/utils/pgwire/src/pg_server.rs:131 ``pg_serve``,
-pg_protocol.rs:220-259 message loop). Implements the simple-query flow —
-startup (trust auth), Query, RowDescription/DataRow/CommandComplete,
-ErrorResponse, ReadyForQuery, Terminate — enough for psql/BI tools and the
-sqllogictest-style drivers the reference serves.
+pg_protocol.rs:220-259 message loop). Implements BOTH flows:
+
+* simple query — Query, RowDescription/DataRow/CommandComplete,
+  ErrorResponse, ReadyForQuery, Terminate;
+* extended query (r5) — Parse/Bind/Describe/Execute/Close/Flush/Sync with
+  text-format parameters, prepared-statement + portal registries, and
+  error-skip-until-Sync semantics (reference: pg_protocol.rs:220-259
+  extended-mode dispatch, pg_extended.rs portals).
+
+Parameters arrive as text; binding substitutes them into the SQL by a
+quote-aware scan ($n never matches inside string literals), typed by the
+Parse-declared OIDs when present and by literal shape otherwise — the
+statement then flows through the same planner/binder as any other SQL
+(the reference rewrites $n into bound parameters at the binder level;
+this design keeps ONE front door instead).
 
 The Session API is synchronous and owns its private event loop, so query
 execution is serialized onto one worker thread; protocol IO stays on the
@@ -47,6 +58,72 @@ def _msg(tag: bytes, payload: bytes) -> bytes:
 
 def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
+
+
+# OIDs whose text values inline unquoted into SQL
+_NUMERIC_OIDS = {16, 20, 21, 23, 700, 701, 1700}
+
+import re as _re
+
+_NUM_RE = _re.compile(r"-?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?")
+
+
+def _render_param(value: Optional[str], oid: int) -> str:
+    """Render one text-format parameter as a SQL literal. Parameters are
+    DATA: a numeric-OID value that is not numeric-shaped is rejected, not
+    inlined (inlining it verbatim would let a bound parameter alter the
+    query's syntax)."""
+    if value is None:
+        return "NULL"
+    if oid in _NUMERIC_OIDS:
+        if oid == 16:
+            return "TRUE" if value in ("t", "true", "1", "TRUE") else "FALSE"
+        if not _NUM_RE.fullmatch(value):
+            raise ValueError(
+                f"invalid input for numeric parameter: {value!r}")
+        return value
+    if oid == 0 and _NUM_RE.fullmatch(value):  # undeclared: shape decides
+        return value
+    return "'" + value.replace("'", "''") + "'"
+
+
+def _substitute_params(sql: str, params: list, oids: list) -> str:
+    """Replace $n placeholders outside string literals / quoted
+    identifiers with rendered literals."""
+    out = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c == "'":                       # string literal ('' escapes)
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:j + 1])
+            i = j + 1
+        elif c == '"':                     # quoted identifier
+            j = sql.find('"', i + 1)
+            j = n - 1 if j < 0 else j
+            out.append(sql[i:j + 1])
+            i = j + 1
+        elif c == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            idx = int(sql[i + 1:j]) - 1
+            if idx < 0 or idx >= len(params):
+                raise ValueError(f"parameter ${idx + 1} not bound")
+            oid = oids[idx] if idx < len(oids) else 0
+            out.append(_render_param(params[idx], oid))
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _fmt_value(v, t: Optional[DataType]) -> str:
@@ -106,6 +183,10 @@ class PgWireServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # per-connection extended-protocol state (reference: pg_extended.rs)
+        stmts: dict[str, tuple[str, list]] = {}     # name -> (sql, oids)
+        portals: dict[str, tuple[str, Optional[list]]] = {}  # -> (sql, schema)
+        skip_until_sync = False
         try:
             if not await self._startup(reader, writer):
                 return
@@ -115,17 +196,35 @@ class PgWireServer:
                 body = await reader.readexactly(ln - 4)
                 if tag == b"X":          # Terminate
                     break
+                if tag == b"S":          # Sync: end of an extended batch
+                    skip_until_sync = False
+                    writer.write(_msg(b"Z", b"I"))
+                    await writer.drain()
+                    continue
+                if skip_until_sync and tag in (b"P", b"B", b"D", b"E", b"C",
+                                               b"H"):
+                    continue             # error mode: discard until Sync
                 if tag == b"Q":
                     sql = body.rstrip(b"\x00").decode()
                     await self._run_query(writer, sql)
-                elif tag in (b"P", b"B", b"D", b"E", b"S", b"C"):
-                    # extended protocol not supported: report cleanly once a
-                    # Sync arrives (reference: pg_protocol extended mode)
-                    if tag == b"S":
-                        self._send_error(
-                            writer, "extended query protocol not supported")
-                        writer.write(_msg(b"Z", b"I"))
-                        await writer.drain()
+                elif tag == b"P":
+                    skip_until_sync = not await self._on_parse(
+                        writer, body, stmts)
+                elif tag == b"B":
+                    skip_until_sync = not await self._on_bind(
+                        writer, body, stmts, portals)
+                elif tag == b"D":
+                    skip_until_sync = not await self._on_describe(
+                        writer, body, stmts, portals)
+                elif tag == b"E":
+                    skip_until_sync = not await self._on_execute(
+                        writer, body, portals)
+                elif tag == b"C":        # Close statement/portal
+                    kind, name = body[0:1], body[1:].split(b"\x00")[0].decode()
+                    (stmts if kind == b"S" else portals).pop(name, None)
+                    writer.write(_msg(b"3", b""))    # CloseComplete
+                elif tag == b"H":        # Flush
+                    await writer.drain()
                 else:
                     self._send_error(writer, f"unknown message {tag!r}")
                     writer.write(_msg(b"Z", b"I"))
@@ -134,6 +233,137 @@ class PgWireServer:
             pass
         finally:
             writer.close()
+
+    # -- extended-query flow ---------------------------------------------------
+
+    async def _on_parse(self, writer, body: bytes, stmts) -> bool:
+        try:
+            name, rest = body.split(b"\x00", 1)
+            sql, rest = rest.split(b"\x00", 1)
+            (n_oids,) = struct.unpack_from("!H", rest, 0)
+            oids = list(struct.unpack_from(f"!{n_oids}I", rest, 2))
+            stmts[name.decode()] = (sql.decode(), oids)
+            writer.write(_msg(b"1", b""))            # ParseComplete
+            return True
+        except Exception as e:  # noqa: BLE001
+            self._send_error(writer, f"parse failed: {e}")
+            await writer.drain()
+            return False
+
+    async def _on_bind(self, writer, body: bytes, stmts, portals) -> bool:
+        try:
+            portal, rest = body.split(b"\x00", 1)
+            stmt_name, rest = rest.split(b"\x00", 1)
+            pos = 0
+            (n_fmt,) = struct.unpack_from("!H", rest, pos)
+            pos += 2 + 2 * n_fmt
+            fmts = list(struct.unpack_from(f"!{n_fmt}H", rest, 2))
+            (n_params,) = struct.unpack_from("!H", rest, pos)
+            pos += 2
+            params: list = []
+            for i in range(n_params):
+                (plen,) = struct.unpack_from("!i", rest, pos)
+                pos += 4
+                if plen < 0:
+                    params.append(None)
+                else:
+                    raw = rest[pos:pos + plen]
+                    pos += plen
+                    fmt = (fmts[i] if i < len(fmts)
+                           else (fmts[0] if len(fmts) == 1 else 0))
+                    if fmt == 1:
+                        raise ValueError(
+                            "binary parameter format not supported")
+                    params.append(raw.decode())
+            sql, oids = stmts[stmt_name.decode()]
+            bound = _substitute_params(sql, params, oids)
+            portals[portal.decode()] = (bound, None)
+            writer.write(_msg(b"2", b""))            # BindComplete
+            return True
+        except KeyError:
+            self._send_error(writer, "unknown prepared statement")
+            await writer.drain()
+            return False
+        except Exception as e:  # noqa: BLE001
+            self._send_error(writer, f"bind failed: {e}")
+            await writer.drain()
+            return False
+
+    def _write_row_description(self, writer, schema) -> None:
+        payload = struct.pack("!H", len(schema))
+        for name, t in schema:
+            payload += (_cstr(name) + struct.pack(
+                "!IHIhih", 0, 0, _OIDS.get(t.kind, 25), -1, -1, 0))
+        writer.write(_msg(b"T", payload))
+
+    async def _on_describe(self, writer, body: bytes, stmts,
+                           portals) -> bool:
+        kind, name = body[0:1], body[1:].split(b"\x00")[0].decode()
+        loop = asyncio.get_running_loop()
+        try:
+            if kind == b"S":
+                sql, oids = stmts[name]
+                writer.write(_msg(b"t", struct.pack(
+                    f"!H{len(oids)}I", len(oids), *oids)))
+                # schema of a parameterized statement: plan with NULLs
+                probe = _substitute_params(
+                    sql, [None] * 64, oids or [0] * 64)
+                schema = await loop.run_in_executor(
+                    self._executor, self._describe, probe)
+            else:
+                sql, schema = portals[name]
+                if schema is None:
+                    schema = await loop.run_in_executor(
+                        self._executor, self._describe, sql)
+                    portals[name] = (sql, schema)
+            if schema is None:
+                writer.write(_msg(b"n", b""))        # NoData
+            else:
+                self._write_row_description(writer, schema)
+            return True
+        except KeyError:
+            self._send_error(writer, "unknown statement/portal")
+            await writer.drain()
+            return False
+        except Exception:  # noqa: BLE001 - undescribable: NoData, not fatal
+            writer.write(_msg(b"n", b""))
+            return True
+
+    async def _on_execute(self, writer, body: bytes, portals) -> bool:
+        name = body.split(b"\x00")[0].decode()
+        loop = asyncio.get_running_loop()
+        try:
+            sql, _schema = portals[name]
+        except KeyError:
+            self._send_error(writer, "unknown portal")
+            await writer.drain()
+            return False
+        try:
+            rows, schema, command = await loop.run_in_executor(
+                self._executor, self._execute, sql)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(writer, str(e))
+            await writer.drain()
+            return False
+        if schema is not None:
+            for row in rows:
+                rbody = struct.pack("!H", len(row))
+                for v, (_, t) in zip(row, schema):
+                    if v is None:
+                        rbody += struct.pack("!i", -1)
+                    else:
+                        s = _fmt_value(v, t).encode()
+                        rbody += struct.pack("!i", len(s)) + s
+                writer.write(_msg(b"D", rbody))
+            command = f"SELECT {len(rows)}"
+        writer.write(_msg(b"C", _cstr(command)))
+        await writer.drain()
+        return True
+
+    def _describe(self, sql: str):
+        """Worker-thread: output schema of ``sql`` WITHOUT executing it
+        (None for statements that return no rows)."""
+        return self.session.describe(sql)
 
     async def _startup(self, reader, writer) -> bool:
         while True:
