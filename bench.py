@@ -120,21 +120,28 @@ class _DeviceBidSource:
             yield Barrier.new(epoch)
 
 
-def measure_q5(n_chunks: int) -> float:
-    """Sustained source rows/s of the q5-core pipeline on this backend."""
-    import jax
+def _q5_pipeline(src):
     from risingwave_tpu.common import INT64, TIMESTAMP
     from risingwave_tpu.expr import Literal, call, col
     from risingwave_tpu.expr.agg import count_star
     from risingwave_tpu.stream import HashAggExecutor, ProjectExecutor
-
-    src = _DeviceBidSource(WARMUP_CHUNKS, 1)
-    proj = ProjectExecutor(src, [
+    exprs = [
         call("tumble_start", col(5, TIMESTAMP), Literal(WINDOW_US, INT64)),
         col(0, INT64),
-    ], names=("window_start", "auction"))
+    ]
+    proj = ProjectExecutor(src, exprs, names=("window_start", "auction"))
     agg = HashAggExecutor(proj, [0, 1], [count_star()],
                           table_capacity=1 << 21, out_capacity=CHUNK)
+    return exprs, agg
+
+
+def measure_q5(n_chunks: int) -> float:
+    """Sustained source rows/s of the q5-core EXECUTOR pipeline (three
+    dispatches per epoch: generate / project / agg-scan)."""
+    import jax
+
+    src = _DeviceBidSource(WARMUP_CHUNKS, 1)
+    _, agg = _q5_pipeline(src)
 
     async def drive() -> float:
         async for _ in agg.execute():  # warmup pass compiles every step
@@ -148,6 +155,55 @@ def measure_q5(n_chunks: int) -> float:
         return time.perf_counter() - t0
 
     elapsed = asyncio.run(drive())
+    return n_chunks * CHUNK / elapsed
+
+
+def measure_q5_fused(n_chunks: int) -> float:
+    """Sustained source rows/s of the q5 core with the WHOLE epoch —
+    generation, projection, aggregation — fused into one lax.scan
+    dispatch (ops/fused_epoch.py; the BASELINE.md headroom item). The
+    barrier path (probe + flush-window gathers + finish) mirrors
+    HashAggExecutor.on_barrier exactly so the work per barrier matches
+    the executor pipeline."""
+    import jax
+    import jax.numpy as jnp
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.ops.fused_epoch import fused_source_agg_epoch
+
+    src = _DeviceBidSource(1, 1)
+    exprs, agg = _q5_pipeline(src)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    fused = fused_source_agg_epoch(gen.chunk_fn(), exprs, agg.core, CHUNK)
+
+    def run(state, n, start_event, batch_no):
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)  # remainder epoch kept
+            done += per
+            key = jax.random.fold_in(jax.random.PRNGKey(17), batch_no)
+            batch_no += 1
+            state = fused(state, jnp.int64(start_event), key, per)
+            start_event += per * CHUNK
+            packed, rank = agg._probe(state)
+            n_dirty, overflow, _live = (
+                int(x) for x in jax.device_get(packed))
+            if overflow:
+                raise RuntimeError("q5 fused: group table overflow")
+            lo = 0
+            while lo < n_dirty:
+                agg._gather(state, rank, jnp.int64(lo))
+                lo += agg.core.groups_per_chunk
+            state = agg._finish(state)
+        return state, start_event, batch_no
+
+    state, start_event, batch_no = run(
+        agg.state, WARMUP_CHUNKS, 0, 0)        # compile everything
+    jax.block_until_ready(state.lanes)
+    t0 = time.perf_counter()
+    state, _, _ = run(state, n_chunks, start_event, batch_no)
+    jax.block_until_ready(state.lanes)
+    elapsed = time.perf_counter() - t0
     return n_chunks * CHUNK / elapsed
 
 
@@ -234,7 +290,10 @@ def run_phase(n_chunks: int, q7_chunks: int, with_latency: bool) -> None:
     """Child entry: measure everything on this process's backend, print one
     JSON line."""
     out = {"metric": "nexmark_q5_core_throughput", "unit": "rows/s"}
-    out["value"] = round(measure_q5(n_chunks), 1)
+    # fused single-dispatch epoch is the headline; the executor path is
+    # kept as a secondary so the fusion win stays visible in the record
+    out["value"] = round(measure_q5_fused(n_chunks), 1)
+    out["q5_executor_rows_per_sec"] = round(measure_q5(n_chunks), 1)
     out["q7_rows_per_sec"] = round(measure_q7(q7_chunks), 1)
     if with_latency:
         lat = measure_barrier_latency(in_flight=1)
@@ -328,8 +387,11 @@ def main() -> int:
         "baseline_kind": "same pipeline, JAX_PLATFORMS=cpu "
                          "(Rust-engine stand-in)",
         "cpu_standin_rows_per_sec": round(cpu_rps, 1),
+        "q5_executor_rows_per_sec": tpu.get("q5_executor_rows_per_sec"),
+        "q5_cpu_executor_rows_per_sec": cpu.get("q5_executor_rows_per_sec"),
         "chunks_per_dispatch": CHUNKS_PER_EPOCH,
-        "ingest": "on-device generation (DeviceBidGenerator)",
+        "ingest": "fused single-dispatch epochs (gen+project+agg in one "
+                  "lax.scan; ops/fused_epoch.py)",
         "q7_join_rows_per_sec": tpu["q7_rows_per_sec"],
         "q7_vs_baseline": round(tpu["q7_rows_per_sec"] / cpu_q7, 2),
         "q7_cpu_standin_rows_per_sec": round(cpu_q7, 1),

@@ -267,3 +267,15 @@ class DeviceBidGenerator:
         start = self.events_so_far
         self.events_so_far += k * self.cfg.chunk_capacity
         return ChunkBatch(self._gen(jnp.int64(start), key, k))
+
+    def chunk_fn(self):
+        """Traceable ``(start_event_id, key) -> StreamChunk`` producing ONE
+        flat chunk — the fusion surface for single-dispatch epochs
+        (ops/fused_epoch.py): callers compose it INSIDE their own jit, so
+        generation fuses with downstream projection/aggregation."""
+        def fn(start, key):
+            ch = self._gen_impl(start, key, 1)
+            return StreamChunk(
+                ch.ops[0], ch.vis[0],
+                tuple(Column(c.data[0], c.mask[0]) for c in ch.columns))
+        return fn

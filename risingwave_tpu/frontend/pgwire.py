@@ -77,7 +77,13 @@ def _render_param(value: Optional[str], oid: int) -> str:
         return "NULL"
     if oid in _NUMERIC_OIDS:
         if oid == 16:
-            return "TRUE" if value in ("t", "true", "1", "TRUE") else "FALSE"
+            low = value.strip().lower()
+            if low in ("t", "true", "y", "yes", "on", "1"):
+                return "TRUE"
+            if low in ("f", "false", "n", "no", "off", "0"):
+                return "FALSE"
+            raise ValueError(
+                f"invalid input syntax for type boolean: {value!r}")
         if not _NUM_RE.fullmatch(value):
             raise ValueError(
                 f"invalid input for numeric parameter: {value!r}")
@@ -87,9 +93,9 @@ def _render_param(value: Optional[str], oid: int) -> str:
     return "'" + value.replace("'", "''") + "'"
 
 
-def _substitute_params(sql: str, params: list, oids: list) -> str:
-    """Replace $n placeholders outside string literals / quoted
-    identifiers with rendered literals."""
+def _scan_params(sql: str, on_param) -> str:
+    """Quote-aware scan: calls ``on_param(idx) -> replacement`` for every
+    $n outside string literals / quoted identifiers."""
     out = []
     i, n = 0, len(sql)
     while i < n:
@@ -114,16 +120,38 @@ def _substitute_params(sql: str, params: list, oids: list) -> str:
             j = i + 1
             while j < n and sql[j].isdigit():
                 j += 1
-            idx = int(sql[i + 1:j]) - 1
-            if idx < 0 or idx >= len(params):
-                raise ValueError(f"parameter ${idx + 1} not bound")
-            oid = oids[idx] if idx < len(oids) else 0
-            out.append(_render_param(params[idx], oid))
+            out.append(on_param(int(sql[i + 1:j]) - 1))
             i = j
         else:
             out.append(c)
             i += 1
     return "".join(out)
+
+
+def _substitute_params(sql: str, params: list, oids: list) -> str:
+    """Replace $n placeholders with rendered literals."""
+    def render(idx: int) -> str:
+        if idx < 0 or idx >= len(params):
+            raise ValueError(f"parameter ${idx + 1} not bound")
+        oid = oids[idx] if idx < len(oids) else 0
+        return _render_param(params[idx], oid)
+
+    return _scan_params(sql, render)
+
+
+def _count_params(sql: str) -> int:
+    """Number of distinct $n placeholders (max index), quote-aware —
+    Describe(statement) must report the INFERRED parameter count even
+    when Parse declared none (drivers that Describe before Bind rely on
+    it)."""
+    seen = [0]
+
+    def note(idx: int) -> str:
+        seen[0] = max(seen[0], idx + 1)
+        return ""
+
+    _scan_params(sql, note)
+    return seen[0]
 
 
 def _fmt_value(v, t: Optional[DataType]) -> str:
@@ -275,6 +303,13 @@ class PgWireServer:
                         raise ValueError(
                             "binary parameter format not supported")
                     params.append(raw.decode())
+            # result-column formats: text only (a client asking for
+            # binary results must get an ERROR, not text bytes it will
+            # misdecode as binary)
+            (n_res,) = struct.unpack_from("!H", rest, pos)
+            res_fmts = struct.unpack_from(f"!{n_res}H", rest, pos + 2)
+            if any(f == 1 for f in res_fmts):
+                raise ValueError("binary result format not supported")
             sql, oids = stmts[stmt_name.decode()]
             bound = _substitute_params(sql, params, oids)
             portals[portal.decode()] = (bound, None)
@@ -296,6 +331,17 @@ class PgWireServer:
                 "!IHIhih", 0, 0, _OIDS.get(t.kind, 25), -1, -1, 0))
         writer.write(_msg(b"T", payload))
 
+    def _write_data_rows(self, writer, rows, schema) -> None:
+        for row in rows:
+            body = struct.pack("!H", len(row))
+            for v, (_, t) in zip(row, schema):
+                if v is None:
+                    body += struct.pack("!i", -1)
+                else:
+                    s = _fmt_value(v, t).encode()
+                    body += struct.pack("!i", len(s)) + s
+            writer.write(_msg(b"D", body))
+
     async def _on_describe(self, writer, body: bytes, stmts,
                            portals) -> bool:
         kind, name = body[0:1], body[1:].split(b"\x00")[0].decode()
@@ -303,8 +349,10 @@ class PgWireServer:
         try:
             if kind == b"S":
                 sql, oids = stmts[name]
+                n_params = max(len(oids), _count_params(sql))
+                all_oids = list(oids) + [25] * (n_params - len(oids))
                 writer.write(_msg(b"t", struct.pack(
-                    f"!H{len(oids)}I", len(oids), *oids)))
+                    f"!H{n_params}I", n_params, *all_oids)))
                 # schema of a parameterized statement: plan with NULLs
                 probe = _substitute_params(
                     sql, [None] * 64, oids or [0] * 64)
@@ -346,15 +394,7 @@ class PgWireServer:
             await writer.drain()
             return False
         if schema is not None:
-            for row in rows:
-                rbody = struct.pack("!H", len(row))
-                for v, (_, t) in zip(row, schema):
-                    if v is None:
-                        rbody += struct.pack("!i", -1)
-                    else:
-                        s = _fmt_value(v, t).encode()
-                        rbody += struct.pack("!i", len(s)) + s
-                writer.write(_msg(b"D", rbody))
+            self._write_data_rows(writer, rows, schema)
             command = f"SELECT {len(rows)}"
         writer.write(_msg(b"C", _cstr(command)))
         await writer.drain()
@@ -404,20 +444,8 @@ class PgWireServer:
             await writer.drain()
             return
         if schema is not None:
-            payload = struct.pack("!H", len(schema))
-            for name, t in schema:
-                payload += (_cstr(name) + struct.pack(
-                    "!IHIhih", 0, 0, _OIDS.get(t.kind, 25), -1, -1, 0))
-            writer.write(_msg(b"T", payload))        # RowDescription
-            for row in rows:
-                body = struct.pack("!H", len(row))
-                for v, (_, t) in zip(row, schema):
-                    if v is None:
-                        body += struct.pack("!i", -1)
-                    else:
-                        s = _fmt_value(v, t).encode()
-                        body += struct.pack("!i", len(s)) + s
-                writer.write(_msg(b"D", body))       # DataRow
+            self._write_row_description(writer, schema)
+            self._write_data_rows(writer, rows, schema)
             command = f"SELECT {len(rows)}"
         writer.write(_msg(b"C", _cstr(command)))     # CommandComplete
         writer.write(_msg(b"Z", b"I"))               # ReadyForQuery
