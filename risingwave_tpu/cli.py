@@ -99,13 +99,14 @@ def _ctl_dispatch(args, session, _json) -> None:
         for k, v in session.parameters():
             print(f"{k}\t{v}")
     elif args.what == "fragments":
-        from .frontend.planner import Planner
         from .meta.fragment import fragment_plan
         for name, mv in sorted(session.catalog.mvs.items()):
             ast = getattr(mv, "query_ast", None)
             if ast is None:
                 continue
-            plan = Planner(session.catalog).plan_select(ast)
+            # the SAME frontend pipeline the job was built with — the
+            # printed topology must match the deployed executors
+            plan = session._plan(ast)
             print(f"-- {name}")
             print(fragment_plan(plan).explain())
     elif args.what == "metrics":

@@ -34,7 +34,7 @@ KEYWORDS = {
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
     "sink", "sinks", "over", "partition", "like", "extract", "set", "to",
-    "parameters", "delete", "update",
+    "parameters", "delete", "update", "explain",
 }
 
 
@@ -149,6 +149,8 @@ class Parser:
         return stmts
 
     def parse_statement(self) -> A.Statement:
+        if self.eat_kw("explain"):
+            return A.Explain(self.parse_statement())
         if self.at_kw("create"):
             return self._create()
         if self.at_kw("drop"):

@@ -464,9 +464,9 @@ class PgWireServer:
                 schema = [("Name", VARCHAR), ("Value", VARCHAR)]
             else:
                 schema = [("Name", VARCHAR)]
-        elif stmts and isinstance(stmts[-1], A.Query):
-            # plan-derived output schema, stored by Session.query — no
-            # second planning pass
+        elif stmts and isinstance(stmts[-1], (A.Query, A.Explain)):
+            # plan-derived output schema, stored by Session.query /
+            # Session._explain — no second planning pass
             schema = list(self.session.last_select_schema)
         command = "OK"
         if stmts:

@@ -354,6 +354,8 @@ class Session:
             if reg is None:
                 raise SqlError(f"cannot SHOW {stmt.what}")
             return [(name,) for name in sorted(reg)]
+        if isinstance(stmt, A.Explain):
+            return self._explain(stmt)
         if isinstance(stmt, A.FlushStatement):
             self.flush()
             return []
@@ -486,12 +488,37 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    def _plan(self, query: A.Select, lenient: bool = False):
+        """Plan + optimize one SELECT (the full frontend pipeline:
+        parse → bind → plan → rule-engine passes)."""
+        from .optimizer import optimize
+        plan = Planner(self.catalog, lenient=lenient).plan_select(query)
+        return optimize(plan)
+
+    def _explain(self, stmt: "A.Explain") -> list:
+        """EXPLAIN: optimized plan as one row per line (reference:
+        handler/explain.rs renders the same way)."""
+        inner = stmt.stmt
+        if isinstance(inner, A.Query):
+            sel = inner.select
+        elif isinstance(inner, (A.CreateMaterializedView, A.CreateSink)):
+            sel = inner.query
+            if sel is None:
+                raise SqlError("EXPLAIN CREATE SINK requires AS SELECT")
+        else:
+            raise SqlError(
+                f"cannot EXPLAIN {type(inner).__name__}")
+        plan = self._plan(sel)
+        from ..common.types import VARCHAR
+        self.last_select_schema = [("QUERY PLAN", VARCHAR)]
+        return [(line,) for line in plan.explain().split("\n")]
+
     def _build_query_pipeline(self, query: A.Select):
         """Shared CREATE MV / CREATE SINK AS SELECT plumbing: plan, build
         executors via the stream-leaf factory, collect session-driven
         queues + their init feeds and (under recovery) the scan leaves
         whose backfill may need re-running."""
-        plan = Planner(self.catalog, lenient=self._recovering).plan_select(query)
+        plan = self._plan(query, lenient=self._recovering)
         queues: list[QueueSource] = []
         init_msgs: list[tuple[QueueSource, list[Message]]] = []
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
@@ -581,8 +608,7 @@ class Session:
         """Plan + classify leaves for a worker-hosted MV: connector
         sources run worker-side; table/MV scans become remote exchange
         channels fed by the session (the upstream jobs are local)."""
-        plan = Planner(self.catalog,
-                       lenient=self._recovering).plan_select(query)
+        plan = self._plan(query, lenient=self._recovering)
         leaves = collect_leaves(plan)
         defs, channels, ups = [], {}, {}
         for i, leaf in enumerate(leaves):
@@ -1622,7 +1648,11 @@ class Session:
             if last.what == "parameters":
                 return [("Name", VARCHAR), ("Value", VARCHAR)]
             return [("Name", VARCHAR)]
+        if isinstance(last, A.Explain):
+            return [("QUERY PLAN", VARCHAR)]
         if isinstance(last, A.Query):
+            # raw plan suffices: every optimizer pass preserves the root
+            # schema by contract, so skip the rewrite work here
             plan = Planner(self.catalog).plan_select(last.select)
             return [(f.name, f.type) for f in plan.schema
                     if not f.name.startswith("_")]
@@ -1631,7 +1661,7 @@ class Session:
     def query(self, sel: A.Select) -> list:
         """Batch SELECT: run the stream plan over snapshot sources."""
         self._drain_inflight()   # read-your-writes snapshot
-        plan = Planner(self.catalog).plan_select(sel)
+        plan = self._plan(sel)
         self.last_select_schema = [
             (f.name, f.type) for f in plan.schema
             if not f.name.startswith("_")]

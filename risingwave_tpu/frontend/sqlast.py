@@ -290,6 +290,14 @@ class SetStatement:
     value: Any
 
 
+@dataclasses.dataclass(frozen=True)
+class Explain:
+    """EXPLAIN <statement>: show the optimized plan without executing
+    (reference: handler/explain.rs — plan-only path)."""
+
+    stmt: "Statement"
+
+
 Statement = Union[CreateSink, CreateSource, CreateTable, CreateMaterializedView,
                   CreateIndex, DropStatement, Insert, Delete, Update, Query,
-                  ShowStatement, FlushStatement, SetStatement]
+                  ShowStatement, FlushStatement, SetStatement, Explain]

@@ -40,6 +40,14 @@ from .state_store import MemoryStateStore
 
 _MANIFEST = "manifest.json"
 
+#: Plan/lowering format generation. State-table ids are assigned by a
+#: deterministic walk of the OPTIMIZED plan, so a data_dir written by a
+#: build with a different frontend pipeline may lay out state tables
+#: differently (reference: Hummock's version/format compatibility gates,
+#: src/meta/src/hummock/manager/versioning.rs). Bump when the planner/
+#: optimizer changes the shape of built plans; recovery warns on mismatch.
+PLAN_FORMAT_VERSION = 2
+
 
 class CheckpointLog:
     def __init__(self, data_dir: Optional[str] = None,
@@ -62,6 +70,7 @@ class CheckpointLog:
         self._fold_lock = threading.Lock()
         self._compact_thread: Optional[threading.Thread] = None
         self._compact_seq = 0
+        self._format_warned = False
 
     # -- manifest -------------------------------------------------------------
 
@@ -72,9 +81,19 @@ class CheckpointLog:
         raw = self.store.get(_MANIFEST)
         if raw is None:
             return {"committed_epoch": 0, "segments": [], "ddl": [],
-                    "dropped_tables": []}
+                    "dropped_tables": [],
+                    "plan_format": PLAN_FORMAT_VERSION}
         m = json.loads(raw)
         m.setdefault("dropped_tables", [])
+        stored = m.setdefault("plan_format", 1)
+        if stored != PLAN_FORMAT_VERSION and not self._format_warned:
+            self._format_warned = True
+            import warnings
+            warnings.warn(
+                f"data dir was written by plan-format {stored}, this "
+                f"build is {PLAN_FORMAT_VERSION}: state-table layout may "
+                "not match the replayed DDL's rebuilt plans — if recovery "
+                "misbehaves, rebuild the MVs from sources (DROP/CREATE)")
         return m
 
     def _write_manifest(self, manifest: dict) -> None:
