@@ -62,6 +62,11 @@ class BuildConfig:
     # groups evict to the state table at checkpoints and fault back in on
     # access (reference: cache/managed_lru.rs). None = grow-or-raise.
     agg_hbm_budget: Optional[int] = None
+    # HBM pressure for joins: cap on live join KEYS per arena; coldest
+    # keys' buckets evict from BOTH sides to the state tables at
+    # checkpoints and fault back on mention (reference: JoinHashMap's
+    # ManagedLruCache, managed_state/join/mod.rs:228-258).
+    join_hbm_budget: Optional[int] = None
     # max snapshot rows per barrier during concurrent backfill
     # (stream/backfill.py); None = max(4 * chunk capacity, 4096)
     backfill_batch_rows: Optional[int] = None
@@ -69,6 +74,14 @@ class BuildConfig:
     # epoch / update-pair checks — reference:
     # src/stream/src/executor/wrapper/); debug & sim runs, off in prod
     sanity_checks: bool = False
+
+
+def join_state_pk(join_keys, stream_pk) -> list:
+    """Join state tables lay their pk out as join_keys ++ stream_pk: rows
+    of one join key are contiguous in key order, so cold-tier fault-in is
+    a pk prefix scan (the reference's JoinHashMap tables are likewise
+    keyed join-key-first, managed_state/join/mod.rs)."""
+    return list(join_keys) + [i for i in stream_pk if i not in join_keys]
 
 
 class BuildContext:
@@ -167,10 +180,19 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         return SimpleAggExecutor(inp, list(plan.agg_calls), state_table=st)
 
     if isinstance(plan, P.PJoin):
+        if (plan.left_keys and cfg.fragment_parallelism > 1
+                and cfg.mesh is None and ctx.durable):
+            # multi-fragment build: both sides hash-dispatch by join key
+            # to N parallel join actors (reference: hash-distributed
+            # HashJoin fragments, dispatch.rs:532)
+            from .fragments import build_fragmented_join
+            return build_fragmented_join(plan, ctx, _JOIN_TYPES)
         left = build_plan(plan.left, ctx)
         right = build_plan(plan.right, ctx)
-        lst = ctx.state_table(plan.left.schema, list(plan.left.pk))
-        rst = ctx.state_table(plan.right.schema, list(plan.right.pk))
+        lst = ctx.state_table(plan.left.schema,
+                              join_state_pk(plan.left_keys, plan.left.pk))
+        rst = ctx.state_table(plan.right.schema,
+                              join_state_pk(plan.right_keys, plan.right.pk))
         if cfg.mesh is not None:
             from ..parallel.executors import ShardedHashJoinExecutor
             return ShardedHashJoinExecutor(
@@ -187,7 +209,8 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             left_state_table=lst, right_state_table=rst,
             key_capacity=cfg.join_key_capacity,
             bucket_width=cfg.join_bucket_width,
-            out_capacity=cfg.chunk_capacity)
+            out_capacity=cfg.chunk_capacity,
+            hbm_key_budget=cfg.join_hbm_budget)
 
     if isinstance(plan, P.PTopN):
         inp = build_plan(plan.input, ctx)
@@ -262,6 +285,47 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         return UnionExecutor([build_plan(i, ctx) for i in plan.inputs])
 
     raise NotImplementedError(f"cannot build {type(plan).__name__}")
+
+
+def config_to_json(cfg: BuildConfig) -> str:
+    """Durable form of a BuildConfig (reschedule persistence). A live
+    ``mesh`` can't be pickled across processes/restarts; what IS durable
+    is its topology — axis names + shape — from which an equivalent mesh
+    reassembles over the restarted process's devices (the reference
+    persists vnode mappings in meta for the same reason,
+    src/meta/src/stream/scale.rs:657)."""
+    import json
+    d = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)
+         if f.name != "mesh"}
+    if cfg.mesh is not None:
+        d["mesh"] = {"axis_names": list(cfg.mesh.axis_names),
+                     "shape": list(cfg.mesh.devices.shape)}
+    else:
+        d["mesh"] = None
+    return json.dumps(d, sort_keys=True)
+
+
+def config_from_json(s: str) -> BuildConfig:
+    """Rebuild a BuildConfig from its durable form. Raises RuntimeError if
+    the mesh topology needs more devices than the process has."""
+    import json
+    d = json.loads(s)
+    mesh_spec = d.pop("mesh", None)
+    known = {f.name for f in dataclasses.fields(BuildConfig)}
+    cfg = BuildConfig(**{k: v for k, v in d.items() if k in known})
+    if mesh_spec is not None:
+        import math
+        import jax
+        import numpy as _np
+        n = math.prod(mesh_spec["shape"])
+        devs = jax.devices()
+        if len(devs) < n:
+            raise RuntimeError(
+                f"persisted mesh needs {n} devices, process has {len(devs)}")
+        cfg = dataclasses.replace(cfg, mesh=jax.sharding.Mesh(
+            _np.array(devs[:n]).reshape(mesh_spec["shape"]),
+            tuple(mesh_spec["axis_names"])))
+    return cfg
 
 
 def collect_leaves(plan: P.PlanNode) -> list:

@@ -31,6 +31,7 @@ from ..stream.dispatch import (
     SimpleDispatcher,
 )
 from ..stream.hash_agg import HashAggExecutor, agg_state_schema
+from ..stream.hash_join import HashJoinExecutor
 from ..storage.state_table import StateTable
 
 
@@ -83,3 +84,73 @@ def build_fragmented_agg(plan, ctx):
     for i in range(n):
         ctx.actors.append(agg_actor(i))
     return MergeExecutor(out_chans, aggs[0].schema)
+
+
+def build_fragmented_join(plan, ctx, join_types):
+    """Build an equi-join as TWO upstream fragments → N join actors → merge.
+
+    Both inputs hash-dispatch by their join keys (the same vnode hash on
+    each side, so matching keys always land on the same actor — the
+    reference's requirement that both exchange edges of a HashJoin share
+    one distribution, dispatch.rs:532), with update-pair splitting live on
+    both edges (dispatch.rs:635-650). Each actor joins its key shard on
+    its own device arena; the N actors share the two logical state tables
+    (disjoint key ranges) and recovery re-filters rows by shard
+    (``load_shard``), so kill/recovery works across ANY parallelism change.
+    """
+    from .build import build_plan
+
+    cfg = ctx.config
+    n = cfg.fragment_parallelism
+    left_up = build_plan(plan.left, ctx)
+    right_up = build_plan(plan.right, ctx)
+
+    from .build import join_state_pk
+    lst0 = ctx.state_table(plan.left.schema,
+                           join_state_pk(plan.left_keys, plan.left.pk))
+    rst0 = ctx.state_table(plan.right.schema,
+                           join_state_pk(plan.right_keys, plan.right.pk))
+
+    l_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
+    r_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
+    out_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
+    l_disp = HashDispatcher(l_chans, plan.left_keys, left_up.schema)
+    r_disp = HashDispatcher(r_chans, plan.right_keys, right_up.schema)
+
+    joins = []
+    for i in range(n):
+        lst = rst = None
+        if lst0 is not None:
+            lst = StateTable(ctx.store, lst0.table_id, lst0.schema,
+                             list(lst0.pk_indices))
+            rst = StateTable(ctx.store, rst0.table_id, rst0.schema,
+                             list(rst0.pk_indices))
+        joins.append(HashJoinExecutor(
+            ChannelSource(l_chans[i], left_up.schema),
+            ChannelSource(r_chans[i], right_up.schema),
+            list(plan.left_keys), list(plan.right_keys),
+            join_type=join_types[plan.kind], condition=plan.condition,
+            left_state_table=lst, right_state_table=rst,
+            key_capacity=cfg.join_key_capacity,
+            bucket_width=cfg.join_bucket_width,
+            out_capacity=cfg.chunk_capacity, load_shard=(i, n),
+            hbm_key_budget=cfg.join_hbm_budget))
+
+    def upstream_actor(up, disp):
+        async def run():
+            async for msg in up.execute():
+                await disp.dispatch(msg)
+        return run
+
+    def join_actor(i: int):
+        async def run():
+            out = SimpleDispatcher(out_chans[i])
+            async for msg in joins[i].execute():
+                await out.dispatch(msg)
+        return run
+
+    ctx.actors.append(upstream_actor(left_up, l_disp))
+    ctx.actors.append(upstream_actor(right_up, r_disp))
+    for i in range(n):
+        ctx.actors.append(join_actor(i))
+    return MergeExecutor(out_chans, joins[0].schema)

@@ -24,6 +24,7 @@ from typing import AsyncIterator, Optional
 from ..common.chunk import StreamChunk, physical_chunk
 from ..common.types import Schema
 from ..storage.state_table import StateTable
+from ..stream.dispatch import MsgQueue
 from ..stream.executor import Executor
 from ..stream.materialize import MaterializeExecutor
 from ..stream.message import Barrier, Message, Watermark
@@ -36,7 +37,7 @@ class QueueSource(Executor):
 
     def __init__(self, schema: Schema):
         self.schema = schema
-        self.queue: asyncio.Queue = asyncio.Queue()
+        self.queue = MsgQueue()
 
     def push(self, msg: Message) -> None:
         self.queue.put_nowait(msg)

@@ -284,19 +284,61 @@ class Session:
         ddl = self.store.log.ddl()  # type: ignore[attr-defined]
         if not ddl:
             return
+        # pre-scan for persisted rescale configs: the LAST one per job wins,
+        # but a later DROP of the job voids it (a re-CREATE after the drop
+        # is a NEW job that ran under the session default); its CREATE below
+        # replays under that config so restarts keep their layout
+        # (round-4 weak #5)
+        resched_cfg: dict[str, object] = {}
+        for piece in ddl:
+            line = piece.strip()
+            if not line.startswith("-- reschedule"):
+                if resched_cfg and "drop" in line.lower():
+                    try:
+                        for stmt in parse_sql(piece):
+                            if isinstance(stmt, A.DropStatement):
+                                resched_cfg.pop(stmt.name, None)
+                    except Exception:  # noqa: BLE001 - replay parses below
+                        pass
+                continue
+            rest = line[len("-- reschedule"):].strip()
+            mv_name, _, cfg_json = rest.partition(" ")
+            if not cfg_json:
+                import warnings
+                warnings.warn(
+                    f"reschedule {mv_name}: legacy log entry without a "
+                    "persisted config; the job recovered with the "
+                    "session's default BuildConfig")
+                continue
+            try:
+                from .build import config_from_json
+                resched_cfg[mv_name] = config_from_json(cfg_json)
+            except Exception as e:  # noqa: BLE001 - corrupt/unportable cfg
+                # covers both "not enough devices" (RuntimeError) and a
+                # corrupt/truncated log line (JSONDecodeError/KeyError):
+                # every job still recovers under the default config
+                import warnings
+                warnings.warn(
+                    f"reschedule {mv_name}: persisted layout not "
+                    f"restorable on this process ({e}); recovering with "
+                    "the session's default BuildConfig")
         self._recovering = True
         try:
             for piece in ddl:
                 if piece.strip().startswith("-- reschedule"):
-                    import warnings
-                    warnings.warn(
-                        f"{piece.strip()[3:]}: rescale configs (meshes) "
-                        "are not persisted; the job recovered with the "
-                        "session's default BuildConfig — re-issue "
-                        "Session.reschedule() to restore the layout")
                     continue
                 for stmt in parse_sql(piece):
-                    self._run_statement(stmt)
+                    name = getattr(stmt, "name", None)
+                    if (isinstance(stmt, A.CreateMaterializedView)
+                            and name in resched_cfg):
+                        saved = self.config
+                        self.config = resched_cfg[name]  # type: ignore[assignment]
+                        try:
+                            self._run_statement(stmt)
+                        finally:
+                            self.config = saved
+                    else:
+                        self._run_statement(stmt)
         finally:
             self._recovering = False
 
@@ -867,12 +909,6 @@ class Session:
         live = [f for f in self.feeds if f.job != name]
         self.feeds = live
         self.backfills = [b for b in self.backfills if b.job != name]
-        # durable note: a BuildConfig (mesh = live device handles) cannot
-        # be persisted; recovery rebuilds with the session's default
-        # config. Record the fact so recovery can WARN instead of
-        # silently reverting the rescale.
-        if self.data_dir is not None:
-            self.store.log.log_ddl(f"-- reschedule {name}")  # type: ignore[attr-defined]
         id0, id1 = mv.table_id_range  # type: ignore[attr-defined]
         ids = iter(range(id0, id1))
         saved_alloc = self.catalog.next_table_id
@@ -959,11 +995,29 @@ class Session:
             q.push(Barrier.new(self.epoch))
         self._await(job.wait_barrier(self.epoch))
         if rollback_error is not None:
-            # the job is healthy again under its ORIGINAL config, but the
-            # requested reschedule did NOT happen — surface that
+            # the job is healthy again under the SESSION DEFAULT config,
+            # but the requested reschedule did NOT happen — persist the
+            # layout the job actually runs now (an earlier successful
+            # rescale's log entry would otherwise resurrect on restart a
+            # layout the live session no longer has), then surface it
+            if self.data_dir is not None:
+                from .build import config_to_json
+                self.store.log.log_ddl(  # type: ignore[attr-defined]
+                    f"-- reschedule {name} {config_to_json(saved_config)}")
             raise RuntimeError(
                 f"reschedule of {name!r} failed; the job was restored "
                 "with its original config") from rollback_error
+        # persist the rescale only once the rebuild SUCCEEDED: the config's
+        # durable form (mesh topology, not live device handles) goes in the
+        # DDL log; recovery replays the CREATE under this config so a
+        # restart keeps its layout (reference: persisted vnode mappings,
+        # stream/scale.rs:657)
+        if self.data_dir is not None:
+            from .build import config_to_json
+            cfg_json = config_to_json(config if config is not None
+                                      else saved_config)
+            self.store.log.log_ddl(  # type: ignore[attr-defined]
+                f"-- reschedule {name} {cfg_json}")
 
     def _pop_downstreams_of(self, job: StreamJob) -> None:
         """Remove jobs transitively fed by ``job``'s bus (they would wait
@@ -1850,6 +1904,12 @@ class Session:
             # the gather future must be created INSIDE the session loop
             await asyncio.gather(*(job.stop() for job in jobs),
                                  return_exceptions=True)
+            # abandoned per-input reader tasks (barrier_align / merge
+            # recv futures) only PROCESS their cancellation on a later
+            # loop tick; give them those ticks now or their queue.get
+            # coroutines get GC-finalized after loop.close()
+            for _ in range(3):
+                await asyncio.sleep(0)
 
         self._await(_stop_all())
         self.jobs.clear()
@@ -1861,6 +1921,23 @@ class Session:
                 pass
             w.terminate()
         self.workers = []
+        # finalize abandoned executor generators (reschedule/stop leave
+        # their `execute()` async generators suspended in `queue.get()`)
+        # while the loop is still alive — if GC ran after loop.close(),
+        # the asyncgen finalizer hook would call_soon on a closed loop and
+        # trip "Event loop is closed" in asyncio.Queue's finalizer. Collect
+        # FIRST (dropped generators finalize through the hook, scheduling
+        # acloses), give those acloses loop ticks to run, then shut down
+        # whatever generators are still referenced.
+        import gc
+        gc.collect()
+
+        async def _drain_finalizers():
+            for _ in range(10):
+                await asyncio.sleep(0)
+
+        self.loop.run_until_complete(_drain_finalizers())
+        self.loop.run_until_complete(self.loop.shutdown_asyncgens())
         self.loop.close()
 
     def _alloc_shard(self) -> int:

@@ -93,6 +93,7 @@ class JoinSideState:
     tomb: jax.Array                     # bool[cap, W] — deleted since last ckpt
     degree: jax.Array                   # int32[cap, W] — opposite-side matches
     ckpt_dirty: jax.Array               # bool[cap, W] — changed since last ckpt
+    lru: jax.Array                      # int32[cap] — key's last-touch step
     ht_overflow: jax.Array              # bool scalar, sticky: key table full
     lane_overflow: jax.Array            # bool scalar, sticky: bucket width full
     inconsistent: jax.Array             # bool scalar, sticky
@@ -154,6 +155,7 @@ class JoinCore:
             tomb=jnp.zeros((cap, W), jnp.bool_),
             degree=jnp.zeros((cap, W), jnp.int32),
             ckpt_dirty=jnp.zeros((cap, W), jnp.bool_),
+            lru=jnp.zeros(cap, jnp.int32),
             ht_overflow=jnp.zeros((), jnp.bool_),
             lane_overflow=jnp.zeros((), jnp.bool_),
             inconsistent=jnp.zeros((), jnp.bool_),
@@ -167,21 +169,27 @@ class JoinCore:
 
     # -- the step --------------------------------------------------------------
 
-    def apply_chunk(self, state: JoinState, chunk: StreamChunk, *, side: str):
+    def apply_chunk(self, state: JoinState, chunk: StreamChunk, *, side: str,
+                    step=None):
         """Join one chunk arriving on ``side``; returns (state, big_chunk).
 
         ``big_chunk`` has capacity 2*N*(2W+1) and is mostly invisible; compact
-        it with gather_units_window before sending downstream."""
+        it with gather_units_window before sending downstream.
+
+        ``step``: optional int32 LRU stamp — when set, both the own-side
+        key slot and every probed opposite-side slot are touched, so the
+        two sides' stamps for one key value stay in sync (the invariant
+        cold-tier eviction relies on to evict a key from BOTH arenas)."""
         is_del = chunk.vis & (
             (chunk.ops == OP_DELETE) | (chunk.ops == OP_UPDATE_DELETE))
         is_ins = chunk.vis & (
             (chunk.ops == OP_INSERT) | (chunk.ops == OP_UPDATE_INSERT))
 
         def run_del(st):
-            return self._pass(st, chunk, is_del, False, side)
+            return self._pass(st, chunk, is_del, False, side, step)
 
         def run_ins(st):
-            return self._pass(st, chunk, is_ins, True, side)
+            return self._pass(st, chunk, is_ins, True, side, step)
 
         def skip(st):
             return st, self._empty_out(chunk.capacity)
@@ -229,7 +237,7 @@ class JoinCore:
         return (res.data & res.mask).reshape(N, W)
 
     def _pass(self, state: JoinState, chunk: StreamChunk, sel: jax.Array,
-              is_insert: bool, side: str):
+              is_insert: bool, side: str, step=None):
         cap, W = self.capacity, self.W
         N = chunk.capacity
         A = state.left if side == "left" else state.right
@@ -273,6 +281,9 @@ class JoinCore:
             degree=B.degree.reshape(-1).at[g].add(delta.reshape(-1), mode="drop")
                     .reshape(cap, W),
         )
+        if step is not None:
+            B = B.replace(lru=B.lru.at[jnp.where(b_found, b_slot, cap)]
+                          .max(step, mode="drop"))
 
         # ---- own-side arena update
         if is_insert:
@@ -307,6 +318,9 @@ class JoinCore:
                             | jnp.any(sel & (a_slot >= cap)),
                 lane_overflow=A.lane_overflow | jnp.any(a_ok & ~lane_ok),
             )
+            if step is not None:
+                A = A.replace(lru=A.lru.at[jnp.where(a_ok, a_slot, cap)]
+                              .max(step, mode="drop"))
         else:
             a_slot, a_found = ht_lookup(A.ht, a_key_cols, sel)
             as_ = jnp.where(a_found, a_slot, 0)
@@ -339,6 +353,9 @@ class JoinCore:
                             .reshape(cap, W),
                 inconsistent=A.inconsistent | jnp.any(sel & ~lane_ok),
             )
+            if step is not None:
+                A = A.replace(lru=A.lru.at[jnp.where(a_found, a_slot, cap)]
+                              .max(step, mode="drop"))
 
         state = (state.replace(left=A, right=B) if side == "left"
                  else state.replace(left=B, right=A))
@@ -487,6 +504,7 @@ def compact_side(core: "JoinCore", old: JoinSideState, schema: Schema,
         tomb=move(old.tomb, False),
         degree=move(old.degree, 0),
         ckpt_dirty=move(old.ckpt_dirty, False),
+        lru=jnp.zeros(cap, jnp.int32).at[dst].set(old.lru, mode="drop"),
         # a key that exhausts probing during rebuild would silently drop its
         # whole bucket via mode="drop" — surface it
         ht_overflow=old.ht_overflow | rebuild_ovf,
@@ -497,6 +515,72 @@ def compact_side(core: "JoinCore", old: JoinSideState, schema: Schema,
 
 def side_any_overflow(st: JoinSideState) -> bool:
     return bool(st.ht_overflow) | bool(st.lane_overflow)
+
+
+def _side_live_keys(st: JoinSideState) -> jax.Array:
+    """bool[cap]: key slots with at least one live row."""
+    return st.ht.occupied & jnp.any(st.occupied, axis=1)
+
+
+def _side_evictable_keys(st: JoinSideState) -> jax.Array:
+    """bool[cap]: live key slots that CAN evict — null-keyed slots are
+    permanently resident (their rows can't be faulted back by key
+    lookup), so they must not count toward the budget either, or a
+    null-heavy side could never get under budget and hot non-null keys
+    would thrash."""
+    live = _side_live_keys(st)
+    for km in st.ht.key_mask:
+        live = live & km
+    return live
+
+
+def join_evict_plan(state: JoinState, keep: int):
+    """Pick cold keys to evict from BOTH arenas so ~``keep`` hottest
+    remain per side (reference: JoinHashMap's ManagedLruCache,
+    src/stream/src/executor/managed_state/join/mod.rs:228-258 +
+    cache/managed_lru.rs — here eviction is whole-key: a key's buckets
+    leave both sides together, so opposite-side degrees stay coherent).
+
+    LRU stamps for one key value are kept in sync across the two sides by
+    ``apply_chunk(step=...)``, so ONE threshold — the max of the two
+    per-side thresholds — names a consistent key set on both sides.
+    Null-keyed slots never evict (their rows can't be faulted back by key
+    lookup). Returns (mask_l bool[cap], mask_r bool[cap], packed
+    [n_evict_l, n_evict_r, n_live_l, n_live_r])."""
+    cap = state.left.lru.shape[0]
+    big = jnp.iinfo(jnp.int32).max
+
+    def thr_of(st):
+        live = _side_evictable_keys(st)
+        n_live = jnp.sum(live)
+        key = jnp.where(live, st.lru, big)
+        skey = jnp.sort(key)
+        k = jnp.clip(n_live - keep, 0, cap - 1)
+        thr = jnp.where(k > 0, skey[jnp.maximum(k - 1, 0)], jnp.int32(-1))
+        return thr, n_live, live
+
+    thr_l, nl, live_l = thr_of(state.left)
+    thr_r, nr, live_r = thr_of(state.right)
+    thr = jnp.maximum(thr_l, thr_r)
+
+    mask_l = live_l & (state.left.lru <= thr)
+    mask_r = live_r & (state.right.lru <= thr)
+    packed = jnp.stack([jnp.sum(mask_l), jnp.sum(mask_r), nl, nr])
+    return mask_l, mask_r, packed
+
+
+def apply_evict_side(st: JoinSideState, mask: jax.Array) -> JoinSideState:
+    """Clear evicted keys' buckets WITHOUT tombstones or dirty marks: the
+    durable rows (flushed by this barrier's checkpoint) ARE the cold
+    copies. Call at a checkpoint barrier AFTER the flush cleared
+    tomb/ckpt_dirty, BEFORE compact (which reclaims the key slots)."""
+    m2 = mask[:, None]
+    return st.replace(
+        occupied=st.occupied & ~m2,
+        row_mask=tuple(rm & ~m2 for rm in st.row_mask),
+        degree=jnp.where(m2, 0, st.degree),
+        lru=jnp.where(mask, 0, st.lru),
+    )
 
 
 def import_side(core: "JoinCore", old: JoinSideState, schema: Schema,
@@ -532,7 +616,7 @@ def import_side(core: "JoinCore", old: JoinSideState, schema: Schema,
         ht = old.ht
         new = JoinSideState(
             ht=ht, row_data=row_data, row_mask=row_mask, occupied=occupied,
-            tomb=tomb, degree=degree, ckpt_dirty=ckpt_dirty,
+            tomb=tomb, degree=degree, ckpt_dirty=ckpt_dirty, lru=old.lru,
             ht_overflow=jnp.zeros((), jnp.bool_),
             lane_overflow=jnp.zeros((), jnp.bool_),
             inconsistent=old.inconsistent,
@@ -560,6 +644,7 @@ def import_side(core: "JoinCore", old: JoinSideState, schema: Schema,
         tomb=move(tomb, False),
         degree=move(degree, 0),
         ckpt_dirty=move(ckpt_dirty, False),
+        lru=jnp.zeros(cap, jnp.int32).at[dst].set(old.lru, mode="drop"),
         ht_overflow=jnp.zeros((), jnp.bool_),
         lane_overflow=jnp.zeros((), jnp.bool_),
         inconsistent=old.inconsistent,

@@ -46,7 +46,9 @@ _MANIFEST = "manifest.json"
 #: differently (reference: Hummock's version/format compatibility gates,
 #: src/meta/src/hummock/manager/versioning.rs). Bump when the planner/
 #: optimizer changes the shape of built plans; recovery warns on mismatch.
-PLAN_FORMAT_VERSION = 2
+#: v3: join state-table pks are join-key-prefixed (frontend/build.py
+#: join_state_pk) — v2 join rows are keyed under the old stream-pk layout.
+PLAN_FORMAT_VERSION = 3
 
 
 class CheckpointLog:

@@ -177,10 +177,38 @@ class StateTable:
         return out, last
 
     def scan_prefix(self, prefix_values: Sequence[Any], n_cols: int) -> Iterator[tuple]:
+        """Rows whose encoded pk starts with the first ``n_cols`` pk
+        columns' encoding, in key order. O(log n) bisect into the store's
+        sorted committed keys + the (small) staged overlay — the join
+        cold-tier fault-in path calls this per faulted key."""
+        import bisect
         prefix = encode_key(list(prefix_values), self._pk_types[:n_cols])
-        for row in self.scan_all():
-            if self.key_of(row).startswith(prefix):
-                yield row
+        committed = self.store.committed_view(self.table_id)
+        skeys = self.store.sorted_committed_keys(self.table_id)
+        merged: dict[bytes, Optional[Any]] = {}
+        i = bisect.bisect_left(skeys, prefix)
+        while i < len(skeys) and skeys[i].startswith(prefix):
+            merged[skeys[i]] = decode_value_row(
+                committed[skeys[i]], self.schema.types)
+            i += 1
+        for e in sorted(self.store._pending):
+            for k, v in self.store._pending[e].get(self.table_id, {}).items():
+                if k.startswith(prefix):
+                    merged[k] = (None if v is None
+                                 else decode_value_row(v, self.schema.types))
+        for k, v in self._puts_enc.items():
+            if k.startswith(prefix):
+                merged[k] = decode_value_row(v, self.schema.types)
+        for k, v in self._puts.items():
+            if k.startswith(prefix):
+                merged[k] = v
+        for k in self._dels:
+            if k.startswith(prefix):
+                merged[k] = None
+        for k in sorted(merged):
+            v = merged[k]
+            if v is not None:
+                yield v
 
     def __len__(self) -> int:
         n = self.store.table_len(self.table_id)

@@ -1,0 +1,44 @@
+"""Shared cold-tier cache helpers for device-state executors.
+
+HashAgg and HashJoin both treat device HBM as an LRU cache over the
+durable StateTable tier (reference: ManagedLruCache,
+src/stream/src/cache/managed_lru.rs). The two pieces they must agree on
+live here so they cannot drift:
+
+  * ``canonical_key`` — the host-side identity of an evicted key. Float
+    keys MUST NOT round-trip through int() (2.3 and 2.7 would collide;
+    r4 review found exactly that bug), ints must not round-trip through
+    float (precision above 2**53).
+  * ``LruClock`` — the per-chunk monotonic touch stamp. Returns None when
+    no budget is set so jitted steps trace a static no-stamp variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def canonical_key(values, types) -> tuple:
+    """np key scalars → canonical python values (identity-preserving)."""
+    out = []
+    for v, t in zip(values, types):
+        out.append(float(v) if t.is_float else int(v))
+    return tuple(out)
+
+
+class LruClock:
+    """Monotonic int32 stamp source; disabled (always None) without a
+    budget, so executors can pass the result straight into their jitted
+    step as a statically-absent argument."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self._step = 0
+
+    def next(self) -> Optional[jnp.ndarray]:
+        if not self.enabled:
+            return None
+        self._step += 1
+        return jnp.asarray(self._step, jnp.int32)

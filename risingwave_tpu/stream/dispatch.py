@@ -34,6 +34,50 @@ from .executor import Executor
 from .message import Barrier, Message, Watermark
 
 
+class MsgQueue:
+    """Single-consumer unbounded message queue.
+
+    Functionally asyncio.Queue(put_nowait/get), minus one asyncio.Queue
+    wart this runtime keeps hitting: Queue.get's cleanup path calls
+    ``getter.cancel()`` → ``loop.call_soon`` even when finalized by GC
+    AFTER the owning loop closed, spraying "Event loop is closed"
+    unraisable warnings whenever an abandoned executor generator (job
+    stop/reschedule leaves them suspended in get()) is collected late.
+    This get() awaits a bare future and only clears it in ``finally`` —
+    no loop interaction on finalization, so late GC is silent."""
+
+    def __init__(self) -> None:
+        import collections
+        self._items: collections.deque = collections.deque()
+        self._waiter: Optional[asyncio.Future] = None
+
+    def put_nowait(self, item) -> None:
+        self._items.append(item)
+        w = self._waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    async def put(self, item) -> None:
+        # unbounded: never blocks (PermitChannel does its own flow
+        # control with a semaphore before calling this)
+        self.put_nowait(item)
+
+    async def get(self):
+        while not self._items:
+            self._waiter = asyncio.get_running_loop().create_future()
+            try:
+                await self._waiter
+            finally:
+                self._waiter = None
+        return self._items.popleft()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+
 class PermitChannel:
     """Bounded exchange edge. Data messages consume permits (one per chunk
     of capacity rows — the reference counts cardinality; capacity is the
@@ -43,7 +87,7 @@ class PermitChannel:
 
     def __init__(self, permits: int = 32):
         self._sem = asyncio.Semaphore(permits)
-        self._q: asyncio.Queue = asyncio.Queue()
+        self._q = MsgQueue()
         self.permits = permits
 
     async def send(self, msg: Message) -> None:
@@ -168,15 +212,35 @@ class SimpleDispatcher(BroadcastDispatcher):
 
 
 class MergeExecutor(Executor):
-    """N-ary fan-in with barrier alignment: chunks/watermarks forward as
-    they arrive; an upstream that produced the epoch's barrier is parked
-    until every upstream has."""
+    """N-ary fan-in with barrier alignment: chunks forward as they arrive;
+    an upstream that produced the epoch's barrier is parked until every
+    upstream has. Watermarks are ALIGNED per column: the merge forwards
+    the minimum over all upstreams, and only once every live upstream has
+    reported one for that column (reference: BufferedWatermarks in
+    executor/merge.rs — a fan-in must not let one shard's watermark
+    overtake another shard's still-buffered rows below it)."""
 
     identity = "Merge"
 
     def __init__(self, channels: Sequence[PermitChannel], schema: Schema):
         self.channels = list(channels)
         self.schema = schema
+        # col_idx -> {channel_idx: latest value}; col_idx -> last forwarded
+        self._wm: dict[int, dict[int, object]] = {}
+        self._wm_sent: dict[int, object] = {}
+
+    def _on_watermark(self, i: int, wm: Watermark,
+                      finished: set) -> Optional[Watermark]:
+        per_chan = self._wm.setdefault(wm.col_idx, {})
+        per_chan[i] = wm.value
+        live = [j for j in range(len(self.channels)) if j not in finished]
+        if not all(j in per_chan for j in live):
+            return None
+        lo = min(per_chan[j] for j in live)
+        if wm.col_idx in self._wm_sent and lo <= self._wm_sent[wm.col_idx]:
+            return None
+        self._wm_sent[wm.col_idx] = lo
+        return Watermark(wm.col_idx, lo)
 
     async def execute(self) -> AsyncIterator[Message]:
         n = len(self.channels)
@@ -204,6 +268,10 @@ class MergeExecutor(Executor):
                             finished.add(i)
                         elif isinstance(msg, Barrier):
                             held[i] = msg
+                        elif isinstance(msg, Watermark):
+                            out = self._on_watermark(i, msg, finished)
+                            if out is not None:
+                                yield out
                         else:
                             yield msg
                 live = [i for i in range(n) if i not in finished]

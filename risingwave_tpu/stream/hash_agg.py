@@ -85,7 +85,8 @@ class HashAggExecutor(SingleInputExecutor):
                     "hbm_group_budget must be < table_capacity")
         self.hbm_group_budget = hbm_group_budget
         self._evicted: set = set()
-        self._lru_step = 0
+        from .cache import LruClock
+        self._lru_clock = LruClock(hbm_group_budget is not None)
         in_schema = input.schema
         key_types = tuple(in_schema[i].type for i in group_keys)
         self.core = AggCore(key_types, group_keys, agg_calls, table_capacity,
@@ -159,19 +160,11 @@ class HashAggExecutor(SingleInputExecutor):
         return GLOBAL_STRING_DICT.device_ranks()
 
     def _pykey(self, values) -> tuple:
-        """np key scalars → canonical python values (identity-preserving:
-        float group keys MUST NOT round-trip through int())."""
-        out = []
-        for v, t in zip(values, self.core.key_types):
-            out.append(float(v) if t.is_float else int(v))
-        return tuple(out)
+        from .cache import canonical_key
+        return canonical_key(values, self.core.key_types)
 
     def _lru(self):
-        """Per-chunk LRU stamp (None when no budget: a static no-op)."""
-        if self.hbm_group_budget is None:
-            return None
-        self._lru_step += 1
-        return jnp.asarray(self._lru_step, jnp.int32)
+        return self._lru_clock.next()
 
     async def map_chunk(self, chunk: StreamChunk):
         self.state = self._apply(self.state, chunk, self._str_ranks(),

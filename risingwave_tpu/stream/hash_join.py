@@ -30,8 +30,8 @@ from ..common.chunk import (
     make_chunk,
 )
 from ..ops.join_state import (
-    JoinCore, JoinSideState, JoinState, JoinType, clean_side_below,
-    compact_side, import_state,
+    JoinCore, JoinSideState, JoinState, JoinType, apply_evict_side,
+    clean_side_below, compact_side, import_state, join_evict_plan,
 )
 from ..storage.state_table import StateTable
 from .barrier_align import barrier_align
@@ -57,20 +57,43 @@ class HashJoinExecutor(Executor):
         out_capacity: int = DEFAULT_CHUNK_CAPACITY,
         strict: bool = True,
         interval_clean: Sequence[tuple] = (),
+        load_shard: Optional[tuple] = None,
+        hbm_key_budget: Optional[int] = None,
     ):
         """``interval_clean``: state-cleaning rules for interval/windowed
         joins — tuples ``(clean_side, clean_col, watch_side, watch_col,
         lag)``: when a watermark arrives on ``watch_side``'s column
         ``watch_col``, rows on ``clean_side`` whose ``clean_col`` value is
         below ``watermark - lag`` are freed at the next checkpoint
-        (reference: interval-join state cleaning, hash_join.rs)."""
+        (reference: interval-join state cleaning, hash_join.rs).
+
+        ``load_shard``: (shard_idx, n_shards) for fragmented builds — the N
+        join actors of one fragment share BOTH logical state tables; on
+        recovery each actor keeps only the rows whose JOIN KEY hashes to
+        its shard (the same device vnode hash the HashDispatcher routes
+        live rows with), so recovery works across any parallelism change
+        (reference: vnode-bitmap reassignment, stream/scale.rs:657).
+
+        ``hbm_key_budget``: cap on LIVE join keys held per device arena.
+        When a checkpoint finds more, the coldest keys (LRU by touch step,
+        synced across the two sides) are evicted from BOTH arenas to the
+        state tables and faulted back when a chunk mentions them — device
+        state becomes a cache over the durable tier instead of
+        grow-or-raise (reference: JoinHashMap's ManagedLruCache,
+        src/stream/src/executor/managed_state/join/mod.rs:228-258).
+        Requires both state tables with JOIN-KEY-PREFIXED pks (the
+        builder lays pks out as join_keys ++ stream_pk so fault-in is a
+        pk prefix scan)."""
         self.left, self.right = left, right
+        self.load_shard = load_shard
         from .metrics import ExecutorStats
         self.stats = ExecutorStats()
         self._join_args = dict(join_type=join_type, condition=condition)
         self._key_args = (left_keys, right_keys)
         self.interval_clean = tuple(interval_clean)
         self._pending_clean: dict[tuple[str, int], int] = {}
+        # max threshold ever applied per (side, col) — the fault-in filter
+        self._applied_clean: dict[tuple[str, int], int] = {}
         self.core = JoinCore(
             left.schema, right.schema, left_keys, right_keys, join_type,
             condition=condition, key_capacity=key_capacity,
@@ -84,16 +107,46 @@ class HashJoinExecutor(Executor):
         self.max_state_cells = 1 << 26    # growth ceiling (cap * W)
         self.state_tables = {"left": left_state_table,
                              "right": right_state_table}
+        if hbm_key_budget is not None:
+            if left_state_table is None or right_state_table is None:
+                hbm_key_budget = None      # no cold tier to evict to
+            elif hbm_key_budget >= key_capacity:
+                raise ValueError("hbm_key_budget must be < key_capacity")
+            else:
+                # the growth ceiling exists to stop unbounded arenas; with
+                # a cold tier the arena is bounded by eviction instead
+                self.max_state_cells = 1 << 30
+        self.hbm_key_budget = hbm_key_budget
+        self._evicted: set = set()
+        from .cache import LruClock
+        self._lru_clock = LruClock(hbm_key_budget is not None)
         self.state = self.core.init_state()
         self._make_jits()
         if any(self.state_tables.values()):
             self._load_from_state_tables()
 
     def _make_jits(self) -> None:
+        core = self.core
         self._apply = {
-            "left": jax.jit(functools.partial(self.core.apply_chunk, side="left")),
-            "right": jax.jit(functools.partial(self.core.apply_chunk, side="right")),
+            "left": jax.jit(lambda st, ch, step=None:
+                            core.apply_chunk(st, ch, side="left", step=step)),
+            "right": jax.jit(lambda st, ch, step=None:
+                             core.apply_chunk(st, ch, side="right", step=step)),
         }
+        self._evict_plan = jax.jit(join_evict_plan, static_argnums=(1,))
+
+        def _live_counts(state: JoinState):
+            from ..ops.join_state import _side_evictable_keys
+            return jnp.stack([jnp.sum(_side_evictable_keys(state.left)),
+                              jnp.sum(_side_evictable_keys(state.right))])
+
+        self._live_probe = jax.jit(_live_counts)
+
+        def _apply_evict(state: JoinState, mask_l, mask_r) -> JoinState:
+            return JoinState(left=apply_evict_side(state.left, mask_l),
+                             right=apply_evict_side(state.right, mask_r))
+
+        self._apply_evict = jax.jit(_apply_evict)
         self._gather = jax.jit(
             lambda ch, lo: gather_units_window(ch, lo, self.out_capacity))
         self._count_units = jax.jit(count_units)
@@ -124,6 +177,15 @@ class HashJoinExecutor(Executor):
 
         self._compact = jax.jit(_compact)
 
+    # -- LRU stamping ----------------------------------------------------------
+
+    def _lru(self):
+        return self._lru_clock.next()
+
+    def _pykey(self, values) -> tuple:
+        from .cache import canonical_key
+        return canonical_key(values, self.core.key_types)
+
     # -- adaptive growth -------------------------------------------------------
 
     def _apply_growing(self, side: str, chunk: StreamChunk):
@@ -131,8 +193,9 @@ class HashJoinExecutor(Executor):
         geometry (bucket width for hot-key skew, key capacity for table
         fill), and retry on the untouched previous state. Functional state
         makes the retry exact — no partial effects to undo."""
+        step = self._lru()
         while True:
-            new_state, big = self._apply[side](self.state, chunk)
+            new_state, big = self._apply[side](self.state, chunk, step)
             sides = {"left": new_state.left, "right": new_state.right}
             lane_ovf = any(bool(st.lane_overflow) for st in sides.values())
             ht_ovf = any(bool(st.ht_overflow) for st in sides.values())
@@ -202,9 +265,19 @@ class HashJoinExecutor(Executor):
                 _, side, chunk = ev
                 stats.chunks_in += 1
                 stats.capacity_rows_in += chunk.capacity
+                if self._evicted:
+                    hits = self._evicted_hits(side, chunk)
+                    if hits:
+                        # flush the optimistic batch FIRST: fault-in
+                        # replays mutate state, and a later rewind of the
+                        # batch must not lose them
+                        for out in self._flush_pending():
+                            yield out
+                        self._fault_in(hits)
                 if self._rewind_state is None:
                     self._rewind_state = self.state
-                new_state, big = self._apply[side](self.state, chunk)
+                new_state, big = self._apply[side](self.state, chunk,
+                                                   self._lru())
                 self.state = new_state
                 self._pending.append(
                     (side, chunk, self._pack_stats(new_state, big), big))
@@ -220,6 +293,8 @@ class HashJoinExecutor(Executor):
                     if barrier.checkpoint:
                         cleaned = self._apply_pending_clean()
                         self._checkpoint(barrier.epoch.curr)
+                        if self.hbm_key_budget is not None:
+                            cleaned |= self._evict_cold()
                         if cleaned:
                             self.state = self._compact(self.state)
                 yield barrier
@@ -245,6 +320,85 @@ class HashJoinExecutor(Executor):
                         yield out
                     yield wm.__class__(out_idx, wm.value)
 
+    # -- eviction / fault-in ---------------------------------------------------
+
+    def _evict_cold(self) -> bool:
+        """Evict the coldest live keys' buckets from BOTH arenas down to
+        3/4 of the budget (their durable rows were just written by this
+        barrier's checkpoint). Returns True if anything was evicted (the
+        caller compacts to reclaim the key slots)."""
+        # cheap gate first: one small reduction + sync, vs the full-sort
+        # evict plan — checkpoints under budget pay only this
+        nl, nr = (int(x) for x in jax.device_get(
+            self._live_probe(self.state)))
+        if max(nl, nr) <= self.hbm_key_budget:
+            return False
+        keep = max(self.hbm_key_budget * 3 // 4, 1)
+        mask_l, mask_r, packed = self._evict_plan(self.state, keep)
+        nel, ner = (int(x) for x in jax.device_get(packed[:2]))
+        if nel == 0 and ner == 0:
+            return False
+        for side, mask in (("left", mask_l), ("right", mask_r)):
+            st = getattr(self.state, side)
+            nm = np.asarray(mask)
+            idx = np.nonzero(nm)[0]
+            if not len(idx):
+                continue
+            key_np = [np.asarray(kd)[idx] for kd in st.ht.key_data]
+            for row in zip(*key_np):
+                self._evicted.add(self._pykey(row))
+        self.state = self._apply_evict(self.state, mask_l, mask_r)
+        return True
+
+    def _evicted_hits(self, side: str, chunk: StreamChunk) -> list:
+        """Evicted join keys mentioned by this chunk (host sync; paid only
+        while evicted keys exist)."""
+        key_idx = (self.core.left_keys if side == "left"
+                   else self.core.right_keys)
+        vis = np.asarray(chunk.vis)
+        datas = [np.asarray(chunk.columns[i].data) for i in key_idx]
+        ok = vis.copy()
+        for i in key_idx:
+            ok &= np.asarray(chunk.columns[i].mask)
+        present = set(zip(*(d[ok] for d in datas))) if datas else set()
+        return [k for k in (self._pykey(p) for p in present)
+                if k in self._evicted]
+
+    def _fault_in(self, keys: list) -> None:
+        """Restore the given keys' rows on BOTH sides from the cold tier:
+        prefix-scan each state table by join key (pks are join-key-
+        prefixed) and replay through the insert path with emission
+        discarded — degrees rebuild exactly, the same way recovery does."""
+        nk = len(self.core.left_keys)
+        for k in keys:
+            self._evicted.discard(k)
+        for side in ("left", "right"):
+            table = self.state_tables[side]
+            schema = (self.core.left_schema if side == "left"
+                      else self.core.right_schema)
+            rows = []
+            for k in keys:
+                rows.extend(table.scan_prefix(list(k), nk))
+            # watermark state cleaning already retired rows below the
+            # applied thresholds on DEVICE; an evicted key's durable rows
+            # missed that — drop them here (and delete them durably)
+            # instead of resurrecting expired state
+            for (cs, cc), thr in self._applied_clean.items():
+                if cs != side or not rows:
+                    continue
+                expired = [r for r in rows
+                           if r[cc] is not None and r[cc] < thr]
+                if expired:
+                    for r in expired:
+                        table.delete(r)
+                    rows = [r for r in rows
+                            if r[cc] is None or r[cc] >= thr]
+            bs = 1024
+            for i in range(0, len(rows), bs):
+                ch = physical_chunk(schema, rows[i: i + bs], bs)
+                big = self._apply_growing(side, ch)
+                del big                      # outputs were emitted long ago
+
     def _apply_pending_clean(self) -> bool:
         """Free rows below the pending watermark thresholds (mark dead +
         tombstone; deletes persist via the checkpoint that follows)."""
@@ -254,6 +408,12 @@ class HashJoinExecutor(Executor):
             st = getattr(self.state, side)
             st = self._clean_side(st, col, jnp.asarray(threshold))
             self.state = self.state.replace(**{side: st})
+            # evicted keys' durable rows are NOT on device: remember the
+            # high-water threshold so fault-in drops (and durably deletes)
+            # expired rows instead of resurrecting them
+            prev = self._applied_clean.get((side, col))
+            if prev is None or threshold > prev:
+                self._applied_clean[(side, col)] = threshold
         self._pending_clean.clear()
         return True
 
@@ -340,19 +500,86 @@ class HashJoinExecutor(Executor):
     def _load_from_state_tables(self) -> None:
         """Recovery: replay both sides' committed rows through the insert
         path (left first, then right) — degrees rebuild exactly; outputs are
-        discarded."""
+        discarded. Under an ``hbm_key_budget`` only the first ``budget``
+        keys load hot; the rest stay in the cold tier and fault in on
+        mention (keys are chosen jointly across the two sides — a key is
+        hot or cold on BOTH, the degree-coherence invariant)."""
+        cold_keys: Optional[set] = None
+        if self.hbm_key_budget is not None:
+            side_rows = {}
+            seen: list = []
+            seen_set: set = set()
+            for side in ("left", "right"):
+                table = self.state_tables[side]
+                rows = list(table.scan_all()) if table is not None else []
+                if rows and self.load_shard is not None:
+                    key_idx = (self.core.left_keys if side == "left"
+                               else self.core.right_keys)
+                    schema = (self.core.left_schema if side == "left"
+                              else self.core.right_schema)
+                    rows = self._filter_shard(rows, key_idx, schema)
+                side_rows[side] = rows
+                key_idx = (self.core.left_keys if side == "left"
+                           else self.core.right_keys)
+                for r in rows:
+                    kv = tuple(r[i] for i in key_idx)
+                    if any(v is None for v in kv):
+                        continue                   # null keys always hot
+                    k = self._pykey(kv)
+                    if k not in seen_set:
+                        seen_set.add(k)
+                        seen.append(k)
+            if len(seen) > self.hbm_key_budget:
+                cold_keys = set(seen[self.hbm_key_budget:])
+                self._evicted |= cold_keys
         for side in ("left", "right"):
             table = self.state_tables[side]
             if table is None:
                 continue
             schema = (self.core.left_schema if side == "left"
                       else self.core.right_schema)
-            rows = list(table.scan_all())
+            key_idx = (self.core.left_keys if side == "left"
+                       else self.core.right_keys)
+            if cold_keys is not None:
+                rows = [
+                    r for r in side_rows[side]
+                    if any(r[i] is None for i in key_idx)
+                    or self._pykey(tuple(r[i] for i in key_idx))
+                    not in cold_keys]
+            elif self.hbm_key_budget is not None:
+                rows = side_rows[side]      # already scanned + shard-filtered
+            else:
+                rows = list(table.scan_all())
+                if rows and self.load_shard is not None:
+                    rows = self._filter_shard(rows, key_idx, schema)
             bs = 1024
             for i in range(0, len(rows), bs):
                 chunk = physical_chunk(schema, rows[i: i + bs], bs)
                 self._apply_growing(side, chunk)
         self.state = self._clear_ckpt(self.state)
+
+    def _filter_shard(self, rows: list, key_idx, schema) -> list:
+        """Keep rows whose join key hashes to this actor's shard — the same
+        device hash the dispatcher routes live rows with, so reload
+        placement always matches routing, for ANY shard count."""
+        import jax.numpy as jnp
+        from ..common.chunk import Column
+        from ..common.hashing import vnode_of, vnode_to_shard
+        idx, n_shards = self.load_shard
+        out = []
+        bs = 1024
+        for i in range(0, len(rows), bs):
+            batch = rows[i:i + bs]
+            cols = []
+            for c in key_idx:
+                vals = [r[c] for r in batch]
+                data = np.array([v if v is not None else 0 for v in vals],
+                                dtype=schema[c].type.np_dtype)
+                mask = np.array([v is not None for v in vals])
+                cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+            shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
+            out.extend(r for r, s in zip(batch, shard) if int(s) == idx)
+        return out
 
 
 def _clear_ckpt_marks(state: JoinState) -> JoinState:

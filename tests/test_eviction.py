@@ -122,3 +122,94 @@ class TestAggEviction:
         assert after[0][1] == w[0][1] + 1
         assert after[199][2] == w[199][2] + 5
         s2.close()
+
+
+def _join_run(cfg, n_keys=120, revisit=True):
+    """Insert rows on both sides over ``n_keys`` join keys, revisiting the
+    earliest (coldest, likely evicted) keys with inserts AND deletes so
+    fault-in must restore both arenas before applying them."""
+    s = Session(config=cfg, checkpoint_frequency=2)
+    s.run_sql("CREATE TABLE l (k BIGINT PRIMARY KEY, j BIGINT, a BIGINT)")
+    s.run_sql("CREATE TABLE r (k BIGINT PRIMARY KEY, j BIGINT, b BIGINT)")
+    s.run_sql("CREATE MATERIALIZED VIEW jm AS "
+              "SELECT l.j AS j, l.a AS a, r.b AS b "
+              "FROM l JOIN r ON l.j = r.j")
+    per = 20
+    for b in range(n_keys // per):
+        lv = ", ".join(f"({b * per + i}, {b * per + i}, {i})"
+                       for i in range(per))
+        rv = ", ".join(f"({b * per + i}, {b * per + i}, {i * 2})"
+                       for i in range(0, per, 2))
+        s.run_sql(f"INSERT INTO l VALUES {lv}")
+        s.run_sql(f"INSERT INTO r VALUES {rv}")
+        s.flush()
+    if revisit:
+        # cold keys: new match on key 0, delete the match on key 2,
+        # second left row on key 4 (degree > 1 after fault-in)
+        s.run_sql("INSERT INTO r VALUES (9001, 1, 77)")
+        s.run_sql("DELETE FROM r WHERE k = 2")
+        s.run_sql("INSERT INTO l VALUES (9002, 4, 55)")
+        s.flush()
+    rows = sorted(s.mv_rows("jm"))
+    caps = _join_capacities(s, "jm")
+    s.close()
+    return rows, caps
+
+
+def _join_capacities(s, mv):
+    caps = []
+    stack = [s.jobs[mv].pipeline]
+    while stack:
+        ex = stack.pop()
+        if ex is None:
+            continue
+        if type(ex).__name__ == "HashJoinExecutor":
+            caps.append(ex.core.capacity)
+        for at in ("input", "left", "right"):
+            stack.append(getattr(ex, at, None))
+    return caps
+
+
+class TestJoinEviction:
+    def test_key_space_larger_than_arena_bounded_hbm(self):
+        """120 join keys through a 128-slot arena with a 32-key budget: the
+        arena must NOT grow (bounded HBM — live keys stay near the budget)
+        and results must equal the unbudgeted run — including
+        deletes/inserts on faulted-back keys."""
+        base, _ = _join_run(BuildConfig())
+        got, caps = _join_run(BuildConfig(join_key_capacity=128,
+                                          join_hbm_budget=32))
+        assert got == base and len(base) > 0
+        assert caps == [128]    # eviction kept the arena at its birth size
+
+    def test_join_recovery_with_more_keys_than_budget(self, tmp_path):
+        d = str(tmp_path / "db")
+        cfg = BuildConfig(join_key_capacity=128, join_hbm_budget=32)
+        s = Session(config=cfg, data_dir=d, checkpoint_frequency=1)
+        s.run_sql("CREATE TABLE l (k BIGINT PRIMARY KEY, j BIGINT, "
+                  "a BIGINT)")
+        s.run_sql("CREATE TABLE r (k BIGINT PRIMARY KEY, j BIGINT, "
+                  "b BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW jm AS "
+                  "SELECT l.j AS j, l.a AS a, r.b AS b "
+                  "FROM l JOIN r ON l.j = r.j")
+        for b in range(6):
+            lv = ", ".join(f"({b * 20 + i}, {b * 20 + i}, {i})"
+                           for i in range(20))
+            s.run_sql(f"INSERT INTO l VALUES {lv}")
+            s.run_sql(f"INSERT INTO r VALUES {lv}")
+            s.flush()
+        want = sorted(s.mv_rows("jm"))
+        assert len(want) == 120
+        s.close()
+
+        s2 = Session(config=cfg, data_dir=d, checkpoint_frequency=1)
+        assert sorted(s2.mv_rows("jm")) == want
+        # cold keys still join correctly after recovery
+        s2.run_sql("INSERT INTO l VALUES (9001, 0, 42)")
+        s2.run_sql("DELETE FROM r WHERE k = 1")
+        s2.flush()
+        after = sorted(s2.mv_rows("jm"))
+        assert (0, 42, 0) in after
+        assert len(after) == 120    # +1 new match, -1 deleted match
+        s2.close()

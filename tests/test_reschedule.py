@@ -48,6 +48,71 @@ class TestReschedule:
             expect[i % 4] = expect.get(i % 4, 0) + i * 10
         assert got == expect
 
+    def test_restart_preserves_mesh_layout(self, tmp_path):
+        """Round-4 weak #5: a rescaled job must keep its layout across a
+        restart. The reschedule persists the config's durable form (mesh
+        topology) in the DDL log; recovery replays the CREATE under it."""
+        d = str(tmp_path / "db")
+        s = Session(data_dir=d)
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW g AS "
+                  "SELECT k % 4 AS grp, sum(v) AS sv FROM t GROUP BY k % 4")
+        for i in range(8):
+            s.run_sql(f"INSERT INTO t VALUES ({i}, {i * 10})")
+        s.flush()
+        before = sorted(s.mv_rows("g"))
+        s.reschedule("g", BuildConfig(mesh=_mesh(4)))
+        s.close()
+
+        import warnings
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            s2 = Session(data_dir=d)
+        # the legacy behavior warned "configs are not persisted" — now the
+        # layout must restore silently
+        assert not [w for w in caught if "reschedule" in str(w.message)]
+        assert sorted(s2.mv_rows("g")) == before
+        ex = s2.jobs["g"].pipeline
+        names = set()
+        while ex is not None:
+            names.add(type(ex).__name__)
+            ex = getattr(ex, "input", None)
+        assert "ShardedHashAggExecutor" in names   # layout survived restart
+        s2.run_sql("INSERT INTO t VALUES (100, 7)")
+        s2.flush()
+        got = dict(s2.mv_rows("g"))
+        assert got[0] == sum(i * 10 for i in range(0, 8, 4)) + 7
+        s2.close()
+
+    def test_drop_voids_persisted_reschedule_config(self, tmp_path):
+        """A DROP after a reschedule voids the persisted layout: a re-CREATE
+        under the same name is a NEW job and must recover with the session
+        default, not the stale rescaled config."""
+        d = str(tmp_path / "db")
+        s = Session(data_dir=d)
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW g AS "
+                  "SELECT k % 2 AS grp, sum(v) AS sv FROM t GROUP BY k % 2")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        s.reschedule("g", BuildConfig(mesh=_mesh(4)))
+        s.run_sql("DROP MATERIALIZED VIEW g")
+        s.run_sql("CREATE MATERIALIZED VIEW g AS "
+                  "SELECT k % 2 AS grp, sum(v) AS sv FROM t GROUP BY k % 2")
+        s.flush()
+        want = sorted(s.mv_rows("g"))
+        s.close()
+
+        s2 = Session(data_dir=d)
+        assert sorted(s2.mv_rows("g")) == want
+        ex = s2.jobs["g"].pipeline
+        names = set()
+        while ex is not None:
+            names.add(type(ex).__name__)
+            ex = getattr(ex, "input", None)
+        assert "ShardedHashAggExecutor" not in names   # default layout
+        s2.close()
+
     def test_reschedule_preserves_downstream_subscription(self, tmp_path):
         s = Session(data_dir=str(tmp_path / "db"))
         s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
