@@ -64,6 +64,18 @@ def encode_value(v: Optional[Any], t: DataType) -> bytes:
         return _VAL_TAG + (b"\x01" if v else b"\x00")
     if t.is_string:
         return _VAL_TAG + _enc_str(GLOBAL_STRING_DICT.lookup(int(v)))
+    if k == TypeKind.LIST:
+        # element-wise: \x01 ++ elem-encoding per element, \x00 end — a
+        # proper-prefix list sorts before its extensions, elements compare
+        # in order (memcomparable for same-typed lists)
+        from .types import GLOBAL_LIST_DICT
+        et = t.elem_type
+        parts = []
+        for e in GLOBAL_LIST_DICT.lookup(int(v)):
+            parts.append(b"\x01")
+            parts.append(encode_value(
+                None if e is None else et.to_physical(e), et))
+        return _VAL_TAG + b"".join(parts) + b"\x00"
     if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
         return _VAL_TAG + _enc_float(float(v))
     if k in (TypeKind.INT16,):
@@ -106,6 +118,17 @@ def encode_value_row(row: Sequence[Optional[Any]],
             raw = GLOBAL_STRING_DICT.lookup(int(v)).encode("utf-8")
             parts.append(struct.pack("<I", len(raw)))
             parts.append(raw)
+        elif k == TypeKind.LIST:
+            # lists persist by CONTENT (ids are process-local): element
+            # count, then value-encoded PYTHON elements (ListDict holds
+            # python values, not physical scalars)
+            from .types import GLOBAL_LIST_DICT
+            elems = GLOBAL_LIST_DICT.lookup(int(v))
+            et = t.elem_type
+            parts.append(struct.pack("<I", len(elems)))
+            parts.append(encode_value_row(
+                [None if e is None else et.to_physical(e) for e in elems],
+                [et] * len(elems)))
         elif t.is_float:
             parts.append(struct.pack("<d", float(v)))
         else:
@@ -113,10 +136,9 @@ def encode_value_row(row: Sequence[Optional[Any]],
     return b"".join(parts)
 
 
-def decode_value_row(data: bytes, types: Sequence[DataType]) -> tuple:
-    """Durable bytes -> physical row tuple (strings re-interned)."""
+def _decode_values(data: bytes, pos: int,
+                   types: Sequence[DataType]) -> tuple[list, int]:
     out: list = []
-    pos = 0
     for t in types:
         tag = data[pos]
         pos += 1
@@ -133,6 +155,14 @@ def decode_value_row(data: bytes, types: Sequence[DataType]) -> tuple:
             s = data[pos:pos + n].decode("utf-8")
             pos += n
             out.append(GLOBAL_STRING_DICT.intern(s))
+        elif k == TypeKind.LIST:
+            from .types import GLOBAL_LIST_DICT
+            (n,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            et = t.elem_type
+            phys, pos = _decode_values(data, pos, [et] * n)
+            elems = [None if e is None else et.to_python(e) for e in phys]
+            out.append(GLOBAL_LIST_DICT.intern(elems))
         elif t.is_float:
             (f,) = struct.unpack_from("<d", data, pos)
             pos += 8
@@ -141,6 +171,12 @@ def decode_value_row(data: bytes, types: Sequence[DataType]) -> tuple:
             (i,) = struct.unpack_from("<q", data, pos)
             pos += 8
             out.append(i)
+    return out, pos
+
+
+def decode_value_row(data: bytes, types: Sequence[DataType]) -> tuple:
+    """Durable bytes -> physical row tuple (strings/lists re-interned)."""
+    out, _ = _decode_values(data, 0, types)
     return tuple(out)
 
 

@@ -33,6 +33,7 @@ class TypeKind(enum.Enum):
     VARCHAR = "varchar"        # int32 dictionary id
     BYTEA = "bytea"            # int32 dictionary id
     SERIAL = "serial"          # int64 row id (vnode-prefixed)
+    LIST = "list"              # int32 list-dictionary id (value-interned)
 
 
 _PHYSICAL: dict[TypeKind, Any] = {
@@ -50,6 +51,7 @@ _PHYSICAL: dict[TypeKind, Any] = {
     TypeKind.VARCHAR: jnp.int32,
     TypeKind.BYTEA: jnp.int32,
     TypeKind.SERIAL: jnp.int64,
+    TypeKind.LIST: jnp.int32,
 }
 
 _INTEGRAL = {
@@ -182,12 +184,56 @@ class StringDict:
 GLOBAL_STRING_DICT = StringDict()
 
 
+class ListDict:
+    """Host-side dictionary for LIST columns — the varlen strategy of
+    StringDict applied to arrays (reference array type:
+    src/common/src/array/list_array.rs). A list VALUE is a python tuple of
+    element values (None = NULL element); interning canonicalizes by value,
+    so id equality on device == semantic list equality. Id 0 is the empty
+    list so zero-initialised buffers decode cleanly. Device code only
+    carries the int32 ids; element access / unnest / aggregation over
+    contents are host-tier operations like every varlen function."""
+
+    __slots__ = ("_to_id", "_to_list", "max_size")
+
+    DEFAULT_MAX = 1 << 22
+
+    def __init__(self, max_size: int = DEFAULT_MAX):
+        self._to_id: dict = {(): 0}
+        self._to_list: list = [()]
+        self.max_size = max_size
+
+    def intern(self, value) -> int:
+        t = tuple(value)
+        i = self._to_id.get(t)
+        if i is not None:
+            return i
+        if len(self._to_list) >= self.max_size:
+            raise RuntimeError(
+                f"list dictionary full ({self.max_size} entries)")
+        i = len(self._to_list)
+        self._to_id[t] = i
+        self._to_list.append(t)
+        return i
+
+    def lookup(self, i: int) -> tuple:
+        return self._to_list[i]
+
+    def __len__(self) -> int:
+        return len(self._to_list)
+
+
+GLOBAL_LIST_DICT = ListDict()
+
+
 @dataclasses.dataclass(frozen=True)
 class DataType:
-    """A logical column type. ``scale`` is only meaningful for DECIMAL."""
+    """A logical column type. ``scale`` is only meaningful for DECIMAL;
+    ``elem_kind`` only for LIST (the element type's kind)."""
 
     kind: TypeKind
     scale: int = 0
+    elem_kind: Optional[TypeKind] = None
 
     @property
     def dtype(self):
@@ -209,6 +255,15 @@ class DataType:
     def is_string(self) -> bool:
         return self.kind in (TypeKind.VARCHAR, TypeKind.BYTEA)
 
+    @property
+    def is_list(self) -> bool:
+        return self.kind == TypeKind.LIST
+
+    @property
+    def elem_type(self) -> "DataType":
+        assert self.kind == TypeKind.LIST and self.elem_kind is not None
+        return DataType(self.elem_kind)
+
     # -- host <-> device value conversion -------------------------------------
 
     def to_physical(self, v: Any) -> Any:
@@ -217,6 +272,8 @@ class DataType:
             return self.null_sentinel()
         if self.kind == TypeKind.DECIMAL:
             return int(round(float(v) * 10**self.scale))
+        if self.is_list:
+            return GLOBAL_LIST_DICT.intern(v)
         if self.is_string:
             return GLOBAL_STRING_DICT.intern(v if isinstance(v, str) else v.decode())
         if self.kind == TypeKind.BOOL:
@@ -229,6 +286,8 @@ class DataType:
         """Physical scalar → Python value (for result rows / tests)."""
         if self.kind == TypeKind.DECIMAL:
             return int(v) / 10**self.scale if self.scale else int(v)
+        if self.is_list:
+            return GLOBAL_LIST_DICT.lookup(int(v))
         if self.is_string:
             return GLOBAL_STRING_DICT.lookup(int(v))
         if self.kind == TypeKind.BOOL:
@@ -265,6 +324,10 @@ SERIAL = DataType(TypeKind.SERIAL)
 
 def decimal(scale: int = 2) -> DataType:
     return DataType(TypeKind.DECIMAL, scale=scale)
+
+
+def list_of(elem: DataType) -> DataType:
+    return DataType(TypeKind.LIST, elem_kind=elem.kind)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -32,10 +32,13 @@ from ..common.chunk import Column
 class AggCall:
     """A planned aggregate: kind + input column index (-1 for count(*))."""
 
-    kind: str                      # count / sum / min / max / avg
+    kind: str                      # count / sum / min / max / avg / …
     arg: int = -1                  # input column index; -1 => count(*)
     arg_type: Optional[DataType] = None
     distinct: bool = False
+    #: constant side argument: string_agg's delimiter,
+    #: percentile_cont's fraction (python value, not an expression)
+    extra: Optional[object] = None
 
     #: HLL registers for approx_count_distinct: m=16 → ~26% rel. error,
     #: 16 int64 lanes per group (reference capability:
@@ -48,8 +51,15 @@ class AggCall:
     def output_type(self) -> DataType:
         if self.kind in ("count", "approx_count_distinct"):
             return INT64
-        if self.kind == "avg":
+        if self.kind in ("avg", "percentile_cont"):
             return FLOAT64
+        if self.kind == "array_agg":
+            from ..common.types import TypeKind
+            assert self.arg_type is not None
+            return DataType(TypeKind.LIST, elem_kind=self.arg_type.kind)
+        if self.kind == "string_agg":
+            from ..common.types import VARCHAR
+            return VARCHAR
         assert self.arg_type is not None
         return self.arg_type
 
@@ -57,6 +67,21 @@ class AggCall:
     def needs_append_only(self) -> bool:
         # HLL registers are monotone maxima — deletes cannot retract them
         return self.kind in ("min", "max", "approx_count_distinct")
+
+    #: agg kinds that can never be fixed device lanes (ragged multiset
+    #: state); always routed to stream/materialized_agg.py
+    MATERIALIZED_KINDS = frozenset(
+        {"array_agg", "string_agg", "percentile_cont", "mode"})
+
+    @property
+    def lanes_unsupported(self) -> bool:
+        """True when this call cannot run on the fixed-lane device path at
+        all (exact DISTINCT dedup or collecting aggregates). Device
+        executors raise on these; the planner routes them to
+        MaterializedAggExecutor (reference: AggStateStorage::
+        MaterializedInput, distinct dedup tables)."""
+        return ((self.distinct and self.kind != "approx_count_distinct")
+                or self.kind in self.MATERIALIZED_KINDS)
 
     @property
     def is_string_minmax(self) -> bool:

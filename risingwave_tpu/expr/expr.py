@@ -722,6 +722,145 @@ _register_regexp("regexp_replace", _pg_regexp_replace, lambda ts: T.VARCHAR)
 _register_regexp("regexp_match", _pg_regexp_match, lambda ts: T.VARCHAR)
 
 
+def _register_host_fn(name: str, str_args: tuple, pyfn, type_infer):
+    """Generic host-tier registration: ``str_args`` marks which positions
+    carry dictionary ids (decoded to str); the rest pass as ints. Work is
+    per UNIQUE argument tuple over rows whose args are all non-NULL —
+    NULL/masked lanes hold dtype sentinels that must never reach pyfn (a
+    sentinel 0 position argument would crash split_part, a garbage
+    timestamp would overflow to_char). A None result is SQL NULL."""
+    def impl(datas, masks, out_type):
+        import numpy as np
+        cols = [np.asarray(d).astype(np.int64) for d in datas]
+        in_valid = np.asarray(_strict_mask(masks))
+        if in_valid.ndim == 0:
+            in_valid = np.full(len(cols[0]), bool(in_valid))
+        stacked = np.stack(cols, axis=1)
+        stacked[~in_valid] = 0        # collapse masked lanes to one tuple
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        results = np.zeros(len(uniq), out_type.np_dtype)
+        valid = np.ones(len(uniq), bool)
+        evaluated = np.zeros(len(uniq), bool)
+        evaluated[inverse[in_valid]] = True
+        for u, tup in enumerate(uniq):
+            if not evaluated[u]:
+                valid[u] = False
+                continue
+            args = [_lookup_str(int(v)) if i in str_args else int(v)
+                    for i, v in enumerate(tup)]
+            r = pyfn(*args)
+            if r is None:
+                valid[u] = False
+            else:
+                results[u] = _intern_str(r) if out_type.is_string else r
+        return (jnp.asarray(results[inverse]),
+                jnp.asarray(in_valid) & jnp.asarray(valid[inverse]))
+    _REGISTRY[name] = (impl, type_infer)
+
+
+def _split_part(s: str, delim: str, n: int):
+    # PG split_part: 1-based field index; negative counts from the end;
+    # out-of-range yields '' (ref: src/expr/src/vector_op/split_part.rs)
+    if n == 0:
+        raise ValueError("field position must not be zero")
+    parts = s.split(delim) if delim else [s]
+    i = n - 1 if n > 0 else len(parts) + n
+    return parts[i] if 0 <= i < len(parts) else ""
+
+
+_register_host_fn("split_part", (0, 1), _split_part, lambda ts: T.VARCHAR)
+
+
+def _regexp_match_group(s: str, p: str, n: int):
+    """(regexp_match(s, p))[n] — 1-based group of the first match, NULL on
+    no match / out-of-range. With no capture groups, [1] is the whole
+    match (regexp_match then returns a 1-element array in PG)."""
+    m = _compile_re(p).search(s)
+    if m is None:
+        return None
+    if m.re.groups == 0:
+        return m.group(0) if n == 1 else None
+    if 1 <= n <= m.re.groups:
+        return m.group(n)
+    return None
+
+
+_register_host_fn("regexp_match_group", (0, 1), _regexp_match_group,
+                  lambda ts: T.VARCHAR)
+
+
+def _array_access(list_id: int, n: int):
+    """1-based element access over a list-dictionary id; out-of-range is
+    NULL (PG array subscript semantics)."""
+    from ..common.types import GLOBAL_LIST_DICT
+    elems = GLOBAL_LIST_DICT.lookup(int(list_id))
+    return elems[n - 1] if 1 <= n <= len(elems) else None
+
+
+_register_host_fn("array_access", (), _array_access,
+                  lambda ts: ts[0].elem_type)
+
+
+@register("array_length", _t_int64)
+def _array_length(datas, masks, out_type):
+    import numpy as np
+    from ..common.types import GLOBAL_LIST_DICT
+    ids = np.asarray(datas[0])
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    results = np.array([len(GLOBAL_LIST_DICT.lookup(int(u))) for u in uniq],
+                       np.int64)
+    return jnp.asarray(results[inverse]), masks[0]
+
+
+# to_char over timestamps (reference: src/expr/src/vector_op/to_char.rs —
+# a Postgres-pattern subset: YYYY/YY/MM/DD/HH24/HH12/HH/MI/SS/MS/AM/PM;
+# numeric patterns match case-insensitively as in PG)
+
+_TO_CHAR_PATTERNS = [
+    ("YYYY", lambda dt: f"{dt[0]:04d}"),
+    ("YY", lambda dt: f"{dt[0] % 100:02d}"),
+    ("MM", lambda dt: f"{dt[1]:02d}"),
+    ("DD", lambda dt: f"{dt[2]:02d}"),
+    ("HH24", lambda dt: f"{dt[3]:02d}"),
+    ("HH12", lambda dt: f"{(dt[3] % 12) or 12:02d}"),
+    ("HH", lambda dt: f"{(dt[3] % 12) or 12:02d}"),
+    ("MI", lambda dt: f"{dt[4]:02d}"),
+    ("SS", lambda dt: f"{dt[5]:02d}"),
+    ("MS", lambda dt: f"{dt[6] // 1000:03d}"),
+    ("AM", lambda dt: "AM" if dt[3] < 12 else "PM"),
+    ("PM", lambda dt: "AM" if dt[3] < 12 else "PM"),
+]
+
+
+@_functools.lru_cache(maxsize=64)
+def _to_char_compile(fmt: str):
+    """fmt -> [literal | pattern-fn] segments, longest pattern first."""
+    segs: list = []
+    i = 0
+    up = fmt.upper()
+    while i < len(fmt):
+        for pat, fn in _TO_CHAR_PATTERNS:
+            if up.startswith(pat, i):
+                segs.append(fn)
+                i += len(pat)
+                break
+        else:
+            segs.append(fmt[i])
+            i += 1
+    return segs
+
+
+def _to_char(us: int, fmt: str) -> str:
+    import datetime
+    d = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=us)
+    dt = (d.year, d.month, d.day, d.hour, d.minute, d.second, d.microsecond)
+    return "".join(seg if isinstance(seg, str) else seg(dt)
+                   for seg in _to_char_compile(fmt))
+
+
+_register_host_fn("to_char", (1,), _to_char, lambda ts: T.VARCHAR)
+
+
 @register("str_rank", _t_int64)
 def _str_rank(datas, masks, out_type):
     """id -> lexicographic rank via the dictionary's rank side table.
@@ -817,6 +956,8 @@ HOST_CALLBACK_FNS = {
     "lower", "upper", "trim", "ltrim", "rtrim", "substr", "substring",
     "length", "concat_op", "like", "not_like",
     "regexp_like", "regexp_count", "regexp_replace", "regexp_match",
+    "regexp_match_group", "split_part", "to_char", "array_access",
+    "array_length",
     # not host callbacks, but must run eagerly: they read the live rank table
     "str_rank", "str_less_than", "str_less_than_or_equal",
     "str_greater_than", "str_greater_than_or_equal",

@@ -96,7 +96,12 @@ _BINOP_FN = {
 }
 
 AGG_KINDS = {"count", "sum", "min", "max", "avg",
-             "approx_count_distinct"}
+             "approx_count_distinct",
+             # materialized-input kinds (stream/materialized_agg.py)
+             "array_agg", "string_agg", "percentile_cont", "mode"}
+
+#: aggs taking a constant second argument, stored on AggCall.extra
+_EXTRA_ARG_AGGS = {"string_agg", "percentile_cont"}
 
 RANK_FUNC_KINDS = {"row_number", "rank", "dense_rank"}
 WINDOW_ONLY_KINDS = RANK_FUNC_KINDS | {"lag", "lead"}
@@ -195,6 +200,36 @@ class ExprBinder:
             return cast(self.bind(node.expr), type_from_name(node.type_name))
         if isinstance(node, A.WindowFunc):
             return self._bind_window(node)
+        if isinstance(node, A.ArrayLit):
+            items = [self.bind(it) for it in node.items]
+            if not all(isinstance(it, Literal) for it in items):
+                raise BindError("ARRAY[…] elements must be constants")
+            # unify element types: ints widen to INT64, any float makes
+            # the whole array FLOAT64; mixed classes are a bind error
+            kinds = {it.type.kind for it in items if it.value is not None}
+            int_kinds = {TypeKind.INT16, TypeKind.INT32, TypeKind.INT64}
+            float_kinds = {TypeKind.FLOAT32, TypeKind.FLOAT64}
+            if not kinds:
+                elem_kind = TypeKind.INT64
+            elif kinds <= int_kinds:
+                elem_kind = TypeKind.INT64
+            elif kinds <= int_kinds | float_kinds:
+                elem_kind = TypeKind.FLOAT64
+            elif len(kinds) == 1:
+                elem_kind = next(iter(kinds))
+            else:
+                raise BindError(
+                    "ARRAY[…] elements must share one type; got "
+                    + ", ".join(sorted(k.value for k in kinds)))
+            conv = (float if elem_kind == TypeKind.FLOAT64 else
+                    int if elem_kind == TypeKind.INT64 else
+                    (lambda v: v))
+            return Literal(
+                tuple(None if it.value is None else conv(it.value)
+                      for it in items),
+                DataType(TypeKind.LIST, elem_kind=elem_kind))
+        if isinstance(node, A.Subscript):
+            return self._bind_subscript(node)
         if isinstance(node, A.ScalarSubquery):
             if self.subquery_sink is None:
                 raise BindError("scalar subquery not supported here")
@@ -202,6 +237,22 @@ class ExprBinder:
             # placeholder: planner rewrites the comparison into DynamicFilter
             return _SubqueryPlaceholder(len(self.subquery_sink) - 1)
         raise BindError(f"cannot bind {type(node).__name__}")
+
+    def _bind_subscript(self, node: A.Subscript) -> Expr:
+        """1-based element access. (regexp_match(s, p))[n] is rewritten to
+        the scalar regexp_match_group(s, p, n) — the match-groups array
+        never materializes (PG semantics: regexp_match returns text[] of
+        capture groups; reference: src/expr/src/vector_op/regexp.rs)."""
+        idx = self.bind(node.index)
+        if (isinstance(node.expr, A.FuncCall)
+                and node.expr.name.lower() == "regexp_match"):
+            args = [self.bind(a) for a in node.expr.args]
+            return call("regexp_match_group", *args, idx)
+        base = self.bind(node.expr)
+        if not base.type.is_list:
+            raise BindError(
+                f"cannot subscript a {base.type.kind.value} value")
+        return call("array_access", base, idx)
 
     def _literal(self, node: A.Lit) -> Literal:
         v = node.value
@@ -246,7 +297,14 @@ class ExprBinder:
         if name in TABLE_FUNC_KINDS:
             args = tuple(self.bind(a) for a in node.args)
             from ..common.types import VARCHAR as _VC
-            out_t = _VC if name == "regexp_split_to_table" else INT64
+            if name == "regexp_split_to_table":
+                out_t = _VC
+            elif name == "unnest":
+                if not args or not args[0].type.is_list:
+                    raise BindError("unnest() requires an array argument")
+                out_t = args[0].type.elem_type
+            else:
+                out_t = INT64
             return TableFuncCall(name, args, out_t)
         if name == "extract":
             from ..expr.expr import make_extract
@@ -309,8 +367,34 @@ class ExprBinder:
         return _WindowPlaceholder(len(self.win_ctx) - 1, out_t)
 
     def _bind_agg(self, kind: str, node: A.FuncCall) -> Expr:
+        extra = None
+        if kind in _EXTRA_ARG_AGGS:
+            if len(node.args) != 2:
+                raise BindError(
+                    f"{kind}(value, constant) takes two arguments")
+            const = self.bind(node.args[1])
+            if not isinstance(const, Literal):
+                raise BindError(f"{kind}()'s second argument must be a "
+                                "constant")
+            extra = const.value
+            node = dataclasses.replace(node, args=node.args[:1])
         if len(node.args) > 1:
             raise BindError(f"{kind}() takes at most one argument")
+        if node.filter is not None:
+            # FILTER (WHERE c) rewrites to a CASE-wrapped argument: rows
+            # failing c contribute NULL, which every aggregate skips
+            # (count counts non-NULL). count(*) FILTER (c) == count(CASE
+            # WHEN c THEN 1 END). Works under DISTINCT too: distinct-ness
+            # is over the surviving non-NULL values. (reference:
+            # src/frontend/src/optimizer/plan_node/logical_agg.rs agg
+            # filter support)
+            if not node.args or isinstance(node.args[0], A.Star):
+                if kind != "count":
+                    raise BindError(f"{kind}(*) is not valid")
+                wrapped: tuple = (A.Case(((node.filter, A.Lit(1)),), None),)
+            else:
+                wrapped = (A.Case(((node.filter, node.args[0]),), None),)
+            node = dataclasses.replace(node, args=wrapped, filter=None)
         if not node.args or isinstance(node.args[0], A.Star):
             if kind != "count":
                 raise BindError(f"{kind}(*) is not valid")
@@ -320,12 +404,14 @@ class ExprBinder:
             if not isinstance(arg, InputRef):
                 # non-trivial agg args get a pre-projection by the planner;
                 # record the expression itself
-                acall = AggCall(kind, -2, arg.type, distinct=node.distinct)
+                acall = AggCall(kind, -2, arg.type, distinct=node.distinct,
+                                extra=extra)
                 bound = BoundAgg(acall, -1)
                 bound.arg_expr = arg  # type: ignore[attr-defined]
                 self.agg_ctx.append(bound)
                 return _AggPlaceholder(len(self.agg_ctx) - 1, acall.output_type)
-            acall = AggCall(kind, arg.index, arg.type, distinct=node.distinct)
+            acall = AggCall(kind, arg.index, arg.type, distinct=node.distinct,
+                            extra=extra)
         # dedup identical agg calls
         for i, b in enumerate(self.agg_ctx):
             if b.call == acall and not hasattr(b, "arg_expr"):

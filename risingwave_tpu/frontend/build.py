@@ -151,6 +151,29 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         return HopWindowExecutor(inp, plan.time_col, plan.slide, plan.size)
 
     if isinstance(plan, P.PAgg):
+        from ..stream.materialized_agg import (
+            MaterializedAggExecutor, call_needs_materialized,
+            materialized_agg_state_schema,
+        )
+        if any(call_needs_materialized(c, plan.append_only_input)
+               for c in plan.agg_calls):
+            # exact DISTINCT / array_agg / string_agg / percentile / mode /
+            # min-max-under-retraction: materialized-input state on the
+            # host tier (reference: AggStateStorage::MaterializedInput);
+            # ragged per-group multisets have no fixed-lane device layout
+            if plan.eowc:
+                raise ValueError(
+                    "EMIT ON WINDOW CLOSE does not support materialized-"
+                    "input aggregates")
+            inp = build_plan(plan.input, ctx)
+            key_fields = [plan.input.schema[i] for i in plan.group_keys]
+            nk = len(plan.group_keys)
+            st = ctx.state_table(
+                materialized_agg_state_schema(key_fields),
+                list(range(nk + 5)))     # keys + agg_idx/is_null/vi/vf/vs
+            return MaterializedAggExecutor(
+                inp, list(plan.group_keys), list(plan.agg_calls),
+                state_table=st, out_capacity=cfg.chunk_capacity)
         if (plan.group_keys and cfg.fragment_parallelism > 1
                 and cfg.mesh is None and ctx.durable):
             # multi-fragment build over the dispatch fabric; batch builds

@@ -9,6 +9,7 @@ keywords; '...' string literals; -- line comments.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import Any, Optional
 
@@ -580,6 +581,11 @@ class Parser:
                 negated = True
             if self.eat_kw("in"):
                 self.expect_op("(")
+                if self.at_kw("select"):
+                    q = self._select()
+                    self.expect_op(")")
+                    e = A.InSubquery(e, q, negated)
+                    continue
                 items = [self.parse_expr()]
                 while self.eat_op(","):
                     items.append(self.parse_expr())
@@ -626,9 +632,15 @@ class Parser:
 
     def _postfix_expr(self):
         e = self._primary_expr()
-        while self.eat_op("::"):
-            e = A.Cast(e, self._type_name())
-        return e
+        while True:
+            if self.eat_op("::"):
+                e = A.Cast(e, self._type_name())
+            elif self.eat_op("["):
+                idx = self.parse_expr()
+                self.expect_op("]")
+                e = A.Subscript(e, idx)
+            else:
+                return e
 
     def _primary_expr(self):
         t = self.peek()
@@ -663,6 +675,17 @@ class Parser:
             if us is None:
                 raise SqlParseError(f"unsupported interval unit {unit!r}")
             return A.Lit(int(amount * us), "interval")
+        if (t.kind in ("name", "kw") and str(t.value).lower() == "array"
+                and self.peek(1).kind == "op" and self.peek(1).value == "["):
+            self.next()
+            self.expect_op("[")
+            items: list = []
+            if not self.at_op("]"):
+                items.append(self.parse_expr())
+                while self.eat_op(","):
+                    items.append(self.parse_expr())
+            self.expect_op("]")
+            return A.ArrayLit(tuple(items))
         if self.eat_kw("case"):
             branches = []
             while self.eat_kw("when"):
@@ -715,6 +738,29 @@ class Parser:
                         args.append(self.parse_expr())
                 self.expect_op(")")
                 fc = A.FuncCall(name, tuple(args), distinct)
+                if (self.peek().kind in ("name", "kw")
+                        and str(self.peek().value).lower() == "within"):
+                    # ordered-set agg: fn(frac…) WITHIN GROUP (ORDER BY v)
+                    # rewrites to fn(v [, frac]) — percentile_cont / mode
+                    self.next()
+                    if self.ident() != "group":
+                        raise SqlParseError("expected GROUP after WITHIN")
+                    self.expect_op("(")
+                    self.expect_kw("order")
+                    self.expect_kw("by")
+                    v = self.parse_expr()
+                    self.expect_op(")")
+                    fc = A.FuncCall(name, (v,) + fc.args, distinct)
+                if (self.peek().kind in ("name", "kw")
+                        and str(self.peek().value).lower() == "filter"
+                        and self.peek(1).kind == "op"
+                        and self.peek(1).value == "("):
+                    self.next()
+                    self.expect_op("(")
+                    self.expect_kw("where")
+                    cond = self.parse_expr()
+                    self.expect_op(")")
+                    fc = dataclasses.replace(fc, filter=cond)
                 if self.eat_kw("over"):
                     return self._over_clause(fc)
                 return fc

@@ -308,10 +308,13 @@ class Planner:
         # (comma-syntax) joins during relation planning — the reference's
         # predicate-pushdown-into-join rule
         dyn_conjuncts: list = []
+        in_conjuncts: list = []
         plain: list = []
         if sel.where is not None:
             for conj in _conjuncts(sel.where):
-                if self._has_subquery(conj):
+                if isinstance(conj, A.InSubquery):
+                    in_conjuncts.append(conj)
+                elif self._has_subquery(conj):
                     dyn_conjuncts.append(conj)
                 else:
                     plain.append(conj)
@@ -322,6 +325,14 @@ class Planner:
             pred = ExprBinder(scope).bind(conj)
             node = PFilter(schema=node.schema, pk=node.pk, input=node,
                            predicate=pred)
+
+        # IN (SELECT …) conjuncts become left semi joins; NOT IN becomes
+        # left anti (reference: subquery unnesting Apply rules,
+        # src/frontend/src/optimizer/rule/apply_join_transpose_rule.rs).
+        # NOT-IN NULL caveat: PG yields no rows when the subquery produces
+        # a NULL; the anti join keys on equality only.
+        for conj in in_conjuncts:
+            node = self._plan_in_subquery(conj, node, scope)
 
         # dynamic filters apply pre-projection (reference: the subquery
         # Apply-rewrite places DynamicFilter below the projection)
@@ -439,17 +450,26 @@ class Planner:
             raise PlanError(f"unknown table function {ref.name!r}")
         binder = ExprBinder(Scope([]))
         args = []
+        binder_types = []
         for a in ref.args:
             b = binder.bind(a)
             if not isinstance(b, Literal):
                 raise PlanError(
                     f"FROM {name}(...) requires constant arguments")
             args.append(b.value)
-        from ..common.types import GLOBAL_STRING_DICT, INT64 as _I64, VARCHAR
-        out_t = VARCHAR if name == "regexp_split_to_table" else _I64
+            binder_types.append(b.type)
+        from ..common.types import INT64 as _I64, VARCHAR
+        if name == "regexp_split_to_table":
+            out_t = VARCHAR
+        elif name == "unnest":
+            if not binder_types or not binder_types[0].is_list:
+                raise PlanError("unnest() requires an array argument")
+            out_t = binder_types[0].elem_type
+        else:
+            out_t = _I64
         vals = series_values(name, args)
-        if out_t.is_string:
-            vals = [GLOBAL_STRING_DICT.lookup(int(v)) for v in vals]
+        # series elements are physical scalars; literals carry python values
+        vals = [None if v is None else out_t.to_python(v) for v in vals]
         rows = tuple((Literal(v, out_t),) for v in vals)
         alias = ref.alias or name
         schema = Schema((Field(alias, out_t),))
@@ -691,8 +711,36 @@ class Planner:
             b = ExprBinder(scope, agg_ctx=aggs).bind(item.expr)
             bound_items.append((b, item.alias or self._auto_name(item.expr)))
         bound_having = None
+        having_dyn: list = []  # (bound_lhs_tree, cmp_fn_name, subquery)
         if sel.having is not None:
-            bound_having = ExprBinder(scope, agg_ctx=aggs).bind(sel.having)
+            plain_h: list = []
+            for conj in _conjuncts(sel.having):
+                if self._has_subquery(conj):
+                    # HAVING agg CMP (SELECT …) → DynamicFilter above the
+                    # agg (reference: the same Apply rewrite as WHERE-level
+                    # scalar subqueries; q102 shape). Bind the agg side NOW
+                    # so its agg call registers before the pre-projection.
+                    if not (isinstance(conj, A.BinaryOp)
+                            and conj.op in _CMP_TO_FN):
+                        raise PlanError("HAVING subquery only supported as "
+                                        "'agg CMP (SELECT …)'")
+                    lsub = isinstance(conj.left, A.ScalarSubquery)
+                    rsub = isinstance(conj.right, A.ScalarSubquery)
+                    if lsub == rsub:
+                        raise PlanError(
+                            "exactly one side must be a scalar subquery")
+                    col_ast = conj.right if lsub else conj.left
+                    sub = conj.left if lsub else conj.right
+                    op = _CMP_FLIP[conj.op] if lsub else conj.op
+                    lhs_b = ExprBinder(scope, agg_ctx=aggs).bind(col_ast)
+                    having_dyn.append((lhs_b, _CMP_TO_FN[op], sub))
+                else:
+                    plain_h.append(conj)
+            if plain_h:
+                e = plain_h[0]
+                for c in plain_h[1:]:
+                    e = A.BinaryOp("AND", e, c)
+                bound_having = ExprBinder(scope, agg_ctx=aggs).bind(e)
 
         # 3. pre-projection: group keys first, then agg args
         pre_exprs = list(group_exprs)
@@ -725,6 +773,7 @@ class Planner:
             schema=Schema(agg_fields), pk=tuple(range(nk)), input=pre,
             group_keys=tuple(range(nk)),
             agg_calls=tuple(a.call for a in aggs),
+            append_only_input=_plan_is_append_only(pre),
             eowc=sel.emit_on_window_close)
 
         # 5. post-projection: rewrite select items over agg output
@@ -755,6 +804,17 @@ class Planner:
             post_node = PFilter(schema=agg_node.schema, pk=agg_node.pk,
                                 input=post_node,
                                 predicate=rewrite_tree(bound_having))
+        for lhs_b, cmp_fn, sub in having_dyn:
+            key = rewrite_tree(lhs_b)
+            if not isinstance(key, InputRef):
+                raise PlanError("HAVING dynamic-filter side must be a "
+                                "single aggregate or group key")
+            right_plan = self.plan_select(sub.query)
+            if len(right_plan.schema) < 1:
+                raise PlanError("scalar subquery must produce one column")
+            post_node = PDynFilter(
+                schema=post_node.schema, pk=post_node.pk, input=post_node,
+                right=right_plan, key_col=key.index, cmp=cmp_fn)
         out_exprs, out_fields = [], []
         for b, name in bound_items:
             e = rewrite_tree(b)
@@ -919,15 +979,42 @@ class Planner:
                           right=right_plan, key_col=b.index,
                           cmp=_CMP_TO_FN[op])
 
+    def _plan_in_subquery(self, conj: A.InSubquery, node: PlanNode,
+                          scope: Scope) -> PlanNode:
+        b = ExprBinder(scope).bind(conj.expr)
+        if not isinstance(b, InputRef):
+            raise PlanError("IN (SELECT …) operand must be a plain column")
+        sub = self.plan_select(conj.query)
+        if len(sub.schema) != 1:
+            raise PlanError("IN subquery must produce exactly one column")
+        kind = "left_anti" if conj.negated else "left_semi"
+        return PJoin(schema=node.schema, pk=node.pk, left=node, right=sub,
+                     kind=kind, left_keys=(b.index,), right_keys=(0,),
+                     condition=None)
+
     def _plan_no_from(self, sel: A.Select) -> PlanNode:
         binder = ExprBinder(Scope([]))
-        rows = []
         row = tuple(binder.bind(i.expr) for i in sel.items)
-        rows.append(row)
+        from ..stream.project_set import TableFuncCall, series_values
+        if len(row) == 1 and isinstance(row[0], TableFuncCall):
+            # FROM-less set-returning select: SELECT unnest(ARRAY[…])
+            tf = row[0]
+            if not all(isinstance(a, Literal) for a in tf.args):
+                raise PlanError(
+                    "set-returning function without FROM requires "
+                    "constant arguments")
+            vals = series_values(tf.name, [a.value for a in tf.args])
+            out_t = tf.type
+            name = sel.items[0].alias or tf.name
+            lit_rows = tuple(
+                (Literal(None if v is None else out_t.to_python(v),
+                         out_t),) for v in vals)
+            return PValues(schema=Schema((Field(name, out_t),)), pk=(),
+                           rows=lit_rows)
         fields = tuple(
             Field(item.alias or self._auto_name(item.expr), e.type)
             for item, e in zip(sel.items, row))
-        return PValues(schema=Schema(fields), pk=(), rows=tuple(rows))
+        return PValues(schema=Schema(fields), pk=(), rows=(row,))
 
     # -- small helpers --------------------------------------------------------
 
@@ -1069,6 +1156,13 @@ def _plan_is_append_only(plan: PlanNode) -> bool:
         return _plan_is_append_only(plan.input)
     if isinstance(plan, PUnion):
         return all(_plan_is_append_only(i) for i in plan.inputs)
+    if isinstance(plan, PJoin):
+        # an inner/semi join of append-only inputs never retracts a row it
+        # emitted (no deletes arrive on either side); every outer/anti
+        # shape can retract its padded or emitted rows
+        return (plan.kind in ("inner", "left_semi")
+                and _plan_is_append_only(plan.left)
+                and _plan_is_append_only(plan.right))
     return False
 
 

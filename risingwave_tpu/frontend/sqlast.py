@@ -33,6 +33,9 @@ class FuncCall:
     name: str
     args: tuple
     distinct: bool = False
+    #: aggregate FILTER (WHERE <cond>) clause (reference:
+    #: src/sqlparser/src/ast/mod.rs Function.filter)
+    filter: Optional[Any] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +88,32 @@ class Cast:
 @dataclasses.dataclass(frozen=True)
 class ScalarSubquery:
     query: "Select"
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery:
+    """<expr> [NOT] IN (SELECT …) — planned as a left semi/anti join
+    (reference: the ApplyJoin subquery-unnesting rules in
+    src/frontend/src/optimizer/rule/apply_join_transpose_rule.rs)."""
+
+    expr: Any
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayLit:
+    """ARRAY[e1, e2, …] constructor."""
+
+    items: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript:
+    """<expr>[<index>] — 1-based array element access (PG semantics)."""
+
+    expr: Any
+    index: Any
 
 
 @dataclasses.dataclass(frozen=True)

@@ -76,6 +76,14 @@ class HashAggExecutor(SingleInputExecutor):
         state_table; must be < table_capacity (headroom for growth
         between checkpoints)."""
         super().__init__(input)
+        for c in agg_calls:
+            if c.lanes_unsupported:
+                # silent wrongness guard: fixed device lanes cannot dedup
+                # or materialize input; the planner must route these to
+                # MaterializedAggExecutor
+                raise ValueError(
+                    f"{c.kind}{'(distinct)' if c.distinct else ''} needs "
+                    "materialized-input state (stream/materialized_agg.py)")
         self.load_shard = load_shard
         if hbm_group_budget is not None:
             if state_table is None:

@@ -414,6 +414,17 @@ class HashJoinExecutor(Executor):
             prev = self._applied_clean.get((side, col))
             if prev is None or threshold > prev:
                 self._applied_clean[(side, col)] = threshold
+            # _applied_clean is process-local: durably retire the evicted
+            # keys' expired rows NOW (staged; commits with the next
+            # checkpoint, same atomicity as the device cleaning) so a
+            # restart cannot resurrect them
+            if self._evicted and self.state_tables.get(side) is not None:
+                table = self.state_tables[side]
+                nk = len(self.core.left_keys)
+                for k in list(self._evicted):
+                    for r in table.scan_prefix(list(k), nk):
+                        if r[col] is not None and r[col] < threshold:
+                            table.delete(r)
         self._pending_clean.clear()
         return True
 

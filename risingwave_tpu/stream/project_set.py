@@ -28,7 +28,7 @@ from ..common.types import DataType, Field, INT64, Schema
 from ..expr.expr import Expr
 from .executor import Executor, SingleInputExecutor
 
-TABLE_FUNC_KINDS = {"generate_series", "regexp_split_to_table"}
+TABLE_FUNC_KINDS = {"generate_series", "regexp_split_to_table", "unnest"}
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -70,6 +70,19 @@ def series_values(name: str, args: Sequence) -> list:
             return []
         parts = re.split(as_str(p), as_str(s))
         return [D.intern(x) for x in parts]
+    if name == "unnest":
+        # one row per array element (reference:
+        # src/expr/src/table_function/ unnest). The argument is a
+        # list-dictionary id (ProjectSet path) or the python tuple itself
+        # (constant FROM position); elements return as PHYSICAL scalars.
+        from ..common.types import GLOBAL_LIST_DICT, GLOBAL_STRING_DICT
+        (lst,) = args
+        if lst is None:
+            return []
+        if not isinstance(lst, (tuple, list)):
+            lst = GLOBAL_LIST_DICT.lookup(int(lst))
+        return [GLOBAL_STRING_DICT.intern(e) if isinstance(e, str) else e
+                for e in lst]
     raise ValueError(f"unknown table function {name}")
 
 

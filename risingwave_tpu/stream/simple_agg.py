@@ -40,6 +40,11 @@ class _AggLanes:
     """Shared lane layout/update logic for the two global-agg executors."""
 
     def __init__(self, agg_calls: Sequence[AggCall]):
+        for c in agg_calls:
+            if c.lanes_unsupported:
+                raise ValueError(
+                    f"{c.kind}{'(distinct)' if c.distinct else ''} needs "
+                    "materialized-input state (stream/materialized_agg.py)")
         self.agg_calls = tuple(agg_calls)
         self.lane_dtypes = [jnp.int64]
         self.call_lane_ofs = []

@@ -1,0 +1,381 @@
+"""Extended NEXmark coverage: q0, q9, q10, q14-q18, q20-q22, q101-q106 —
+the remainder of the reference's streaming suite (reference query texts:
+e2e_test/streaming/nexmark/views/q*.slt.part and
+src/tests/simulation/src/nexmark/q*.sql), each checked against an
+independent Python recomputation of the same deterministic generator
+stream (VERDICT r4 item 7)."""
+
+import collections
+import datetime
+
+from test_nexmark_queries import DDL, TICKS, make_session, replay
+
+
+def run_mv(sql: str, name: str, ticks: int = TICKS):
+    s = make_session()
+    s.run_sql(sql)
+    for _ in range(ticks):
+        s.tick()
+    rows = sorted(s.mv_rows(name))
+    s.close()
+    return rows
+
+
+def day_of(us: int) -> str:
+    d = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=us)
+    return f"{d.year:04d}-{d.month:02d}-{d.day:02d}"
+
+
+def hhmi_of(us: int) -> str:
+    d = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=us)
+    h12 = (d.hour % 12) or 12
+    return f"{h12:02d}:{d.minute:02d}"
+
+
+def test_q0_passthrough():
+    got = run_mv("CREATE MATERIALIZED VIEW q0 AS SELECT auction, bidder, "
+                 "price, date_time FROM bid", "q0")
+    bids = replay("bid", TICKS)
+    assert got == sorted((b[0], b[1], b[2], b[5]) for b in bids)
+
+
+def test_q9_winning_bids():
+    got = run_mv("""CREATE MATERIALIZED VIEW q9 AS
+        SELECT id, item_name, auction, bidder, price, bid_date_time FROM (
+          SELECT A.id, A.item_name, B.auction, B.bidder, B.price,
+                 B.date_time AS bid_date_time,
+            ROW_NUMBER() OVER (PARTITION BY A.id
+                ORDER BY B.price DESC, B.date_time ASC) AS rownum
+          FROM auction A, bid B
+          WHERE A.id = B.auction
+            AND B.date_time BETWEEN A.date_time AND A.expires
+        ) WHERE rownum <= 1""", "q9", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    # auction ids repeat in the NEXmark stream, so a PARTITION BY A.id
+    # partition can span several auction ROWS: the join rows of every
+    # auction row with that id compete for rownum 1 together. item_name
+    # is nondeterministic under order-by ties (two auction rows of one id
+    # matching the same bid), so compare it by membership.
+    per_id: dict = {}
+    names: dict = {}
+    for a in auctions:
+        names.setdefault(a[0], set()).add(a[1])
+        for b in bids:
+            if b[0] == a[0] and a[5] <= b[5] <= a[6]:
+                per_id.setdefault(a[0], []).append(b)
+    exp = {}
+    for aid, cands in per_id.items():
+        w = min(cands, key=lambda b: (-b[2], b[5]))
+        exp[aid] = (w[0], w[1], w[2], w[5])
+    assert len(got) == len(exp) > 0
+    for row in got:
+        aid, item_name = row[0], row[1]
+        assert row[2:] == exp[aid]
+        assert item_name in names[aid]
+
+
+def test_q10_log_format():
+    got = run_mv("""CREATE MATERIALIZED VIEW q10 AS
+        SELECT auction, bidder, price, date_time,
+               TO_CHAR(date_time, 'YYYY-MM-DD') as date,
+               TO_CHAR(date_time, 'HH:MI') as time FROM bid""", "q10")
+    bids = replay("bid", TICKS)
+    exp = sorted((b[0], b[1], b[2], b[5], day_of(b[5]), hhmi_of(b[5]))
+                 for b in bids)
+    assert got == exp
+
+
+def test_q14_calculated_fields():
+    got = run_mv("""CREATE MATERIALIZED VIEW q14 AS
+        SELECT auction, bidder, 908 * price / 1000 as price,
+          CASE WHEN extract(hour from date_time) >= 8
+                AND extract(hour from date_time) <= 18 THEN 'dayTime'
+               WHEN extract(hour from date_time) <= 6
+                 OR extract(hour from date_time) >= 20 THEN 'nightTime'
+          ELSE 'otherTime' END AS bidtimetype, date_time
+        FROM bid WHERE 908 * price / 1000 > 1000""", "q14")
+    bids = replay("bid", TICKS)
+    exp = []
+    for b in bids:
+        p = 908 * b[2] // 1000
+        if p > 1000:
+            hour = ((b[5] // 3_600_000_000) % 24)
+            if 8 <= hour <= 18:
+                t = "dayTime"
+            elif hour <= 6 or hour >= 20:
+                t = "nightTime"
+            else:
+                t = "otherTime"
+            exp.append((b[0], b[1], p, t, b[5]))
+    assert got == sorted(exp) and len(got) > 0
+
+
+def _rank_of(price: int) -> int:
+    if price < 10_000:
+        return 1
+    if price < 1_000_000:
+        return 2
+    return 3
+
+
+def test_q15_bidding_statistics():
+    got = run_mv("""CREATE MATERIALIZED VIEW q15 AS
+        SELECT TO_CHAR(date_time, 'yyyy-MM-dd') as day,
+          count(*) AS total_bids,
+          count(*) filter (where price < 10000) AS rank1_bids,
+          count(*) filter (where price >= 10000 and price < 1000000)
+            AS rank2_bids,
+          count(*) filter (where price >= 1000000) AS rank3_bids,
+          count(distinct bidder) AS total_bidders,
+          count(distinct bidder) filter (where price < 10000)
+            AS rank1_bidders,
+          count(distinct auction) AS total_auctions,
+          count(distinct auction) filter (where price >= 1000000)
+            AS rank3_auctions
+        FROM bid GROUP BY to_char(date_time, 'yyyy-MM-dd')""",
+        "q15", ticks=6)
+    bids = replay("bid", 6)
+    per_day = collections.defaultdict(list)
+    for b in bids:
+        per_day[day_of(b[5])].append(b)
+    exp = []
+    for day, bs in per_day.items():
+        exp.append((
+            day, len(bs),
+            sum(1 for b in bs if _rank_of(b[2]) == 1),
+            sum(1 for b in bs if _rank_of(b[2]) == 2),
+            sum(1 for b in bs if _rank_of(b[2]) == 3),
+            len({b[1] for b in bs}),
+            len({b[1] for b in bs if _rank_of(b[2]) == 1}),
+            len({b[0] for b in bs}),
+            len({b[0] for b in bs if _rank_of(b[2]) == 3}),
+        ))
+    assert got == sorted(exp) and len(got) > 0
+
+
+def test_q16_channel_statistics():
+    got = run_mv("""CREATE MATERIALIZED VIEW q16 AS
+        SELECT channel, to_char(date_time, 'YYYY-MM-DD') as day,
+          max(to_char(date_time, 'HH:MI')) as minute,
+          count(*) AS total_bids,
+          count(*) filter (where price < 10000) AS rank1_bids,
+          count(distinct bidder) AS total_bidders,
+          count(distinct auction) AS total_auctions
+        FROM bid GROUP BY channel, to_char(date_time, 'YYYY-MM-DD')""",
+        "q16", ticks=6)
+    bids = replay("bid", 6)
+    groups = collections.defaultdict(list)
+    for b in bids:
+        groups[(b[3], day_of(b[5]))].append(b)
+    exp = []
+    for (ch, day), bs in groups.items():
+        exp.append((
+            ch, day, max(hhmi_of(b[5]) for b in bs), len(bs),
+            sum(1 for b in bs if _rank_of(b[2]) == 1),
+            len({b[1] for b in bs}), len({b[0] for b in bs}),
+        ))
+    assert got == sorted(exp) and len(got) > 0
+
+
+def test_q17_auction_statistics():
+    got = run_mv("""CREATE MATERIALIZED VIEW q17 AS
+        SELECT auction, to_char(date_time, 'YYYY-MM-DD') AS day,
+          count(*) AS total_bids,
+          count(*) filter (where price < 10000) AS rank1_bids,
+          min(price) AS min_price, max(price) AS max_price,
+          avg(price) AS avg_price, sum(price) AS sum_price
+        FROM bid GROUP BY auction, to_char(date_time, 'YYYY-MM-DD')""",
+        "q17", ticks=6)
+    bids = replay("bid", 6)
+    groups = collections.defaultdict(list)
+    for b in bids:
+        groups[(b[0], day_of(b[5]))].append(b)
+    exp = []
+    for (auc, day), bs in groups.items():
+        prices = [b[2] for b in bs]
+        exp.append((auc, day, len(bs),
+                    sum(1 for p in prices if p < 10_000),
+                    min(prices), max(prices),
+                    sum(prices) / len(prices), sum(prices)))
+    exp.sort()
+    assert len(got) == len(exp) and len(got) > 0
+    for g, e in zip(got, exp):
+        assert g[:6] == e[:6] and g[7] == e[7]
+        assert abs(g[6] - e[6]) < 1e-9
+
+
+def test_q18_last_bid():
+    got = run_mv("""CREATE MATERIALIZED VIEW q18 AS
+        SELECT auction, bidder, price, date_time
+        FROM (SELECT *, RANK() OVER (PARTITION BY bidder, auction
+                  ORDER BY date_time DESC) AS rank_number
+              FROM bid) WHERE rank_number <= 1""", "q18")
+    bids = replay("bid", TICKS)
+    last: dict = {}
+    for b in bids:
+        k = (b[1], b[0])
+        if k not in last or b[5] > last[k][5]:
+            last[k] = b
+    exp = sorted((b[0], b[1], b[2], b[5]) for b in last.values())
+    assert got == exp and len(got) > 0
+
+
+def test_q20_expand_bid():
+    got = run_mv("""CREATE MATERIALIZED VIEW q20 AS
+        SELECT auction, bidder, price, channel, item_name, seller, category
+        FROM bid AS B INNER JOIN auction AS A on B.auction = A.id
+        WHERE A.category = 10""", "q20", ticks=8)
+    bids = replay("bid", 8)
+    auctions = replay("auction", 8)
+    exp = [(b[0], b[1], b[2], b[3], a[1], a[7], a[8])
+           for b in bids for a in auctions
+           if b[0] == a[0] and a[8] == 10]
+    assert got == sorted(exp)
+
+
+def test_q21_channel_id():
+    got = run_mv("""CREATE MATERIALIZED VIEW q21 AS
+        SELECT auction, bidder, price, channel,
+          CASE WHEN LOWER(channel) = 'apple' THEN '0'
+               WHEN LOWER(channel) = 'google' THEN '1'
+               WHEN LOWER(channel) = 'facebook' THEN '2'
+               WHEN LOWER(channel) = 'baidu' THEN '3'
+          ELSE (regexp_match(url, '(&|^)channel_id=([^&]*)'))[2] END
+            AS channel_id
+        FROM bid
+        WHERE (regexp_match(url, '(&|^)channel_id=([^&]*)'))[2]
+                is not null
+           or LOWER(channel) in ('apple', 'google', 'facebook', 'baidu')""",
+        "q21")
+    import re
+    bids = replay("bid", TICKS)
+    rx = re.compile(r"(&|^)channel_id=([^&]*)")
+    known = {"apple": "0", "google": "1", "facebook": "2", "baidu": "3"}
+    exp = []
+    for b in bids:
+        m = rx.search(b[4])
+        low = b[3].lower()
+        if low in known:
+            exp.append((b[0], b[1], b[2], b[3], known[low]))
+        elif m is not None:
+            exp.append((b[0], b[1], b[2], b[3], m.group(2)))
+    assert got == sorted(exp) and len(got) > 0
+
+
+def test_q22_url_directories():
+    got = run_mv("""CREATE MATERIALIZED VIEW q22 AS
+        SELECT auction, bidder, price, channel,
+          split_part(url, '/', 4) as dir1,
+          split_part(url, '/', 5) as dir2,
+          split_part(url, '/', 6) as dir3 FROM bid""", "q22")
+    bids = replay("bid", TICKS)
+
+    def part(u, n):
+        ps = u.split("/")
+        return ps[n - 1] if 0 <= n - 1 < len(ps) else ""
+
+    exp = sorted((b[0], b[1], b[2], b[3], part(b[4], 4), part(b[4], 5),
+                  part(b[4], 6)) for b in bids)
+    assert got == exp
+
+
+def test_q101_highest_bid_outer():
+    got = run_mv("""CREATE MATERIALIZED VIEW q101 AS
+        SELECT a.id AS auction_id, a.item_name, b.max_price
+        FROM auction a LEFT OUTER JOIN (
+          SELECT b1.auction, MAX(b1.price) max_price
+          FROM bid b1 GROUP BY b1.auction
+        ) b ON a.id = b.auction""", "q101", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    best: dict = {}
+    for b in bids:
+        best[b[0]] = max(best.get(b[0], 0), b[2])
+    exp = sorted(((a[0], a[1], best.get(a[0])) for a in auctions),
+                 key=lambda r: (r[0], r[1], r[2] is not None, r[2] or 0))
+    key = lambda r: (r[0], r[1], r[2] is not None, r[2] or 0)  # noqa: E731
+    assert sorted(got, key=key) == exp and len(got) > 0
+    assert any(r[2] is None for r in got)      # outer-ness exercised
+
+
+def test_q102_bid_count_above_average():
+    got = run_mv("""CREATE MATERIALIZED VIEW q102 AS
+        SELECT a.id AS auction_id, a.item_name, COUNT(b.auction)
+          AS bid_count
+        FROM auction a JOIN bid b ON a.id = b.auction
+        GROUP BY a.id, a.item_name
+        HAVING COUNT(b.auction) >= (
+          SELECT COUNT(*) / COUNT(DISTINCT auction) FROM bid)""",
+        "q102", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    n_bid = collections.Counter(b[0] for b in bids)
+    avg = len(bids) // len({b[0] for b in bids})
+    exp = sorted((a[0], a[1], n_bid[a[0]]) for a in auctions
+                 if n_bid[a[0]] >= avg)
+    assert got == exp and len(got) > 0
+
+
+def test_q103_semi_join():
+    got = run_mv("""CREATE MATERIALIZED VIEW q103 AS
+        SELECT a.id AS auction_id, a.item_name FROM auction a
+        WHERE a.id IN (
+          SELECT b.auction FROM bid b GROUP BY b.auction
+          HAVING COUNT(*) >= 2)""", "q103", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    n_bid = collections.Counter(b[0] for b in bids)
+    exp = sorted((a[0], a[1]) for a in auctions if n_bid[a[0]] >= 2)
+    assert got == exp and len(got) > 0
+
+
+def test_q104_anti_join():
+    got = run_mv("""CREATE MATERIALIZED VIEW q104 AS
+        SELECT a.id AS auction_id, a.item_name FROM auction a
+        WHERE a.id NOT IN (
+          SELECT b.auction FROM bid b GROUP BY b.auction
+          HAVING COUNT(*) < 2)""", "q104", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    n_bid = collections.Counter(b[0] for b in bids)
+    exp = sorted((a[0], a[1]) for a in auctions
+                 if not (0 < n_bid[a[0]] < 2))
+    assert got == exp and len(got) > 0
+
+
+def test_q105_top_auctions():
+    got = run_mv("""CREATE MATERIALIZED VIEW q105 AS
+        SELECT a.id AS auction_id, a.item_name, COUNT(b.auction)
+          AS bid_count
+        FROM auction a JOIN bid b ON a.id = b.auction
+        GROUP BY a.id, a.item_name
+        ORDER BY bid_count DESC LIMIT 1000""", "q105", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    n_bid = collections.Counter(b[0] for b in bids)
+    exp = sorted((a[0], a[1], n_bid[a[0]]) for a in auctions
+                 if n_bid[a[0]] > 0)
+    assert got == exp and len(got) > 0  # < 1000 groups: TopN keeps all
+
+
+def test_q106_min_final_price():
+    """Two-phase stateful agg: MIN over per-auction MAX. The outer MIN's
+    input retracts (each new max replaces the old), so this exercises
+    min-with-retraction (materialized input state — reference:
+    AggStateStorage::MaterializedInput, agg_state.rs:65)."""
+    got = run_mv("""CREATE MATERIALIZED VIEW q106 AS
+        SELECT MIN(final) AS min_final FROM (
+          SELECT auction.id, MAX(price) AS final FROM auction, bid
+          WHERE bid.auction = auction.id
+            AND bid.date_time BETWEEN auction.date_time AND auction.expires
+          GROUP BY auction.id)""", "q106", ticks=6)
+    auctions = replay("auction", 6)
+    bids = replay("bid", 6)
+    finals: dict = {}
+    for a in auctions:
+        for b in bids:
+            if b[0] == a[0] and a[5] <= b[5] <= a[6]:
+                finals[a[0]] = max(finals.get(a[0], 0), b[2])
+    assert finals, "workload must produce at least one final price"
+    assert got == [(min(finals.values()),)]
