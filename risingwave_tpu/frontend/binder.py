@@ -382,12 +382,18 @@ class ExprBinder:
             raise BindError(f"{kind}() takes at most one argument")
         if node.filter is not None:
             # FILTER (WHERE c) rewrites to a CASE-wrapped argument: rows
-            # failing c contribute NULL, which every aggregate skips
+            # failing c contribute NULL, which the aggregates here skip
             # (count counts non-NULL). count(*) FILTER (c) == count(CASE
             # WHEN c THEN 1 END). Works under DISTINCT too: distinct-ness
             # is over the surviving non-NULL values. (reference:
             # src/frontend/src/optimizer/plan_node/logical_agg.rs agg
-            # filter support)
+            # filter support.) array_agg is the one NULL-KEEPING
+            # aggregate — the rewrite would turn excluded rows into NULL
+            # elements — so it is rejected rather than silently wrong.
+            if kind == "array_agg":
+                raise BindError(
+                    "FILTER on array_agg is not supported (array_agg "
+                    "keeps NULL elements; filter in a subquery instead)")
             if not node.args or isinstance(node.args[0], A.Star):
                 if kind != "count":
                     raise BindError(f"{kind}(*) is not valid")

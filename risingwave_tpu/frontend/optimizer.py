@@ -324,6 +324,90 @@ class ProjectMerge(Rule):
             exprs=tuple(subst_expr(e, inner.exprs) for e in node.exprs))
 
 
+class RankFilterToGroupTopN(Rule):
+    """``rownum <= k`` over a rank-family window → GroupTopN.
+
+    Matches the planner's over-window shape  PProject(outer) → PFilter
+    (rank CMP k) → PProject(post) → POverWindow([one rank-kind call])
+    and replaces the window with  PTopN(group_by=partition,
+    order=order, limit=k, with_ties = kind=='rank')  over the window's
+    input; the dead rank column becomes a NULL literal for pruning to
+    remove. This turns q9/q18-style "top row per key" from O(partition)
+    window recompute per barrier into incremental per-group TopN
+    maintenance (reference: over_window_to_topn_rule.rs; e2e q18 "covers
+    group top-n").
+
+    Runs BEFORE the pushdown stage: FilterProjectTranspose would
+    otherwise dissolve the exact shape this matches."""
+
+    name = "rank_filter_to_group_topn"
+
+    def apply(self, node):
+        if not isinstance(node, P.PProject):
+            return None
+        filt = node.input
+        if not isinstance(filt, P.PFilter):
+            return None
+        post = filt.input
+        if not isinstance(post, P.PProject):
+            return None
+        win = post.input
+        if not isinstance(win, P.POverWindow) or win.eowc:
+            return None
+        if len(win.calls) != 1:
+            return None
+        wcall = win.calls[0]
+        if wcall.kind not in ("row_number", "rank"):
+            return None
+        n_in = len(win.input.schema)
+        rank_cols = [i for i, e in enumerate(post.exprs)
+                     if isinstance(e, InputRef) and e.index == n_in]
+        if len(rank_cols) != 1:
+            return None
+        rank_col = rank_cols[0]
+        for i, e in enumerate(post.exprs):
+            if i != rank_col and any(r >= n_in for r in expr_refs(e)):
+                return None
+        limit = self._limit_from_pred(filt.predicate, rank_col,
+                                      wcall.kind)
+        if limit is None:
+            return None
+        for e in node.exprs:                  # rank must be dead above
+            if rank_col in expr_refs(e):
+                return None
+        topn = P.PTopN(
+            schema=win.input.schema, pk=win.input.pk, input=win.input,
+            order=tuple(wcall.order_by), limit=limit, offset=0,
+            with_ties=(wcall.kind == "rank"),
+            group_by=tuple(wcall.partition_by))
+        from ..common.types import INT64
+        new_exprs = list(post.exprs)
+        new_exprs[rank_col] = Literal(None, INT64)
+        new_post = dataclasses.replace(post, input=topn,
+                                       exprs=tuple(new_exprs))
+        return dataclasses.replace(node, input=new_post)
+
+    @staticmethod
+    def _limit_from_pred(pred, rank_col: int, kind: str):
+        if not isinstance(pred, FunctionCall) or len(pred.args) != 2:
+            return None
+        a, b = pred.args
+        if not (isinstance(a, InputRef) and a.index == rank_col
+                and isinstance(b, Literal)
+                and isinstance(b.value, int)):
+            return None
+        if pred.name == "less_than_or_equal" and b.value >= 1:
+            return b.value
+        if pred.name == "less_than" and b.value > 1:
+            return b.value - 1
+        if pred.name == "equal" and b.value == 1:
+            return 1
+        return None
+
+
+#: shape-dependent rewrites that must see the planner's raw tree
+PREPASS_RULES = (RankFilterToGroupTopN(),)
+
 PUSHDOWN_RULES = (
     FilterMerge(), FilterProjectTranspose(), FilterJoinPushdown(),
     FilterAggTranspose(), FilterUnionTranspose(),
@@ -464,6 +548,7 @@ def optimize(plan: P.PlanNode) -> P.PlanNode:
     """The pass pipeline: pushdown stage to fixpoint, then column
     pruning, then a cleanup stage merging the projections pruning
     introduced (reference: logical_optimization.rs stage list)."""
+    plan = rewrite_fixpoint(plan, PREPASS_RULES)
     plan = rewrite_fixpoint(plan, PUSHDOWN_RULES)
     plan = prune_columns(plan)
     plan = rewrite_fixpoint(plan, CLEANUP_RULES)

@@ -96,6 +96,13 @@ class MaterializedAggExecutor(SingleInputExecutor):
         super().__init__(input)
         self.group_keys = tuple(group_keys)
         self.agg_calls = tuple(agg_calls)
+        for c in self.agg_calls:
+            if c.arg_type is not None and c.arg_type.is_list:
+                # list-dictionary ids are process-local; the multiset
+                # value columns persist ints/floats/strings by content
+                # but have no durable list representation
+                raise ValueError(
+                    f"{c.kind}() over an array column is not supported")
         self.in_schema = input.schema
         self.state_table = state_table
         self.out_capacity = out_capacity
@@ -117,6 +124,13 @@ class MaterializedAggExecutor(SingleInputExecutor):
         self._ckpt_dirty: set = set()
         if state_table is not None:
             self._load_from_state_table()
+        if not self.group_keys and () not in self._groups:
+            # global aggregation always has its one group: the MV shows
+            # count = 0 / NULLs before any input and after full
+            # retraction (SimpleAggExecutor's first-barrier contract)
+            self._groups[()] = _GroupState(len(self.agg_calls))
+            self._dirty.add(())
+            self._ckpt_dirty.add(())
 
     # -- input application ----------------------------------------------------
 
@@ -236,12 +250,14 @@ class MaterializedAggExecutor(SingleInputExecutor):
         for key in sorted(self._dirty, key=repr):
             g = self._groups.get(key)
             old = self._out.get(key)
-            if g is None or g.total == 0:
+            if (g is None or g.total == 0) and self.group_keys:
                 self._groups.pop(key, None)
                 if old is not None:
                     pairs.append((OP_DELETE, old))
                     del self._out[key]
                 continue
+            if g is None:                     # global group never dies
+                g = self._groups[key] = _GroupState(len(self.agg_calls))
             new = self._group_row(key, g)
             if old is None:
                 pairs.append((OP_INSERT, new))
@@ -283,7 +299,7 @@ class MaterializedAggExecutor(SingleInputExecutor):
             for row in st.scan_prefix(key, len(self.group_keys)):
                 st.delete(row)
             g = self._groups.get(key)
-            if g is not None and g.total > 0:
+            if g is not None and (g.total > 0 or not self.group_keys):
                 for row in self._state_rows(key, g):
                     st.insert(row)
         self._ckpt_dirty.clear()

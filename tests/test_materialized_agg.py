@@ -141,6 +141,24 @@ def test_materialized_state_recovery():
         s2.close()
 
 
+def test_global_distinct_zero_row():
+    """Global (no GROUP BY) materialized agg shows count = 0 before any
+    input and returns to 0 after full retraction — never an empty MV
+    (SimpleAggExecutor's first-barrier contract)."""
+    s = fresh()
+    s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT "
+              "count(distinct v) AS dv, min(v) AS lo FROM t")
+    s.tick()
+    assert s.mv_rows("m") == [(0, None)]
+    s.run_sql("INSERT INTO t VALUES (1, 1, 5, 'a'), (2, 1, 5, 'b')")
+    s.tick()
+    assert s.mv_rows("m") == [(1, 5)]
+    s.run_sql("DELETE FROM t WHERE k = 1")
+    s.tick()
+    assert s.mv_rows("m") == [(0, None)]
+    s.close()
+
+
 def test_unnest_and_array_functions():
     s = fresh()
     assert s.run_sql("SELECT * FROM unnest(ARRAY[3, 1, 2])") == [
