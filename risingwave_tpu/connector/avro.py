@@ -1,0 +1,192 @@
+"""Minimal Avro binary codec (no external dependency).
+
+Counterpart of the reference's Avro parser family (reference:
+src/connector/src/parser/avro/ — schema-resolved binary datum decode; the
+schema-registry wire envelope is the 5-byte magic+id header,
+src/connector/src/parser/schema_registry/). Implements the Avro 1.11
+binary encoding for the subset streaming ingestion needs:
+
+* records of primitive fields: null, boolean, int, long, float, double,
+  string, bytes
+* unions (encoded as zigzag branch index + value) — the common
+  ``["null", T]`` nullable-field shape
+* enums (index → symbol string) and logical types passing through their
+  base primitive (timestamp-micros arrives as long, which matches the
+  engine's µs TIMESTAMP physical type)
+
+``decode`` accepts either a raw datum or a Confluent-framed message
+(magic byte 0x00 + 4-byte schema id), ignoring the id — single-schema
+sources, the common case for this engine's broker source.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, Dict
+
+
+class AvroError(ValueError):
+    pass
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _read_varint(buf: io.BytesIO) -> int:
+    shift = 0
+    out = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise AvroError("truncated varint")
+        out |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            return out
+        shift += 7
+        if shift > 70:
+            raise AvroError("varint too long")
+
+
+def _write_varint(out: bytearray, n: int) -> None:
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    return _zigzag_decode(_read_varint(buf))
+
+
+def _write_long(out: bytearray, v: int) -> None:
+    _write_varint(out, ((v << 1) ^ (v >> 63)) & ((1 << 64) - 1))
+
+
+class AvroCodec:
+    """Encode/decode datums of one Avro RECORD schema. ``framing``:
+    'raw' = bare binary datum; 'confluent' = magic 0x00 + 4-byte
+    schema-registry id prefix (stripped on decode, id unchecked — a
+    single-schema source). Framing must be DECLARED, not sniffed: a raw
+    datum whose first field is a zero varint is byte-identical to the
+    magic byte."""
+
+    def __init__(self, schema_json: str, framing: str = "raw"):
+        schema = json.loads(schema_json) if isinstance(schema_json, str) \
+            else schema_json
+        if not (isinstance(schema, dict) and schema.get("type") == "record"):
+            raise AvroError("top-level Avro schema must be a record")
+        if framing not in ("raw", "confluent"):
+            raise AvroError(f"unknown framing {framing!r}")
+        self.name = schema.get("name", "record")
+        self.framing = framing
+        self.fields = [(f["name"], f["type"]) for f in schema["fields"]]
+
+    # -- decode ---------------------------------------------------------------
+
+    def decode(self, payload: bytes) -> Dict[str, Any]:
+        if self.framing == "confluent":
+            if len(payload) < 5 or payload[0] != 0:
+                raise AvroError("missing Confluent wire-format header")
+            payload = payload[5:]
+        buf = io.BytesIO(payload)
+        out = {name: self._read(buf, t) for name, t in self.fields}
+        return out
+
+    def _read(self, buf: io.BytesIO, t) -> Any:
+        if isinstance(t, list):                       # union
+            branch = _read_long(buf)
+            if not 0 <= branch < len(t):
+                raise AvroError(f"union branch {branch} out of range")
+            return self._read(buf, t[branch])
+        if isinstance(t, dict):
+            if t.get("type") == "enum":
+                idx = _read_long(buf)
+                symbols = t.get("symbols", [])
+                if not 0 <= idx < len(symbols):
+                    raise AvroError(f"enum index {idx} out of range")
+                return symbols[idx]
+            # logical types decode as their base primitive
+            return self._read(buf, t.get("type"))
+        if t == "null":
+            return None
+        if t == "boolean":
+            b = buf.read(1)
+            if not b:
+                raise AvroError("truncated boolean")
+            return b[0] != 0
+        if t in ("int", "long"):
+            return _read_long(buf)
+        if t == "float":
+            raw = buf.read(4)
+            if len(raw) != 4:
+                raise AvroError("truncated float")
+            return struct.unpack("<f", raw)[0]
+        if t == "double":
+            raw = buf.read(8)
+            if len(raw) != 8:
+                raise AvroError("truncated double")
+            return struct.unpack("<d", raw)[0]
+        if t in ("string", "bytes"):
+            n = _read_long(buf)
+            if n < 0:
+                raise AvroError("negative length")
+            raw = buf.read(n)
+            if len(raw) != n:
+                raise AvroError("truncated string/bytes")
+            return raw.decode("utf-8") if t == "string" else raw
+        raise AvroError(f"unsupported Avro type {t!r}")
+
+    # -- encode (producers in tests / sinks) ----------------------------------
+
+    def encode(self, record: Dict[str, Any]) -> bytes:
+        out = bytearray()
+        for name, t in self.fields:
+            self._write(out, t, record.get(name))
+        return bytes(out)
+
+    def _write(self, out: bytearray, t, v) -> None:
+        if isinstance(t, list):
+            for i, branch in enumerate(t):
+                if (branch == "null") == (v is None):
+                    _write_long(out, i)
+                    return self._write(out, branch, v)
+            raise AvroError(f"no union branch for {v!r} in {t}")
+        if isinstance(t, dict):
+            if t.get("type") == "enum":
+                _write_long(out, t["symbols"].index(v))
+                return
+            return self._write(out, t.get("type"), v)
+        if t == "null":
+            if v is not None:
+                raise AvroError("non-null value for null type")
+            return
+        if t == "boolean":
+            out.append(1 if v else 0)
+            return
+        if t in ("int", "long"):
+            _write_long(out, int(v))
+            return
+        if t == "float":
+            out.extend(struct.pack("<f", float(v)))
+            return
+        if t == "double":
+            out.extend(struct.pack("<d", float(v)))
+            return
+        if t == "string":
+            raw = str(v).encode("utf-8")
+            _write_long(out, len(raw))
+            out.extend(raw)
+            return
+        if t == "bytes":
+            _write_long(out, len(v))
+            out.extend(v)
+            return
+        raise AvroError(f"unsupported Avro type {t!r}")

@@ -47,6 +47,15 @@ def make_reader(connector: str, options: dict, schema,
             raise ConnectorError(DEBEZIUM_NEEDS_PK)
         return FileSourceReader(schema, str(path), fmt=fmt,
                                 rows_per_chunk=chunk_capacity)
+    if connector in ("broker", "kafka"):
+        from .broker import BrokerSourceReader, parse_broker_options
+        address, topic = parse_broker_options(options)
+        fmt = str(options.get("format", "json")).lower()
+        return BrokerSourceReader(
+            schema, address, topic, fmt=fmt,
+            avro_schema=options.get("avro.schema"),
+            avro_framing=str(options.get("avro.framing", "raw")),
+            rows_per_chunk=chunk_capacity)
     if connector == "":
         return None
     raise ConnectorError(f"unsupported connector {connector!r}")

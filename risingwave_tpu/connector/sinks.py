@@ -101,6 +101,47 @@ class FileSink(Sink):
         self._f.close()
 
 
+class BrokerSink(Sink):
+    """Changelog → broker topic as JSON messages with an ``__op`` field
+    (reference: the Kafka sink's changelog-JSON shape,
+    src/connector/src/sink/kafka.rs). Delivery position = messages
+    published; the broker log is append-only so truncation is logical
+    (consumers use offsets), matching at-least-once like the reference's
+    non-transactional Kafka sink."""
+
+    def __init__(self, address: str, topic: str, schema: Schema,
+                 partition: int = 0):
+        from .broker import BrokerClient
+        self.client = BrokerClient(address)
+        self.topic = topic
+        self.schema = schema
+        self.partition = partition
+        self._published = 0
+
+    def write_rows(self, rows: Sequence[Row]) -> None:
+        payloads = []
+        for op, values in rows:
+            obj = {"__op": _OP_NAMES.get(op, str(op))}
+            for f, v in zip(self.schema, values):
+                obj[f.name] = v          # already python-typed (sink.py)
+            payloads.append(json.dumps(obj, default=str).encode())
+        # pipelined batch: one RTT per epoch flush, not per row. One
+        # partition per sink keeps the changelog totally ordered (the
+        # reference's kafka sink orders per key via key-hash partitioning;
+        # pick the partition with the topic.partition option)
+        self.client.publish_many(self.topic, self.partition, payloads)
+        self._published += len(payloads)
+
+    def position(self) -> int:
+        return self._published
+
+    def truncate_to(self, position: int) -> None:
+        self._published = position
+
+    def close(self) -> None:
+        self.client.close()
+
+
 def build_sink(connector: str, options: dict, schema: Schema) -> Sink:
     """Sink registry (reference: SinkImpl::new, sink/mod.rs:150)."""
     c = connector.lower()
@@ -112,4 +153,9 @@ def build_sink(connector: str, options: dict, schema: Schema) -> Sink:
             raise ValueError("file sink requires path option")
         return FileSink(str(path), schema,
                         fmt=str(options.get("format", "jsonl")))
+    if c in ("broker", "kafka"):
+        from .broker import parse_broker_options
+        address, topic = parse_broker_options(options)
+        return BrokerSink(address, topic, schema,
+                          partition=int(options.get("topic.partition", 0)))
     raise ValueError(f"unsupported sink connector {connector!r}")

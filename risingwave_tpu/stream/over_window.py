@@ -258,6 +258,7 @@ class OverWindowExecutor(SingleInputExecutor):
         self._agg_idx = [i for i, c in enumerate(self.calls)
                         if c.kind in AGG_WINDOW_KINDS]
         self._parts: dict[tuple, _Partition] = {}
+        self._pk_loc: dict[tuple, tuple] = {}   # pk -> (part, sortkey)
         self._out: dict[tuple, dict] = {}   # part -> {pk: (row, vals)}
         #: per-barrier change tracking
         self._min_key: dict[tuple, tuple] = {}   # part -> min touched key
@@ -290,27 +291,40 @@ class OverWindowExecutor(SingleInputExecutor):
         pk = tuple(row[i] for i in self.pk_indices)
         part = self._part_of(row)
         key = self._sortkey(row)
-        p = self._parts.get(part)
         if op in (OP_INSERT, OP_UPDATE_INSERT):
+            loc = self._pk_loc.get(pk)
+            if loc is not None:
+                # upsert: a bare INSERT for a live pk replaces its row —
+                # possibly in a DIFFERENT partition (the pre-incremental
+                # executor's contract)
+                self._drop_entry(pk, *loc)
+                self._removed.setdefault(loc[0], set()).add(pk)
+            p = self._parts.get(part)
             if p is None:
                 p = self._parts[part] = _Partition()
             pos = bisect.bisect_left(p.entries, key, key=lambda e: e[0])
-            if (pos < len(p.entries) and p.entries[pos][0] == key):
-                raise RuntimeError(
-                    f"over-window: duplicate pk {pk} in partition {part}")
             p.entries.insert(pos, (key, row))
             p.vals.insert(pos, None)
             p.accs.insert(pos, None)
             p.dense.insert(pos, -1)
+            self._pk_loc[pk] = (part, key)
             self._removed.get(part, set()).discard(pk)
+            self._note(part, key)
         else:
-            if p is None:
-                return
-            pos = bisect.bisect_left(p.entries, key, key=lambda e: e[0])
-            if pos >= len(p.entries) or p.entries[pos][0] != key:
+            loc = self._pk_loc.pop(pk, None)
+            if loc is None:
                 return                     # delete of unknown row
+            self._drop_entry(pk, *loc)
+            self._removed.setdefault(loc[0], set()).add(pk)
+
+    def _drop_entry(self, pk: tuple, part: tuple, key: tuple) -> None:
+        import bisect
+        p = self._parts.get(part)
+        if p is None:
+            return
+        pos = bisect.bisect_left(p.entries, key, key=lambda e: e[0])
+        if pos < len(p.entries) and p.entries[pos][0] == key:
             del p.entries[pos], p.vals[pos], p.accs[pos], p.dense[pos]
-            self._removed.setdefault(part, set()).add(pk)
         self._note(part, key)
 
     async def map_chunk(self, chunk: StreamChunk):
@@ -418,20 +432,24 @@ class OverWindowExecutor(SingleInputExecutor):
         # _max_lead, so every position whose lead target changed is INSIDE
         # the recomputed suffix)
 
-    def _recompute_and_diff(self, part: tuple) -> list:
-        """Returns (op, out_row) pairs for one dirty partition and updates
-        the emitted-output cache."""
+    def _recompute_and_diff(self, part: tuple) -> tuple:
+        """Returns (deletes, others) op/out_row pair lists for one dirty
+        partition and updates the emitted-output cache. Deletes are
+        separated so the barrier can emit ALL deletes first — a pk moving
+        between partitions must retract from its old partition before the
+        new partition's insert reaches the downstream pk-keyed state."""
         p = self._parts.get(part)
         out = self._out.setdefault(part, {})
-        pairs: list = []
+        deletes: list = []
+        others: list = []
         min_key = self._min_key[part]
         removed = self._removed.pop(part, set())
         if p is None or not p.entries:
             self._parts.pop(part, None)
             for pk, (row, vals) in out.items():
-                pairs.append((OP_DELETE, row + vals))
+                deletes.append((OP_DELETE, row + vals))
             self._out.pop(part, None)
-            return pairs
+            return deletes, others
         start = self._start_pos(p, min_key)
         self._recompute_suffix(p, start)
         live_suffix_pks = set()
@@ -442,24 +460,28 @@ class OverWindowExecutor(SingleInputExecutor):
             vals = p.vals[i]
             old = out.get(pk)
             if old is None:
-                pairs.append((OP_INSERT, row + vals))
+                others.append((OP_INSERT, row + vals))
             elif old != (row, vals):
-                pairs.append((OP_UPDATE_DELETE, old[0] + old[1]))
-                pairs.append((OP_UPDATE_INSERT, row + vals))
+                others.append((OP_UPDATE_DELETE, old[0] + old[1]))
+                others.append((OP_UPDATE_INSERT, row + vals))
             out[pk] = (row, vals)
         for pk in removed:
             if pk not in live_suffix_pks and pk in out:
                 row, vals = out.pop(pk)
-                pairs.append((OP_DELETE, row + vals))
-        return pairs
+                deletes.append((OP_DELETE, row + vals))
+        return deletes, others
 
     async def on_barrier(self, barrier: Barrier):
-        pairs: list = []
+        deletes: list = []
+        others: list = []
         for part in sorted(self._min_key, key=repr):
-            pairs.extend(self._recompute_and_diff(part))
+            d, o = self._recompute_and_diff(part)
+            deletes.extend(d)
+            others.extend(o)
         self._min_key.clear()
         self._removed.clear()
-        for chunk in _emit_chunks(self.schema, pairs, self.out_capacity):
+        for chunk in _emit_chunks(self.schema, deletes + others,
+                                  self.out_capacity):
             yield chunk
         if self.state_table is not None:
             self.state_table.commit(barrier.epoch.curr)

@@ -1,0 +1,209 @@
+"""Broker-shaped source/sink + Avro parser (VERDICT r4 item 10; reference:
+src/connector/src/source/base.rs:295-340 Kafka splits,
+src/connector/src/parser/avro/, src/connector/src/sink/kafka.rs)."""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+from risingwave_tpu.connector.avro import AvroCodec
+from risingwave_tpu.connector.broker import (
+    BrokerClient, BrokerServer, BrokerSourceReader,
+)
+from risingwave_tpu.frontend import Session
+
+
+AVRO_SCHEMA = json.dumps({
+    "type": "record", "name": "bid",
+    "fields": [
+        {"name": "auction", "type": "long"},
+        {"name": "price", "type": ["null", "long"]},
+        {"name": "channel", "type": "string"},
+        {"name": "ok", "type": "boolean"},
+        {"name": "score", "type": "double"},
+    ],
+})
+
+
+def test_avro_roundtrip():
+    codec = AvroCodec(AVRO_SCHEMA)
+    recs = [
+        {"auction": 7, "price": 1200, "channel": "web", "ok": True,
+         "score": 2.5},
+        {"auction": -3, "price": None, "channel": "", "ok": False,
+         "score": -0.125},
+    ]
+    for r in recs:
+        assert codec.decode(codec.encode(r)) == r
+    # zero-leading datum (auction=0) must NOT be mistaken for framing
+    zero = {"auction": 0, "price": 0, "channel": "ch0", "ok": True,
+            "score": 0.0}
+    assert codec.decode(codec.encode(zero)) == zero
+    # Confluent framing is explicit, declared per codec
+    confluent = AvroCodec(AVRO_SCHEMA, framing="confluent")
+    framed = b"\x00\x00\x00\x00\x07" + codec.encode(recs[0])
+    assert confluent.decode(framed) == recs[0]
+    with pytest.raises(Exception):
+        codec.decode(b"\xff\x01")     # truncated garbage fails loudly
+
+
+def test_broker_server_protocol_and_reader():
+    srv = BrokerServer(n_partitions=2).start()
+    try:
+        cl = BrokerClient(srv.address)
+        assert cl.n_partitions("t") == 2
+        assert cl.publish("t", 0, b'{"a": 1}') == 0
+        assert cl.publish("t", 0, b'{"a": 2}') == 1
+        assert cl.publish("t", 1, b'{"a": 3}') == 0
+        assert cl.fetch("t", 0, 0, 10) == [b'{"a": 1}', b'{"a": 2}']
+        assert cl.fetch("t", 0, 2, 10) == []
+        cl.close()
+
+        from risingwave_tpu.common import chunk_to_rows
+        from risingwave_tpu.common.types import Field, INT64, Schema
+        schema = Schema((Field("a", INT64),))
+        rd = BrokerSourceReader(schema, srv.address, "t",
+                                rows_per_chunk=8)
+        got = []
+        while True:
+            ch = rd.next_chunk()
+            if ch is None:
+                break
+            got.extend(chunk_to_rows(ch, schema))
+        assert sorted(got) == [(1,), (2,), (3,)]
+        assert rd.offsets == {"t-0": 2, "t-1": 1}
+        # deterministic seek: replay of [0, ..) yields identical rows
+        rd.seek({"t-0": 0, "t-1": 0})
+        replay = []
+        while True:
+            ch = rd.next_chunk()
+            if ch is None:
+                break
+            replay.extend(chunk_to_rows(ch, schema))
+        assert sorted(replay) == sorted(got)
+        rd.close()
+    finally:
+        srv.close()
+
+
+def test_broker_source_e2e_with_crash_resume():
+    """CREATE SOURCE over the broker; kill the session; publish more;
+    a recovered session must resume from the checkpointed offsets —
+    no duplicates, no gaps."""
+    with tempfile.TemporaryDirectory() as d:
+        srv = BrokerServer(n_partitions=2).start()
+        try:
+            cl = BrokerClient(srv.address)
+            for i in range(6):
+                cl.publish("bids", i % 2,
+                           json.dumps({"auction": i, "price": 100 + i})
+                           .encode())
+            data = os.path.join(d, "data")
+            s = Session(data_dir=data)
+            s.run_sql(f"""CREATE SOURCE bid (auction BIGINT, price BIGINT)
+                WITH (connector = 'broker',
+                      'broker.address' = '{srv.address}',
+                      topic = 'bids')""")
+            s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                      "SELECT auction, price FROM bid")
+            s.tick()
+            s.tick()       # two partitions: a tick drains one chunk each
+            s.run_sql("FLUSH")
+            assert sorted(s.mv_rows("m")) == [
+                (i, 100 + i) for i in range(6)]
+            s.close()
+
+            # while "down": six more events
+            for i in range(6, 12):
+                cl.publish("bids", i % 2,
+                           json.dumps({"auction": i, "price": 100 + i})
+                           .encode())
+            s2 = Session(data_dir=data)
+            s2.tick()
+            s2.tick()
+            assert sorted(s2.mv_rows("m")) == [
+                (i, 100 + i) for i in range(12)]
+            s2.close()
+            cl.close()
+        finally:
+            srv.close()
+
+
+def test_broker_avro_source():
+    srv = BrokerServer(n_partitions=1).start()
+    try:
+        codec = AvroCodec(AVRO_SCHEMA)
+        cl = BrokerClient(srv.address)
+        for i in range(4):
+            cl.publish("av", 0, codec.encode({
+                "auction": i, "price": None if i == 2 else i * 10,
+                "channel": f"ch{i}", "ok": i % 2 == 0,
+                "score": i / 2}))
+        cl.publish("av", 0, b"\xff garbage \xff")   # dropped, not fatal
+        s = Session()
+        s.run_sql(f"""CREATE SOURCE av (auction BIGINT, price BIGINT,
+                channel VARCHAR, ok BOOLEAN, score DOUBLE)
+            WITH (connector = 'broker',
+                  'broker.address' = '{srv.address}',
+                  topic = 'av', format = 'avro',
+                  'avro.schema' = '{AVRO_SCHEMA.replace(chr(39), "")}')""")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT auction, price, "
+                  "channel, ok, score FROM av")
+        s.tick()
+        rows = sorted(s.mv_rows("m"))
+        assert rows == [
+            (0, 0, "ch0", True, 0.0),
+            (1, 10, "ch1", False, 0.5),
+            (2, None, "ch2", True, 1.0),
+            (3, 30, "ch3", False, 1.5),
+        ]
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_broker_sink_changelog():
+    """MV changelog delivered to a broker topic as JSON with __op."""
+    srv = BrokerServer(n_partitions=1).start()
+    try:
+        s = Session()
+        s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT id, v FROM t WHERE v > 10")
+        s.run_sql(f"""CREATE SINK snk FROM m
+            WITH (connector = 'broker',
+                  'broker.address' = '{srv.address}',
+                  topic = 'out')""")
+        s.run_sql("INSERT INTO t VALUES (1, 5), (2, 20)")
+        s.tick()
+        s.run_sql("DELETE FROM t WHERE id = 2")
+        s.tick()
+        s.close()
+        cl = BrokerClient(srv.address)
+        msgs = [json.loads(m) for m in cl.fetch("out", 0, 0, 100)]
+        cl.close()
+        inserts = [m for m in msgs if m["__op"] == "insert"]
+        deletes = [m for m in msgs if m["__op"] == "delete"]
+        assert {(m["id"], m["v"]) for m in inserts} == {(2, 20)}
+        assert {(m["id"], m["v"]) for m in deletes} == {(2, 20)}
+    finally:
+        srv.close()
+
+
+def test_broker_durable_segments_survive_restart():
+    with tempfile.TemporaryDirectory() as d:
+        srv = BrokerServer(n_partitions=1, data_dir=d).start()
+        cl = BrokerClient(srv.address)
+        cl.publish("t", 0, b"one")
+        cl.publish("t", 0, b"two")
+        cl.close()
+        srv.close()
+        srv2 = BrokerServer(n_partitions=1, data_dir=d).start()
+        try:
+            cl = BrokerClient(srv2.address)
+            assert cl.fetch("t", 0, 0, 10) == [b"one", b"two"]
+            cl.close()
+        finally:
+            srv2.close()

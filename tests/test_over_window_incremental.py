@@ -109,6 +109,29 @@ def test_varchar_order_keys_survive_dictionary_growth():
     assert got == {3: (1,), 2: (2,)}, got
 
 
+def test_bare_insert_upserts_live_pk():
+    """A bare INSERT for a live pk replaces its row — including a move to
+    a DIFFERENT partition — instead of leaving two live entries (the
+    pre-incremental executor's upsert contract)."""
+    from risingwave_tpu.common.chunk import OP_INSERT
+    from risingwave_tpu.stream.message import Barrier
+
+    src = _ScriptSource(SCHEMA)
+    ex = OverWindowExecutor(src, _calls(), pk_indices=(3,))
+    _drive(ex, src, [Barrier.new(1),
+                     _mk([(1, 10, 5, 1), (1, 20, 7, 2)]), Barrier.new(2)])
+    # same partition, new order key
+    _drive(ex, src, [_mk([(1, 30, 9, 1)], ), Barrier.new(3)])
+    got = {pk[0]: vals for pk, (_, vals) in ex._out[(1,)].items()}
+    assert got == {2: (1, 7, None), 1: (2, 16, 7)}, got
+    # move pk 2 to partition 9: old partition must retract it
+    _drive(ex, src, [_mk([(9, 5, 1, 2)]), Barrier.new(4)])
+    got1 = {pk[0]: vals for pk, (_, vals) in ex._out[(1,)].items()}
+    got9 = {pk[0]: vals for pk, (_, vals) in ex._out[(9,)].items()}
+    assert got1 == {1: (1, 9, None)}, got1
+    assert got9 == {2: (1, 1, None)}, got9
+
+
 def test_incremental_matches_full_recompute_under_churn():
     """Random out-of-order inserts and deletes: the incremental outputs
     must equal the full-recompute host model after every barrier."""
