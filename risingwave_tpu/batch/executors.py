@@ -77,15 +77,22 @@ class RowSeqScan(BatchExecutor):
 
     def __init__(self, table: StateTable,
                  vnodes: Optional[Sequence[int]] = None,
-                 batch_size: int = 4096):
+                 batch_size: int = 4096,
+                 prefix: Optional[Sequence] = None):
+        """``prefix``: values of the first len(prefix) pk columns —
+        restricts the scan to that sorted-key range (the index point
+        lookup path; reference: row_seq_scan.rs scan_range)."""
         self.table = table
         self.schema = table.schema
         self.vnodes = None if vnodes is None else sorted(set(vnodes))
         self.batch_size = batch_size
+        self.prefix = None if prefix is None else list(prefix)
 
     def execute_chunks(self):
         buf: List[tuple] = []
-        for row in self.table.scan_all():
+        it = (self.table.scan_all() if self.prefix is None
+              else self.table.scan_prefix(self.prefix, len(self.prefix)))
+        for row in it:
             buf.append(row)
             if len(buf) >= self.batch_size:
                 yield self._chunk(buf)

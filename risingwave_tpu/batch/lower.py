@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..expr.expr import FunctionCall, InputRef, Literal
 from ..frontend import planner as P
 from ..storage.state_table import StateTable
 from .executors import (
@@ -22,25 +23,101 @@ from .executors import (
 )
 
 
-def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
+def _index_scan(plan: P.PFilter, catalog, store) -> Optional[BatchExecutor]:
+    """Filter-over-scan with constant equality on an index prefix →
+    prefix scan of the index arrangement (reference: the index-selection
+    rule, src/frontend/src/optimizer/rule/index_selection_rule.rs scaled
+    to equality prefixes). Returns executor in BASE schema order with the
+    full predicate re-applied (harmless superset filtering)."""
+    base = plan.input
+    # column pruning may interpose a pure-InputRef projection over the
+    # scan; compose its column mapping instead of giving up
+    mapping = None
+    if (isinstance(base, P.PProject)
+            and all(isinstance(e, InputRef) for e in base.exprs)
+            and isinstance(base.input, (P.PTableScan, P.PMvScan))):
+        mapping = [e.index for e in base.exprs]
+        base = base.input
+    if not isinstance(base, (P.PTableScan, P.PMvScan)):
+        return None
+    d = base.table if isinstance(base, P.PTableScan) else base.mv
+    if getattr(d, "n_visible", len(d.schema)) != len(d.schema):
+        return None                       # hidden cols: mapping unsafe
+    if mapping is None:
+        mapping = list(range(len(d.schema)))
+    # constant-equality conjuncts: BASE col idx -> literal value
+    from ..frontend.optimizer import conjuncts_of
+    eq: dict = {}
+    for c in conjuncts_of(plan.predicate):
+        if (isinstance(c, FunctionCall) and c.name == "equal"
+                and len(c.args) == 2):
+            a, b = c.args
+            if isinstance(a, Literal) and isinstance(b, InputRef):
+                a, b = b, a
+            if (isinstance(a, InputRef) and isinstance(b, Literal)
+                    and b.value is not None):
+                eq.setdefault(mapping[a.index], b)
+    if not eq:
+        return None
+    col_names = [f.name for f in d.schema]
+    base_name = getattr(d, "name", None)
+    best = None
+    for ix in catalog.indexes.values():
+        if ix.table != base_name or not ix.mv_name:
+            continue
+        mv = catalog.mvs.get(ix.mv_name)
+        if mv is None:
+            continue
+        # how many leading index columns are equality-bound?
+        vals = []
+        for cname in ix.columns:
+            pos = col_names.index(cname)
+            if pos in eq:
+                vals.append(eq[pos])
+            else:
+                break
+        if vals and (best is None or len(vals) > len(best[1])):
+            best = (mv, vals, ix)
+    if best is None:
+        return None
+    mv, lits, ix = best
+    prefix = [lit.type.to_physical(lit.value) for lit in lits]
+    scan = RowSeqScan(StateTable(store, mv.table_id, mv.schema,
+                                 list(mv.pk)), prefix=prefix)
+    # permute index-MV columns into the filter's INPUT order — the base
+    # scan's schema through the (possibly pruned) projection mapping
+    mv_names = [f.name for f in mv.schema]
+    exprs = [InputRef(mv_names.index(col_names[bi]),
+                      d.schema[bi].type) for bi in mapping]
+    proj = BatchProject(scan, exprs,
+                        names=[col_names[bi] for bi in mapping])
+    return BatchFilter(proj, plan.predicate)
+
+
+def lower_plan(plan: P.PlanNode, store,
+               catalog=None) -> Optional[BatchExecutor]:
     if isinstance(plan, (P.PTableScan, P.PMvScan)):
         d = plan.table if isinstance(plan, P.PTableScan) else plan.mv
         return RowSeqScan(StateTable(store, d.table_id, d.schema,
                                      list(d.pk)))
     if isinstance(plan, P.PProject):
-        inp = lower_plan(plan.input, store)
+        inp = lower_plan(plan.input, store, catalog)
         if inp is None:
             return None
         return BatchProject(inp, list(plan.exprs), names=plan.schema.names)
     if isinstance(plan, P.PFilter):
-        inp = lower_plan(plan.input, store)
+        if catalog is not None:
+            ix = _index_scan(plan, catalog, store)
+            if ix is not None:
+                return ix
+        inp = lower_plan(plan.input, store, catalog)
         if inp is None:
             return None
         return BatchFilter(inp, plan.predicate)
     if isinstance(plan, P.PAgg):
         if plan.eowc or any(c.distinct for c in plan.agg_calls):
             return None
-        inp = lower_plan(plan.input, store)
+        inp = lower_plan(plan.input, store, catalog)
         if inp is None:
             return None
         return BatchHashAgg(inp, list(plan.group_keys),
@@ -48,8 +125,8 @@ def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
     if isinstance(plan, P.PJoin):
         if plan.kind not in ("inner", "left"):
             return None
-        left = lower_plan(plan.left, store)
-        right = lower_plan(plan.right, store)
+        left = lower_plan(plan.left, store, catalog)
+        right = lower_plan(plan.right, store, catalog)
         if left is None or right is None:
             return None
         # pick the build side STATICALLY when pk metadata proves
@@ -69,7 +146,7 @@ def lower_plan(plan: P.PlanNode, store) -> Optional[BatchExecutor]:
     if isinstance(plan, P.PTopN):
         if plan.with_ties or plan.group_by:
             return None
-        inp = lower_plan(plan.input, store)
+        inp = lower_plan(plan.input, store, catalog)
         if inp is None:
             return None
         return BatchLimit(BatchSort(inp, list(plan.order)),

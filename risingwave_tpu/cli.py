@@ -56,8 +56,11 @@ def main(argv=None) -> int:
         "ctl", help="admin inspection of a durable data dir "
                     "(reference: risectl)")
     ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
-                                      "metrics", "trace"])
+                                      "metrics", "trace", "backup",
+                                      "restore", "backup-info"])
     ctl.add_argument("--data-dir", required=True)
+    ctl.add_argument("--backup-dir",
+                     help="backup location for backup/restore/backup-info")
 
     args = p.parse_args(argv)
 
@@ -77,8 +80,23 @@ def main(argv=None) -> int:
 def _ctl(args) -> int:
     """risectl-lite: recover a session from the data dir and inspect it
     (reference: src/ctl/src/lib.rs:48-75 — cluster-info, table scan,
-    trace, profile)."""
+    trace, profile; meta backup/restore:
+    src/meta/src/backup_restore/backup_manager.rs)."""
     import json as _json
+    if args.what in ("backup", "restore", "backup-info"):
+        from .storage.backup import (
+            create_backup, list_backup, restore_backup,
+        )
+        if not args.backup_dir:
+            raise SystemExit("--backup-dir is required")
+        if args.what == "backup":
+            desc = create_backup(args.data_dir, args.backup_dir)
+        elif args.what == "restore":
+            desc = restore_backup(args.backup_dir, args.data_dir)
+        else:
+            desc = list_backup(args.backup_dir)
+        print(_json.dumps(desc, indent=2))
+        return 0
     session = _build_session(args)
     try:
         _ctl_dispatch(args, session, _json)

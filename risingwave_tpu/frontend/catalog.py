@@ -88,6 +88,11 @@ class IndexDef:
     name: str
     table: str
     columns: tuple
+    #: hidden MV materializing (index cols ⧺ remaining visible cols) with
+    #: state-table pk = index cols ⧺ base pk — the arrangement batch
+    #: lookups prefix-scan (reference: index = StreamMaterialize ordered
+    #: by index columns, src/frontend/src/handler/create_index.rs)
+    mv_name: str = ""
 
 
 class CatalogError(ValueError):

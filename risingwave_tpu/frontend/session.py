@@ -354,13 +354,14 @@ class Session:
                         and isinstance(stmt, (
                             A.CreateSource, A.CreateTable,
                             A.CreateMaterializedView, A.CreateSink,
-                            A.DropStatement))):
+                            A.CreateIndex, A.DropStatement))):
                     self.store.log.log_ddl(piece)  # type: ignore[attr-defined]
         return out
 
     def _run_statement(self, stmt: A.Statement) -> list:
         if isinstance(stmt, (A.CreateSource, A.CreateTable,
-                             A.CreateMaterializedView, A.CreateSink)):
+                             A.CreateMaterializedView, A.CreateSink,
+                             A.CreateIndex)):
             # transactional table-id allocation: a failed CREATE must not
             # shift later statements' ids (recovery replays only logged —
             # successful — DDL, so id assignment must be replay-deterministic)
@@ -372,6 +373,8 @@ class Session:
                     return self._create_table(stmt)
                 if isinstance(stmt, A.CreateSink):
                     return self._create_sink(stmt)
+                if isinstance(stmt, A.CreateIndex):
+                    return self._create_index(stmt)
                 return self._create_mv(stmt)
             except BaseException:
                 self.catalog._next_table_id = saved_id
@@ -595,12 +598,52 @@ class Session:
                 init.extend(up_job.snapshot_messages(
                     Barrier.new(self.epoch), self.source_chunk_capacity))
 
-    def _create_mv(self, stmt: A.CreateMaterializedView) -> list:
+    def _create_index(self, stmt: A.CreateIndex) -> list:
+        """CREATE INDEX = a hidden MV materializing the base relation
+        re-keyed by the index columns (reference: an index is a
+        StreamMaterialize with order/distribution on the index columns,
+        src/frontend/src/handler/create_index.rs). Batch point lookups
+        prefix-scan its state table (batch/lower.py)."""
+        from .catalog import IndexDef, strip_schema
+        if stmt.if_not_exists and stmt.name in self.catalog.indexes:
+            return []
+        self.catalog._check_free(stmt.name)
+        base_name = strip_schema(stmt.table)
+        kind, d = self.catalog.resolve_relation(base_name)
+        if kind == "source":
+            raise SqlError("cannot index a source; index a table or MV")
+        n_vis = getattr(d, "n_visible", len(d.schema))
+        visible = [f.name for i, f in enumerate(d.schema) if i < n_vis]
+        for c in stmt.columns:
+            if c not in visible:
+                raise SqlError(f"column {c!r} not found in {base_name!r}")
+        for i in d.pk:
+            if d.schema[i].name not in visible:
+                raise SqlError(
+                    f"cannot index {base_name!r}: its stream key has "
+                    "hidden columns")
+        rest = [c for c in visible if c not in stmt.columns]
+        mv_name = f"__idx_{stmt.name}"
+        sel = parse_sql(
+            f"SELECT {', '.join(list(stmt.columns) + rest)} "
+            f"FROM {base_name}")[0].select
+        self._create_mv(
+            A.CreateMaterializedView(mv_name, sel),
+            pk_prefix=len(stmt.columns))
+        self.catalog_writer.add_index(
+            IndexDef(stmt.name, base_name, tuple(stmt.columns),
+                     mv_name=mv_name))
+        return []
+
+    def _create_mv(self, stmt: A.CreateMaterializedView,
+                   pk_prefix: int = 0) -> list:
         if stmt.if_not_exists and stmt.name in self.catalog.mvs:
             return []
         self._drain_inflight()   # subscribe at a quiesced epoch boundary
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
-        if self.workers:
+        if self.workers and not pk_prefix:
+            # index arrangements always build session-local (they scan
+            # session-owned base state); worker placement is for plain MVs
             return self._create_mv_remote(stmt)
         n_feeds0 = len(self.feeds)
         n_bf0 = len(self.backfills)
@@ -608,15 +651,22 @@ class Session:
         (plan, pipeline, ctx, queues, init_msgs,
          scan_leaf_queues) = self._build_query_pipeline(stmt.query)
         mv_table_id = self.catalog.next_table_id()
+        mv_pk = list(plan.pk)
+        if pk_prefix:
+            # index arrangement: key by the index columns first, base pk
+            # after (dedup keeps key order); prefix scans by index value
+            # ride the sorted key encoding
+            mv_pk = list(range(pk_prefix)) + [
+                i for i in plan.pk if i >= pk_prefix]
         mat = MaterializeExecutor(
             pipeline,
-            StateTable(self.store, mv_table_id, plan.schema, list(plan.pk)))
+            StateTable(self.store, mv_table_id, plan.schema, mv_pk))
         # (no _maybe_rebackfill here: scan leaves re-run their own backfill
         # from the persisted cursor — created-but-never-checkpointed
         # recovery is the empty-progress case of stream/backfill.py)
         n_visible = sum(1 for f in plan.schema if not f.name.startswith("_"))
         mv = MaterializedViewDef(
-            stmt.name, plan.schema, tuple(plan.pk), table_id=mv_table_id,
+            stmt.name, plan.schema, tuple(mv_pk), table_id=mv_table_id,
             definition="")
         mv.n_visible = n_visible  # type: ignore[attr-defined]
         mv.state_table_ids = tuple(ctx.state_table_ids)  # type: ignore[attr-defined]
@@ -1292,6 +1342,23 @@ class Session:
                 other.bus.unsubscribe(q)
 
     def _drop(self, stmt: A.DropStatement) -> list:
+        if stmt.kind == "index":
+            ix = self.catalog.indexes.get(stmt.name)
+            if ix is None:
+                if stmt.if_exists:
+                    return []
+                raise SqlError(f"index {stmt.name!r} not found")
+            self.catalog_writer.drop("index", stmt.name, False)
+            # the arrangement MV goes with it
+            return self._drop(dataclasses.replace(
+                stmt, kind="materialized_view", name=ix.mv_name,
+                if_exists=True))
+        # dropping a base relation cascades to its indexes — a dangling
+        # index would keep serving the DROPPED table's rows to lookups
+        for ix_name in [n for n, ix in self.catalog.indexes.items()
+                        if ix.table == stmt.name]:
+            self._drop(dataclasses.replace(
+                stmt, kind="index", name=ix_name, if_exists=True))
         self._drain_inflight()
         # free the object's durable state (tombstoned in the manifest so
         # recovery and compaction skip it)
@@ -1732,7 +1799,8 @@ class Session:
         try:
             # a remote MV's rows live in the worker's store, not ours —
             # the local-scan fast path would silently read empty tables
-            lowered = None if remote_mvs else lower_plan(plan, self.store)
+            lowered = None if remote_mvs else lower_plan(
+                plan, self.store, catalog=self.catalog)
         except BatchFallback:
             lowered = None
         if lowered is not None:

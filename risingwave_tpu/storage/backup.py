@@ -1,0 +1,114 @@
+"""Meta-snapshot backup / restore of a durable data directory.
+
+Counterpart of the reference's backup tooling (reference:
+src/meta/src/backup_restore/backup_manager.rs — meta snapshot =
+cluster metadata + the Hummock version manifest, written to the backup
+object store; src/storage/backup/ meta_snapshot.rs format;
+restore.rs rebuilds a fresh meta store from a snapshot). Here a snapshot
+captures the SAME two tiers:
+
+* the checkpoint manifest + every segment it references (the durable
+  state version — orphan segments from torn publishes are deliberately
+  excluded, exactly like unreferenced SSTs),
+* the meta tier (``meta/meta.jsonl`` — catalog, DDL log, system params).
+
+The snapshot is self-describing (``backup.json`` with id, epoch and the
+captured file list) and restore refuses to overwrite a non-empty target,
+mirroring the reference's restore precondition that the new cluster must
+be uninitialized (backup_restore/restore.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Optional
+
+_BACKUP_META = "backup.json"
+
+
+class BackupError(RuntimeError):
+    pass
+
+
+def create_backup(data_dir: str, dest: str,
+                  backup_id: Optional[str] = None) -> dict:
+    """Snapshot ``data_dir`` into ``dest`` (created; must not already hold
+    a backup). Returns the backup descriptor."""
+    manifest_path = os.path.join(data_dir, "manifest.json")
+    if not os.path.exists(manifest_path):
+        raise BackupError(f"{data_dir!r} has no checkpoint manifest")
+    with open(manifest_path, "rb") as f:
+        manifest_raw = f.read()
+    manifest = json.loads(manifest_raw)
+    os.makedirs(dest, exist_ok=True)
+    if os.path.exists(os.path.join(dest, _BACKUP_META)):
+        raise BackupError(f"{dest!r} already contains a backup")
+
+    files = []
+    # 1. the manifest itself (fixed bytes: the version being captured)
+    with open(os.path.join(dest, "manifest.json"), "wb") as f:
+        f.write(manifest_raw)
+    files.append("manifest.json")
+    # 2. every segment the manifest references — and nothing else
+    for seg in manifest.get("segments", []):
+        src = os.path.join(data_dir, seg)
+        if not os.path.exists(src):
+            raise BackupError(
+                f"manifest references missing segment {seg!r}")
+        shutil.copy2(src, os.path.join(dest, seg))
+        files.append(seg)
+    # 3. the meta tier (catalog / DDL log / params)
+    meta_src = os.path.join(data_dir, "meta", "meta.jsonl")
+    if os.path.exists(meta_src):
+        os.makedirs(os.path.join(dest, "meta"), exist_ok=True)
+        shutil.copy2(meta_src, os.path.join(dest, "meta", "meta.jsonl"))
+        files.append("meta/meta.jsonl")
+
+    desc = {
+        "backup_id": backup_id or f"backup-{int(time.time())}",
+        "committed_epoch": manifest.get("committed_epoch"),
+        "files": files,
+        "source_dir": os.path.abspath(data_dir),
+    }
+    tmp = os.path.join(dest, _BACKUP_META + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(desc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dest, _BACKUP_META))
+    return desc
+
+
+def restore_backup(backup_dir: str, data_dir: str) -> dict:
+    """Materialize a backup into a FRESH data dir; a recovered Session
+    over it resumes at the snapshot's committed epoch."""
+    desc_path = os.path.join(backup_dir, _BACKUP_META)
+    if not os.path.exists(desc_path):
+        raise BackupError(f"{backup_dir!r} is not a backup (no "
+                          f"{_BACKUP_META})")
+    with open(desc_path, "r", encoding="utf-8") as f:
+        desc = json.load(f)
+    if os.path.exists(data_dir) and os.listdir(data_dir):
+        raise BackupError(
+            f"restore target {data_dir!r} is not empty (refusing to "
+            "overwrite a live data dir)")
+    os.makedirs(data_dir, exist_ok=True)
+    for rel in desc["files"]:
+        src = os.path.join(backup_dir, rel)
+        if not os.path.exists(src):
+            raise BackupError(f"backup is missing file {rel!r}")
+        dst = os.path.join(data_dir, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy2(src, dst)
+    return desc
+
+
+def list_backup(backup_dir: str) -> dict:
+    desc_path = os.path.join(backup_dir, _BACKUP_META)
+    if not os.path.exists(desc_path):
+        raise BackupError(f"{backup_dir!r} is not a backup")
+    with open(desc_path, "r", encoding="utf-8") as f:
+        return json.load(f)

@@ -132,9 +132,17 @@ class HashAggExecutor(SingleInputExecutor):
         # are shared by all flush windows of the barrier.
         def _probe(st):
             rank = self.core.flush_rank(st)
-            n_live = jnp.sum(st.table.occupied & (st.lanes[0] > 0))
+            if self.hbm_group_budget is not None:
+                # live-group census gates cold eviction; only budgeted
+                # executors pay for it (an O(capacity) int64 compare —
+                # kept OFF the bench-critical unbudgeted probe, which is
+                # the exact graph proven on-chip in round 3)
+                n_live = jnp.sum(st.table.occupied & (st.lanes[0] > 0))
+                n_live = n_live.astype(jnp.int32)
+            else:
+                n_live = jnp.zeros((), jnp.int32)
             packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32),
-                                n_live.astype(jnp.int32)])
+                                n_live])
             return packed, rank
 
         self._probe = jax.jit(_probe)

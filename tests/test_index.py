@@ -1,0 +1,175 @@
+"""CREATE INDEX: incremental index arrangements + batch point lookups
+(VERDICT r4 missing #5; reference: src/frontend/src/handler/create_index.rs
+— an index is a re-keyed StreamMaterialize; index selection
+src/frontend/src/optimizer/rule/index_selection_rule.rs)."""
+
+import os
+import tempfile
+
+import pytest
+
+from risingwave_tpu.batch.executors import (
+    BatchFilter, BatchProject, RowSeqScan,
+)
+from risingwave_tpu.batch.lower import lower_plan
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.parser import parse_sql
+
+
+def _plan(s, sql):
+    return s._plan(parse_sql(sql)[0].select)
+
+
+def test_index_create_maintain_lookup():
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT, v VARCHAR)")
+    s.run_sql("CREATE INDEX ix_k ON t (k)")
+    assert "ix_k" in s.catalog.indexes
+    s.run_sql("INSERT INTO t VALUES (1, 10, 'a'), (2, 20, 'b'), "
+              "(3, 10, 'c')")
+    s.tick()
+    # the lookup goes through the index arrangement: prefix-scan executor
+    plan = _plan(s, "SELECT id, v FROM t WHERE k = 10")
+    lowered = lower_plan(plan, s.store, catalog=s.catalog)
+    node = lowered
+    while not isinstance(node, RowSeqScan):
+        node = node.input
+    assert node.prefix is not None, "expected an index prefix scan"
+    # and the answers are right, through the public API
+    assert sorted(s.run_sql("SELECT id, v FROM t WHERE k = 10")) == [
+        (1, "a"), (3, "c")]
+    # index maintenance is incremental: updates and deletes flow
+    s.run_sql("UPDATE t SET k = 10 WHERE id = 2")
+    s.tick()
+    assert sorted(s.run_sql("SELECT id FROM t WHERE k = 10")) == [
+        (1,), (2,), (3,)]
+    s.run_sql("DELETE FROM t WHERE id = 1")
+    s.tick()
+    assert sorted(s.run_sql("SELECT id FROM t WHERE k = 10")) == [
+        (2,), (3,)]
+    s.close()
+
+
+def test_composite_index_prefix_match():
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, a BIGINT, b BIGINT, "
+              "v BIGINT)")
+    s.run_sql("CREATE INDEX ix_ab ON t (a, b)")
+    s.run_sql("INSERT INTO t VALUES (1, 1, 1, 10), (2, 1, 2, 20), "
+              "(3, 2, 1, 30)")
+    s.tick()
+    # full composite equality
+    assert s.run_sql("SELECT v FROM t WHERE a = 1 AND b = 2") == [(20,)]
+    # leading-column-only equality still uses the prefix
+    plan = _plan(s, "SELECT v FROM t WHERE a = 1")
+    lowered = lower_plan(plan, s.store, catalog=s.catalog)
+    node = lowered
+    while not isinstance(node, RowSeqScan):
+        node = node.input
+    assert node.prefix is not None and len(node.prefix) == 1
+    assert sorted(s.run_sql("SELECT v FROM t WHERE a = 1")) == [
+        (10,), (20,)]
+    # equality on a NON-leading column alone cannot use the index
+    plan = _plan(s, "SELECT v FROM t WHERE b = 1")
+    lowered = lower_plan(plan, s.store, catalog=s.catalog)
+    node = lowered
+    while not isinstance(node, RowSeqScan):
+        node = node.input
+    assert node.prefix is None
+    s.close()
+
+
+def test_index_survives_recovery_and_drop():
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "data")
+        s = Session(data_dir=data)
+        s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+        s.run_sql("CREATE INDEX ix ON t (k)")
+        s.run_sql("INSERT INTO t VALUES (1, 5), (2, 7)")
+        s.tick()
+        s.run_sql("FLUSH")
+        s.close()
+
+        s2 = Session(data_dir=data)
+        assert "ix" in s2.catalog.indexes
+        assert s2.run_sql("SELECT id FROM t WHERE k = 7") == [(2,)]
+        # still maintained after recovery
+        s2.run_sql("INSERT INTO t VALUES (3, 7)")
+        s2.tick()
+        assert sorted(s2.run_sql("SELECT id FROM t WHERE k = 7")) == [
+            (2,), (3,)]
+        s2.run_sql("DROP INDEX ix")
+        assert "ix" not in s2.catalog.indexes
+        assert not any(n.startswith("__idx_ix")
+                       for n in s2.catalog.mvs)
+        # queries fall back to full scans, still correct
+        assert sorted(s2.run_sql("SELECT id FROM t WHERE k = 7")) == [
+            (2,), (3,)]
+        s2.close()
+
+
+def test_index_on_mv():
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT, v BIGINT)")
+    s.run_sql("CREATE MATERIALIZED VIEW agg AS "
+              "SELECT k, sum(v) AS sv FROM t GROUP BY k")
+    s.run_sql("CREATE INDEX ix_sv ON agg (sv)")
+    s.run_sql("INSERT INTO t VALUES (1, 1, 10), (2, 2, 20)")
+    s.tick()
+    assert sorted(s.run_sql("SELECT k FROM agg WHERE sv = 10")) == [(1,)]
+    s.run_sql("INSERT INTO t VALUES (4, 1, 5)")     # k=1 moves to 15
+    s.tick()
+    assert s.run_sql("SELECT k FROM agg WHERE sv = 15") == [(1,)]
+    assert s.run_sql("SELECT k FROM agg WHERE sv = 10") == []
+    assert s.run_sql("SELECT k FROM agg WHERE sv = 20") == [(2,)]
+    s.close()
+
+
+def test_drop_base_cascades_to_index():
+    """DROP TABLE removes dependent indexes — a dangling arrangement must
+    not serve the dropped table's rows to a re-created namesake."""
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+    s.run_sql("CREATE INDEX ix ON t (k)")
+    s.run_sql("INSERT INTO t VALUES (2, 7)")
+    s.tick()
+    assert s.run_sql("SELECT id FROM t WHERE k = 7") == [(2,)]
+    s.run_sql("DROP TABLE t")
+    assert "ix" not in s.catalog.indexes
+    assert not any(n.startswith("__idx_") for n in s.catalog.mvs)
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+    assert s.run_sql("SELECT id FROM t WHERE k = 7") == []
+    s.run_sql("INSERT INTO t VALUES (9, 7)")
+    s.tick()
+    assert s.run_sql("SELECT id FROM t WHERE k = 7") == [(9,)]
+    s.close()
+
+
+def test_index_recovery_with_workers(tmp_path):
+    """A data dir whose DDL log contains CREATE INDEX must reopen fine
+    with worker placement enabled (the index replays session-local)."""
+    data = str(tmp_path / "data")
+    s = Session(data_dir=data)
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+    s.run_sql("CREATE INDEX ix ON t (k)")
+    s.run_sql("INSERT INTO t VALUES (1, 5)")
+    s.tick()
+    s.run_sql("FLUSH")
+    s.close()
+    s2 = Session(data_dir=data, workers=1)
+    try:
+        assert "ix" in s2.catalog.indexes
+        assert s2.run_sql("SELECT id FROM t WHERE k = 5") == [(1,)]
+    finally:
+        s2.close()
+
+
+def test_index_errors():
+    s = Session()
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, k BIGINT)")
+    with pytest.raises(Exception):
+        s.run_sql("CREATE INDEX ix ON t (nope)")
+    s.run_sql("CREATE SOURCE src (a BIGINT) WITH (connector = 'datagen')")
+    with pytest.raises(Exception):
+        s.run_sql("CREATE INDEX ix2 ON src (a)")
+    s.close()
