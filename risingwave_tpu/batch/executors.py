@@ -231,17 +231,27 @@ class BatchHashAgg(_SingleInput):
 
 
 class BatchHashJoin(BatchExecutor):
-    """One-shot hash join with a UNIQUE-keyed build side (reference:
-    src/batch/src/executor/join/hash_join.rs; the TPC-H q3/q10 shapes
-    join against a pk side). Build: scatter build columns into slot
-    arrays keyed by the join key. Probe: lookup + gather — both phases
-    are jitted device steps.
+    """One-shot hash join over every join shape (reference:
+    src/batch/src/executor/join/hash_join.rs — inner / left / right /
+    full outer / left semi / left anti).
 
-    Inner joins auto-pick the build side: the right side is built first
-    and, if its keys are not unique, the left side is tried (q3's
-    customer⋈orders builds on customer's pk). When NEITHER side is
-    unique, BatchFallback sends the query to the streaming join, which
-    handles arbitrary multiplicity."""
+    Two build layouts, both fully jitted device steps:
+
+    * **unique** (W=1): build columns scatter into [cap] slot arrays —
+      the TPC-H q3/q10 shape joining against a pk side; probe is a
+      gather.
+    * **bucketed** (W>1): duplicate-keyed build sides store up to W rows
+      per key in [cap·W] lanes; probes gather all W candidates and emit
+      an N·W expansion with a validity mask — the same dense-lane bet the
+      streaming join arena makes, amortized once for the whole query.
+      W starts small and the build retries at 8× on overflow before
+      giving up to the streaming fold (BatchFallback).
+
+    RIGHT joins run as probe-side-outer with the sides swapped; FULL
+    outer additionally tracks per-build-lane matched flags during the
+    probe and emits unmatched build rows in a tail pass."""
+
+    MAX_BUCKET_W = 512
 
     def __init__(self, left: BatchExecutor, right: BatchExecutor,
                  left_keys: Sequence[int], right_keys: Sequence[int],
@@ -249,7 +259,8 @@ class BatchHashJoin(BatchExecutor):
                  condition: Optional[Expr] = None,
                  table_capacity: int = 1 << 16,
                  prefer_build: str = "right"):
-        if join_type not in ("inner", "left"):
+        if join_type not in ("inner", "left", "right", "full",
+                             "left_semi", "left_anti"):
             raise BatchFallback(f"batch join type {join_type!r}")
         self.left, self.right = left, right
         self.left_keys = tuple(left_keys)
@@ -258,42 +269,74 @@ class BatchHashJoin(BatchExecutor):
         self.condition = condition
         self.capacity = table_capacity
         # plan-time hint (pk covers the join key ⇒ provably unique):
-        # avoids a wasted trial build; left joins always build right
-        self.prefer_build = (prefer_build if join_type == "inner"
-                             else "right")
-        self.schema = Schema(tuple(left.schema) + tuple(right.schema))
+        # avoids a wasted trial build; probe-side-outer shapes fix the
+        # build side (right joins build LEFT and probe right)
+        if join_type == "inner":
+            self.prefer_build = prefer_build
+        elif join_type == "right":
+            self.prefer_build = "left"
+        else:
+            self.prefer_build = "right"
+        if join_type in ("left_semi", "left_anti"):
+            self.schema = Schema(tuple(left.schema))
+        else:
+            self.schema = Schema(tuple(left.schema) + tuple(right.schema))
         self._eager = condition is not None and uses_host_callback(condition)
-        self._steps = {}    # swapped -> (build_step, probe_step)
+        self._steps = {}    # (swapped, W) -> (build_step, probe_step)
 
-    def _mk_steps(self, swapped: bool):
-        if swapped in self._steps:
-            return self._steps[swapped]
+    #: total build lanes (cap·W) held on device per trial build
+    LANE_BUDGET = 1 << 22
+
+    def _cap_for(self, W: int) -> int:
+        cap = min(self.capacity, max(1024, self.LANE_BUDGET // W))
+        # round down to a power of two (hash table requirement)
+        p = 1
+        while p * 2 <= cap:
+            p *= 2
+        return p
+
+    def _mk_steps(self, swapped: bool, W: int):
+        key = (swapped, W)
+        if key in self._steps:
+            return self._steps[key]
         build_keys = self.left_keys if swapped else self.right_keys
         probe_keys = self.right_keys if swapped else self.left_keys
-        cap = self.capacity
+        cap = self._cap_for(W)
         cond = self.condition
         join_type = self.join_type
+        probe_outer = join_type in ("left", "right", "full")
 
-        def _build_step(table, cols_acc, masks_acc, chunk):
+        def _build_step(table, counts, cols_acc, masks_acc, chunk):
             key_cols = [chunk.columns[i] for i in build_keys]
             # SQL semantics: NULL join keys never match (the streaming
             # join enforces the same) — null-keyed build rows are skipped
             keyed = chunk.vis
             for c in key_cols:
                 keyed = keyed & c.mask
-            table, slots, is_new, ovf = ht_lookup_or_insert(
+            table, slots, _is_new, ovf = ht_lookup_or_insert(
                 table, key_cols, keyed)
-            dup = jnp.any(keyed & ~is_new)
-            idx = jnp.where(keyed, slots, cap)
+            n = slots.shape[0]
+            # occurrence ordinal among this chunk's earlier same-key rows
+            # ([N,N] comparison — MXU-friendly dense form, one-shot cost)
+            same = ((slots[:, None] == slots[None, :])
+                    & keyed[:, None] & keyed[None, :])
+            lower = jnp.tril(jnp.ones((n, n), jnp.bool_), -1)
+            occ = jnp.sum(same & lower, axis=1).astype(jnp.int32)
+            pos = counts[jnp.clip(slots, 0, cap - 1)] + occ
+            lane_over = jnp.any(keyed & (pos >= W))
+            idx = jnp.where(keyed & (pos < W), slots * W + pos, cap * W)
             cols_acc = tuple(
                 acc.at[idx].set(c.data, mode="drop")
                 for acc, c in zip(cols_acc, chunk.columns))
             masks_acc = tuple(
                 acc.at[idx].set(c.mask, mode="drop")
                 for acc, c in zip(masks_acc, chunk.columns))
-            return table, cols_acc, masks_acc, dup | ovf
+            counts = counts.at[jnp.where(keyed, slots, cap)].add(
+                keyed.astype(jnp.int32), mode="drop")
+            return table, counts, cols_acc, masks_acc, lane_over | ovf
 
-        def _probe_step(table, cols_acc, masks_acc, chunk):
+        def _probe_step(table, counts, cols_acc, masks_acc, matched,
+                        chunk):
             key_cols = [chunk.columns[i] for i in probe_keys]
             keyed = chunk.vis
             for c in key_cols:
@@ -301,68 +344,162 @@ class BatchHashJoin(BatchExecutor):
             slots, found = ht_lookup(table, key_cols, keyed)
             found = found & keyed          # NULL probe keys never match
             safe = jnp.clip(slots, 0, cap - 1)
+            n = found.shape[0]
+            lanes = (safe[:, None] * W
+                     + jnp.arange(W, dtype=jnp.int32)[None, :])
+            flat = lanes.reshape(n * W)
+            cnt = counts[safe]
+            cand = ((jnp.arange(W, dtype=jnp.int32)[None, :] < cnt[:, None])
+                    & found[:, None]).reshape(n * W)
+            vis_rep = jnp.repeat(chunk.vis, W)
+            ops_rep = jnp.repeat(chunk.ops, W)
             bcols = tuple(
-                Column(acc[safe], m[safe] & found)
+                Column(acc[flat], m[flat] & cand)
                 for acc, m in zip(cols_acc, masks_acc))
-            # output columns in schema order (left ++ right) regardless
-            # of which side was built — the condition indexes into it
-            if swapped:
-                all_cols = bcols + tuple(chunk.columns)
-            else:
-                all_cols = tuple(chunk.columns) + bcols
-            out = StreamChunk(chunk.ops, chunk.vis, all_cols)
+            pcols = tuple(
+                Column(jnp.repeat(c.data, W), jnp.repeat(c.mask, W))
+                for c in chunk.columns)
+            # columns in schema order (left ++ right) regardless of the
+            # built side — the condition indexes into it
+            all_cols = (bcols + pcols) if swapped else (pcols + bcols)
+            wide = StreamChunk(ops_rep, vis_rep, all_cols)
             if cond is not None:
-                c = cond.eval(out)
-                match = found & c.data & c.mask
+                c = cond.eval(wide)
+                match = cand & c.data & c.mask
             else:
-                match = found
-            if join_type == "inner":
-                return out.with_vis(chunk.vis & match)
-            # left join (never swapped): unmatched probe rows keep NULL
-            # build columns
-            bcols = tuple(Column(c.data, c.mask & match) for c in bcols)
-            return StreamChunk(chunk.ops, chunk.vis,
-                               tuple(chunk.columns) + bcols)
+                match = cand
+            match = match & vis_rep
+            row_any = jnp.any(match.reshape(n, W), axis=1)
+            lane0 = (jnp.arange(n * W, dtype=jnp.int32) % W) == 0
+            midx = jnp.where(match, flat, cap * W)
+            matched = matched.at[midx].set(True, mode="drop")
+            if join_type in ("left_semi", "left_anti"):
+                keep = jnp.repeat(
+                    row_any if join_type == "left_semi" else
+                    ~row_any, W)
+                out = StreamChunk(ops_rep, vis_rep & lane0 & keep, pcols)
+            elif probe_outer:
+                # pad rows (no surviving candidate) must carry NULL build
+                # columns — masking with `cand` alone leaks values when a
+                # key matched but the non-equi condition rejected it
+                pad = lane0 & jnp.repeat(~row_any, W)
+                b_nulled = tuple(
+                    Column(c.data, c.mask & match) for c in bcols)
+                all2 = ((b_nulled + pcols) if swapped
+                        else (pcols + b_nulled))
+                out = StreamChunk(ops_rep, match | (vis_rep & pad), all2)
+            else:
+                out = wide.with_vis(match)
+            return out, matched
 
-        pair = ((_build_step, _probe_step) if self._eager
-                else (jax.jit(_build_step), jax.jit(_probe_step)))
-        self._steps[swapped] = pair
-        return pair
+        def _tail_step(counts, cols_acc, masks_acc, matched):
+            # FULL outer: occupied-but-unmatched build lanes with NULL
+            # probe columns
+            lane_no = jnp.arange(cap * W, dtype=jnp.int32) % W
+            occupied = lane_no < jnp.repeat(counts, W)
+            vis = occupied & ~matched
+            bcols = tuple(Column(acc, m & vis)
+                          for acc, m in zip(cols_acc, masks_acc))
+            return vis, bcols
 
-    def _try_build(self, side: BatchExecutor, swapped: bool):
+        trio = ((_build_step, _probe_step, _tail_step) if self._eager
+                else (jax.jit(_build_step), jax.jit(_probe_step),
+                      jax.jit(_tail_step)))
+        self._steps[key] = trio
+        return trio
+
+    def _try_build(self, side: BatchExecutor, swapped: bool, W: int,
+                   chunks: list):
         build_keys = self.left_keys if swapped else self.right_keys
         key_types = tuple(side.schema[i].type for i in build_keys)
-        build_step, _ = self._mk_steps(swapped)
-        table = ht_new(key_types, self.capacity)
+        build_step, _, _ = self._mk_steps(swapped, W)
+        cap = self._cap_for(W)
+        table = ht_new(key_types, cap)
+        counts = jnp.zeros(cap, jnp.int32)
         cols_acc = tuple(
-            jnp.zeros(self.capacity, f.type.dtype) for f in side.schema)
+            jnp.zeros(cap * W, f.type.dtype) for f in side.schema)
         masks_acc = tuple(
-            jnp.zeros(self.capacity, jnp.bool_) for _ in side.schema)
+            jnp.zeros(cap * W, jnp.bool_) for _ in side.schema)
         bad = jnp.zeros((), jnp.bool_)
-        for chunk in side.execute_chunks():
-            table, cols_acc, masks_acc, step_bad = build_step(
-                table, cols_acc, masks_acc, chunk)
+        for chunk in chunks:
+            table, counts, cols_acc, masks_acc, step_bad = build_step(
+                table, counts, cols_acc, masks_acc, chunk)
             bad = bad | step_bad
-        return (None if bool(bad) else (table, cols_acc, masks_acc))
+        if bool(bad):
+            return None
+        return table, counts, cols_acc, masks_acc
 
     def execute_chunks(self):
-        first_swapped = self.prefer_build == "left"
-        swapped = first_swapped
-        built = self._try_build(
-            self.left if first_swapped else self.right, swapped)
-        if built is None and self.join_type == "inner":
-            swapped = not first_swapped
-            built = self._try_build(
-                self.left if swapped else self.right, swapped)
+        swapped = self.prefer_build == "left"
+        build_side = self.left if swapped else self.right
+        build_chunks = list(build_side.execute_chunks())
+        built = None
+        W = 1
+        # W=1 is the unique fast path; duplicates escalate the bucket
+        # width (shrinking table capacity to hold the lane budget)
+        while built is None and W <= self.MAX_BUCKET_W:
+            built = self._try_build(build_side, swapped, W, build_chunks)
+            if built is None:
+                W *= 8
         if built is None:
             raise BatchFallback(
-                "batch hash join needs a unique-keyed build side within "
-                "capacity; falling back to the streaming join")
-        table, cols_acc, masks_acc = built
-        _, probe_step = self._mk_steps(swapped)
+                "batch hash join build side exceeds the bucket budget "
+                f"(> {self.MAX_BUCKET_W} rows per key or too many keys); "
+                "falling back to the streaming join")
+        table, counts, cols_acc, masks_acc = built
+        null_keyed = []
+        if self.join_type == "full":
+            # null-keyed build rows never match (skipped by the build),
+            # but FULL outer must still emit them with NULL probe columns
+            build_keys = self.left_keys if swapped else self.right_keys
+            probe_schema = (self.right.schema if swapped
+                            else self.left.schema)
+            for chunk in build_chunks:
+                unkeyed = chunk.vis
+                keyed = chunk.vis
+                for i in build_keys:
+                    keyed = keyed & chunk.columns[i].mask
+                unkeyed = unkeyed & ~keyed
+                if bool(jnp.any(unkeyed)):
+                    nulls = tuple(
+                        Column(jnp.zeros(chunk.capacity, f.type.dtype),
+                               jnp.zeros(chunk.capacity, jnp.bool_))
+                        for f in probe_schema)
+                    cols = ((tuple(chunk.columns) + nulls) if swapped
+                            else (nulls + tuple(chunk.columns)))
+                    null_keyed.append(
+                        StreamChunk(chunk.ops, unkeyed, cols))
+        del build_chunks          # scattered into cols_acc; free the copy
+        _, probe_step, tail_step = self._mk_steps(swapped, W)
+        cap = self._cap_for(W)
+        matched = jnp.zeros(cap * W, jnp.bool_)
         probe_side = self.right if swapped else self.left
         for chunk in probe_side.execute_chunks():
-            yield probe_step(table, cols_acc, masks_acc, chunk)
+            out, matched = probe_step(table, counts, cols_acc, masks_acc,
+                                      matched, chunk)
+            yield out
+        if self.join_type == "full":
+            yield from null_keyed
+            vis, bcols = tail_step(counts, cols_acc, masks_acc, matched)
+            # the NULL-padded side is the PROBE side
+            probe_schema = (self.right.schema if swapped
+                            else self.left.schema)
+            piece = 1 << 16
+            total = cap * W
+            for lo in range(0, total, piece):
+                hi = min(lo + piece, total)
+                pv = vis[lo:hi]
+                if not bool(jnp.any(pv)):
+                    continue
+                pb = tuple(Column(c.data[lo:hi], c.mask[lo:hi])
+                           for c in bcols)
+                nulls = tuple(
+                    Column(jnp.zeros(hi - lo, f.type.dtype),
+                           jnp.zeros(hi - lo, jnp.bool_))
+                    for f in probe_schema)
+                cols = (pb + nulls) if swapped else (nulls + pb)
+                yield StreamChunk(jnp.zeros(hi - lo, jnp.int8),
+                                  pv, cols)
 
 
 def _host_order_key(t):

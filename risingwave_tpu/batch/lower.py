@@ -123,7 +123,8 @@ def lower_plan(plan: P.PlanNode, store,
         return BatchHashAgg(inp, list(plan.group_keys),
                             list(plan.agg_calls))
     if isinstance(plan, P.PJoin):
-        if plan.kind not in ("inner", "left"):
+        if plan.kind not in ("inner", "left", "right", "full",
+                             "left_semi", "left_anti"):
             return None
         left = lower_plan(plan.left, store, catalog)
         right = lower_plan(plan.right, store, catalog)

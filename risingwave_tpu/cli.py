@@ -43,6 +43,11 @@ def main(argv=None) -> int:
     pg.add_argument("--workers", type=int, default=0,
                     help="worker PROCESSES hosting MV jobs (reference: "
                     "compute nodes; 0 = everything in-process)")
+    pg.add_argument("--user", default="root",
+                    help="user name for password auth (with --password)")
+    pg.add_argument("--password", default=None,
+                    help="enable md5 password authentication "
+                    "(default: trust, like the reference playground)")
 
     q = sub.add_parser("sql", help="run SQL statements and print results")
     q.add_argument("statement")
@@ -141,7 +146,9 @@ def _playground(args) -> int:
     session = _build_session(args)
 
     async def run():
-        server = PgWireServer(session, args.host, args.port)
+        auth = ({args.user: args.password}
+                if getattr(args, "password", None) else None)
+        server = PgWireServer(session, args.host, args.port, auth=auth)
         await server.start()
         print(f"risingwave_tpu playground listening on "
               f"{args.host}:{args.port}", flush=True)

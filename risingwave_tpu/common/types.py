@@ -34,6 +34,7 @@ class TypeKind(enum.Enum):
     BYTEA = "bytea"            # int32 dictionary id
     SERIAL = "serial"          # int64 row id (vnode-prefixed)
     LIST = "list"              # int32 list-dictionary id (value-interned)
+    JSONB = "jsonb"            # int32 dictionary id (canonical JSON text)
 
 
 _PHYSICAL: dict[TypeKind, Any] = {
@@ -52,6 +53,7 @@ _PHYSICAL: dict[TypeKind, Any] = {
     TypeKind.BYTEA: jnp.int32,
     TypeKind.SERIAL: jnp.int64,
     TypeKind.LIST: jnp.int32,
+    TypeKind.JSONB: jnp.int32,
 }
 
 _INTEGRAL = {
@@ -253,7 +255,11 @@ class DataType:
 
     @property
     def is_string(self) -> bool:
-        return self.kind in (TypeKind.VARCHAR, TypeKind.BYTEA)
+        # JSONB is dictionary-encoded canonical JSON text: it rides every
+        # varlen path (interning, content-addressed persistence, host
+        # functions) exactly like VARCHAR
+        return self.kind in (TypeKind.VARCHAR, TypeKind.BYTEA,
+                             TypeKind.JSONB)
 
     @property
     def is_list(self) -> bool:
@@ -320,6 +326,7 @@ INTERVAL = DataType(TypeKind.INTERVAL)
 VARCHAR = DataType(TypeKind.VARCHAR)
 BYTEA = DataType(TypeKind.BYTEA)
 SERIAL = DataType(TypeKind.SERIAL)
+JSONB = DataType(TypeKind.JSONB)
 
 
 def decimal(scale: int = 2) -> DataType:

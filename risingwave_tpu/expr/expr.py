@@ -801,6 +801,89 @@ _register_host_fn("array_access", (), _array_access,
                   lambda ts: ts[0].elem_type)
 
 
+# JSONB operators (reference: src/expr/src/vector_op/jsonb_access.rs).
+# JSONB values are dictionary ids of canonical JSON text; access parses
+# per UNIQUE id (dictionary-sized work), results re-canonicalized.
+
+import json as _json
+
+
+@_functools.lru_cache(maxsize=4096)
+def _jsonb_parse(s: str):
+    try:
+        return _json.loads(s) if s else None
+    except ValueError:
+        return None
+
+
+def _jsonb_canon(v) -> str:
+    return _json.dumps(v, separators=(",", ":"), sort_keys=True)
+
+
+_MISSING = object()    # distinguishes an ABSENT key from a JSON null value
+
+
+def _jsonb_get(j, key):
+    if isinstance(j, dict):
+        return j.get(key, _MISSING) if isinstance(key, str) else _MISSING
+    if isinstance(j, list) and isinstance(key, int):
+        return j[key] if -len(j) <= key < len(j) else _MISSING
+    return _MISSING
+
+
+def _jsonb_access(s: str, key, as_text: bool):
+    v = _jsonb_get(_jsonb_parse(s), key)
+    if v is _MISSING:
+        return None                 # absent key → SQL NULL
+    if as_text:
+        # ->> maps a present JSON null to SQL NULL (PG semantics)
+        if v is None:
+            return None
+        return v if isinstance(v, str) else _jsonb_canon(v)
+    return _jsonb_canon(v)          # -> on a null value yields jsonb 'null'
+
+
+def _register_jsonb(name, key_is_str, as_text, out_infer):
+    str_args = (0, 1) if key_is_str else (0,)
+    _register_host_fn(
+        name, str_args,
+        lambda s, k: _jsonb_access(s, k, as_text), out_infer)
+
+
+def _t_jsonb(ts):
+    from ..common.types import JSONB as _J
+    return _J
+
+
+_register_jsonb("jsonb_get_field", True, False, _t_jsonb)
+_register_jsonb("jsonb_get_elem", False, False, _t_jsonb)
+_register_jsonb("jsonb_get_field_text", True, True, lambda ts: T.VARCHAR)
+_register_jsonb("jsonb_get_elem_text", False, True, lambda ts: T.VARCHAR)
+
+
+def _jsonb_typeof(s: str):
+    v = _jsonb_parse(s)
+    if s == "null":
+        return "null"
+    if v is None:
+        return None
+    return {dict: "object", list: "array", str: "string", bool: "boolean",
+            int: "number", float: "number"}.get(type(v))
+
+
+_register_host_fn("jsonb_typeof", (0,), _jsonb_typeof,
+                  lambda ts: T.VARCHAR)
+
+
+def _jsonb_array_length(s: str):
+    v = _jsonb_parse(s)
+    return len(v) if isinstance(v, list) else None
+
+
+_register_host_fn("jsonb_array_length", (0,), _jsonb_array_length,
+                  _t_int64)
+
+
 @register("array_length", _t_int64)
 def _array_length(datas, masks, out_type):
     import numpy as np
@@ -957,7 +1040,9 @@ HOST_CALLBACK_FNS = {
     "length", "concat_op", "like", "not_like",
     "regexp_like", "regexp_count", "regexp_replace", "regexp_match",
     "regexp_match_group", "split_part", "to_char", "array_access",
-    "array_length",
+    "array_length", "jsonb_get_field", "jsonb_get_elem",
+    "jsonb_get_field_text", "jsonb_get_elem_text", "jsonb_typeof",
+    "jsonb_array_length",
     # not host callbacks, but must run eagerly: they read the live rank table
     "str_rank", "str_less_than", "str_less_than_or_equal",
     "str_greater_than", "str_greater_than_or_equal",

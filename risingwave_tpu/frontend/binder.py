@@ -275,6 +275,23 @@ class ExprBinder:
         raise BindError(f"cannot bind literal {v!r}")
 
     def _binop(self, node: A.BinaryOp) -> Expr:
+        if node.op in ("->", "->>"):
+            left = self.bind(node.left)
+            right = self.bind(node.right)
+            if left.type.kind != TypeKind.JSONB:
+                raise BindError(
+                    f"{node.op} requires a jsonb left operand; got "
+                    f"{left.type.kind.value}")
+            text = node.op == "->>"
+            if right.type.kind == TypeKind.VARCHAR:
+                fn = "jsonb_get_field_text" if text else "jsonb_get_field"
+            elif right.type.is_integral:
+                fn = "jsonb_get_elem_text" if text else "jsonb_get_elem"
+            else:
+                # is_string also covers JSONB/BYTEA — their serialized
+                # text silently used as a key would mask a type error
+                raise BindError(f"{node.op} key must be text or integer")
+            return call(fn, left, right)
         fn = _BINOP_FN.get(node.op)
         if fn is None:
             raise BindError(f"unsupported operator {node.op}")

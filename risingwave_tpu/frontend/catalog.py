@@ -13,7 +13,7 @@ import itertools
 from typing import Optional
 
 from ..common.types import (
-    BOOL, DATE, FLOAT32, FLOAT64, INT16, INT32, INT64, INTERVAL,
+    BOOL, DATE, FLOAT32, FLOAT64, INT16, INT32, INT64, INTERVAL, JSONB,
     TIME, TIMESTAMP, VARCHAR, DataType, Field, Schema, decimal,
 )
 
@@ -30,6 +30,7 @@ _TYPE_NAMES: dict[str, DataType] = {
     "interval": INTERVAL,
     "varchar": VARCHAR, "text": VARCHAR, "string": VARCHAR,
     "serial": INT64,
+    "jsonb": JSONB, "json": JSONB,
 }
 
 

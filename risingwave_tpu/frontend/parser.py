@@ -21,7 +21,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+\.\d+|\.\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
   | (?P<name>[A-Za-z_][A-Za-z_0-9$]*)
-  | (?P<op><>|!=|<=|>=|\|\||::|[-+*/%(),.<>=;\[\]])
+  | (?P<op>->>|->|<>|!=|<=|>=|\|\||::|[-+*/%(),.<>=;\[\]])
 """, re.VERBOSE)
 
 KEYWORDS = {
@@ -639,6 +639,9 @@ class Parser:
                 idx = self.parse_expr()
                 self.expect_op("]")
                 e = A.Subscript(e, idx)
+            elif self.at_op("->", "->>"):
+                op = self.next().value
+                e = A.BinaryOp(op, e, self._primary_expr())
             else:
                 return e
 

@@ -183,13 +183,55 @@ def _fmt_value(v, t: Optional[DataType]) -> str:
 
 class PgWireServer:
     def __init__(self, session: Session, host: str = "127.0.0.1",
-                 port: int = 4566):
+                 port: int = 4566, auth: Optional[dict] = None,
+                 auth_method: str = "md5"):
+        """``auth``: user → password map enabling password authentication
+        (reference: pg_protocol.rs:220-259 startup auth; SCRAM/TLS are
+        not implemented — md5 and cleartext cover psql/psycopg2/JDBC
+        defaults). ``auth=None`` = trust (playground default)."""
         self.session = session
         self.host = host
         self.port = port
+        if auth_method not in ("md5", "cleartext"):
+            raise ValueError(f"unknown auth method {auth_method!r}")
+        self.auth = dict(auth) if auth else None
+        self.auth_method = auth_method
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()      # live client writers (forced closed)
         # one worker thread: the Session is single-threaded by design
         self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    async def _authenticate(self, reader, writer, user: str) -> bool:
+        import hashlib
+        import os as _os
+        expected = self.auth.get(user)
+        if self.auth_method == "md5":
+            salt = _os.urandom(4)
+            writer.write(_msg(b"R", struct.pack("!I", 5) + salt))
+        else:
+            writer.write(_msg(b"R", struct.pack("!I", 3)))
+        await writer.drain()
+        tag = await reader.readexactly(1)
+        ln = struct.unpack("!I", await reader.readexactly(4))[0]
+        body = await reader.readexactly(ln - 4)
+        if tag != b"p":
+            return False
+        supplied = body.rstrip(b"\x00").decode("utf-8", "replace")
+        if expected is None:
+            ok = False          # unknown user: burn the exchange anyway
+        elif self.auth_method == "md5":
+            inner = hashlib.md5(
+                (expected + user).encode()).hexdigest().encode()
+            want = "md5" + hashlib.md5(inner + salt).hexdigest()
+            ok = supplied == want
+        else:
+            ok = supplied == expected
+        if not ok:
+            self._send_error(
+                writer, f'password authentication failed for user "{user}"')
+            await writer.drain()
+            return False
+        return True
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -204,6 +246,14 @@ class PgWireServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # 3.12 wait_closed() waits for connection HANDLERS too — a
+            # client that never disconnects would hang shutdown, so force
+            # the remaining transports closed first
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception:
+                    pass
             await self._server.wait_closed()
         self._executor.shutdown(wait=False)
 
@@ -215,6 +265,7 @@ class PgWireServer:
         stmts: dict[str, tuple[str, list]] = {}     # name -> (sql, oids)
         portals: dict[str, tuple[str, Optional[list]]] = {}  # -> (sql, schema)
         skip_until_sync = False
+        self._conns.add(writer)
         try:
             if not await self._startup(reader, writer):
                 return
@@ -260,6 +311,7 @@ class PgWireServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     # -- extended-query flow ---------------------------------------------------
@@ -417,7 +469,19 @@ class PgWireServer:
             if code == 80877102:         # CancelRequest
                 return False
             break                         # StartupMessage
-        # trust auth (reference playground default)
+        # startup parameters: null-separated key/value pairs
+        params = {}
+        parts = body[4:].split(b"\x00")
+        for i in range(0, len(parts) - 1, 2):
+            if parts[i]:
+                params[parts[i].decode("utf-8", "replace")] = \
+                    parts[i + 1].decode("utf-8", "replace")
+        if self.auth:
+            ok = await self._authenticate(reader, writer,
+                                          params.get("user", ""))
+            if not ok:
+                return False
+        # else trust auth (reference playground default)
         writer.write(_msg(b"R", struct.pack("!I", 0)))       # AuthenticationOk
         for k, v in (("server_version", "13.0"),
                      ("server_encoding", "UTF8"),

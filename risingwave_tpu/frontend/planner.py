@@ -985,8 +985,13 @@ class Planner:
         if not isinstance(b, InputRef):
             raise PlanError("IN (SELECT …) operand must be a plain column")
         sub = self.plan_select(conj.query)
-        if len(sub.schema) != 1:
+        n_visible = sum(1 for f in sub.schema
+                        if not f.name.startswith("_"))
+        if n_visible != 1 or not sub.schema[0].name or \
+                sub.schema[0].name.startswith("_"):
             raise PlanError("IN subquery must produce exactly one column")
+        # hidden stream-key columns (appended by the planner) ride along
+        # as the semi-join state's pk; only column 0 joins
         kind = "left_anti" if conj.negated else "left_semi"
         return PJoin(schema=node.schema, pk=node.pk, left=node, right=sub,
                      kind=kind, left_keys=(b.index,), right_keys=(0,),

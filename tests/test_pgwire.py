@@ -264,3 +264,82 @@ class TestExtendedQuery:
                     stmt="s1")
                 assert err is None and rows == [(want,)]
         asyncio.run(_with_server(go))
+
+
+class TestAuth:
+    """Password authentication on startup (reference:
+    pg_protocol.rs:220-259; md5 = psql/psycopg2's default non-TLS flow)."""
+
+    @staticmethod
+    async def _connect_auth(host, port, user, password, method):
+        import hashlib
+        reader, writer = await asyncio.open_connection(host, port)
+        c = MiniPgClient(reader, writer)
+        params = f"user\x00{user}\x00database\x00dev\x00\x00".encode()
+        body = struct.pack("!I", 196608) + params
+        writer.write(struct.pack("!I", len(body) + 4) + body)
+        await writer.drain()
+        tag, payload = await c.read_msg()
+        assert tag == b"R"
+        (code,) = struct.unpack("!I", payload[:4])
+        if code == 5 and method == "md5":
+            salt = payload[4:8]
+            inner = hashlib.md5(
+                (password + user).encode()).hexdigest().encode()
+            pw = "md5" + hashlib.md5(inner + salt).hexdigest()
+        elif code == 3:
+            pw = password
+        else:
+            raise AssertionError(f"unexpected auth code {code}")
+        body = pw.encode() + b"\x00"
+        writer.write(b"p" + struct.pack("!I", len(body) + 4) + body)
+        await writer.drain()
+        while True:
+            tag, payload = await c.read_msg()
+            if tag == b"E":
+                return None
+            if tag == b"Z":
+                return c
+
+    def _with_auth_server(self, fn, method="md5"):
+        async def run():
+            session = Session()
+            server = PgWireServer(session, "127.0.0.1", 0,
+                                  auth={"ada": "s3cret"},
+                                  auth_method=method)
+            await server.start()
+            port = server._server.sockets[0].getsockname()[1]
+            try:
+                return await fn(port)
+            finally:
+                await server.close()
+        return asyncio.run(run())
+
+    def test_md5_auth_success_and_query(self):
+        async def go(port):
+            c = await self._connect_auth(
+                "127.0.0.1", port, "ada", "s3cret", "md5")
+            assert c is not None
+            try:
+                cols, rows, err = await c.query("SELECT 1 + 1")
+                assert err is None and rows == [("2",)]
+            finally:
+                c.close()
+        self._with_auth_server(go)
+
+    def test_md5_auth_wrong_password_rejected(self):
+        async def go(port):
+            c = await self._connect_auth(
+                "127.0.0.1", port, "ada", "wrong", "md5")
+            assert c is None
+        self._with_auth_server(go)
+
+    def test_cleartext_auth(self):
+        async def go(port):
+            ok = await self._connect_auth(
+                "127.0.0.1", port, "ada", "s3cret", "cleartext")
+            assert ok is not None
+            bad = await self._connect_auth(
+                "127.0.0.1", port, "nobody", "s3cret", "cleartext")
+            assert bad is None
+        self._with_auth_server(go, method="cleartext")
