@@ -26,14 +26,23 @@ from .frontend.session import Session
 
 class SimCluster:
     def __init__(self, data_dir: str, seed: int = 0, kill_rate: float = 0.3,
-                 checkpoint_frequency: int = 2, **session_kw):
+                 checkpoint_frequency: int = 2, workers: int = 0,
+                 **session_kw):
+        """``workers`` > 0 runs MV jobs on worker PROCESSES and arms
+        per-component kills: the chaos step randomly SIGKILLs one worker
+        (scoped heartbeat-TTL recovery) instead of always restarting the
+        whole cluster — the madsim individual-node kill
+        (reference: cluster.rs:498-510)."""
         self.data_dir = data_dir
         self.rng = random.Random(seed)
         self.kill_rate = kill_rate
         self.session_kw = dict(session_kw,
                                checkpoint_frequency=checkpoint_frequency)
+        if workers:
+            self.session_kw["workers"] = workers
         self.session = Session(data_dir=data_dir, **self.session_kw)
         self.kills = 0
+        self.worker_kills = 0
         self._unacked: List[str] = []     # DML since the last FLUSH
 
     # -- client API -----------------------------------------------------------
@@ -60,18 +69,41 @@ class SimCluster:
     # -- chaos ----------------------------------------------------------------
 
     def maybe_kill(self) -> bool:
-        if self.rng.random() < self.kill_rate:
+        if self.rng.random() >= self.kill_rate:
+            return False
+        if getattr(self.session, "workers", None) and \
+                self.rng.random() < 0.5:
+            self.kill_worker()
+        else:
             self.kill()
-            return True
-        return False
+        return True
+
+    def kill_worker(self) -> None:
+        """SIGKILL one worker process (per-component failure): the
+        session survives; the heartbeat TTL declares the worker's jobs
+        dead and scoped recovery respawns it on subsequent ticks."""
+        w = self.rng.choice(self.session.workers)
+        w.kill9()
+        self.worker_kills += 1
+        for _ in range(12):               # TTL + respawn happen in-tick
+            self.session.tick()
+            if not w.dead:
+                return
+        raise AssertionError("killed worker was not recovered")
 
     def kill(self) -> None:
         """Abandon the session with no shutdown (uncommitted state and
         unacked DML are lost), then recover + re-apply unacked DML."""
         self.kills += 1
-        # crash semantics: no job shutdown, no flush — but do close the
-        # abandoned private event loop so kills don't leak loops
+        # crash semantics: no job shutdown, no flush — but kill the old
+        # worker PROCESSES (their parent is gone, like a machine reboot)
+        # and close the abandoned private event loop so kills don't leak
         old = self.session
+        for w in getattr(old, "workers", []) or []:
+            try:
+                w.kill9()
+            except Exception:   # noqa: BLE001
+                pass
         try:
             old.loop.close()
         except Exception:   # noqa: BLE001
