@@ -262,14 +262,15 @@ class JoinCore:
             matches = matches & self._eval_condition(chunk, b_datas, b_masks, side)
         c_cnt = jnp.sum(matches, axis=1).astype(jnp.int32)             # [N]
 
-        # ---- rank/total of same-key rows within this pass (MXU matmuls):
+        # ---- rank/total of same-key rows within this pass:
         # r[i,w] = |{j<i: key_j == key_i, (j,w) matches}|, t = same over all j.
+        # On TPU the fused Pallas kernel generates the [N,N] equality
+        # tiles in VMEM and feeds the MXU directly (ops/pallas_rank.py);
+        # elsewhere the jnp matmul formulation runs. RWTPU_PALLAS=0/1
+        # overrides the choice.
+        from .pallas_rank import rank_totals
         ident = jnp.where(b_found, b_slot, -1)
-        eqf = (ident[:, None] == ident[None, :]) & (ident >= 0)[:, None]
-        lower = eqf & (idx[None, :] < idx[:, None])
-        mf = matches.astype(jnp.float32)
-        r = jnp.round(lower.astype(jnp.float32) @ mf).astype(jnp.int32)
-        t = jnp.round(eqf.astype(jnp.float32) @ mf).astype(jnp.int32)
+        r, t = rank_totals(ident, matches)
         d0 = B.degree[bs]                                              # [N, W]
 
         # ---- opposite-side degree maintenance (reference join/mod.rs degrees)

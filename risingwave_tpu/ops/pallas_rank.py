@@ -1,0 +1,140 @@
+"""Pallas TPU kernel: fused same-key rank/total accumulation for the
+streaming join probe.
+
+The join's chunk pass needs, per probe row i (reference semantics:
+eq_join_oneside's per-row match bookkeeping, hash_join.rs:972 — here
+vectorized over the whole chunk):
+
+    r[i, w] = |{ j < i : ident[j] == ident[i], matches[j, w] }|
+    t[i, w] = |{ j     : ident[j] == ident[i], matches[j, w] }|
+
+The jnp formulation (ops/join_state.py) builds ``eqf``/``lower`` as
+[N, N] float32 matrices in HBM and runs two [N,N]·[N,W] matmuls — at the
+bench shapes (N=4096, W=128) that is 2×64 MB of HBM traffic per chunk
+pass just for the masks. This kernel fuses mask GENERATION into the
+matmul: the [TI, TJ] equality tile is computed in VMEM from two [T]
+slices of ``ident`` and fed straight to the MXU, so the [N, N] matrices
+never exist in memory (SURVEY.md §7 stage 3: "hash probe … rank/degree
+updates" is the named Pallas target).
+
+Grid: (N/TI, N/TJ); j is the reduction dimension — TPU grid cells run
+sequentially, so the output tile accumulates across the j sweep
+(initialized at j == 0). Both outputs ride the same equality tile.
+
+``rank_totals`` picks the implementation: the Pallas kernel on TPU (or
+when RWTPU_PALLAS=1 forces it, e.g. interpret mode in tests), the jnp
+matmul formulation elsewhere. Both produce bit-identical int32 results —
+``tests/test_pallas_kernels.py`` asserts parity.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+TILE_I = 256
+TILE_J = 256
+
+
+def rank_totals_jnp(ident: jax.Array, matches: jax.Array):
+    """Reference jnp formulation (the pre-kernel code path)."""
+    n = ident.shape[0]
+    idx = jnp.arange(n)
+    eqf = (ident[:, None] == ident[None, :]) & (ident >= 0)[:, None]
+    lower = eqf & (idx[None, :] < idx[:, None])
+    mf = matches.astype(jnp.float32)
+    r = jnp.round(lower.astype(jnp.float32) @ mf).astype(jnp.int32)
+    t = jnp.round(eqf.astype(jnp.float32) @ mf).astype(jnp.int32)
+    return r, t
+
+
+def _kernel(ident_i_ref, ident_j_ref, m_ref, r_ref, t_ref):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        r_ref[:] = jnp.zeros_like(r_ref)
+        t_ref[:] = jnp.zeros_like(t_ref)
+
+    ti = ident_i_ref.shape[0]
+    tj = ident_j_ref.shape[0]
+    i0 = pl.program_id(0) * ti
+    j0 = j * tj
+    ident_i = ident_i_ref[:]
+    ident_j = ident_j_ref[:]
+    # the [TI, TJ] equality tile, generated in VMEM — never materialized
+    # at [N, N]
+    eq = (ident_i[:, None] == ident_j[None, :]) & (ident_i >= 0)[:, None]
+    row_i = i0 + jax.lax.broadcasted_iota(jnp.int32, (ti, tj), 0)
+    col_j = j0 + jax.lax.broadcasted_iota(jnp.int32, (ti, tj), 1)
+    lower = eq & (col_j < row_i)
+    mf = m_ref[:].astype(jnp.float32)
+    r_ref[:] += jnp.dot(
+        lower.astype(jnp.float32), mf,
+        preferred_element_type=jnp.float32)
+    t_ref[:] += jnp.dot(
+        eq.astype(jnp.float32), mf,
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rank_totals_pallas(ident: jax.Array, matches: jax.Array,
+                       interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    n, w = matches.shape
+    ti = min(TILE_I, n)
+    tj = min(TILE_J, n)
+    if (n % ti or n % tj
+            or (not interpret and jax.default_backend() != "tpu")):
+        # ragged capacities, or a backend with no Pallas lowering, fall
+        # back to the jnp formulation (identical results)
+        return rank_totals_jnp(ident, matches)
+    grid = (n // ti, n // tj)
+    r, t = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ti,), lambda i, j: (i,)),
+            pl.BlockSpec((tj,), lambda i, j: (j,)),
+            pl.BlockSpec((tj, w), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, w), lambda i, j: (i, 0)),
+            pl.BlockSpec((ti, w), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, w), jnp.float32),
+            jax.ShapeDtypeStruct((n, w), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ident, ident, matches)
+    return (jnp.round(r).astype(jnp.int32),
+            jnp.round(t).astype(jnp.int32))
+
+
+def _use_pallas() -> bool:
+    mode = os.environ.get("RWTPU_PALLAS", "auto").lower()
+    if mode in ("1", "on", "true"):
+        return True
+    if mode in ("0", "off", "false"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:   # noqa: BLE001 — backend probe must never break eval
+        return False
+
+
+def rank_totals(ident: jax.Array, matches: jax.Array):
+    """r[i,w], t[i,w] as int32 — kernel on TPU, jnp elsewhere.
+    RWTPU_PALLAS=0 forces the jnp path (escape hatch if a backend
+    rejects the kernel); =1 forces Pallas (interpret on CPU)."""
+    if _use_pallas():
+        interpret = jax.default_backend() != "tpu"
+        return rank_totals_pallas(ident, matches, interpret=interpret)
+    return rank_totals_jnp(ident, matches)
