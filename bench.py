@@ -354,8 +354,12 @@ def measure_tpu() -> tuple:
     for attempt in range(TPU_ATTEMPTS):
         env = {} if attempt == 0 else {"RWTPU_PALLAS": "0"}
         try:
-            return _spawn_phase(env, N_CHUNKS, Q7_N_CHUNKS,
-                                with_latency=True), None
+            res = _spawn_phase(env, N_CHUNKS, Q7_N_CHUNKS,
+                               with_latency=True)
+            # attribution: which code path produced the number
+            res["rank_kernel"] = ("pallas" if attempt == 0
+                                  else "jnp_fallback")
+            return res, None
         except Exception as e:
             last_err = f"attempt {attempt + 1}/{TPU_ATTEMPTS}: {e}"
             sys.stderr.write(f"bench: tpu {last_err}\n")
@@ -402,6 +406,7 @@ def main() -> int:
         "p99_barrier_ms": tpu.get("p99_barrier_ms"),
         "p50_barrier_ms": tpu.get("p50_barrier_ms"),
         "p99_barrier_ms_inflight4": tpu.get("p99_barrier_ms_inflight4"),
+        "rank_kernel": tpu.get("rank_kernel"),
     })
     return 0
 

@@ -113,6 +113,22 @@ class RowSeqScan(BatchExecutor):
         return chunk.with_vis(chunk.vis & sel[vn])
 
 
+class BatchRows(BatchExecutor):
+    """Physical rows from a provider callable — the session-side face of
+    a remote batch stage (the provider runs the worker task)."""
+
+    def __init__(self, schema: Schema, provider, batch_size: int = 4096):
+        self.schema = schema
+        self.provider = provider
+        self.batch_size = batch_size
+
+    def execute_chunks(self):
+        rows = self.provider()
+        for i in range(0, len(rows), self.batch_size):
+            part = rows[i:i + self.batch_size]
+            yield physical_chunk(self.schema, part, max(len(part), 1))
+
+
 class BatchFilter(_SingleInput):
     def __init__(self, input: BatchExecutor, predicate: Expr):
         super().__init__(input)

@@ -100,6 +100,9 @@ def lower_plan(plan: P.PlanNode, store,
         d = plan.table if isinstance(plan, P.PTableScan) else plan.mv
         return RowSeqScan(StateTable(store, d.table_id, d.schema,
                                      list(d.pk)))
+    if isinstance(plan, P.PRemoteFragment):
+        from .executors import BatchRows
+        return BatchRows(plan.schema, plan.fetch)
     if isinstance(plan, P.PProject):
         inp = lower_plan(plan.input, store, catalog)
         if inp is None:

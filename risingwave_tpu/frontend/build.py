@@ -134,7 +134,8 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
 
 def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
     cfg = ctx.config
-    if isinstance(plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)):
+    if isinstance(plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues,
+                         P.PRemoteFragment)):
         return ctx.source_factory(plan)
 
     if isinstance(plan, P.PProject):
@@ -355,7 +356,8 @@ def collect_leaves(plan: P.PlanNode) -> list:
     """All leaf nodes (sources/scans/values) in plan order."""
     if not plan.children:
         return [plan] if isinstance(
-            plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues)) else []
+            plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues,
+                   P.PRemoteFragment)) else []
     out = []
     for c in plan.children:
         out.extend(collect_leaves(c))

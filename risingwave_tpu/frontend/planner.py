@@ -18,7 +18,7 @@ src/frontend/src/optimizer/rule/).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 from ..common.types import Field, Schema, TIMESTAMP
 from ..expr.agg import AggCall
@@ -76,6 +76,25 @@ class PTableScan(PlanNode):
 @dataclasses.dataclass
 class PMvScan(PlanNode):
     mv: MaterializedViewDef
+
+
+@dataclasses.dataclass
+class PRemoteFragment(PlanNode):
+    """A batch stage shipped to the worker PROCESS hosting its state; the
+    session sees only the stage's output rows (reference: distributed
+    batch stages over compute nodes,
+    src/frontend/src/scheduler/distributed/query.rs:69,115).
+    ``fetch()`` runs the remote task and returns physical rows."""
+
+    job: str = ""
+    fetch: Any = None                # () -> list[physical row tuples]
+
+    @property
+    def children(self):
+        return ()
+
+    def _describe(self):
+        return f"RemoteFragment {{job={self.job}}}"
 
 
 @dataclasses.dataclass

@@ -1779,6 +1779,61 @@ class Session:
                     if not f.name.startswith("_")]
         return None
 
+    def _push_remote_fragments(self, plan):
+        """Cut maximal Filter/Project chains over worker-hosted MV scans
+        into PRemoteFragment stages: the scan+filter+project runs ON the
+        worker owning the state and only result rows cross the socket
+        (reference: distributed batch stages,
+        scheduler/distributed/query.rs:69,115)."""
+        from .planner import (
+            PFilter as _PF, PProject as _PP, PRemoteFragment,
+        )
+
+        def chain_base(node):
+            cur = node
+            while isinstance(cur, (_PF, _PP)):
+                cur = cur.input
+            return cur
+
+        def make_fragment(node):
+            base = chain_base(node)
+            name = base.mv.name
+            spec = self._remote_specs[name]
+            from .plan_json import defs_to_json, plan_to_json
+            plan_json = plan_to_json(node)
+            defs_json = defs_to_json([base.mv])
+            worker = spec["worker"]
+            types = [f.type for f in node.schema]
+
+            def fetch():
+                import base64 as _b64
+
+                from ..common.row import decode_value_row
+                resp = self._await(worker.request(
+                    {"type": "batch_task", "job": name,
+                     "plan": plan_json, "defs": defs_json}))
+                return [decode_value_row(_b64.b64decode(b), types)
+                        for b in resp["rows"]]
+
+            return PRemoteFragment(schema=node.schema, pk=node.pk,
+                                   job=name, fetch=fetch)
+
+        def rewrite(node):
+            base = chain_base(node)
+            if (isinstance(base, PMvScan)
+                    and base.mv.name in self._remote_specs):
+                return make_fragment(node)
+            kids = list(node.children)
+            if not kids:
+                return node
+            new_kids = [rewrite(k) for k in kids]
+            if all(a is b for a, b in zip(new_kids, kids)):
+                return node
+            from .optimizer import _with_children
+            return _with_children(node, new_kids)
+
+        return rewrite(plan)
+
     def query(self, sel: A.Select) -> list:
         """Batch SELECT: run the stream plan over snapshot sources."""
         self._drain_inflight()   # read-your-writes snapshot
@@ -1793,12 +1848,15 @@ class Session:
         # stream-fold below
         from ..batch.executors import BatchFallback, run_batch
         from ..batch.lower import lower_plan
+        if self._remote_specs:
+            plan = self._push_remote_fragments(plan)
         remote_mvs = {l.mv.name for l in collect_leaves(plan)
                       if isinstance(l, PMvScan)
                       and l.mv.name in self._remote_specs}
         try:
             # a remote MV's rows live in the worker's store, not ours —
             # the local-scan fast path would silently read empty tables
+            # (fragment pushdown above converts the common shapes)
             lowered = None if remote_mvs else lower_plan(
                 plan, self.store, catalog=self.catalog)
         except BatchFallback:
@@ -1820,12 +1878,17 @@ class Session:
                 return self._present(out, sel, plan)
 
         def factory(leaf) -> Executor:
-            if isinstance(leaf, (PTableScan, PMvScan)):
+            from .planner import PRemoteFragment
+            if isinstance(leaf, (PTableScan, PMvScan, PRemoteFragment)):
                 if isinstance(leaf, PTableScan):
                     tid, schema = leaf.table.table_id, leaf.table.schema
-                else:
+                elif isinstance(leaf, PMvScan):
                     tid, schema = leaf.mv.table_id, leaf.mv.schema
-                if (isinstance(leaf, PMvScan)
+                else:
+                    schema = leaf.schema
+                if isinstance(leaf, PRemoteFragment):
+                    rows = leaf.fetch()       # stage ran on the worker
+                elif (isinstance(leaf, PMvScan)
                         and leaf.mv.name in self._remote_specs):
                     rows = self._remote_scan(leaf.mv.name, schema,
                                              physical=True)

@@ -178,6 +178,17 @@ class WorkerHost:
         import os
         return os.path.join(self.data_dir, "jobs", name)
 
+    def _register_defs(self, defs_json: str) -> None:
+        """Upsert the session's shipped catalog replicas (shared by job
+        creation and batch tasks so the two cannot resolve different
+        catalogs)."""
+        for d in defs_from_json(defs_json):
+            kind = type(d).__name__
+            reg = {"SourceDef": self.catalog.sources,
+                   "TableDef": self.catalog.tables,
+                   "MaterializedViewDef": self.catalog.mvs}[kind]
+            reg[d.name] = d
+
     async def handle_create_job(self, req: dict) -> dict:
         name = req["name"]
         if req.get("fresh"):
@@ -191,12 +202,7 @@ class WorkerHost:
         if store is None:
             store = DurableStateStore(self._job_dir(name))
             self.stores[name] = store
-        for d in defs_from_json(req["defs"]):
-            kind = type(d).__name__
-            reg = {"SourceDef": self.catalog.sources,
-                   "TableDef": self.catalog.tables,
-                   "MaterializedViewDef": self.catalog.mvs}[kind]
-            reg[d.name] = d                      # replica upsert
+        self._register_defs(req["defs"])
         self.chunks_per_tick = req.get("chunks_per_tick", 1)
         self.chunk_capacity = req.get("chunk_capacity", 1024)
         self.seed = req.get("seed", 42)
@@ -334,6 +340,32 @@ class WorkerHost:
         await self.send({"type": "barrier_complete", "epoch": epoch,
                          "init": bool(req.get("init", False))})
 
+    # -- distributed batch stage ----------------------------------------------
+
+    def handle_batch_task(self, req: dict) -> dict:
+        """Execute a batch plan FRAGMENT against this worker's job store
+        and return its result rows — the distributed batch stage
+        (reference: per-stage task execution on compute nodes,
+        src/frontend/src/scheduler/distributed/query.rs:69,115 +
+        BatchManager::fire_task, task_manager.rs:93). Only the stage's
+        OUTPUT crosses the wire, not the scanned state."""
+        from ..batch.executors import run_batch
+        from ..batch.lower import lower_plan
+        name = req["job"]
+        store = self.stores.get(name)
+        if store is None:
+            return {"ok": False, "error": f"job {name!r} has no store"}
+        self._register_defs(req["defs"])
+        plan = plan_from_json(req["plan"], self.catalog)
+        ex = lower_plan(plan, store)
+        if ex is None:
+            return {"ok": False,
+                    "error": "stage plan is not batch-lowerable"}
+        types = [f.type for f in plan.schema]
+        rows = [base64.b64encode(encode_value_row(r, types)).decode()
+                for r in run_batch(ex)]
+        return {"ok": True, "rows": rows}
+
     # -- scan ------------------------------------------------------------------
 
     def handle_scan(self, req: dict) -> dict:
@@ -392,6 +424,10 @@ class WorkerHost:
                     async def _scan(f):
                         return self.handle_scan(f)
                     await self._reply(frame, _scan)
+                elif t == "batch_task":
+                    async def _bt(f):
+                        return self.handle_batch_task(f)
+                    await self._reply(frame, _bt)
                 elif t == "shutdown":
                     await self.send({"type": "reply", "rid": frame["rid"],
                                      "ok": True})
