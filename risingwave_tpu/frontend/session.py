@@ -395,10 +395,12 @@ class Session:
             reg = {"tables": self.catalog.tables,
                    "sources": self.catalog.sources,
                    "sinks": self.catalog.sinks,
+                   "indexes": self.catalog.indexes,
                    "materialized_views": self.catalog.mvs}.get(stmt.what)
             if reg is None:
                 raise SqlError(f"cannot SHOW {stmt.what}")
-            return [(name,) for name in sorted(reg)]
+            return [(name,) for name in sorted(reg)
+                    if not name.startswith("__idx_")]
         if isinstance(stmt, A.Explain):
             return self._explain(stmt)
         if isinstance(stmt, A.FlushStatement):

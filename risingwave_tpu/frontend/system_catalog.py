@@ -30,7 +30,8 @@ def _pg_matviews(catalog):
     schema = Schema.of(("schemaname", VARCHAR), ("matviewname", VARCHAR),
                        ("definition", VARCHAR))
     rows = [(_SCHEMA_STR, name, mv.definition or "")
-            for name, mv in catalog.mvs.items()]
+            for name, mv in catalog.mvs.items()
+            if not name.startswith("__idx_")]
     return schema, rows
 
 
@@ -39,7 +40,8 @@ def _info_tables(catalog):
                        ("table_type", VARCHAR))
     rows = [(_SCHEMA_STR, n, "BASE TABLE") for n in catalog.tables]
     rows += [(_SCHEMA_STR, n, "SYSTEM SOURCE") for n in catalog.sources]
-    rows += [(_SCHEMA_STR, n, "MATERIALIZED VIEW") for n in catalog.mvs]
+    rows += [(_SCHEMA_STR, n, "MATERIALIZED VIEW") for n in catalog.mvs
+             if not n.startswith("__idx_")]
     return schema, rows
 
 
@@ -64,7 +66,8 @@ def _rw_relations(catalog):
     schema = Schema.of(("name", VARCHAR), ("kind", VARCHAR))
     rows = [(n, "table") for n in catalog.tables]
     rows += [(n, "source") for n in catalog.sources]
-    rows += [(n, "materialized view") for n in catalog.mvs]
+    rows += [(n, "materialized view") for n in catalog.mvs
+             if not n.startswith("__idx_")]
     rows += [(n, "sink") for n in catalog.sinks]
     rows += [(n, "index") for n in catalog.indexes]
     return schema, rows
