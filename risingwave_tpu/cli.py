@@ -48,6 +48,9 @@ def main(argv=None) -> int:
     pg.add_argument("--password", default=None,
                     help="enable md5 password authentication "
                     "(default: trust, like the reference playground)")
+    pg.add_argument("--dashboard-port", type=int, default=None,
+                    help="serve the meta dashboard (cluster / fragment "
+                    "graphs / await-tree) on this port")
 
     q = sub.add_parser("sql", help="run SQL statements and print results")
     q.add_argument("statement")
@@ -152,6 +155,11 @@ def _playground(args) -> int:
         await server.start()
         print(f"risingwave_tpu playground listening on "
               f"{args.host}:{args.port}", flush=True)
+        if getattr(args, "dashboard_port", None) is not None:
+            from .frontend.dashboard import serve_dashboard
+            dash = serve_dashboard(session, args.host, args.dashboard_port)
+            print(f"dashboard on http://{args.host}:{dash.port}/",
+                  flush=True)
 
         session.barrier_interval_ms = args.tick_interval_ms
 

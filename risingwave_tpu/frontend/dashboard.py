@@ -1,0 +1,156 @@
+"""Meta dashboard: cluster / fragment-graph / await-tree introspection
+over HTTP.
+
+Counterpart of the reference's embedded meta dashboard (reference:
+src/meta/src/dashboard/ serving the Next.js UI — cluster overview,
+fragment graphs, await-tree dumps; the await-tree RPC is
+src/compute/src/rpc/service/monitor_service.rs:46). Scaled to this
+build: one threaded endpoint over the live Session serving a small
+self-contained HTML page plus the JSON APIs it fetches:
+
+    /                    HTML overview (no external assets)
+    /api/cluster         epoch, worker processes, catalog inventory
+    /api/fragments       per-MV fragment graph (explain text)
+    /api/metrics         Session.metrics() as JSON
+    /api/await_tree      executor-tree dump with counters/queue depths
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+_PAGE = """<!doctype html>
+<html><head><title>risingwave_tpu dashboard</title><style>
+body { font-family: monospace; margin: 2em; background: #fafafa; }
+h1 { font-size: 1.2em; } h2 { font-size: 1em; margin-top: 1.5em; }
+pre { background: #fff; border: 1px solid #ddd; padding: 1em;
+      overflow-x: auto; }
+</style></head><body>
+<h1>risingwave_tpu dashboard</h1>
+<h2>cluster</h2><pre id="cluster">loading…</pre>
+<h2>fragment graphs</h2><pre id="fragments">loading…</pre>
+<h2>await tree</h2><pre id="await_tree">loading…</pre>
+<h2>metrics</h2><pre id="metrics">loading…</pre>
+<script>
+async function load(id, url, text) {
+  const r = await fetch(url);
+  document.getElementById(id).textContent =
+    text ? await r.text() : JSON.stringify(await r.json(), null, 2);
+}
+function refresh() {
+  load("cluster", "/api/cluster");
+  load("fragments", "/api/fragments", true);
+  load("await_tree", "/api/await_tree", true);
+  load("metrics", "/api/metrics");
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def cluster_info(session) -> dict:
+    workers = []
+    for i, w in enumerate(getattr(session, "workers", []) or []):
+        workers.append({
+            "worker": i,
+            "pid": getattr(getattr(w, "proc", None), "pid", None),
+            "dead": bool(getattr(w, "dead", False)),
+        })
+    return {
+        "epoch": session.epoch,
+        "paused": bool(getattr(session, "paused", False)),
+        "workers": workers,
+        "catalog": {
+            "tables": sorted(session.catalog.tables),
+            "sources": sorted(session.catalog.sources),
+            "materialized_views": sorted(
+                n for n in session.catalog.mvs
+                if not n.startswith("__idx_")),
+            "indexes": sorted(session.catalog.indexes),
+            "sinks": sorted(session.catalog.sinks),
+        },
+        "jobs": sorted(session.jobs),
+        "remote_jobs": sorted(getattr(session, "_remote_specs", {})),
+    }
+
+
+def fragment_text(session) -> str:
+    from ..meta.fragment import fragment_plan
+    out = []
+    for name, mv in sorted(session.catalog.mvs.items()):
+        if name.startswith("__idx_"):
+            continue
+        ast = getattr(mv, "query_ast", None)
+        if ast is None:
+            continue
+        try:
+            plan = session._plan(ast)
+            out.append(f"-- {name}\n{fragment_plan(plan).explain()}")
+        except Exception as e:  # noqa: BLE001 — a bad plan must not 500
+            out.append(f"-- {name}: <{type(e).__name__}: {e}>")
+    return "\n\n".join(out) or "(no materialized views)"
+
+
+class DashboardServer:
+    """Threaded dashboard endpoint over a live Session."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        sess = session
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):       # noqa: N802 - stdlib API
+                path = self.path.rstrip("/") or "/"
+                try:
+                    if path == "/":
+                        return self._send(_PAGE.encode(),
+                                          "text/html; charset=utf-8")
+                    if path == "/api/cluster":
+                        return self._send(
+                            json.dumps(cluster_info(sess)).encode(),
+                            "application/json")
+                    if path == "/api/fragments":
+                        return self._send(fragment_text(sess).encode(),
+                                          "text/plain; charset=utf-8")
+                    if path == "/api/await_tree":
+                        from ..stream.trace import dump_session
+                        return self._send(dump_session(sess).encode(),
+                                          "text/plain; charset=utf-8")
+                    if path == "/api/metrics":
+                        return self._send(
+                            json.dumps(sess.metrics(),
+                                       default=str).encode(),
+                            "application/json")
+                except Exception as e:  # session mid-shutdown
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def log_message(self, *a):   # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dashboard-endpoint")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_dashboard(session, host: str = "127.0.0.1",
+                    port: int = 0) -> DashboardServer:
+    return DashboardServer(session, host, port)

@@ -1,0 +1,64 @@
+"""Meta dashboard endpoint (reference: src/meta/src/dashboard/ — cluster
+overview, fragment graphs, await-tree dumps)."""
+
+import json
+import urllib.request
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.dashboard import serve_dashboard
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_dashboard_endpoints():
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.run_sql("CREATE MATERIALIZED VIEW m AS "
+              "SELECT k % 2 AS g, sum(v) AS sv FROM t GROUP BY k % 2")
+    s.run_sql("CREATE INDEX ix ON t (v)")
+    s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+    s.tick()
+    dash = serve_dashboard(s)
+    try:
+        status, html = _get(dash.port, "/")
+        assert status == 200 and "dashboard" in html
+
+        status, body = _get(dash.port, "/api/cluster")
+        info = json.loads(body)
+        assert info["catalog"]["tables"] == ["t"]
+        assert info["catalog"]["materialized_views"] == ["m"]
+        assert info["catalog"]["indexes"] == ["ix"]
+        assert "__idx_ix" not in info["catalog"]["materialized_views"]
+        assert info["epoch"] >= 1
+
+        status, frags = _get(dash.port, "/api/fragments")
+        assert status == 200 and "-- m" in frags and "Fragment" in frags
+
+        status, tree = _get(dash.port, "/api/await_tree")
+        assert status == 200 and "epoch" in tree
+
+        status, body = _get(dash.port, "/api/metrics")
+        m = json.loads(body)
+        assert "barrier_latency" in m and "jobs" in m
+    finally:
+        dash.close()
+        s.close()
+
+
+def test_dashboard_404():
+    s = Session()
+    dash = serve_dashboard(s)
+    try:
+        import urllib.error
+        try:
+            _get(dash.port, "/api/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        dash.close()
+        s.close()
