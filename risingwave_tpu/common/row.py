@@ -76,6 +76,17 @@ def encode_value(v: Optional[Any], t: DataType) -> bytes:
             parts.append(encode_value(
                 None if e is None else et.to_physical(e), et))
         return _VAL_TAG + b"".join(parts) + b"\x00"
+    if k == TypeKind.STRUCT:
+        from .types import GLOBAL_LIST_DICT
+        fields = GLOBAL_LIST_DICT.lookup(int(v))
+        ftypes = [ft for _, ft in (t.struct_fields or ())]
+        if len(fields) != len(ftypes):
+            raise ValueError(
+                f"struct value arity {len(fields)} != declared "
+                f"{len(ftypes)}")
+        return _VAL_TAG + b"".join(
+            encode_value(None if e is None else ft.to_physical(e), ft)
+            for e, ft in zip(fields, ftypes))
     if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
         return _VAL_TAG + _enc_float(float(v))
     if k in (TypeKind.INT16,):
@@ -129,6 +140,18 @@ def encode_value_row(row: Sequence[Optional[Any]],
             parts.append(encode_value_row(
                 [None if e is None else et.to_physical(e) for e in elems],
                 [et] * len(elems)))
+        elif k == TypeKind.STRUCT:
+            # fixed arity from the declared field types — no count prefix
+            from .types import GLOBAL_LIST_DICT
+            fields = GLOBAL_LIST_DICT.lookup(int(v))
+            ftypes = [ft for _, ft in (t.struct_fields or ())]
+            if len(fields) != len(ftypes):
+                raise ValueError(
+                    f"struct value arity {len(fields)} != declared "
+                    f"{len(ftypes)}")
+            parts.append(encode_value_row(
+                [None if e is None else ft.to_physical(e)
+                 for e, ft in zip(fields, ftypes)], ftypes))
         elif t.is_float:
             parts.append(struct.pack("<d", float(v)))
         else:
@@ -163,6 +186,13 @@ def _decode_values(data: bytes, pos: int,
             phys, pos = _decode_values(data, pos, [et] * n)
             elems = [None if e is None else et.to_python(e) for e in phys]
             out.append(GLOBAL_LIST_DICT.intern(elems))
+        elif k == TypeKind.STRUCT:
+            from .types import GLOBAL_LIST_DICT
+            ftypes = [ft for _, ft in (t.struct_fields or ())]
+            phys, pos = _decode_values(data, pos, ftypes)
+            fields = [None if e is None else ft.to_python(e)
+                      for e, ft in zip(phys, ftypes)]
+            out.append(GLOBAL_LIST_DICT.intern(fields))
         elif t.is_float:
             (f,) = struct.unpack_from("<d", data, pos)
             pos += 8

@@ -35,6 +35,7 @@ class TypeKind(enum.Enum):
     SERIAL = "serial"          # int64 row id (vnode-prefixed)
     LIST = "list"              # int32 list-dictionary id (value-interned)
     JSONB = "jsonb"            # int32 dictionary id (canonical JSON text)
+    STRUCT = "struct"          # int32 list-dictionary id (field tuple)
 
 
 _PHYSICAL: dict[TypeKind, Any] = {
@@ -54,6 +55,7 @@ _PHYSICAL: dict[TypeKind, Any] = {
     TypeKind.SERIAL: jnp.int64,
     TypeKind.LIST: jnp.int32,
     TypeKind.JSONB: jnp.int32,
+    TypeKind.STRUCT: jnp.int32,
 }
 
 _INTEGRAL = {
@@ -83,11 +85,14 @@ class StringDict:
     """
 
     __slots__ = ("_to_id", "_to_str", "max_size", "_sorted", "_unsorted",
-                 "_rank_version", "_ranks", "_device_version", "_device_ranks")
+                 "_rank_version", "_ranks", "_device_version",
+                 "_device_ranks", "_lock")
 
     DEFAULT_MAX = 1 << 22          # 4M distinct strings
 
     def __init__(self, max_size: int = DEFAULT_MAX) -> None:
+        import threading
+        self._lock = threading.Lock()
         self._to_id: dict[str, int] = {"": 0}
         self._to_str: list[str] = [""]
         self.max_size = max_size
@@ -103,16 +108,25 @@ class StringDict:
 
     def intern(self, s: str) -> int:
         i = self._to_id.get(s)
-        if i is None:
-            if len(self._to_str) >= self.max_size:
-                raise RuntimeError(
-                    f"string dictionary full ({self.max_size} distinct "
-                    "values); raise StringDict.max_size or reduce string "
-                    "cardinality (e.g. avoid interning unbounded keys)")
-            i = len(self._to_str)
-            self._to_id[s] = i
-            self._to_str.append(s)
-            self._unsorted.append(s)
+        if i is not None:
+            return i
+        # check-then-append must be atomic: observability endpoints
+        # (dashboard/prometheus) run on threads and may intern while the
+        # session does — two threads assigning one id to two strings
+        # would corrupt every string column
+        with self._lock:
+            i = self._to_id.get(s)
+            if i is None:
+                if len(self._to_str) >= self.max_size:
+                    raise RuntimeError(
+                        f"string dictionary full ({self.max_size} distinct "
+                        "values); raise StringDict.max_size or reduce "
+                        "string cardinality (e.g. avoid interning "
+                        "unbounded keys)")
+                i = len(self._to_str)
+                self._to_id[s] = i
+                self._to_str.append(s)
+                self._unsorted.append(s)
         return i
 
     def lookup(self, i: int) -> str:
@@ -196,11 +210,13 @@ class ListDict:
     carries the int32 ids; element access / unnest / aggregation over
     contents are host-tier operations like every varlen function."""
 
-    __slots__ = ("_to_id", "_to_list", "max_size")
+    __slots__ = ("_to_id", "_to_list", "max_size", "_lock")
 
     DEFAULT_MAX = 1 << 22
 
     def __init__(self, max_size: int = DEFAULT_MAX):
+        import threading
+        self._lock = threading.Lock()
         self._to_id: dict = {(): 0}
         self._to_list: list = [()]
         self.max_size = max_size
@@ -210,12 +226,15 @@ class ListDict:
         i = self._to_id.get(t)
         if i is not None:
             return i
-        if len(self._to_list) >= self.max_size:
-            raise RuntimeError(
-                f"list dictionary full ({self.max_size} entries)")
-        i = len(self._to_list)
-        self._to_id[t] = i
-        self._to_list.append(t)
+        with self._lock:        # see StringDict.intern
+            i = self._to_id.get(t)
+            if i is None:
+                if len(self._to_list) >= self.max_size:
+                    raise RuntimeError(
+                        f"list dictionary full ({self.max_size} entries)")
+                i = len(self._to_list)
+                self._to_id[t] = i
+                self._to_list.append(t)
         return i
 
     def lookup(self, i: int) -> tuple:
@@ -231,11 +250,16 @@ GLOBAL_LIST_DICT = ListDict()
 @dataclasses.dataclass(frozen=True)
 class DataType:
     """A logical column type. ``scale`` is only meaningful for DECIMAL;
-    ``elem_kind`` only for LIST (the element type's kind)."""
+    ``elem_kind`` only for LIST (the element type's kind);
+    ``struct_fields`` only for STRUCT ((name, TypeKind) pairs —
+    reference composite: src/common/src/array/struct_array.rs)."""
 
     kind: TypeKind
     scale: int = 0
     elem_kind: Optional[TypeKind] = None
+    #: STRUCT only: ((name, DataType), …) — FULL types, so decimal scale
+    #: and nested composites survive persistence and field access
+    struct_fields: Optional[tuple] = None
 
     @property
     def dtype(self):
@@ -266,9 +290,27 @@ class DataType:
         return self.kind == TypeKind.LIST
 
     @property
+    def is_struct(self) -> bool:
+        return self.kind == TypeKind.STRUCT
+
+    @property
     def elem_type(self) -> "DataType":
         assert self.kind == TypeKind.LIST and self.elem_kind is not None
         return DataType(self.elem_kind)
+
+    def field_type(self, name: str) -> "DataType":
+        assert self.kind == TypeKind.STRUCT and self.struct_fields
+        for fname, ft in self.struct_fields:
+            if fname == name:
+                return ft
+        raise KeyError(f"struct has no field {name!r}")
+
+    def field_index(self, name: str) -> int:
+        assert self.kind == TypeKind.STRUCT and self.struct_fields
+        for i, (fname, _) in enumerate(self.struct_fields):
+            if fname == name:
+                return i
+        raise KeyError(f"struct has no field {name!r}")
 
     # -- host <-> device value conversion -------------------------------------
 
@@ -278,6 +320,13 @@ class DataType:
             return self.null_sentinel()
         if self.kind == TypeKind.DECIMAL:
             return int(round(float(v) * 10**self.scale))
+        if self.is_struct:
+            fields = self.struct_fields or ()
+            if len(tuple(v)) != len(fields):
+                raise ValueError(
+                    f"struct value has {len(tuple(v))} fields; type "
+                    f"declares {len(fields)}")
+            return GLOBAL_LIST_DICT.intern(v)
         if self.is_list:
             return GLOBAL_LIST_DICT.intern(v)
         if self.is_string:
@@ -292,7 +341,7 @@ class DataType:
         """Physical scalar → Python value (for result rows / tests)."""
         if self.kind == TypeKind.DECIMAL:
             return int(v) / 10**self.scale if self.scale else int(v)
-        if self.is_list:
+        if self.is_list or self.is_struct:
             return GLOBAL_LIST_DICT.lookup(int(v))
         if self.is_string:
             return GLOBAL_STRING_DICT.lookup(int(v))
@@ -335,6 +384,14 @@ def decimal(scale: int = 2) -> DataType:
 
 def list_of(elem: DataType) -> DataType:
     return DataType(TypeKind.LIST, elem_kind=elem.kind)
+
+
+def struct_of(*fields) -> DataType:
+    """struct_of(("a", INT64), ("b", VARCHAR)) — full field DataTypes
+    (a bare TypeKind is wrapped for convenience)."""
+    return DataType(TypeKind.STRUCT, struct_fields=tuple(
+        (n, t if isinstance(t, DataType) else DataType(t))
+        for n, t in fields))
 
 
 @dataclasses.dataclass(frozen=True)

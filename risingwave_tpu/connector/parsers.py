@@ -17,9 +17,24 @@ from typing import Any, List, Optional, Sequence
 from ..common.types import Schema, TypeKind
 
 
-def _coerce(v: Any, kind: TypeKind) -> Any:
+def _coerce(v: Any, kind: TypeKind, dtype=None) -> Any:
     if v is None:
         return None
+    if kind == TypeKind.STRUCT and dtype is not None:
+        # nested JSON object -> field tuple in declared order
+        if isinstance(v, dict):
+            return tuple(
+                _coerce(v.get(fn), fk) for fn, fk in dtype.struct_fields)
+        return None
+    if kind == TypeKind.LIST:
+        if isinstance(v, (list, tuple)):
+            ek = dtype.elem_kind if dtype is not None else None
+            return tuple(_coerce(e, ek) if ek is not None else e
+                         for e in v)
+        return None
+    if kind == TypeKind.JSONB and not isinstance(v, str):
+        import json as _json
+        return _json.dumps(v, separators=(",", ":"), sort_keys=True)
     if kind in (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
                 TypeKind.SERIAL, TypeKind.DATE, TypeKind.TIME,
                 TypeKind.TIMESTAMP, TypeKind.INTERVAL):
@@ -40,7 +55,8 @@ def parse_json_line(line: str, schema: Schema) -> Optional[tuple]:
     if not line:
         return None
     obj = json.loads(line)
-    return tuple(_coerce(obj.get(f.name), f.type.kind) for f in schema)
+    return tuple(_coerce(obj.get(f.name), f.type.kind, f.type)
+                 for f in schema)
 
 
 def parse_json_lines(text: str, schema: Schema) -> List[tuple]:
@@ -107,7 +123,7 @@ def parse_debezium_line(line: str,
         if not isinstance(img, dict):
             raise ValueError("debezium row image is not an object")
         return tuple(
-            _coerce(img.get(f.name), f.type.kind) for f in schema)
+            _coerce(img.get(f.name), f.type.kind, f.type) for f in schema)
 
     op = payload.get("op")
     before, after = payload.get("before"), payload.get("after")

@@ -722,13 +722,20 @@ _register_regexp("regexp_replace", _pg_regexp_replace, lambda ts: T.VARCHAR)
 _register_regexp("regexp_match", _pg_regexp_match, lambda ts: T.VARCHAR)
 
 
-def _register_host_fn(name: str, str_args: tuple, pyfn, type_infer):
+def _register_host_fn(name: str, str_args: tuple, pyfn, type_infer,
+                      convert=None):
     """Generic host-tier registration: ``str_args`` marks which positions
     carry dictionary ids (decoded to str); the rest pass as ints. Work is
     per UNIQUE argument tuple over rows whose args are all non-NULL —
     NULL/masked lanes hold dtype sentinels that must never reach pyfn (a
     sentinel 0 position argument would crash split_part, a garbage
-    timestamp would overflow to_char). A None result is SQL NULL."""
+    timestamp would overflow to_char). A None result is SQL NULL.
+    ``convert(result, out_type)`` maps pyfn's python result to the
+    physical scalar (default: intern strings, pass numerics)."""
+    if convert is None:
+        def convert(r, out_type):
+            return _intern_str(r) if out_type.is_string else r
+
     def impl(datas, masks, out_type):
         import numpy as np
         cols = [np.asarray(d).astype(np.int64) for d in datas]
@@ -752,7 +759,7 @@ def _register_host_fn(name: str, str_args: tuple, pyfn, type_infer):
             if r is None:
                 valid[u] = False
             else:
-                results[u] = _intern_str(r) if out_type.is_string else r
+                results[u] = convert(r, out_type)
         return (jnp.asarray(results[inverse]),
                 jnp.asarray(in_valid) & jnp.asarray(valid[inverse]))
     _REGISTRY[name] = (impl, type_infer)
@@ -882,6 +889,19 @@ def _jsonb_array_length(s: str):
 
 _register_host_fn("jsonb_array_length", (0,), _jsonb_array_length,
                   _t_int64)
+
+
+def _struct_field(sid: int, fi: int):
+    """(struct).field — element fi of the interned field tuple; the
+    binder sets the out type from the declared field type (reference
+    composite access: src/expr/src/expr/expr_field.rs)."""
+    from ..common.types import GLOBAL_LIST_DICT
+    fields = GLOBAL_LIST_DICT.lookup(sid)
+    return fields[fi] if 0 <= fi < len(fields) else None
+
+
+_register_host_fn("struct_field", (), _struct_field, _t_int64,
+                  convert=lambda r, out_type: out_type.to_physical(r))
 
 
 @register("array_length", _t_int64)
@@ -1040,7 +1060,7 @@ HOST_CALLBACK_FNS = {
     "length", "concat_op", "like", "not_like",
     "regexp_like", "regexp_count", "regexp_replace", "regexp_match",
     "regexp_match_group", "split_part", "to_char", "array_access",
-    "array_length", "jsonb_get_field", "jsonb_get_elem",
+    "array_length", "struct_field", "jsonb_get_field", "jsonb_get_elem",
     "jsonb_get_field_text", "jsonb_get_elem_text", "jsonb_typeof",
     "jsonb_array_length",
     # not host callbacks, but must run eagerly: they read the live rank table

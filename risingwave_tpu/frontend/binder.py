@@ -230,6 +230,18 @@ class ExprBinder:
                 DataType(TypeKind.LIST, elem_kind=elem_kind))
         if isinstance(node, A.Subscript):
             return self._bind_subscript(node)
+        if isinstance(node, A.FieldAccess):
+            base = self.bind(node.expr)
+            if not base.type.is_struct:
+                raise BindError(
+                    f"cannot access field {node.field!r} of a "
+                    f"{base.type.kind.value} value")
+            from ..expr.expr import FunctionCall as RFunctionCall
+            idx = base.type.field_index(node.field)
+            return RFunctionCall(
+                "struct_field",
+                (base, Literal(idx, INT64)),
+                base.type.field_type(node.field))
         if isinstance(node, A.ScalarSubquery):
             if self.subquery_sink is None:
                 raise BindError("scalar subquery not supported here")
@@ -328,6 +340,15 @@ class ExprBinder:
             field = node.args[0]
             assert isinstance(field, A.Lit)
             return make_extract(str(field.value), self.bind(node.args[1]))
+        if name == "row":
+            # ROW(c1, c2, …) composite constructor; PG names fields f1…fn
+            items = [self.bind(a) for a in node.args]
+            if not all(isinstance(it, Literal) for it in items):
+                raise BindError("ROW(…) fields must be constants")
+            from ..common.types import struct_of
+            t = struct_of(*((f"f{i + 1}", it.type.kind)
+                            for i, it in enumerate(items)))
+            return Literal(tuple(it.value for it in items), t)
         if name in AGG_KINDS:
             if self.agg_ctx is None:
                 raise BindError(f"aggregate {name}() not allowed here")

@@ -34,8 +34,34 @@ _TYPE_NAMES: dict[str, DataType] = {
 }
 
 
+def _split_struct_body(body: str) -> list:
+    """Split 'a bigint, b struct<x int, y int>' on TOP-LEVEL commas."""
+    parts, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "<":
+            depth += 1
+        elif ch == ">":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
 def type_from_name(name: str) -> DataType:
-    t = _TYPE_NAMES.get(name.lower())
+    low = name.lower().strip()
+    if low.startswith("struct<") and low.endswith(">"):
+        from ..common.types import struct_of
+        fields = []
+        for part in _split_struct_body(low[len("struct<"):-1]):
+            fname, _, ftype = part.strip().partition(" ")
+            fields.append((fname, type_from_name(ftype.strip())))
+        return struct_of(*fields)
+    t = _TYPE_NAMES.get(low)
     if t is None:
         raise ValueError(f"unknown type name {name!r}")
     return t

@@ -283,6 +283,20 @@ class Parser:
         if name == "double" and self.peek().value == "precision":
             self.next()
             return "double"
+        if name == "struct" and self.at_op("<"):
+            # STRUCT<a BIGINT, b VARCHAR> — composite column type
+            # (reference: struct_array.rs); flattened back to a string the
+            # catalog's type_from_name re-parses
+            self.next()
+            fields = []
+            while True:
+                fname = self.ident()
+                ftype = self._type_name()
+                fields.append(f"{fname} {ftype}")
+                if not self.eat_op(","):
+                    break
+            self.expect_op(">")
+            return f"struct<{', '.join(fields)}>"
         if self.eat_op("("):
             # varchar(n) / decimal(p,s) — size args recorded but unused
             args = [self.next().value]
@@ -642,6 +656,12 @@ class Parser:
             elif self.at_op("->", "->>"):
                 op = self.next().value
                 e = A.BinaryOp(op, e, self._primary_expr())
+            elif (self.at_op(".")
+                    and self.peek(1).kind in ("name", "kw")):
+                # (expr).field — struct access; qualified column names
+                # never reach here (consumed inside _primary_expr)
+                self.next()
+                e = A.FieldAccess(e, self.ident())
             else:
                 return e
 

@@ -117,6 +117,14 @@ class Subscript:
 
 
 @dataclasses.dataclass(frozen=True)
+class FieldAccess:
+    """(<expr>).field — struct field access."""
+
+    expr: Any
+    field: str
+
+
+@dataclasses.dataclass(frozen=True)
 class WindowFunc:
     """fn(args) OVER (PARTITION BY … ORDER BY …)."""
 
