@@ -138,8 +138,10 @@ def _ctl_dispatch(args, session, _json) -> None:
     elif args.what == "metrics":
         print(_json.dumps(session.metrics(), indent=2, default=str))
     elif args.what == "trace":
-        from .stream.trace import dump_session
-        print(dump_session(session))
+        # await_tree() federates worker-hosted jobs' trees (and takes the
+        # API lock) — a bare dump_session would print them as
+        # "<remote; no stats snapshot yet>"
+        print(session.await_tree())
 
 
 def _playground(args) -> int:

@@ -26,6 +26,13 @@ class StreamingConfig:
     join_key_capacity: int = 1 << 13
     join_bucket_width: int = 16
     topn_table_capacity: int = 1 << 16
+    # observability (common/tracing.py): span ring size per process, and
+    # the slow-epoch detector — an epoch whose inject→collect latency
+    # meets the threshold gets its span tree snapshotted for post-hoc
+    # inspection (0 disables; reference capability: barrier_latency
+    # histograms + await-tree dumps read together by hand)
+    trace_ring_capacity: int = 4096
+    slow_epoch_threshold_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -85,4 +92,5 @@ MUTABLE_SYSTEM_PARAMS = {
     "checkpoint_frequency": int,
     "barrier_interval_ms": int,
     "in_flight_barrier_nums": int,
+    "slow_epoch_threshold_ms": float,
 }

@@ -1,0 +1,193 @@
+"""Epoch-aware structured tracing: bounded span ring + Chrome trace export.
+
+Counterpart of the reference's tracing layer (reference:
+src/utils/runtime/src/logger.rs tracing subscribers + the await-tree /
+risectl trace surface, src/compute/src/rpc/service/monitor_service.rs:46).
+Scaled to this build: every barrier cycle produces a small tree of spans —
+
+    epoch <N>                      conductor: inject -> collect -> commit
+      barrier.inject               source/table queue pushes + remote inject
+      <Executor>.barrier           each executor's on_barrier work
+      checkpoint.commit            store + worker phase-2 commit
+      DurableStateStore.commit     segment append inside the store
+
+captured into a bounded ring buffer (``TraceRecorder``) so the last few
+hundred epochs are always inspectable post-hoc without any collector
+infrastructure. ``to_chrome_trace`` renders spans as Chrome trace-event
+JSON ("X" complete events) loadable in Perfetto / chrome://tracing: one
+epoch shows as a timeline across executors.
+
+Cross-process: worker processes record into their own per-process
+``GLOBAL_TRACE``; the session's stats federation drains those rings over
+the control socket and re-ingests the spans with the worker's pid, so a
+single export covers the whole cluster. Span timestamps use the shared
+wall clock (``time.time()``) so per-process timelines align; durations
+are measured with ``perf_counter`` for precision.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+from typing import Iterable, Optional
+
+#: span categories (Chrome trace "cat" field)
+CAT_EPOCH = "epoch"          # whole-epoch + inject/collect conductor spans
+CAT_BARRIER = "barrier"      # per-executor on_barrier work
+CAT_STORAGE = "storage"      # state-table / store commit work
+CAT_EXCHANGE = "exchange"    # cross-process data movement
+
+
+@dataclasses.dataclass
+class Span:
+    """One completed span. ``ts`` is wall-clock seconds (shared across
+    processes); ``dur`` is perf_counter-measured seconds."""
+
+    name: str
+    cat: str
+    ts: float
+    dur: float
+    epoch: Optional[int] = None
+    tid: str = "main"            # logical track: executor identity etc.
+    pid: int = 0                 # 0 = session; worker_id + 1 = worker
+    args: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class TraceRecorder:
+    """Bounded, thread-safe ring of completed spans.
+
+    Recording must stay cheap enough for the barrier hot path: one lock
+    acquisition + deque append per span, no allocation beyond the Span."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self.capacity = capacity
+            self._spans = collections.deque(self._spans, maxlen=capacity)
+
+    def record(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(span)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = CAT_BARRIER,
+             epoch: Optional[int] = None, tid: str = "main",
+             pid: int = 0, **args):
+        """Context manager recording one span around its body."""
+        if not self.enabled:
+            yield
+            return
+        ts = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(Span(name, cat, ts, time.perf_counter() - t0,
+                             epoch=epoch, tid=tid, pid=pid, args=args))
+
+    def snapshot(self, epoch: Optional[int] = None) -> list[Span]:
+        """Copy of the ring, optionally filtered to one epoch's tree."""
+        with self._lock:
+            spans = list(self._spans)
+        if epoch is not None:
+            spans = [s for s in spans if s.epoch == epoch]
+        return spans
+
+    def drain(self) -> list[Span]:
+        """Take-and-clear — the worker side of span federation (each
+        session stats poll drains, so no span ships twice)."""
+        with self._lock:
+            spans = list(self._spans)
+            self._spans.clear()
+        return spans
+
+    def ingest(self, dicts: Iterable[dict], pid: Optional[int] = None) -> None:
+        """Re-record spans shipped from another process (stats reply)."""
+        for d in dicts:
+            s = Span.from_dict(d)
+            if pid is not None:
+                s.pid = pid
+            self.record(s)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def epochs(self) -> list[int]:
+        """Distinct epochs currently covered by the ring, ascending."""
+        return sorted({s.epoch for s in self.snapshot()
+                       if s.epoch is not None})
+
+
+#: the per-process recorder every instrumentation seam writes to
+GLOBAL_TRACE = TraceRecorder()
+
+
+def trace_span(name: str, cat: str = CAT_BARRIER,
+               epoch: Optional[int] = None, tid: str = "main", **args):
+    """``with trace_span(...):`` against the process-global recorder."""
+    return GLOBAL_TRACE.span(name, cat=cat, epoch=epoch, tid=tid, **args)
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+def to_chrome_trace(spans: Iterable[Span],
+                    process_names: Optional[dict] = None) -> dict:
+    """Spans → Chrome trace-event JSON object (Perfetto-loadable).
+
+    Every span becomes a complete ("X") event; epoch spans live on the
+    ``conductor`` track and executor spans on per-identity tracks, so one
+    epoch renders as a timeline across executors. Timestamps are
+    microseconds relative to the earliest span so the viewer opens at
+    t=0."""
+    spans = sorted(spans, key=lambda s: s.ts)
+    base = spans[0].ts if spans else 0.0
+    events: list[dict] = []
+    names = {0: "session"}
+    names.update(process_names or {})
+    for s in spans:
+        if s.pid not in names:
+            names[s.pid] = f"worker-{s.pid - 1}"
+        args = {"epoch": s.epoch, **s.args} if s.epoch is not None \
+            else dict(s.args)
+        events.append({
+            "name": s.name, "cat": s.cat, "ph": "X",
+            "ts": round((s.ts - base) * 1e6, 3),
+            "dur": round(s.dur * 1e6, 3),
+            "pid": s.pid, "tid": s.tid, "args": args,
+        })
+    meta: list[dict] = []
+    for pid, pname in sorted(names.items()):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": "", "args": {"name": pname}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(spans: Iterable[Span],
+                        path: Optional[str] = None, **kw) -> dict:
+    """Render and optionally write the Chrome trace JSON."""
+    obj = to_chrome_trace(spans, **kw)
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(obj, f)
+    return obj

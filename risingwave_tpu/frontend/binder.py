@@ -343,10 +343,34 @@ class ExprBinder:
         if name == "row":
             # ROW(c1, c2, …) composite constructor; PG names fields f1…fn
             items = [self.bind(a) for a in node.args]
+            # const-fold a literal cast (ROW(1.23::decimal)) so the field
+            # carries the cast's target type, scale included. Only a
+            # VALUE-PRESERVING cast folds: a lossy one (1.9::bigint,
+            # 1::varchar) would need the runtime Cast's rounding rules,
+            # so it falls through to the constants check below instead of
+            # silently diverging from `SELECT 1.9::bigint`
+            def _fold(it: Expr) -> Expr:
+                if not (isinstance(it, RCast)
+                        and isinstance(it.arg, Literal)):
+                    return it
+                if it.arg.value is None:
+                    return Literal(None, it.type)
+                try:
+                    v = it.type.to_python(it.type.to_physical(it.arg.value))
+                    if v != it.arg.value:
+                        return it
+                except Exception:
+                    return it
+                return Literal(v, it.type)
+
+            items = [_fold(it) for it in items]
             if not all(isinstance(it, Literal) for it in items):
                 raise BindError("ROW(…) fields must be constants")
             from ..common.types import struct_of
-            t = struct_of(*((f"f{i + 1}", it.type.kind)
+            # full DataTypes, not bare kinds: decimal scale and list
+            # element types must survive into the struct's field types or
+            # field access / persistence decode at the wrong scale
+            t = struct_of(*((f"f{i + 1}", it.type)
                             for i, it in enumerate(items)))
             return Literal(tuple(it.value for it in items), t)
         if name in AGG_KINDS:

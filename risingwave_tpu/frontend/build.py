@@ -161,7 +161,9 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             # exact DISTINCT / array_agg / string_agg / percentile / mode /
             # min-max-under-retraction: materialized-input state on the
             # host tier (reference: AggStateStorage::MaterializedInput);
-            # ragged per-group multisets have no fixed-lane device layout
+            # ragged per-group multisets have no fixed-lane device layout.
+            # ALL sibling calls ride along — approx_count_distinct included
+            # (evaluated there exactly, a superset of its approx contract)
             if plan.eowc:
                 raise ValueError(
                     "EMIT ON WINDOW CLOSE does not support materialized-"

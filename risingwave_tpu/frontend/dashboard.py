@@ -11,9 +11,20 @@ self-contained HTML page plus the JSON APIs it fetches:
     /                    HTML overview (no external assets)
     /api/cluster         epoch, worker processes, catalog inventory
     /api/fragments       per-MV fragment graph (explain text)
-    /api/metrics         Session.metrics() as JSON
-    /api/await_tree      executor-tree dump with counters/queue depths
-"""
+    /api/metrics         Session.metrics() as JSON (federated: includes
+                         worker-hosted jobs' counters)
+    /api/await_tree      executor trees with counters/queue depths —
+                         local AND worker-hosted jobs
+    /api/trace           Chrome trace-event JSON of the span ring
+                         (load in Perfetto / chrome://tracing)
+    /api/slow_epochs     captured slow-epoch span trees
+    /api/profiler/start  POST-only: opt-in jax.profiler.trace capture
+    /api/profiler/stop   (requires serve_dashboard(..., profiler_dir=...))
+
+Thread safety: the handlers run on HTTP server threads while the session
+thread mutates catalog/metrics/jobs mid-tick; every read happens under
+the session's API lock (``Session._api_lock``), the same serialization
+pgwire gets from its one-worker executor."""
 
 from __future__ import annotations
 
@@ -29,9 +40,12 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
       overflow-x: auto; }
 </style></head><body>
 <h1>risingwave_tpu dashboard</h1>
+<p><a href="/api/trace" download="trace.json">download Chrome trace</a>
+(load in Perfetto / chrome://tracing)</p>
 <h2>cluster</h2><pre id="cluster">loading…</pre>
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
+<h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
 <h2>metrics</h2><pre id="metrics">loading…</pre>
 <script>
 async function load(id, url, text) {
@@ -43,6 +57,7 @@ function refresh() {
   load("cluster", "/api/cluster");
   load("fragments", "/api/fragments", true);
   load("await_tree", "/api/await_tree", true);
+  load("slow_epochs", "/api/slow_epochs");
   load("metrics", "/api/metrics");
 }
 refresh(); setInterval(refresh, 2000);
@@ -93,42 +108,121 @@ def fragment_text(session) -> str:
 
 
 class DashboardServer:
-    """Threaded dashboard endpoint over a live Session."""
+    """Threaded dashboard endpoint over a live Session.
 
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+    ``profiler_dir`` opts in the ``/api/profiler/{start,stop}`` endpoints
+    (reference: the compute node's CPU/heap profiling RPCs,
+    monitor_service.rs profiling handlers — here a ``jax.profiler.trace``
+    capture of device/host activity, viewable in TensorBoard/Perfetto).
+    Left ``None``, the endpoints answer 403: profiling captures can be
+    large and must be an explicit operator decision."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 profiler_dir: str | None = None):
         sess = session
+        srv = self
+        self.profiler_dir = profiler_dir
+        self._profiling = False
+        self._closed = False
+        self._profiler_lock = threading.Lock()
+
+        def profiler(action: str) -> tuple[int, dict]:
+            if srv.profiler_dir is None:
+                return 403, {"error": "profiler disabled; pass "
+                                      "profiler_dir to serve_dashboard"}
+            import jax
+            # handlers run on ThreadingHTTPServer threads: the
+            # check-and-set must be atomic or two concurrent /start
+            # requests double-start the device trace
+            with srv._profiler_lock:
+                if srv._closed:
+                    # a /start racing close() must not win the lock and
+                    # leave a device trace nobody will ever stop
+                    return 503, {"error": "dashboard is shutting down"}
+                if action == "start":
+                    if srv._profiling:
+                        return 409, {"error": "profiler already running"}
+                    jax.profiler.start_trace(srv.profiler_dir)
+                    srv._profiling = True
+                    return 200, {"ok": True, "dir": srv.profiler_dir}
+                if srv._profiling:
+                    try:
+                        jax.profiler.stop_trace()
+                    finally:
+                        # even a failed stop ends the capture session —
+                        # a sticky True would wedge /start with 409 and
+                        # /stop with the same error forever
+                        srv._profiling = False
+                    return 200, {"ok": True, "dir": srv.profiler_dir}
+                return 409, {"error": "profiler not running"}
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _send(self, body: bytes, ctype: str) -> None:
-                self.send_response(200)
+            def _send(self, body: bytes, ctype: str,
+                      status: int = 200) -> None:
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):       # noqa: N802 - stdlib API
-                path = self.path.rstrip("/") or "/"
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
                     if path == "/":
                         return self._send(_PAGE.encode(),
                                           "text/html; charset=utf-8")
                     if path == "/api/cluster":
-                        return self._send(
-                            json.dumps(cluster_info(sess)).encode(),
-                            "application/json")
+                        with sess._api_lock:
+                            info = cluster_info(sess)
+                        return self._send(json.dumps(info).encode(),
+                                          "application/json")
                     if path == "/api/fragments":
-                        return self._send(fragment_text(sess).encode(),
+                        with sess._api_lock:
+                            text = fragment_text(sess)
+                        return self._send(text.encode(),
                                           "text/plain; charset=utf-8")
                     if path == "/api/await_tree":
-                        from ..stream.trace import dump_session
-                        return self._send(dump_session(sess).encode(),
+                        return self._send(sess.await_tree().encode(),
                                           "text/plain; charset=utf-8")
                     if path == "/api/metrics":
                         return self._send(
                             json.dumps(sess.metrics(),
                                        default=str).encode(),
                             "application/json")
+                    if path == "/api/trace":
+                        return self._send(
+                            json.dumps(sess.export_chrome_trace()).encode(),
+                            "application/json")
+                    if path == "/api/slow_epochs":
+                        return self._send(
+                            json.dumps(sess.slow_epochs(),
+                                       default=str).encode(),
+                            "application/json")
+                    if path in ("/api/profiler/start",
+                                "/api/profiler/stop"):
+                        # state-mutating: POST only, or any web page the
+                        # operator has open could start a device trace
+                        # via a drive-by <img src=…> GET
+                        return self._send(
+                            json.dumps({"error": "use POST"}).encode(),
+                            "application/json", 405)
                 except Exception as e:  # session mid-shutdown
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_POST(self):      # noqa: N802 - stdlib API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path in ("/api/profiler/start",
+                                "/api/profiler/stop"):
+                        status, obj = profiler(path.rsplit("/", 1)[1])
+                        return self._send(json.dumps(obj).encode(),
+                                          "application/json", status)
+                except Exception as e:
                     self.send_response(500)
                     self.end_headers()
                     self.wfile.write(str(e).encode())
@@ -147,10 +241,21 @@ class DashboardServer:
         self._thread.start()
 
     def close(self) -> None:
+        with self._profiler_lock:   # vs a concurrent /api/profiler/start
+            self._closed = True
+            if self._profiling:
+                # a dangling device trace would buffer forever
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+                self._profiling = False
         self._httpd.shutdown()
         self._httpd.server_close()
 
 
 def serve_dashboard(session, host: str = "127.0.0.1",
-                    port: int = 0) -> DashboardServer:
-    return DashboardServer(session, host, port)
+                    port: int = 0,
+                    profiler_dir: str | None = None) -> DashboardServer:
+    return DashboardServer(session, host, port, profiler_dir=profiler_dir)

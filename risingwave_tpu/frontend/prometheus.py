@@ -7,6 +7,11 @@ dashboards, docs/metrics.md semantics). ``render_metrics`` flattens
 ``Session.metrics()`` into the exposition format; ``serve_metrics``
 mounts it on a tiny threaded HTTP server at ``/metrics`` so a stock
 Prometheus scrape config works against a playground session.
+
+``Session.metrics()`` federates worker processes' stats over the control
+socket, so worker-hosted jobs' counters appear in the same exposition —
+one scrape covers the whole cluster (the reference scrapes each compute
+node separately; here the session is the aggregation point).
 """
 
 from __future__ import annotations
@@ -58,6 +63,20 @@ def render_metrics(session) -> str:
             sum(v for v in nbytes.values()
                 if isinstance(v, (int, float)))
         lines.append(f'rw_state_bytes{{job="{_sanitize(job)}"}} {total}')
+    workers = m.get("workers") or []
+    if workers:
+        lines += ["# HELP rw_worker_up Worker process liveness "
+                  "(1 = serving, 0 = dead).",
+                  "# TYPE rw_worker_up gauge"]
+        for w in workers:
+            lines.append(
+                f'rw_worker_up{{worker="{w["worker"]}"}} '
+                f'{0 if w.get("dead") else 1}')
+    if "slow_epoch_total" in m:
+        lines += ["# HELP rw_slow_epoch_total Epochs whose barrier "
+                  "latency tripped the slow-epoch threshold.",
+                  "# TYPE rw_slow_epoch_total counter",
+                  f"rw_slow_epoch_total {m['slow_epoch_total']}"]
     return "\n".join(lines) + "\n"
 
 

@@ -215,8 +215,14 @@ class RemoteWorker:
         obj = {**obj, "rid": rid}
         fut = self.loop.create_future()
         self._pending[rid] = fut
-        await self.send(obj)
-        resp = await fut
+        try:
+            await self.send(obj)
+            resp = await fut
+        finally:
+            # a caller-side wait_for timeout cancels ``fut`` but would
+            # otherwise leave its rid in _pending forever (the late
+            # reply, if any, is discarded by _read_loop's pop)
+            self._pending.pop(rid, None)
         if resp.get("ok") is False:
             raise RuntimeError(
                 f"worker {self.worker_id}: {resp.get('error')}")
@@ -313,6 +319,17 @@ class RemoteWorker:
 
     async def commit(self, epoch: int) -> None:
         await self.send({"type": "commit", "epoch": epoch})
+
+    async def get_stats(self, timeout: float = 10.0,
+                        span_ack: Optional[int] = None) -> dict:
+        """Fetch this worker's monitor snapshot (executor trees, counters,
+        queue depths, state bytes, tracing spans). ``span_ack`` echoes the
+        last ``span_seq`` this session processed so the worker can discard
+        its retained span batch (a timed-out reply is resent, not lost)."""
+        req: dict = {"type": "stats"}
+        if span_ack is not None:
+            req["span_ack"] = span_ack
+        return await asyncio.wait_for(self.request(req), timeout)
 
     async def shutdown(self) -> None:
         try:

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import functools
+import threading
 from typing import Any, Callable, Optional, Sequence
 
 from ..common.chunk import (
@@ -50,6 +52,24 @@ from .runtime import ChangelogBus, QueueSource, StreamJob
 
 class SqlError(ValueError):
     pass
+
+
+def _locked(fn):
+    """Serialize a public Session entry point on the session's API lock.
+
+    The Session is single-threaded by design, but observability endpoints
+    (dashboard / Prometheus HTTP threads) read catalog, metrics, and the
+    event loop concurrently with the driving thread — the lock makes every
+    public entry a consistent snapshot boundary (pgwire gets the same
+    property from its one-worker executor). Reentrant: locked entries call
+    each other (run_sql → flush → tick)."""
+
+    @functools.wraps(fn)
+    def inner(self, *args, **kwargs):
+        with self._api_lock:
+            return fn(self, *args, **kwargs)
+
+    return inner
 
 
 from ..connector.factory import DEBEZIUM_NEEDS_PK as _DEBEZIUM_NEEDS_PK
@@ -170,12 +190,30 @@ class Session:
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
         # style). Reference: load_config + SystemParams (config.rs:128).
+        # API lock FIRST: _recover() below runs locked entry points, and
+        # observability HTTP threads may attach before __init__ returns
+        self._api_lock = threading.RLock()
+        # slow-epoch detector + span-tree snapshots (common/tracing.py)
+        self.slow_epoch_threshold_ms: float = 0.0
+        import collections as _collections
+        self._slow_epochs: _collections.deque = _collections.deque(maxlen=16)
+        self._slow_epoch_total = 0
+        # federation cache: last stats snapshot per worker (metrics() and
+        # await_tree() refresh it; it survives a dead worker for post-hoc
+        # inspection)
+        self._worker_stats: dict[int, dict] = {}
+        self._worker_stats_at = 0.0            # monotonic; rate-limits polls
+        self._worker_span_ack: dict[int, int] = {}   # last span_seq ingested
         if rw_config is not None:
             st = rw_config.streaming
             checkpoint_frequency = st.checkpoint_frequency
             in_flight_barriers = st.in_flight_barrier_nums
             source_chunk_capacity = st.chunk_capacity
             data_dir = rw_config.storage.data_dir or data_dir
+            self.slow_epoch_threshold_ms = float(st.slow_epoch_threshold_ms)
+            from ..common.tracing import GLOBAL_TRACE
+            if st.trace_ring_capacity != GLOBAL_TRACE.capacity:
+                GLOBAL_TRACE.set_capacity(st.trace_ring_capacity)
             config = config or BuildConfig(
                 chunk_capacity=st.chunk_capacity,
                 agg_table_capacity=st.agg_table_capacity,
@@ -241,14 +279,13 @@ class Session:
         self._pending_mutation: Optional[Mutation] = None
         from ..stream.metrics import LatencyRecorder
         self.barrier_latency = LatencyRecorder()
-        self._inject_time: dict[int, float] = {}
+        self._inject_time: dict[int, tuple] = {}   # epoch -> (perf, wall)
         # the session owns its event loop: jobs are long-lived tasks that
         # must survive across synchronous API calls, independent of any
         # ambient loop other code may create/close
         self.loop = asyncio.new_event_loop()
         # pre-warm the native row codec off the hot path: its first use
         # otherwise pays a synchronous g++ compile inside a barrier
-        import threading
         from ..native import codec as _native_codec
         threading.Thread(target=_native_codec, daemon=True).start()
         # remote worker processes (reference: compute nodes; the session
@@ -344,6 +381,7 @@ class Session:
 
     # ------------------------------------------------------------------ SQL --
 
+    @_locked
     def run_sql(self, sql: str) -> list:
         """Execute statements; returns the last statement's result rows."""
         out: list = []
@@ -428,6 +466,8 @@ class Session:
             self.in_flight_barriers = max(1, value)
         elif name == "barrier_interval_ms":
             self.barrier_interval_ms = value   # read live by the CLI ticker
+        elif name == "slow_epoch_threshold_ms":
+            self.slow_epoch_threshold_ms = max(0.0, value)
         return []
 
     def parameters(self) -> list:
@@ -436,6 +476,7 @@ class Session:
             ("barrier_interval_ms", str(self.barrier_interval_ms)),
             ("checkpoint_frequency", str(self.checkpoint_frequency)),
             ("in_flight_barrier_nums", str(self.in_flight_barriers)),
+            ("slow_epoch_threshold_ms", str(self.slow_epoch_threshold_ms)),
         ]
 
     # ----------------------------------------------------------------- DDL --
@@ -830,6 +871,10 @@ class Session:
             self._dead_jobs.discard(name)
         if worker.dead:
             worker.respawn(self._await)
+            # the replacement process numbers its span batches from 0 —
+            # a stale ack could match the fresh counter and make the
+            # worker discard a never-delivered span outbox
+            self._worker_span_ack.pop(worker.worker_id, None)
         from .remote import RemoteJob
         req = dict(spec["req"])
         if spec["channels"]:
@@ -935,6 +980,7 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    @_locked
     def reschedule(self, name: str, config: Optional[BuildConfig] = None):
         """Online rescale of one MV job: rebuild its executors under a new
         BuildConfig (typically a different ``mesh``) from durable state at
@@ -1542,6 +1588,7 @@ class Session:
 
     # --------------------------------------------------------------- epochs --
 
+    @_locked
     def tick(self, generate: bool = True, checkpoint: Optional[bool] = None,
              mutation: Optional[Mutation] = None) -> int:
         """One barrier cycle: feed sources, inject the barrier, and await
@@ -1571,32 +1618,36 @@ class Session:
                     chunk = feed.generator()
                     if chunk is not None:
                         feed.queue.push(chunk)
-        self.dml.drain_into_epoch()
-        for feed in self.feeds:
-            if feed.reader is not None:
-                feed.offsets_at_epoch[epoch] = feed.reader.offsets
-            feed.queue.push(barrier)
-        for queues in self._table_queues.values():
-            for q in queues:
-                q.push(barrier)
-        if self.workers:
-            from .remote import WorkerDied
+        from ..common.tracing import CAT_EPOCH, trace_span
+        with trace_span("barrier.inject", CAT_EPOCH, epoch=epoch,
+                        tid="conductor", checkpoint=checkpoint):
+            self.dml.drain_into_epoch()
+            for feed in self.feeds:
+                if feed.reader is not None:
+                    feed.offsets_at_epoch[epoch] = feed.reader.offsets
+                feed.queue.push(barrier)
+            for queues in self._table_queues.values():
+                for q in queues:
+                    q.push(barrier)
+            if self.workers:
+                from .remote import WorkerDied
 
-            async def _inject_remote() -> None:
-                for w in self.workers:
-                    if w.dead:
-                        continue
-                    try:
-                        await w.inject_barrier(
-                            epoch, checkpoint,
-                            generate and not self.paused, mutation)
-                    except WorkerDied:
-                        pass        # collect marks its jobs dead
-            self._await(_inject_remote())
+                async def _inject_remote() -> None:
+                    for w in self.workers:
+                        if w.dead:
+                            continue
+                        try:
+                            await w.inject_barrier(
+                                epoch, checkpoint,
+                                generate and not self.paused, mutation)
+                        except WorkerDied:
+                            pass        # collect marks its jobs dead
+                self._await(_inject_remote())
         self._injected = epoch
         self._inflight.append((epoch, checkpoint))
         import time as _time
-        self._inject_time[epoch] = _time.perf_counter()
+        # (perf_counter for latency precision, wall clock for span export)
+        self._inject_time[epoch] = (_time.perf_counter(), _time.time())
         # pipelined barriers would let an upstream run AHEAD of an active
         # backfill's snapshot reads (the scan would see a later epoch's
         # staged rows and the same update would also arrive as a delta —
@@ -1635,8 +1686,11 @@ class Session:
         return self.epoch
 
     def _complete_oldest(self) -> None:
+        from ..common.tracing import CAT_EPOCH, GLOBAL_TRACE, Span, trace_span
         e, ckpt = self._inflight.pop(0)
-        self._await(self._collect_barrier(e))
+        with trace_span("barrier.collect", CAT_EPOCH, epoch=e,
+                        tid="conductor"):
+            self._await(self._collect_barrier(e))
         if ckpt and self._dead_jobs:
             # a dead job may have staged a torn subset of its tables for an
             # epoch whose checkpoint it never finished — keep those buffers
@@ -1646,47 +1700,38 @@ class Session:
             for n in self._dead_jobs:
                 self.store.discard_pending_tables(self._job_state_ids(n))
         if ckpt:
-            # persist source split offsets atomically with the epoch commit
-            # (reference: split state committed with the checkpoint barrier)
-            from ..common.types import VARCHAR
-            for feed in self.feeds:
-                if feed.state_table is None:
-                    continue
-                if feed.job in self._dead_jobs:
-                    # freeze the dead job's offsets at its last completed
-                    # checkpoint: its state did not advance, so persisting
-                    # newer offsets would silently skip the rows in between
-                    continue
-                latest = None
-                for oe in sorted(list(feed.offsets_at_epoch)):
-                    if oe <= e:
-                        latest = feed.offsets_at_epoch.pop(oe)
-                if latest is not None:
-                    for sid, off in latest.items():
-                        feed.state_table.insert(
-                            (VARCHAR.to_physical(sid), int(off)))
-                    feed.state_table.commit(e)
-            self.store.commit(e)
-            if self.workers:
-                # phase 2 of the cluster checkpoint: workers sealed and
-                # acked; only now may their staged epochs become durable
-                # (a worker killed before this frame recovers one
-                # checkpoint back and its deterministic sources replay)
-                from .remote import WorkerDied
-
-                async def _commit_remote() -> None:
-                    for w in self.workers:
-                        if w.dead:
-                            continue
-                        try:
-                            await w.commit(e)
-                        except WorkerDied:
-                            pass
-                self._await(_commit_remote())
+            with trace_span("checkpoint.commit", CAT_EPOCH, epoch=e,
+                            tid="conductor"):
+                self._commit_checkpoint(e)
         import time as _time
         t0 = self._inject_time.pop(e, None)
         if t0 is not None:
-            self.barrier_latency.record(_time.perf_counter() - t0)
+            perf0, wall0 = t0
+            lat = _time.perf_counter() - perf0
+            self.barrier_latency.record(lat)
+            # the whole-epoch span (inject → collect/commit): parent of
+            # this epoch's executor spans in the trace export
+            GLOBAL_TRACE.record(Span(
+                f"epoch {e}", CAT_EPOCH, wall0, lat, epoch=e,
+                tid="conductor", args={"checkpoint": ckpt}))
+            lat_ms = lat * 1e3
+            if (self.slow_epoch_threshold_ms
+                    and lat_ms >= self.slow_epoch_threshold_ms):
+                # slow-epoch detector: freeze the offending epoch's span
+                # tree for post-hoc inspection (the ring may overwrite it
+                # long before anyone looks). Pull workers' spans FIRST —
+                # without the forced poll a worker-hosted job's capture
+                # would hold only conductor-side spans. Short fuse: this
+                # runs INSIDE barrier completion, and a 2s stall here
+                # would itself keep every following epoch over threshold
+                self._federate_worker_stats(force=True, timeout=0.25)
+                self._slow_epoch_total += 1
+                self._slow_epochs.append({
+                    "epoch": e, "latency_ms": round(lat_ms, 3),
+                    "checkpoint": ckpt,
+                    "spans": [s.to_dict()
+                              for s in GLOBAL_TRACE.snapshot(epoch=e)],
+                })
         self.epoch = e
         # control-plane publication (reference: barrier_complete responses +
         # hummock version notifications, SURVEY.md §3.2 tail)
@@ -1694,6 +1739,48 @@ class Session:
         self.meta.publish_barrier(e, ckpt)
         if ckpt:
             self.meta.publish_checkpoint(e)
+
+    def _commit_checkpoint(self, e: int) -> None:
+        """Phase 2 of the cluster checkpoint for epoch ``e``: split
+        offsets + the session store tier, then the workers' staged
+        epochs."""
+        # persist source split offsets atomically with the epoch commit
+        # (reference: split state committed with the checkpoint barrier)
+        from ..common.types import VARCHAR
+        for feed in self.feeds:
+            if feed.state_table is None:
+                continue
+            if feed.job in self._dead_jobs:
+                # freeze the dead job's offsets at its last completed
+                # checkpoint: its state did not advance, so persisting
+                # newer offsets would silently skip the rows in between
+                continue
+            latest = None
+            for oe in sorted(list(feed.offsets_at_epoch)):
+                if oe <= e:
+                    latest = feed.offsets_at_epoch.pop(oe)
+            if latest is not None:
+                for sid, off in latest.items():
+                    feed.state_table.insert(
+                        (VARCHAR.to_physical(sid), int(off)))
+                feed.state_table.commit(e)
+        self.store.commit(e)
+        if self.workers:
+            # phase 2 of the cluster checkpoint: workers sealed and
+            # acked; only now may their staged epochs become durable
+            # (a worker killed before this frame recovers one
+            # checkpoint back and its deterministic sources replay)
+            from .remote import WorkerDied
+
+            async def _commit_remote() -> None:
+                for w in self.workers:
+                    if w.dead:
+                        continue
+                    try:
+                        await w.commit(e)
+                    except WorkerDied:
+                        pass
+            self._await(_commit_remote())
 
     def _drain_inflight(self) -> None:
         while self._inflight:
@@ -1735,6 +1822,7 @@ class Session:
         await asyncio.gather(
             *(one(n, j) for n, j in self.jobs.items()))
 
+    @_locked
     def flush(self) -> None:
         """FLUSH: complete a checkpoint epoch (DML + state made durable)."""
         self.tick(generate=False, checkpoint=True)
@@ -1742,6 +1830,7 @@ class Session:
 
     # ----------------------------------------------------------- mutations --
 
+    @_locked
     def pause(self) -> None:
         """Stop source data flow; barriers keep flowing (reference:
         Mutation::Pause, executor/mod.rs:241-251 — used during config
@@ -1750,6 +1839,7 @@ class Session:
             self.paused = True
             self.tick(generate=False, mutation=Mutation(MutationKind.PAUSE))
 
+    @_locked
     def resume(self) -> None:
         if self.paused:
             self.paused = False
@@ -1757,6 +1847,7 @@ class Session:
 
     # ---------------------------------------------------------------- query --
 
+    @_locked
     def describe(self, sql: str):
         """Output schema of ``sql``'s LAST statement WITHOUT executing it
         — the extended-protocol Describe contract (reference: pgwire
@@ -1836,6 +1927,7 @@ class Session:
 
         return rewrite(plan)
 
+    @_locked
     def query(self, sel: A.Select) -> list:
         """Batch SELECT: run the stream plan over snapshot sources."""
         self._drain_inflight()   # read-your-writes snapshot
@@ -1961,6 +2053,7 @@ class Session:
 
     # -------------------------------------------------------------- helpers --
 
+    @_locked
     def mv_rows(self, name: str) -> list:
         """Current contents of an MV (visible columns, decoded)."""
         self._drain_inflight()   # read-your-writes
@@ -2000,13 +2093,18 @@ class Session:
                     for i, v in enumerate(phys)))
         return out
 
+    @_locked
     def metrics(self) -> dict:
         """Observability dump: per-job per-executor counters + session
         barrier latency percentiles (reference:
-        src/stream/src/executor/monitor/streaming_stats.rs:27-88)."""
+        src/stream/src/executor/monitor/streaming_stats.rs:27-88),
+        FEDERATED across worker processes — a worker-hosted job's
+        counters and state bytes appear exactly like a local job's
+        (reference: per-compute-node exporters scraped into one
+        Prometheus; here the session is the scraper)."""
         from ..common.memory import pipeline_state_bytes
         from ..stream.metrics import pipeline_metrics
-        return {
+        out = {
             "barrier_latency": self.barrier_latency.snapshot(),
             "epoch": self.epoch,
             "jobs": {
@@ -2019,8 +2117,100 @@ class Session:
                 for name, job in self.jobs.items()
                 if job.pipeline is not None
             },
+            "slow_epoch_total": self._slow_epoch_total,
+            "slow_epochs": [
+                {k: v for k, v in se.items() if k != "spans"}
+                for se in self._slow_epochs
+            ],
         }
+        worker_stats = self._federate_worker_stats()
+        for wid, st in sorted(worker_stats.items()):
+            # live local jobs win over cached worker snapshots of the
+            # same name (an MV recreated in-process after worker death)
+            for name, jm in st.get("jobs", {}).items():
+                out["jobs"].setdefault(name, jm)
+            for name, nb in st.get("state_bytes", {}).items():
+                out["state_bytes"].setdefault(name, nb)
+        out["workers"] = [
+            {"worker": w.worker_id,
+             "pid": getattr(getattr(w, "proc", None), "pid", None),
+             "dead": bool(w.dead),
+             "jobs": sorted(worker_stats.get(w.worker_id, {})
+                            .get("jobs", {}))}
+            for w in self.workers
+        ]
+        return out
 
+    def _federate_worker_stats(self, force: bool = False,
+                               timeout: float = 0.5) -> dict[int, dict]:
+        """Poll every live worker's ``stats`` frame. Worker spans merge
+        into the session's trace ring (tagged pid = worker_id + 1) and the
+        per-worker snapshot refreshes ``self._worker_stats`` — a dead
+        worker keeps its last snapshot for post-hoc inspection.
+
+        Polls are rate-limited and short-fused: the caller holds the API
+        lock, so a scrape storm (dashboard auto-refresh + Prometheus) or
+        a hung-but-connected worker must not stall tick()/run_sql() on
+        the driving thread for long."""
+        if not self.workers or self.loop.is_running():
+            return self._worker_stats
+        import time as _time
+        now = _time.monotonic()
+        if not force and now - self._worker_stats_at < 0.5:
+            return self._worker_stats
+        from ..common.tracing import GLOBAL_TRACE
+
+        async def _one(w):
+            try:
+                return (w.worker_id, await w.get_stats(
+                    timeout=timeout,
+                    span_ack=self._worker_span_ack.get(w.worker_id)))
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                return None
+
+        async def _fetch() -> list:
+            # concurrent: a hung worker costs one timeout, not one per
+            # worker, while the caller holds the API lock
+            got = await asyncio.gather(
+                *(_one(w) for w in self.workers if not w.dead))
+            return [g for g in got if g is not None]
+
+        for wid, resp in self._await(_fetch()):
+            GLOBAL_TRACE.ingest(resp.pop("spans", []) or [], pid=wid + 1)
+            seq = resp.pop("span_seq", None)
+            if seq is not None:
+                self._worker_span_ack[wid] = seq
+            self._worker_stats[wid] = resp
+        self._worker_stats_at = _time.monotonic()
+        return self._worker_stats
+
+    @_locked
+    def await_tree(self) -> str:
+        """Federated await-tree dump: local jobs walked in-process plus
+        every worker-hosted job's tree over the stats RPC — "the
+        await-tree of a worker-hosted job, visible over HTTP while it
+        runs" (reference: risectl trace / dashboard await-tree,
+        monitor_service.rs:46)."""
+        from ..stream.trace import dump_session
+        self._federate_worker_stats()
+        return dump_session(self)
+
+    @_locked
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON of the span ring (Perfetto-loadable):
+        epochs on the conductor track, executors on their own tracks,
+        workers as separate processes. Optionally written to ``path``."""
+        from ..common.tracing import GLOBAL_TRACE, export_chrome_trace
+        self._federate_worker_stats()    # pull workers' latest spans
+        return export_chrome_trace(GLOBAL_TRACE.snapshot(), path=path)
+
+    @_locked
+    def slow_epochs(self) -> list:
+        """Captured slow-epoch span trees (newest last), each
+        ``{epoch, latency_ms, checkpoint, spans}``."""
+        return list(self._slow_epochs)
+
+    @_locked
     def close(self) -> None:
         """Graceful shutdown: stop all stream jobs, close sinks, close the
         session loop. A closed session cannot be reused."""

@@ -316,7 +316,7 @@ class ShardedHashJoinExecutor(Executor):
                 barrier = ev[1]
                 for out in self._flush_pending():
                     yield out
-                with barrier_timer(stats):
+                with barrier_timer(stats, self.identity, barrier.epoch.curr):
                     self._check_flags()
                     if barrier.checkpoint:
                         self._checkpoint(barrier.epoch.curr)

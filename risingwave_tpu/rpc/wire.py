@@ -18,6 +18,17 @@ relies on.
 Frame layout: 4-byte little-endian length, then UTF-8 JSON. Binary row
 payloads are base64 fields inside the JSON — simple, debuggable, and off
 the hot path (single-process pipelines never touch this module).
+
+Control-plane observability: a ``{"type": "stats"}`` request makes the
+worker answer with its full monitor snapshot — per-job executor trees,
+per-executor counters, exchange-channel queue depths, state bytes, and a
+drain of its tracing-span ring (reference: MonitorService.stack_trace,
+src/compute/src/rpc/service/monitor_service.rs:46). The session federates
+those snapshots into ``Session.metrics()`` / the dashboard so a
+worker-hosted job is as visible as a local one. Spans cross as
+``Span.to_dict()`` dicts (``common/tracing.py`` is the codec: the worker
+ships ``to_dict``, the session re-ingests via ``TraceRecorder.ingest``,
+which tolerates unknown keys from a newer worker).
 """
 
 from __future__ import annotations

@@ -349,11 +349,14 @@ class DurableStateStore(MemoryStateStore):
     def commit(self, epoch: int) -> None:
         if epoch <= self.committed_epoch:
             return
+        from ..common.tracing import CAT_STORAGE, trace_span
         deltas: dict[int, dict[bytes, Optional[bytes]]] = {}
         for e in sorted(k for k in self._pending if k <= epoch):
             for table_id, buf in self._pending[e].items():
                 deltas.setdefault(table_id, {}).update(buf)
-        self.log.append_epoch(epoch, deltas)
+        with trace_span("DurableStateStore.commit", CAT_STORAGE,
+                        epoch=epoch, tid="storage", tables=len(deltas)):
+            self.log.append_epoch(epoch, deltas)
         super().commit(epoch)
 
     def drop_table(self, table_id: int) -> None:

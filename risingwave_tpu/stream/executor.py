@@ -82,7 +82,7 @@ class SingleInputExecutor(Executor):
                     stats.chunks_out += 1
                     yield out
             elif isinstance(msg, Barrier):
-                with barrier_timer(stats):
+                with barrier_timer(stats, self.identity, msg.epoch.curr):
                     outs = [out async for out in self.on_barrier(msg)]
                 for out in outs:
                     stats.chunks_out += 1

@@ -266,7 +266,11 @@ class HashAggExecutor(SingleInputExecutor):
             self._pending_clean.clear()
             cleaned = True
         if barrier.checkpoint and self.state_table is not None:
-            self._checkpoint_to_state_table(barrier.epoch.curr)
+            from ..common.tracing import CAT_STORAGE, trace_span
+            with trace_span(f"{self.identity}.checkpoint", CAT_STORAGE,
+                            epoch=barrier.epoch.curr, tid=self.identity,
+                            groups=n_live):
+                self._checkpoint_to_state_table(barrier.epoch.curr)
             if (self.hbm_group_budget is not None
                     and n_live > self.hbm_group_budget):
                 self._evict_cold()

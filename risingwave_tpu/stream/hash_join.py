@@ -288,7 +288,7 @@ class HashJoinExecutor(Executor):
                 barrier = ev[1]
                 for out in self._flush_pending():
                     yield out
-                with barrier_timer(stats):
+                with barrier_timer(stats, self.identity, barrier.epoch.curr):
                     self._check_flags()
                     if barrier.checkpoint:
                         cleaned = self._apply_pending_clean()

@@ -44,7 +44,10 @@ class MaterializeExecutor(SingleInputExecutor):
         # and could strand them pending forever (reference: HummockManager.
         # commit_epoch is driven by meta after barrier collection, not by
         # materialize).
-        self.table.commit(barrier.epoch.curr)
+        from ..common.tracing import CAT_STORAGE, trace_span
+        with trace_span(f"{self.identity}.seal", CAT_STORAGE,
+                        epoch=barrier.epoch.curr, tid=self.identity):
+            self.table.commit(barrier.epoch.curr)
         if False:
             yield
 

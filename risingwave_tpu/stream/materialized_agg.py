@@ -97,12 +97,17 @@ class MaterializedAggExecutor(SingleInputExecutor):
         self.group_keys = tuple(group_keys)
         self.agg_calls = tuple(agg_calls)
         for c in self.agg_calls:
-            if c.arg_type is not None and c.arg_type.is_list:
-                # list-dictionary ids are process-local; the multiset
-                # value columns persist ints/floats/strings by content
-                # but have no durable list representation
+            if c.arg_type is not None and (c.arg_type.is_list
+                                           or c.arg_type.is_struct):
+                # list/struct dictionary ids are process-local; the
+                # multiset value columns persist ints/floats/strings by
+                # content but have no durable composite representation —
+                # persisted raw ids would silently miscount DISTINCT/mode
+                # after recovery
                 raise ValueError(
-                    f"{c.kind}() over an array column is not supported")
+                    f"{c.kind}() over an array column is not supported"
+                    if c.arg_type.is_list else
+                    f"{c.kind}() over a struct column is not supported")
         self.in_schema = input.schema
         self.state_table = state_table
         self.out_capacity = out_capacity
@@ -182,6 +187,13 @@ class MaterializedAggExecutor(SingleInputExecutor):
             if c.distinct:
                 return len(counter)
             return sum(counter.values())
+        if c.kind == "approx_count_distinct":
+            # a call that normally lives on the device HLL lanes can be
+            # routed here when ANY sibling call needs materialized input
+            # (frontend/build.py sends the whole agg); the multiset is
+            # already exact, and an exact distinct count is a valid
+            # superset of the approximate contract
+            return len(counter)
         if c.kind == "array_agg" and (counter or g.null_counts[i]):
             pass                     # NULL elements alone still aggregate
         elif not counter:

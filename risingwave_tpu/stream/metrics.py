@@ -16,6 +16,8 @@ import dataclasses
 import time
 from typing import Iterator, Optional
 
+from ..common.tracing import CAT_BARRIER, GLOBAL_TRACE, Span
+
 
 @dataclasses.dataclass
 class ExecutorStats:
@@ -33,23 +35,37 @@ class ExecutorStats:
 
 
 class _BarrierTimer:
-    __slots__ = ("stats", "_t0")
+    __slots__ = ("stats", "identity", "epoch", "_t0", "_ts")
 
-    def __init__(self, stats: ExecutorStats):
+    def __init__(self, stats: ExecutorStats, identity: Optional[str] = None,
+                 epoch: Optional[int] = None):
         self.stats = stats
+        self.identity = identity
+        self.epoch = epoch
 
     def __enter__(self):
+        self._ts = time.time()
         self._t0 = time.perf_counter()
         self.stats.barriers += 1
         return self
 
     def __exit__(self, *exc):
-        self.stats.barrier_seconds += time.perf_counter() - self._t0
+        dur = time.perf_counter() - self._t0
+        self.stats.barrier_seconds += dur
+        if self.identity is not None:
+            # the tracing seam: every identified barrier timing doubles as
+            # a per-executor span in the epoch's trace tree
+            GLOBAL_TRACE.record(Span(
+                f"{self.identity}.barrier", CAT_BARRIER, self._ts, dur,
+                epoch=self.epoch, tid=self.identity))
         return False
 
 
-def barrier_timer(stats: ExecutorStats) -> _BarrierTimer:
-    return _BarrierTimer(stats)
+def barrier_timer(stats: ExecutorStats, identity: Optional[str] = None,
+                  epoch: Optional[int] = None) -> _BarrierTimer:
+    """Time one barrier's handling into ``stats``; with ``identity`` (and
+    ideally ``epoch``) the timing is also recorded as a tracing span."""
+    return _BarrierTimer(stats, identity, epoch)
 
 
 def iter_executors(root) -> Iterator:

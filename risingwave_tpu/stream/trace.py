@@ -39,12 +39,38 @@ def executor_tree(root, indent: int = 0) -> List[str]:
 
 
 def dump_session(session) -> str:
-    """Full session dump: per-job executor trees + barrier progress."""
+    """Full session dump: per-job executor trees + barrier progress.
+
+    Worker-hosted jobs (pipeline lives in another process) render from
+    the session's federation cache — their trees arrive over the ``stats``
+    control frame (``Session._federate_worker_stats``), so a remote job is
+    as inspectable as a local one (reference: MonitorService.stack_trace
+    aggregating per-compute-node await-trees)."""
     lines = [
         f"epoch: completed={session.epoch} injected={session._injected} "
         f"in_flight={[e for e, _ in session._inflight]}",
     ]
+    remote_trees: dict = {}
+    for wid, st in sorted(getattr(session, "_worker_stats", {}).items()):
+        for name, tree in (st.get("trees") or {}).items():
+            remote_trees[name] = (wid, tree)
     for name, job in session.jobs.items():
-        lines.append(f"job {name!r}:")
-        lines.extend(executor_tree(job.pipeline, indent=1))
+        if job.pipeline is not None:
+            # a live local pipeline always wins over a cached worker
+            # snapshot of the same name (e.g. an MV recreated in-process
+            # after its worker died)
+            remote_trees.pop(name, None)
+            lines.append(f"job {name!r}:")
+            lines.extend(executor_tree(job.pipeline, indent=1))
+            continue
+        if name in remote_trees:
+            wid, tree = remote_trees.pop(name)
+            lines.append(f"job {name!r} (worker {wid}):")
+            lines.extend("  " + ln for ln in tree)
+            continue
+        lines.append(f"job {name!r}: <remote; no stats snapshot yet>")
+    # trees cached for jobs no longer in session.jobs (post-mortem)
+    for name, (wid, tree) in remote_trees.items():
+        lines.append(f"job {name!r} (worker {wid}, cached):")
+        lines.extend("  " + ln for ln in tree)
     return "\n".join(lines)

@@ -136,6 +136,11 @@ class WorkerHost:
         self._next_shard = worker_id * 4096 + 1
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock = asyncio.Lock()
+        # tracing-span outbox: drained batches are retained until the
+        # session's NEXT stats request acknowledges their sequence
+        # number, so a timed-out (discarded) stats reply loses no spans
+        self._span_outbox: list = []
+        self._span_seq = 0
 
     async def send(self, obj: dict) -> None:
         if self._writer is not None:
@@ -316,10 +321,13 @@ class WorkerHost:
                     for ch in _channel_roots(job):
                         ch.queue.put_nowait(barrier)
         try:
-            for name in scope:
-                job = self.jobs.get(name)
-                if job is not None:
-                    await job.wait_barrier(epoch)
+            from ..common.tracing import CAT_EPOCH, trace_span
+            with trace_span("barrier.collect", CAT_EPOCH, epoch=epoch,
+                            tid="conductor", checkpoint=checkpoint):
+                for name in scope:
+                    job = self.jobs.get(name)
+                    if job is not None:
+                        await job.wait_barrier(epoch)
         except BaseException as e:   # noqa: BLE001 - surfaced to the session
             await self.send({"type": "barrier_complete", "epoch": epoch,
                              "ok": False, "error": repr(e)})
@@ -365,6 +373,47 @@ class WorkerHost:
         rows = [base64.b64encode(encode_value_row(r, types)).decode()
                 for r in run_batch(ex)]
         return {"ok": True, "rows": rows}
+
+    # -- monitor ---------------------------------------------------------------
+
+    def handle_stats(self, req: dict) -> dict:
+        """Monitor snapshot: per-job executor trees + counters + state
+        bytes, exchange queue depths, and a drain of this process's
+        tracing-span ring — the worker half of metrics federation
+        (reference: MonitorService.stack_trace + Prometheus exporters,
+        src/compute/src/rpc/service/monitor_service.rs:46)."""
+        from ..common.memory import pipeline_state_bytes
+        from ..common.tracing import GLOBAL_TRACE
+        from ..stream.metrics import pipeline_metrics
+        from ..stream.trace import executor_tree
+        jobs: dict = {}
+        trees: dict = {}
+        state_bytes: dict = {}
+        for name, job in self.jobs.items():
+            if job.pipeline is None:
+                continue
+            jobs[name] = pipeline_metrics(job.pipeline)
+            trees[name] = executor_tree(job.pipeline)
+            try:
+                state_bytes[name] = pipeline_state_bytes(job.pipeline)
+            except Exception:  # noqa: BLE001 - stats must never fail a job
+                pass
+        if req.get("span_ack") == self._span_seq:
+            self._span_outbox = []         # previous batch safely landed
+        new = GLOBAL_TRACE.drain()
+        if new:
+            self._span_outbox.extend(s.to_dict() for s in new)
+            cap = GLOBAL_TRACE.capacity    # bound resends like the ring
+            if len(self._span_outbox) > cap:
+                del self._span_outbox[:-cap]
+            self._span_seq += 1
+        return {
+            "ok": True, "worker_id": self.worker_id,
+            "jobs": jobs, "trees": trees, "state_bytes": state_bytes,
+            "queue_depths": {str(c): ch.queue.qsize()
+                             for c, ch in self.channels.items()},
+            "spans": list(self._span_outbox), "span_seq": self._span_seq,
+        }
 
     # -- scan ------------------------------------------------------------------
 
@@ -424,6 +473,10 @@ class WorkerHost:
                     async def _scan(f):
                         return self.handle_scan(f)
                     await self._reply(frame, _scan)
+                elif t == "stats":
+                    async def _stats(f):
+                        return self.handle_stats(f)
+                    await self._reply(frame, _stats)
                 elif t == "batch_task":
                     async def _bt(f):
                         return self.handle_batch_task(f)

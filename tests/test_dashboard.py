@@ -62,3 +62,68 @@ def test_dashboard_404():
     finally:
         dash.close()
         s.close()
+
+
+def test_dashboard_trace_and_slow_epoch_endpoints():
+    from risingwave_tpu.common.tracing import GLOBAL_TRACE
+
+    GLOBAL_TRACE.clear()
+    s = Session()
+    s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.run_sql("CREATE MATERIALIZED VIEW m AS "
+              "SELECT k, sum(v) AS sv FROM t GROUP BY k")
+    s.run_sql("SET slow_epoch_threshold_ms = 0.0001")
+    s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+    s.tick()
+    s.tick()
+    dash = serve_dashboard(s)
+    try:
+        status, body = _get(dash.port, "/api/trace")
+        assert status == 200
+        obj = json.loads(body)
+        events = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+        assert any(e["name"].startswith("epoch ") for e in events)
+        assert any(e["cat"] == "barrier" for e in events)
+
+        status, body = _get(dash.port, "/api/slow_epochs")
+        assert status == 200
+        slow = json.loads(body)
+        assert slow and slow[-1]["spans"]      # span tree captured
+
+        # landing page links the trace download
+        _, html = _get(dash.port, "/")
+        assert "/api/trace" in html and "slow_epochs" in html
+    finally:
+        dash.close()
+        s.close()
+
+
+def test_dashboard_profiler_endpoint_gated():
+    """The jax.profiler endpoints are POST-only (a GET must not mutate
+    profiler state) and answer 403 without profiler_dir — device trace
+    capture must be an explicit operator decision."""
+    import urllib.error
+
+    def _post(port, path):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status
+
+    s = Session()
+    dash = serve_dashboard(s)
+    try:
+        for path in ("/api/profiler/start", "/api/profiler/stop"):
+            try:
+                _get(dash.port, path)
+                raise AssertionError("expected 405")
+            except urllib.error.HTTPError as e:
+                assert e.code == 405          # GET never mutates
+            try:
+                _post(dash.port, path)
+                raise AssertionError("expected 403")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403          # disabled without opt-in
+    finally:
+        dash.close()
+        s.close()

@@ -179,3 +179,46 @@ def test_unnest_and_array_functions():
     s.tick()
     assert sorted(s.run_sql("SELECT * FROM un")) == [(1, 4), (2, 9)]
     s.close()
+
+
+def test_approx_count_distinct_with_materialized_sibling():
+    """A CREATE MV mixing approx_count_distinct with another
+    materialized-input agg routes ALL calls to MaterializedAggExecutor
+    (frontend/build.py sends the whole agg); the executor evaluates it
+    there as exact len(counter) — a valid superset of the approximate
+    contract. Regression: the missing branch used to kill the stream job
+    on the first barrier."""
+    s = fresh()
+    s.run_sql("CREATE MATERIALIZED VIEW m AS SELECT k, "
+              "approx_count_distinct(v) AS ad, count(distinct v) AS dv "
+              "FROM t GROUP BY k")
+    s.run_sql("INSERT INTO t VALUES (1, 1, 10, 'a'), (2, 1, 10, 'b'), "
+              "(3, 1, 20, 'c'), (4, 2, 5, 'd')")
+    s.tick()
+    # evaluated over the exact multiset: ad == dv exactly
+    assert sorted(s.mv_rows("m")) == [(1, 2, 2), (2, 1, 1)]
+    # retraction flows through both calls (the device HLL can't retract;
+    # the materialized path must)
+    s.run_sql("DELETE FROM t WHERE s = 'c'")
+    s.tick()
+    assert sorted(s.mv_rows("m")) == [(1, 1, 1), (2, 1, 1)]
+    # the job survived its barriers — counters still stream
+    s.run_sql("INSERT INTO t VALUES (5, 2, 6, 'e')")
+    s.tick()
+    assert sorted(s.mv_rows("m")) == [(1, 1, 1), (2, 2, 2)]
+    s.close()
+
+
+def test_struct_agg_arg_rejected():
+    """STRUCT agg args are rejected like LIST args: struct dictionary
+    ids are process-local, so persisted raw ids would silently miscount
+    DISTINCT/mode after recovery."""
+    import pytest
+
+    s = Session()
+    s.run_sql("CREATE TABLE ts (id BIGINT PRIMARY KEY, "
+              "st STRUCT<a BIGINT, b VARCHAR>)")
+    with pytest.raises(Exception, match="struct column is not supported"):
+        s.run_sql("CREATE MATERIALIZED VIEW bad AS "
+                  "SELECT count(distinct st) AS d FROM ts")
+    s.close()

@@ -52,3 +52,15 @@ def test_http_scrape():
     finally:
         srv.close()
         s.close()
+
+
+def test_render_slow_epoch_counter():
+    s = _session()
+    s.run_sql("SET slow_epoch_threshold_ms = 0.0001")
+    s.tick()
+    s._drain_inflight()
+    text = render_metrics(s)
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("rw_slow_epoch_total"))
+    assert float(line.split(" ")[-1]) >= 1
+    s.close()

@@ -71,6 +71,30 @@ def test_struct_decimal_scale_and_nesting_survive():
         s2.close()
 
 
+def test_row_literal_carries_full_field_types():
+    """ROW(…) builds its struct type from the items' FULL DataTypes —
+    a decimal field keeps its scale (the bare-kind bug decoded
+    1.23::decimal as 123 after the scale was dropped), and a literal
+    cast inside ROW is const-folded rather than rejected."""
+    s = Session()
+    assert s.run_sql("SELECT ROW(1.23::decimal)") == [((1.23,),)]
+    assert s.run_sql("SELECT ROW('hi'::varchar, 2::bigint)") == [
+        (("hi", 2),)]
+    # round-trip through a stored struct column and field access
+    s.run_sql("CREATE TABLE t (id BIGINT PRIMARY KEY, "
+              "v STRUCT<f1 DECIMAL>)")
+    s.run_sql("INSERT INTO t VALUES (1, ROW(1.23::decimal))")
+    s.tick()
+    assert s.run_sql("SELECT (v).f1 FROM t") == [(1.23,)]
+    assert s.run_sql("SELECT v FROM t") == [((1.23,),)]
+    # a cast the fold can't represent stays a clean bind error, not a
+    # crash inside the type conversion
+    import pytest
+    with pytest.raises(Exception, match="must be constants"):
+        s.run_sql("SELECT ROW(1::varchar)")
+    s.close()
+
+
 def test_struct_arity_mismatch_rejected():
     import pytest
     s = Session()
