@@ -274,7 +274,8 @@ class BatchHashJoin(BatchExecutor):
                  join_type: str = "inner",
                  condition: Optional[Expr] = None,
                  table_capacity: int = 1 << 16,
-                 prefer_build: str = "right"):
+                 prefer_build: str = "right",
+                 null_aware: bool = False):
         if join_type not in ("inner", "left", "right", "full",
                              "left_semi", "left_anti"):
             raise BatchFallback(f"batch join type {join_type!r}")
@@ -283,6 +284,9 @@ class BatchHashJoin(BatchExecutor):
         self.right_keys = tuple(right_keys)
         self.join_type = join_type
         self.condition = condition
+        #: PG NOT IN: a NULL on the build (subquery) side means no probe
+        #: row passes the anti join at all (planner.py _plan_in_subquery)
+        self.null_aware = null_aware and join_type == "left_anti"
         self.capacity = table_capacity
         # plan-time hint (pk covers the join key ⇒ provably unique):
         # avoids a wasted trial build; probe-side-outer shapes fix the
@@ -463,6 +467,18 @@ class BatchHashJoin(BatchExecutor):
                 f"(> {self.MAX_BUCKET_W} rows per key or too many keys); "
                 "falling back to the streaming join")
         table, counts, cols_acc, masks_acc = built
+        if self.null_aware:
+            # NOT IN semantics: any null-keyed build row poisons the
+            # whole anti join — x <> NULL is unknown for every x, so PG
+            # returns zero rows. One host sync over the (already
+            # materialized) build chunks, taken before they are freed.
+            build_keys = self.left_keys if swapped else self.right_keys
+            for chunk in build_chunks:
+                keyed = chunk.vis
+                for i in build_keys:
+                    keyed = keyed & chunk.columns[i].mask
+                if bool(jnp.any(chunk.vis & ~keyed)):
+                    return
         null_keyed = []
         if self.join_type == "full":
             # null-keyed build rows never match (skipped by the build),

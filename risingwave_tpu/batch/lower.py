@@ -146,7 +146,8 @@ def lower_plan(plan: P.PlanNode, store,
         return BatchHashJoin(left, right, list(plan.left_keys),
                              list(plan.right_keys), join_type=plan.kind,
                              condition=plan.condition,
-                             prefer_build=prefer)
+                             prefer_build=prefer,
+                             null_aware=getattr(plan, "null_aware", False))
     if isinstance(plan, P.PTopN):
         if plan.with_ties or plan.group_by:
             return None

@@ -24,6 +24,10 @@ def _build_session(args):
         kwargs["checkpoint_frequency"] = args.checkpoint_frequency
     if getattr(args, "workers", 0):
         kwargs["workers"] = args.workers
+    if getattr(args, "state_store", None):
+        kwargs["state_store"] = args.state_store
+    if getattr(args, "compactors", 0):
+        kwargs["compactors"] = args.compactors
     return Session(**kwargs)
 
 
@@ -43,6 +47,14 @@ def main(argv=None) -> int:
     pg.add_argument("--workers", type=int, default=0,
                     help="worker PROCESSES hosting MV jobs (reference: "
                     "compute nodes; 0 = everything in-process)")
+    pg.add_argument("--state-store", default=None,
+                    choices=["segment", "hummock"],
+                    help="durable tier for a NEW data dir: epoch-delta "
+                    "segment log, or Hummock-lite L0 SSTs under a "
+                    "versioned manifest (recovery auto-detects)")
+    pg.add_argument("--compactors", type=int, default=0,
+                    help="dedicated compactor worker PROCESSES "
+                    "(hummock tier; 0 = in-process background fold)")
     pg.add_argument("--user", default="root",
                     help="user name for password auth (with --password)")
     pg.add_argument("--password", default=None,
@@ -65,10 +77,23 @@ def main(argv=None) -> int:
                     "(reference: risectl)")
     ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
                                       "metrics", "trace", "backup",
-                                      "restore", "backup-info"])
+                                      "restore", "backup-info",
+                                      "hummock", "vacuum"])
     ctl.add_argument("--data-dir", required=True)
     ctl.add_argument("--backup-dir",
                      help="backup location for backup/restore/backup-info")
+    ctl.add_argument("--force", action="store_true",
+                     help="vacuum: actually delete (default is a dry "
+                     "run; only safe with no live session on the dir)")
+
+    comp = sub.add_parser(
+        "compactor",
+        help="run a dedicated Hummock-lite compaction worker against a "
+             "shared object-store root (reference: the standalone "
+             "compactor node)")
+    comp.add_argument("--data-dir", required=True)
+    comp.add_argument("--worker-id", type=int, default=0)
+    comp.add_argument("--port", type=int, default=0)
 
     args = p.parse_args(argv)
 
@@ -76,6 +101,12 @@ def main(argv=None) -> int:
         return _playground(args)
     if args.command == "ctl":
         return _ctl(args)
+    if args.command == "compactor":
+        from .worker.compactor import main as compactor_main
+        compactor_main(["--data-dir", args.data_dir,
+                        "--worker-id", str(args.worker_id),
+                        "--port", str(args.port)])
+        return 0
     session = _build_session(args)
     sql = (args.statement if args.command == "sql"
            else open(args.path, "r", encoding="utf-8").read())
@@ -104,6 +135,38 @@ def _ctl(args) -> int:
         else:
             desc = list_backup(args.backup_dir)
         print(_json.dumps(desc, indent=2))
+        return 0
+    if args.what in ("hummock", "vacuum"):
+        # storage-only inspection: no session (and no job recovery) —
+        # read the version manifest straight off the object store
+        from .meta.hummock import HummockManager
+        from .storage.object_store import LocalFsObjectStore
+        mgr = HummockManager(LocalFsObjectStore(args.data_dir))
+        if not mgr.exists():
+            raise SystemExit(
+                f"{args.data_dir!r} holds no hummock version manifest")
+        if args.what == "vacuum":
+            # OFFLINE-ONLY: pins, in-progress uploads, and in-flight
+            # compaction tasks live in the OWNING session's memory — a
+            # fresh manager cannot see them, so vacuuming under a live
+            # session could delete objects it is about to reference. The
+            # live path is the session's own vacuum (the compaction pump
+            # runs it after every task). Default is therefore a DRY RUN;
+            # --force performs the deletes and is the operator's
+            # assertion that no session is running over this dir.
+            if args.force:
+                deleted = mgr.vacuum()
+                print(_json.dumps({"deleted": deleted}, indent=2))
+            else:
+                victims = mgr.vacuum(dry_run=True)
+                print(_json.dumps({
+                    "would_delete": victims,
+                    "note": "dry run — pass --force only when NO live "
+                            "session is using this data dir (a live "
+                            "cluster vacuums itself)"}, indent=2))
+        else:
+            print(_json.dumps({"version": mgr.version.summary(),
+                               "stats": mgr.stats}, indent=2))
         return 0
     session = _build_session(args)
     try:

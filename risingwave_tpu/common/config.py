@@ -39,6 +39,17 @@ class StreamingConfig:
 class StorageConfig:
     data_dir: Optional[str] = None          # None = RAM-only playground
     segment_target_bytes: int = 4 << 20
+    # durable-tier backend: "segment" = epoch-delta log + in-process fold
+    # (storage/checkpoint.py); "hummock" = L0 SSTs under a meta-managed
+    # version with a compactor role (storage/hummock.py). None = AUTO:
+    # recovery detects an existing dir's tier; a new dir gets "segment".
+    # The default must stay None — a concrete default would be
+    # indistinguishable from an explicit choice and would silently open
+    # an existing hummock dir as a fresh segment store.
+    state_store: Optional[str] = None
+    # dedicated compactor worker processes (hummock tier only; 0 keeps
+    # compaction on an in-process background thread)
+    compactors: int = 0
 
 
 @dataclasses.dataclass

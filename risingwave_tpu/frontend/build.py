@@ -206,6 +206,16 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
         return SimpleAggExecutor(inp, list(plan.agg_calls), state_table=st)
 
     if isinstance(plan, P.PJoin):
+        if getattr(plan, "null_aware", False) and (
+                cfg.mesh is not None or (
+                    plan.left_keys and cfg.fragment_parallelism > 1
+                    and ctx.durable)):
+            # sharded/fragmented anti joins don't carry the NOT IN null
+            # guard; fail at build time, not with silently wrong rows
+            raise ValueError(
+                "NOT IN (SELECT ...) is not supported on sharded or "
+                "fragmented join layouts; use NOT EXISTS or the default "
+                "layout")
         if (plan.left_keys and cfg.fragment_parallelism > 1
                 and cfg.mesh is None and ctx.durable):
             # multi-fragment build: both sides hash-dispatch by join key
@@ -236,7 +246,8 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
             key_capacity=cfg.join_key_capacity,
             bucket_width=cfg.join_bucket_width,
             out_capacity=cfg.chunk_capacity,
-            hbm_key_budget=cfg.join_hbm_budget)
+            hbm_key_budget=cfg.join_hbm_budget,
+            null_aware_anti=getattr(plan, "null_aware", False))
 
     if isinstance(plan, P.PTopN):
         inp = build_plan(plan.input, ctx)

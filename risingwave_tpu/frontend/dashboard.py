@@ -46,6 +46,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
+<h2>storage tier</h2><pre id="storage">loading…</pre>
 <h2>metrics</h2><pre id="metrics">loading…</pre>
 <script>
 async function load(id, url, text) {
@@ -53,12 +54,20 @@ async function load(id, url, text) {
   document.getElementById(id).textContent =
     text ? await r.text() : JSON.stringify(await r.json(), null, 2);
 }
+async function loadStorage() {
+  const r = await fetch("/api/metrics");
+  const m = await r.json();
+  document.getElementById("storage").textContent =
+    JSON.stringify(m.storage || {}, null, 2);
+  document.getElementById("metrics").textContent =
+    JSON.stringify(m, null, 2);
+}
 function refresh() {
   load("cluster", "/api/cluster");
   load("fragments", "/api/fragments", true);
   load("await_tree", "/api/await_tree", true);
   load("slow_epochs", "/api/slow_epochs");
-  load("metrics", "/api/metrics");
+  loadStorage();
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""

@@ -164,14 +164,19 @@ class PJoin(PlanNode):
     left_keys: tuple
     right_keys: tuple
     condition: Optional[Expr]        # residual non-equi condition, over concat
+    #: PG NOT IN semantics for a left_anti join: a NULL in the subquery
+    #: (build side) means NO probe row passes. The planner also filters
+    #: NULL probe keys below the join (they never pass NOT IN).
+    null_aware: bool = False
 
     @property
     def children(self):
         return (self.left, self.right)
 
     def _describe(self):
+        na = ", null_aware" if self.null_aware else ""
         return (f"HashJoin {{type={self.kind}, on={list(self.left_keys)}="
-                f"{list(self.right_keys)}, pk={list(self.pk)}}}")
+                f"{list(self.right_keys)}{na}, pk={list(self.pk)}}}")
 
 
 @dataclasses.dataclass
@@ -346,10 +351,10 @@ class Planner:
                            predicate=pred)
 
         # IN (SELECT …) conjuncts become left semi joins; NOT IN becomes
-        # left anti (reference: subquery unnesting Apply rules,
-        # src/frontend/src/optimizer/rule/apply_join_transpose_rule.rs).
-        # NOT-IN NULL caveat: PG yields no rows when the subquery produces
-        # a NULL; the anti join keys on equality only.
+        # a NULL-AWARE left anti join (reference: subquery unnesting Apply
+        # rules, src/frontend/src/optimizer/rule/apply_join_transpose_rule.rs):
+        # NULL probe keys are filtered below the join, and a NULL produced
+        # by the subquery yields no rows (batch) / a loud error (streaming).
         for conj in in_conjuncts:
             node = self._plan_in_subquery(conj, node, scope)
 
@@ -1011,9 +1016,27 @@ class Planner:
             raise PlanError("IN subquery must produce exactly one column")
         # hidden stream-key columns (appended by the planner) ride along
         # as the semi-join state's pk; only column 0 joins
-        kind = "left_anti" if conj.negated else "left_semi"
+        if conj.negated:
+            # PG NOT IN NULL semantics: a NULL probe value never passes
+            # (x <> NULL is unknown), so filter it below the join; a NULL
+            # in the subquery means NO row passes — the anti join carries
+            # ``null_aware`` so each engine enforces it (batch: emit
+            # nothing; streaming: reject loudly rather than diverge).
+            # KNOWN divergence: PG keeps a NULL probe row when the
+            # subquery is EMPTY (NOT IN over the empty set is TRUE); the
+            # static filter drops it regardless. Incrementally exact
+            # behavior would retract those rows on the subquery's
+            # empty→non-empty transition — out of scope, and the corner
+            # (NULL probe AND always-empty subquery) is documented here
+            # rather than silently wrong in the common case.
+            node = PFilter(schema=node.schema, pk=node.pk, input=node,
+                           predicate=call("is_not_null", b))
+            return PJoin(schema=node.schema, pk=node.pk, left=node,
+                         right=sub, kind="left_anti",
+                         left_keys=(b.index,), right_keys=(0,),
+                         condition=None, null_aware=True)
         return PJoin(schema=node.schema, pk=node.pk, left=node, right=sub,
-                     kind=kind, left_keys=(b.index,), right_keys=(0,),
+                     kind="left_semi", left_keys=(b.index,), right_keys=(0,),
                      condition=None)
 
     def _plan_no_from(self, sel: A.Select) -> PlanNode:

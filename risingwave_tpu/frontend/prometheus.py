@@ -77,6 +77,24 @@ def render_metrics(session) -> str:
                   "latency tripped the slow-epoch threshold.",
                   "# TYPE rw_slow_epoch_total counter",
                   f"rw_slow_epoch_total {m['slow_epoch_total']}"]
+    storage = m.get("storage") or {}
+    if storage:
+        lines += ["# HELP rw_storage_stat Durable-tier counters "
+                  "(hummock: version id, level shape, compaction + "
+                  "vacuum progress).",
+                  "# TYPE rw_storage_stat gauge"]
+        tier = _sanitize(storage.get("tier", "unknown"))
+        for name, value in storage.items():
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            lines.append(
+                f'rw_storage_stat{{tier="{tier}",'
+                f'stat="{_sanitize(name)}"}} {value}')
+        for c in storage.get("compactors", ()):
+            lines.append(
+                f'rw_compactor_up{{worker="{c["worker"]}"}} '
+                f'{0 if c.get("dead") else 1}')
     return "\n".join(lines) + "\n"
 
 

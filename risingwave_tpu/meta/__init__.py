@@ -1,3 +1,4 @@
 from .cluster import ClusterManager, WorkerNode  # noqa: F401
 from .fragment import Fragment, FragmentManager, fragment_plan  # noqa: F401
 from .notification import NotificationManager  # noqa: F401
+from .hummock import HummockManager  # noqa: F401

@@ -1,0 +1,283 @@
+"""HummockManager: the meta-side LSM version manager.
+
+Counterpart of the reference's Hummock manager on the meta node
+(reference: src/meta/src/hummock/manager/ — ``commit_epoch`` version
+bumps, ``pin_version``/``unpin`` leases for consistent snapshot reads,
+``get_compact_task``/``report_compact_task`` driving stateless compactor
+workers, and the vacuum that deletes SSTs no version references;
+versioning.rs for the pinned-version safety rule).
+
+The manager owns exactly one mutable thing: the CURRENT
+``HummockVersion``, published to the object store via ``atomic_put`` so
+readers see the old manifest or the new one, never a torn mix. Every
+other structure here (pins, in-flight compact tasks, stale-object
+bookkeeping) exists to answer one question safely: *which SST objects
+may vacuum delete?*
+
+Safety rule (the invariant every test leans on):
+
+    an object may be deleted iff it is referenced by
+      - no current version,
+      - no pinned version,
+      - no in-flight compaction task (inputs still being read,
+        outputs not yet committed).
+
+Pins are process-local leases (the reference's are worker leases on the
+meta node — same lifetime: a crashed process's pins vanish with it, and
+its reads vanish too).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from ..storage.hummock import (
+    SST_PREFIX, VERSION_KEY, CompactTask, HummockVersion,
+)
+from ..storage.object_store import ObjectStore
+
+
+class HummockManager:
+    """Version manager over one ObjectStore namespace."""
+
+    #: L0 runs that trigger a compaction task (bounds read amplification
+    #: the same way CheckpointLog.COMPACT_AFTER bounds segment counts)
+    L0_COMPACT_TRIGGER = 8
+
+    def __init__(self, store: ObjectStore,
+                 l0_compact_trigger: Optional[int] = None):
+        self.store = store
+        if l0_compact_trigger is not None:
+            self.L0_COMPACT_TRIGGER = l0_compact_trigger
+        self._lock = threading.RLock()
+        self._version = self._load_or_init()
+        self._pins: Dict[int, HummockVersion] = {}
+        self._pin_ids = itertools.count(1)
+        self._task_ids = itertools.count(1)
+        self._inflight: Dict[int, CompactTask] = {}
+        # SSTs PUT but not yet referenced by a published version: the
+        # barrier path uploads the L0 object first and commits the
+        # version second, so a concurrently running vacuum would see an
+        # orphan about to become referenced — registering the upload
+        # closes that window (reference: vacuum's SST-id watermark)
+        self._pending_uploads: Set[str] = set()
+        # observability counters (surfaced via Session.metrics()["storage"]
+        # and the Prometheus exposition)
+        self.stats = {
+            "version_id": self._version.vid,
+            "commits": 0,
+            "l0_runs": len(self._version.l0),
+            "l1_runs": len(self._version.l1),
+            "compact_tasks_scheduled": 0,
+            "compact_tasks_completed": 0,
+            "compact_tasks_failed": 0,
+            "ssts_vacuumed": 0,
+            "vacuum_runs": 0,
+        }
+
+    # -- version plumbing ------------------------------------------------------
+
+    def _load_or_init(self) -> HummockVersion:
+        raw = self.store.get(VERSION_KEY)
+        if raw is None:
+            return HummockVersion.initial()
+        return HummockVersion.from_bytes(raw)
+
+    def _publish(self, v: HummockVersion) -> None:
+        from ..common.failpoint import fail_point
+        fail_point("hummock.version.publish")
+        self.store.atomic_put(VERSION_KEY, v.to_bytes())
+        self._version = v
+        self.stats["version_id"] = v.vid
+        self.stats["l0_runs"] = len(v.l0)
+        self.stats["l1_runs"] = len(v.l1)
+
+    @property
+    def version(self) -> HummockVersion:
+        """The current version (immutable snapshot; safe to hold)."""
+        with self._lock:
+            return self._version
+
+    def exists(self) -> bool:
+        return self.store.exists(VERSION_KEY)
+
+    # -- epoch commit ----------------------------------------------------------
+
+    def begin_upload(self, name: str) -> None:
+        """Register an SST about to be PUT: vacuum must not delete it in
+        the window before the version referencing it publishes."""
+        with self._lock:
+            self._pending_uploads.add(name)
+
+    def abort_upload(self, name: str) -> None:
+        """The upload failed or its commit did: the object (if any
+        landed) is a true orphan again — vacuum food."""
+        with self._lock:
+            self._pending_uploads.discard(name)
+
+    def commit_epoch(self, epoch: int, sst_name: Optional[str]) -> None:
+        """Publish a new version with ``sst_name`` as the newest L0 run
+        (None = idle checkpoint: only the committed epoch advances).
+        The SST object itself must already be durable — a crash between
+        SST write and this publish leaves an orphan that vacuum sweeps,
+        never a version referencing a missing object (the same write
+        discipline as the segment log)."""
+        with self._lock:
+            v = self._version
+            l0 = ((sst_name,) + v.l0) if sst_name else v.l0
+            self._publish(v.replace(
+                vid=v.vid + 1, committed_epoch=epoch, l0=l0))
+            if sst_name:
+                self._pending_uploads.discard(sst_name)
+            self.stats["commits"] += 1
+
+    # -- manifest duties shared with the segment log ---------------------------
+
+    def log_ddl(self, sql: str) -> None:
+        with self._lock:
+            v = self._version
+            self._publish(v.replace(vid=v.vid + 1, ddl=v.ddl + (sql,)))
+
+    def ddl(self) -> List[str]:
+        with self._lock:
+            return list(self._version.ddl)
+
+    def drop_table(self, table_id: int) -> None:
+        with self._lock:
+            v = self._version
+            if table_id in v.dropped_tables:
+                return
+            self._publish(v.replace(
+                vid=v.vid + 1,
+                dropped_tables=v.dropped_tables + (table_id,)))
+
+    # -- pinning (consistent snapshot reads) -----------------------------------
+
+    def pin_version(self) -> tuple[int, HummockVersion]:
+        """Lease the current version: its SSTs outlive any concurrent
+        compaction rewrite until ``unpin`` (reference:
+        versioning.rs pin_version / HummockVersionSafePoint)."""
+        with self._lock:
+            pin_id = next(self._pin_ids)
+            self._pins[pin_id] = self._version
+            return pin_id, self._version
+
+    def unpin_version(self, pin_id: int) -> None:
+        with self._lock:
+            self._pins.pop(pin_id, None)
+
+    def pinned_versions(self) -> List[HummockVersion]:
+        with self._lock:
+            return list(self._pins.values())
+
+    # -- compaction scheduling -------------------------------------------------
+
+    def get_compact_task(self, force: bool = False) -> Optional[CompactTask]:
+        """Hand out ONE merge task when L0 is deep enough: rewrite every
+        L0 run plus the overlapping L1 runs into fresh sorted L1 runs.
+        One task at a time — the version swap in ``report_compact_task``
+        assumes its inputs are still current (the segment log's fold
+        makes the same single-writer bet). ``force`` schedules regardless
+        of depth (ctl / tests / post-DROP cleanup)."""
+        with self._lock:
+            if self._inflight:
+                return None
+            v = self._version
+            if force:
+                if not v.all_runs():
+                    return None
+            elif len(v.l0) < self.L0_COMPACT_TRIGGER:
+                return None
+            inputs = list(v.l0) + list(v.l1)
+            task = CompactTask(
+                task_id=next(self._task_ids),
+                inputs=tuple(inputs),
+                dropped_tables=v.dropped_tables,
+                # every live run participates: tombstones and dropped
+                # tables' rows can be discarded for good
+                bottom=True,
+                base_vid=v.vid)
+            self._inflight[task.task_id] = task
+            self.stats["compact_tasks_scheduled"] += 1
+            return task
+
+    def report_compact_task(self, task_id: int,
+                            outputs: List[str]) -> bool:
+        """Swap the task's inputs for its outputs in a new version.
+        Returns False (and treats the outputs as orphans for vacuum) if
+        the task is unknown/cancelled — a late report from a compactor
+        the meta already gave up on must not corrupt the version."""
+        with self._lock:
+            task = self._inflight.pop(task_id, None)
+            if task is None:
+                self.stats["compact_tasks_failed"] += 1
+                return False
+            v = self._version
+            inputs = set(task.inputs)
+            # appends since the task snapshot stay; order is preserved
+            l0 = tuple(s for s in v.l0 if s not in inputs)
+            l1 = tuple(outputs) + tuple(
+                s for s in v.l1 if s not in inputs)
+            self._publish(v.replace(vid=v.vid + 1, l0=l0, l1=l1))
+            self.stats["compact_tasks_completed"] += 1
+            return True
+
+    def cancel_compact_task(self, task_id: int) -> None:
+        """Forget an in-flight task (compactor died / task failed): the
+        version is untouched, a rescheduled task converges, and any
+        half-written outputs become vacuum food."""
+        with self._lock:
+            if self._inflight.pop(task_id, None) is not None:
+                self.stats["compact_tasks_failed"] += 1
+
+    def inflight_tasks(self) -> List[CompactTask]:
+        with self._lock:
+            return list(self._inflight.values())
+
+    # -- vacuum ----------------------------------------------------------------
+
+    def referenced_ssts(self) -> Set[str]:
+        with self._lock:
+            refs: Set[str] = set()
+            refs.update(self._version.all_runs())
+            refs.update(self._pending_uploads)
+            for v in self._pins.values():
+                refs.update(v.all_runs())
+            for t in self._inflight.values():
+                refs.update(t.inputs)
+            return refs
+
+    def _protected_prefixes(self) -> List[str]:
+        """Output-name prefixes of in-flight tasks: a compactor (possibly
+        another process) is writing ``c{task_id}-…`` objects that its
+        report will reference — vacuum must not eat them mid-task."""
+        return [f"{SST_PREFIX}c{t.task_id:06d}-"
+                for t in self._inflight.values()]
+
+    def vacuum(self, dry_run: bool = False) -> List[str]:
+        """Delete every SST object unreferenced by the current version,
+        any pinned version, any in-flight compaction (inputs AND not-yet-
+        reported outputs), or any registered in-progress upload — orphans
+        from torn publishes, cancelled tasks, and rewritten runs
+        (reference: hummock/vacuum.rs full-scan GC). Returns the deleted
+        names; ``dry_run`` only reports them (the offline ctl default)."""
+        with self._lock:
+            refs = self.referenced_ssts()
+            protected = self._protected_prefixes()
+            victims = [name for name in self.store.list(SST_PREFIX)
+                       if name not in refs
+                       and not any(name.startswith(p) for p in protected)]
+            if dry_run:
+                return victims
+            self.stats["ssts_vacuumed"] += len(victims)
+            self.stats["vacuum_runs"] += 1
+        # deletes run OUTSIDE the lock: a checkpoint's commit_epoch must
+        # not stall behind object-store IO. Safe: victims were already
+        # unreferenced by every version/pin/task/upload at decision time,
+        # new references only ever name NEW objects (uuid/task-id unique
+        # names), so nothing can re-reference a victim meanwhile.
+        for name in victims:
+            self.store.delete(name)
+        return victims

@@ -78,6 +78,36 @@ async def write_frame(writer: asyncio.StreamWriter, obj: dict,
         await writer.drain()
 
 
+def read_frame_sync(sock) -> Optional[dict]:
+    """Blocking read of one frame from a plain socket; None on clean EOF.
+    The compactor control conversation (meta → compactor) is strict
+    request/reply with no multiplexed data plane, so its meta-side
+    client stays synchronous — no event-loop integration needed
+    (reference: the compactor's one gRPC stream,
+    src/storage/compactor/src/server.rs:57)."""
+    buf = b""
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    if n > MAX_FRAME:
+        raise ValueError(f"oversized frame: {n} bytes")
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(min(1 << 20, n - len(body)))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body)
+
+
+def write_frame_sync(sock, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
 # -- message codecs -----------------------------------------------------------
 
 def chunk_to_wire(chunk: StreamChunk, schema: Schema) -> dict:

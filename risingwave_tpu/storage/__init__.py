@@ -1,2 +1,6 @@
 from .state_store import MemoryStateStore  # noqa: F401
 from .state_table import StateTable  # noqa: F401
+from .sstable import Sstable, SstBuilder  # noqa: F401
+from .hummock import (  # noqa: F401
+    CompactTask, HummockStateStore, HummockVersion, PinnedSnapshot,
+)

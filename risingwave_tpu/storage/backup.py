@@ -36,7 +36,10 @@ class BackupError(RuntimeError):
 def create_backup(data_dir: str, dest: str,
                   backup_id: Optional[str] = None) -> dict:
     """Snapshot ``data_dir`` into ``dest`` (created; must not already hold
-    a backup). Returns the backup descriptor."""
+    a backup). Returns the backup descriptor. Detects the durable tier:
+    segment log (manifest.json) or Hummock-lite (hummock/version.json)."""
+    if os.path.exists(os.path.join(data_dir, "hummock", "version.json")):
+        return _create_backup_hummock(data_dir, dest, backup_id)
     manifest_path = os.path.join(data_dir, "manifest.json")
     if not os.path.exists(manifest_path):
         raise BackupError(f"{data_dir!r} has no checkpoint manifest")
@@ -70,6 +73,70 @@ def create_backup(data_dir: str, dest: str,
     desc = {
         "backup_id": backup_id or f"backup-{int(time.time())}",
         "committed_epoch": manifest.get("committed_epoch"),
+        "files": files,
+        "source_dir": os.path.abspath(data_dir),
+    }
+    tmp = os.path.join(dest, _BACKUP_META + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(desc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dest, _BACKUP_META))
+    return desc
+
+
+def _create_backup_hummock(data_dir: str, dest: str,
+                           backup_id: Optional[str]) -> dict:
+    """Hummock-tier snapshot: the version manifest + every SST it
+    references + the meta tier. In-process callers pin the version
+    instead (Session.pin_version); a CROSS-process backup cannot hold a
+    pin, so it leans on the tier's immutability discipline — the
+    manifest swap is atomic and runs are immutable — and simply re-reads
+    the manifest if a referenced SST was vacuumed mid-copy (the same
+    retry rule as recovery's fold)."""
+    os.makedirs(dest, exist_ok=True)
+    if os.path.exists(os.path.join(dest, _BACKUP_META)):
+        raise BackupError(f"{dest!r} already contains a backup")
+    version_path = os.path.join(data_dir, "hummock", "version.json")
+    for attempt in range(8):
+        with open(version_path, "rb") as f:
+            version_raw = f.read()
+        version = json.loads(version_raw)
+        runs = list(version.get("l0", [])) + list(version.get("l1", []))
+        try:
+            staged = []
+            for rel in runs:
+                src = os.path.join(data_dir, rel)
+                if not os.path.exists(src):
+                    raise FileNotFoundError(rel)
+                staged.append(rel)
+            files = []
+            os.makedirs(os.path.join(dest, "hummock"), exist_ok=True)
+            with open(os.path.join(dest, "hummock", "version.json"),
+                      "wb") as f:
+                f.write(version_raw)
+            files.append("hummock/version.json")
+            for rel in staged:
+                dst = os.path.join(dest, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy2(os.path.join(data_dir, rel), dst)
+                files.append(rel)
+            break
+        except FileNotFoundError:
+            if attempt == 7:
+                raise BackupError(
+                    "version kept referencing vanished SSTs (live "
+                    "compactor racing the backup?)")
+    meta_src = os.path.join(data_dir, "meta", "meta.jsonl")
+    if os.path.exists(meta_src):
+        os.makedirs(os.path.join(dest, "meta"), exist_ok=True)
+        shutil.copy2(meta_src, os.path.join(dest, "meta", "meta.jsonl"))
+        files.append("meta/meta.jsonl")
+    desc = {
+        "backup_id": backup_id or f"backup-{int(time.time())}",
+        "committed_epoch": version.get("committed_epoch"),
+        "version_id": version.get("vid"),
+        "tier": "hummock",
         "files": files,
         "source_dir": os.path.abspath(data_dir),
     }

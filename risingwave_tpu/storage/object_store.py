@@ -67,6 +67,8 @@ class LocalFsObjectStore(ObjectStore):
 
     def atomic_put(self, path: str, data: bytes) -> None:
         full = self._p(path)
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = full + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)

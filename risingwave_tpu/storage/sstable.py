@@ -1,0 +1,311 @@
+"""SSTable: sorted immutable runs for the Hummock-lite state tier.
+
+Counterpart of the reference's Hummock SST (reference:
+src/storage/src/hummock/sstable/builder.rs:87 block-structured build,
+sstable/bloom.rs bloom filter, sstable/mod.rs block index + footer).
+Entries are ``(table_id, key) -> value | tombstone`` in strict composite
+order; a block index (first composite key + offset per block) gives
+point reads one block scan, and a bloom filter over composite keys makes
+"not here" answers cheap across a deep L0 stack.
+
+Whole objects move through the ``ObjectStore`` abstraction
+(storage/object_store.py) — LocalFs and Mem both work, so the tier is
+one backend swap away from cloud object storage, exactly the property
+the checkpoint log already has.
+
+Layout (little-endian):
+
+    [entry...]                     concatenated data blocks
+    meta JSON (utf-8)              block index, bloom, stats
+    <I meta_len> <8s magic>        footer
+
+    entry := <I table_id> <H klen> key <B live> [<I vlen> value]
+
+Binary keys/bloom bits cross into the JSON meta as base64 — the same
+debuggable-over-compact tradeoff the wire frames make (rpc/wire.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+_MAGIC = b"RWSST\x01\x00\x00"
+_FOOTER = struct.Struct("<I8s")
+_ENTRY_HEAD = struct.Struct("<IH")
+
+Entry = Tuple[int, bytes, Optional[bytes]]      # (table_id, key, value|None)
+
+
+# -- bloom filter -------------------------------------------------------------
+
+class BloomFilter:
+    """Split-hash bloom over composite keys (reference: sstable/bloom.rs).
+    k probes are carved out of one blake2b digest; false positives cost a
+    wasted block scan, never a wrong answer."""
+
+    K = 7
+
+    def __init__(self, bits: bytearray, k: int = K):
+        self.bits = bits
+        self.k = k
+
+    @classmethod
+    def with_capacity(cls, n_keys: int) -> "BloomFilter":
+        # ~10 bits/key ≈ 1% false positives at k=7
+        m = max(64, n_keys * 10)
+        m = (m + 7) // 8 * 8
+        return cls(bytearray(m // 8))
+
+    def _probes(self, table_id: int, key: bytes) -> Iterator[int]:
+        h = hashlib.blake2b(struct.pack("<I", table_id) + key,
+                            digest_size=4 * self.k).digest()
+        m = len(self.bits) * 8
+        for i in range(self.k):
+            yield struct.unpack_from("<I", h, 4 * i)[0] % m
+
+    def add(self, table_id: int, key: bytes) -> None:
+        for p in self._probes(table_id, key):
+            self.bits[p // 8] |= 1 << (p % 8)
+
+    def may_contain(self, table_id: int, key: bytes) -> bool:
+        return all(self.bits[p // 8] & (1 << (p % 8))
+                   for p in self._probes(table_id, key))
+
+    def to_b64(self) -> str:
+        return base64.b64encode(bytes(self.bits)).decode()
+
+    @classmethod
+    def from_b64(cls, s: str, k: int) -> "BloomFilter":
+        return cls(bytearray(base64.b64decode(s)), k)
+
+
+# -- builder ------------------------------------------------------------------
+
+def _pack_entry(table_id: int, key: bytes, value: Optional[bytes]) -> bytes:
+    head = _ENTRY_HEAD.pack(table_id, len(key)) + key
+    if value is None:
+        return head + b"\x00"
+    return head + b"\x01" + struct.pack("<I", len(value)) + value
+
+
+class SstBuilder:
+    """Streaming builder: feed strictly increasing ``(table_id, key)``
+    entries, get immutable bytes. Tombstones (value=None) are kept — a
+    run must shadow older runs' rows until bottom-level compaction."""
+
+    def __init__(self, block_target_bytes: int = 4096):
+        self.block_target = block_target_bytes
+        self._parts: List[bytes] = []
+        self._size = 0
+        self._block_start = 0
+        self._block_first: Optional[Tuple[int, str]] = None
+        self._index: List[dict] = []     # {table, key(b64), off, len}
+        self._keys: List[Tuple[int, bytes]] = []
+        self._last: Optional[Tuple[int, bytes]] = None
+        self.n_entries = 0
+        self.n_tombstones = 0
+        self._tables: set = set()
+
+    def add(self, table_id: int, key: bytes, value: Optional[bytes]) -> None:
+        ck = (table_id, key)
+        if self._last is not None and ck <= self._last:
+            raise ValueError(
+                f"SST entries must be strictly increasing: {ck!r} after "
+                f"{self._last!r}")
+        self._last = ck
+        if self._block_first is None:
+            self._block_first = (table_id,
+                                 base64.b64encode(key).decode())
+            self._block_start = self._size
+        rec = _pack_entry(table_id, key, value)
+        self._parts.append(rec)
+        self._size += len(rec)
+        self._keys.append(ck)
+        self.n_entries += 1
+        if value is None:
+            self.n_tombstones += 1
+        self._tables.add(table_id)
+        if self._size - self._block_start >= self.block_target:
+            self._seal_block()
+
+    def _seal_block(self) -> None:
+        if self._block_first is None:
+            return
+        self._index.append({
+            "table": self._block_first[0], "key": self._block_first[1],
+            "off": self._block_start,
+            "len": self._size - self._block_start,
+        })
+        self._block_first = None
+
+    def finish(self) -> bytes:
+        self._seal_block()
+        bloom = BloomFilter.with_capacity(self.n_entries)
+        for t, k in self._keys:
+            bloom.add(t, k)
+        first = self._keys[0] if self._keys else None
+        last = self._keys[-1] if self._keys else None
+        meta = {
+            "n_entries": self.n_entries,
+            "n_tombstones": self.n_tombstones,
+            "tables": sorted(self._tables),
+            "first": ([first[0], base64.b64encode(first[1]).decode()]
+                      if first else None),
+            "last": ([last[0], base64.b64encode(last[1]).decode()]
+                     if last else None),
+            "index": self._index,
+            "bloom": bloom.to_b64(),
+            "bloom_k": bloom.k,
+        }
+        meta_b = json.dumps(meta).encode()
+        return (b"".join(self._parts) + meta_b
+                + _FOOTER.pack(len(meta_b), _MAGIC))
+
+
+def build_sst(entries: Iterable[Entry],
+              block_target_bytes: int = 4096) -> bytes:
+    """One-shot build from an iterable already in composite-key order."""
+    b = SstBuilder(block_target_bytes)
+    for table_id, key, value in entries:
+        b.add(table_id, key, value)
+    return b.finish()
+
+
+# -- reader -------------------------------------------------------------------
+
+class CorruptSst(ValueError):
+    pass
+
+
+class Sstable:
+    """Immutable reader over one SST's bytes. ``lookup`` answers
+    (found, value|None-for-tombstone); iteration yields raw entries in
+    composite order (the compactor's merge input)."""
+
+    def __init__(self, data: bytes, name: str = "<sst>"):
+        self.name = name
+        self._data = data
+        if len(data) < _FOOTER.size:
+            raise CorruptSst(f"{name}: truncated footer")
+        meta_len, magic = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
+        if magic != _MAGIC:
+            raise CorruptSst(f"{name}: bad magic {magic!r}")
+        meta_end = len(data) - _FOOTER.size
+        if meta_len > meta_end:
+            raise CorruptSst(f"{name}: meta overruns object")
+        self.meta = json.loads(data[meta_end - meta_len:meta_end])
+        self._data_end = meta_end - meta_len
+        self._index: List[Tuple[Tuple[int, bytes], int, int]] = [
+            ((e["table"], base64.b64decode(e["key"])), e["off"], e["len"])
+            for e in self.meta["index"]
+        ]
+        # bisect target for point reads (avoids rebuilding per lookup)
+        self._firsts = [e[0] for e in self._index]
+        self.bloom = BloomFilter.from_b64(self.meta["bloom"],
+                                          self.meta.get("bloom_k",
+                                                        BloomFilter.K))
+
+    # range/meta accessors ----------------------------------------------------
+
+    @property
+    def n_entries(self) -> int:
+        return self.meta["n_entries"]
+
+    @property
+    def table_ids(self) -> List[int]:
+        return list(self.meta["tables"])
+
+    def key_range(self) -> Optional[Tuple[Tuple[int, bytes],
+                                          Tuple[int, bytes]]]:
+        f, l = self.meta["first"], self.meta["last"]
+        if f is None:
+            return None
+        return ((f[0], base64.b64decode(f[1])),
+                (l[0], base64.b64decode(l[1])))
+
+    # reads -------------------------------------------------------------------
+
+    def _parse_block(self, off: int, length: int) -> Iterator[Entry]:
+        data = self._data
+        pos, end = off, off + length
+        if end > self._data_end:
+            raise CorruptSst(f"{self.name}: block overruns data area")
+        while pos < end:
+            table_id, klen = _ENTRY_HEAD.unpack_from(data, pos)
+            pos += _ENTRY_HEAD.size
+            key = data[pos:pos + klen]
+            pos += klen
+            live = data[pos]
+            pos += 1
+            if live:
+                (vlen,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                yield table_id, key, data[pos:pos + vlen]
+                pos += vlen
+            else:
+                yield table_id, key, None
+
+    def may_contain(self, table_id: int, key: bytes) -> bool:
+        return self.bloom.may_contain(table_id, key)
+
+    def lookup(self, table_id: int,
+               key: bytes) -> Tuple[bool, Optional[bytes]]:
+        """(found, value). found=True with value=None is a tombstone —
+        the caller must STOP searching older runs."""
+        if not self._index or not self.may_contain(table_id, key):
+            return False, None
+        import bisect
+        ck = (table_id, key)
+        i = bisect.bisect_right(self._firsts, ck) - 1
+        if i < 0:
+            return False, None
+        _, off, length = self._index[i]
+        for t, k, v in self._parse_block(off, length):
+            if (t, k) == ck:
+                return True, v
+            if (t, k) > ck:
+                break
+        return False, None
+
+    def iter_entries(self) -> Iterator[Entry]:
+        for _, off, length in self._index:
+            yield from self._parse_block(off, length)
+
+    def __len__(self) -> int:
+        return self.n_entries
+
+
+def load_sst(store, name: str) -> Sstable:
+    """Fetch + parse one SST through the ObjectStore abstraction."""
+    data = store.get(name)
+    if data is None:
+        raise FileNotFoundError(name)
+    return Sstable(data, name)
+
+
+def merge_iter(runs: List[Sstable]) -> Iterator[Entry]:
+    """k-way merge of runs ordered NEWEST FIRST: for duplicate composite
+    keys the newest run wins (the compactor core; reference:
+    hummock/compactor/ merge iterators). Tombstones pass through — the
+    caller decides whether the output level may drop them."""
+    import heapq
+    iters = [iter(r.iter_entries()) for r in runs]
+    heap: List[Tuple[Tuple[int, bytes], int, Optional[bytes]]] = []
+    for rank, it in enumerate(iters):
+        e = next(it, None)
+        if e is not None:
+            heapq.heappush(heap, ((e[0], e[1]), rank, e[2]))
+    last: Optional[Tuple[int, bytes]] = None
+    while heap:
+        ck, rank, value = heapq.heappop(heap)
+        e = next(iters[rank], None)
+        if e is not None:
+            heapq.heappush(heap, ((e[0], e[1]), rank, e[2]))
+        if ck == last:
+            continue                    # older run's row: shadowed
+        last = ck
+        yield ck[0], ck[1], value

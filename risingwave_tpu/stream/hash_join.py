@@ -59,6 +59,7 @@ class HashJoinExecutor(Executor):
         interval_clean: Sequence[tuple] = (),
         load_shard: Optional[tuple] = None,
         hbm_key_budget: Optional[int] = None,
+        null_aware_anti: bool = False,
     ):
         """``interval_clean``: state-cleaning rules for interval/windowed
         joins — tuples ``(clean_side, clean_col, watch_side, watch_col,
@@ -86,6 +87,14 @@ class HashJoinExecutor(Executor):
         pk prefix scan)."""
         self.left, self.right = left, right
         self.load_shard = load_shard
+        # PG NOT IN semantics (planner.py _plan_in_subquery): a NULL
+        # arriving on the build side would have to retract EVERY emitted
+        # probe row — incremental null-aware anti join is a global flip
+        # this executor does not implement, so it rejects loudly instead
+        # of silently diverging from PG (NULL probe keys are already
+        # filtered below the join at plan time).
+        self.null_aware_anti = bool(null_aware_anti) and \
+            join_type == JoinType.LEFT_ANTI
         from .metrics import ExecutorStats
         self.stats = ExecutorStats()
         self._join_args = dict(join_type=join_type, condition=condition)
@@ -265,6 +274,8 @@ class HashJoinExecutor(Executor):
                 _, side, chunk = ev
                 stats.chunks_in += 1
                 stats.capacity_rows_in += chunk.capacity
+                if self.null_aware_anti and side == "right":
+                    self._reject_null_build_keys(chunk)
                 if self._evicted:
                     hits = self._evicted_hits(side, chunk)
                     if hits:
@@ -319,6 +330,23 @@ class HashJoinExecutor(Executor):
                     for out in self._flush_pending():
                         yield out
                     yield wm.__class__(out_idx, wm.value)
+
+    def _reject_null_build_keys(self, chunk: StreamChunk) -> None:
+        """NULL-aware anti join (NOT IN): a NULL subquery value makes PG
+        return zero rows for the WHOLE view, which incrementally means
+        retracting everything already emitted — unsupported; fail with an
+        actionable message instead of diverging. One host sync per
+        build-side chunk, only on NOT IN plans."""
+        keyed = chunk.vis
+        for i in self.core.right_keys:
+            keyed = keyed & chunk.columns[i].mask
+        if bool(jnp.any(chunk.vis & ~keyed)):
+            raise RuntimeError(
+                "NULL value in NOT IN (SELECT ...) subquery: PostgreSQL "
+                "semantics would drop every row of the view, which a "
+                "streaming anti join cannot express incrementally — "
+                "filter NULLs in the subquery (WHERE col IS NOT NULL) "
+                "or use NOT EXISTS")
 
     # -- eviction / fault-in ---------------------------------------------------
 

@@ -1,1 +1,6 @@
 from .host import WorkerHost  # noqa: F401
+
+# NOTE: worker.compactor is deliberately NOT imported here — the module
+# doubles as a ``python -m risingwave_tpu.worker.compactor`` entry point,
+# and importing it from the package __init__ would shadow runpy's module
+# execution (sys.modules warning). Import it explicitly.

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# CI gate: bytecode-compile the whole package, then run the storage-tier
+# test subset — including the vacuum-leak assertion (after drop + vacuum,
+# ObjectStore.list() shows no orphaned SSTs) so object-store growth stays
+# bounded in tests. Usage: scripts/check.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+unset PALLAS_AXON_POOL_IPS TPU_LIBRARY_PATH 2>/dev/null || true
+
+echo "== compileall =="
+python -m compileall -q risingwave_tpu
+
+echo "== storage-tier tests =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_object_store.py \
+    tests/test_sstable.py \
+    tests/test_hummock.py \
+    tests/test_compactor.py \
+    tests/test_durability.py \
+    tests/test_failpoints.py \
+    tests/test_backup_restore.py \
+    "$@"
+
+echo "== vacuum-leak assertion =="
+python - <<'EOF'
+from risingwave_tpu.storage.hummock import SST_PREFIX, HummockStateStore
+from risingwave_tpu.storage.object_store import MemObjectStore
+
+st = HummockStateStore(object_store=MemObjectStore(),
+                       inline_compaction=False)
+for e in range(1, 10):
+    st.ingest(5, e, {b"k%03d" % e: b"v"}, set())
+    st.ingest(6, e, {b"k%03d" % e: b"v"}, set())
+    st.commit(e)
+st.drop_table(5)
+st.compact()
+st.vacuum()
+listed = set(st.object_store.list(SST_PREFIX))
+referenced = set(st.manager.version.all_runs())
+assert listed == referenced, (
+    f"orphaned SSTs after drop+vacuum: {sorted(listed - referenced)}")
+_, tables = st.committed_epoch, dict(st.iter_table(6))
+assert len(tables) == 9 and not dict(st.iter_table(5))
+print(f"no orphans: {len(listed)} SSTs listed, all referenced")
+EOF
+
+echo "check.sh: OK"

@@ -1,0 +1,211 @@
+"""The dedicated compactor role + crash safety of compaction on BOTH
+durable tiers.
+
+Acceptance (ISSUE 2): ``kill -9`` of the compactor mid-task leaves the
+store recoverable — restart replays the last committed version and a
+rescheduled compaction converges."""
+
+import threading
+
+import pytest
+
+from risingwave_tpu.common.failpoint import failpoints
+from risingwave_tpu.storage.checkpoint import CheckpointLog
+from risingwave_tpu.storage.hummock import (
+    SST_PREFIX, HummockStateStore, run_compact_task,
+)
+from risingwave_tpu.worker.compactor import CompactorClient, CompactorDied
+
+
+def _fill(st, table=7, epochs=range(1, 8)):
+    for e in epochs:
+        st.ingest(table, e, {b"k%03d" % e: b"v%d" % e}, set())
+        st.commit(e)
+
+
+def _expect(epochs):
+    return {b"k%03d" % e: b"v%d" % e for e in epochs}
+
+
+class TestCompactorWorker:
+    def test_task_roundtrip_and_stats(self, tmp_path):
+        d = str(tmp_path / "hm")
+        st = HummockStateStore(data_dir=d, inline_compaction=False)
+        _fill(st)
+        c = CompactorClient(d)
+        c.spawn()
+        try:
+            task = st.manager.get_compact_task(force=True)
+            outputs = c.compact(task)
+            assert outputs
+            st.manager.report_compact_task(task.task_id, outputs)
+            st.vacuum()
+            stats = c.get_stats()
+            assert stats["compactor"]["tasks_completed"] == 1
+            assert stats["compactor"]["ssts_written"] == len(outputs)
+        finally:
+            c.shutdown()
+        st2 = HummockStateStore(data_dir=d)
+        assert dict(st2.iter_table(7)) == _expect(range(1, 8))
+
+    def test_kill9_mid_task_store_recoverable(self, tmp_path):
+        """The acceptance test: SIGKILL the compactor process while it is
+        compacting; the store recovers at the last committed version and
+        a rescheduled task (fresh process) converges."""
+        d = str(tmp_path / "hm")
+        st = HummockStateStore(data_dir=d, inline_compaction=False)
+        _fill(st, epochs=range(1, 10))
+        pre_version = st.manager.version
+        c = CompactorClient(d)
+        c.spawn()
+        task = st.manager.get_compact_task(force=True)
+        err = []
+
+        def run():
+            try:
+                c.compact(task, delay_ms=5000)   # widen the kill window
+            except (CompactorDied, RuntimeError) as e:
+                err.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        import time
+        time.sleep(0.5)
+        c.kill9()                                # mid-task
+        t.join(timeout=30)
+        assert err, "compact() must fail when the worker is SIGKILLed"
+        st.manager.cancel_compact_task(task.task_id)
+
+        # restart replays the last committed (pre-compaction) version
+        st2 = HummockStateStore(data_dir=d, inline_compaction=False)
+        assert st2.committed_epoch == 9
+        assert dict(st2.iter_table(7)) == _expect(range(1, 10))
+        assert set(st2.manager.version.all_runs()) == set(
+            pre_version.all_runs())
+
+        # rescheduled compaction (fresh worker) converges
+        c.respawn()
+        try:
+            task2 = st2.manager.get_compact_task(force=True)
+            outputs = c.compact(task2)
+            st2.manager.report_compact_task(task2.task_id, outputs)
+            st2.vacuum()
+        finally:
+            c.shutdown()
+        st3 = HummockStateStore(data_dir=d)
+        assert dict(st3.iter_table(7)) == _expect(range(1, 10))
+        assert set(st3.object_store.list(SST_PREFIX)) == set(
+            st3.manager.version.all_runs())
+
+    def test_session_compactor_death_and_respawn(self, tmp_path):
+        """Session-level: the compaction pump survives a dead compactor —
+        it respawns the stateless worker and a later checkpoint's
+        rescheduled task converges."""
+        from risingwave_tpu.frontend import Session
+        d = str(tmp_path / "db")
+        s = Session(data_dir=d, state_store="hummock", compactors=1,
+                    checkpoint_frequency=1)
+        try:
+            s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+            s.compactors[0].kill9()          # dies BEFORE any task
+            for i in range(10):
+                s.run_sql(f"INSERT INTO t VALUES ({i}, {i})")
+                s.flush()
+            s.wait_compaction()
+            # the pump respawned the worker and compaction converged
+            mgr = s.store.manager
+            assert mgr.stats["compact_tasks_completed"] >= 1
+            assert not s.compactors[0].dead
+            assert sorted(s.run_sql("SELECT k, v FROM t")) == [
+                (i, i) for i in range(10)]
+        finally:
+            s.close()
+
+
+class TestCompactionCrashSafety:
+    """Failpoint kills mid-compaction (ISSUE 2 satellite): both the
+    legacy segment fold and the new compactor task must leave a
+    consistent pre-compaction version with no lost epochs."""
+
+    def test_segment_fold_killed_mid_write(self, tmp_path):
+        log = CheckpointLog(str(tmp_path), compact_after=1000)
+        for e in range(1, 6):
+            log.append_epoch(e, {7: {b"k%03d" % e: b"v%d" % e}})
+        manifest_before = log._read_manifest()
+        with failpoints(**{"checkpoint.segment.write": OSError}):
+            # the fold writes its folded segment through _write_segment;
+            # the manifest swap never happens
+            with pytest.raises(OSError):
+                log.compact()
+        assert log._read_manifest() == manifest_before
+        epoch, tables = log.load_tables()
+        assert epoch == 5
+        assert tables[7] == _expect(range(1, 6))
+        # retry converges
+        log.compact()
+        epoch, tables = log.load_tables()
+        assert epoch == 5 and tables[7] == _expect(range(1, 6))
+        assert len(log._read_manifest()["segments"]) == 1
+
+    def test_segment_fold_failure_then_retry_converges(self, tmp_path):
+        """A fold that dies mid-write leaves old segments valid; the next
+        fold attempt (failpoint cleared) converges."""
+        log = CheckpointLog(str(tmp_path), compact_after=1000)
+        for e in range(1, 6):
+            log.append_epoch(e, {7: {b"k%03d" % e: b"v%d" % e}})
+        from risingwave_tpu.common.failpoint import arm, disarm
+        arm("checkpoint.segment.write", OSError, once=True)
+        try:
+            with pytest.raises(OSError):
+                log.compact()
+        finally:
+            disarm()
+        log.compact()                          # retry converges
+        epoch, tables = log.load_tables()
+        assert epoch == 5 and tables[7] == _expect(range(1, 6))
+        assert len(log._read_manifest()["segments"]) == 1
+
+    @pytest.mark.parametrize("site", ["compactor.task.start",
+                                      "compactor.output.write",
+                                      "compactor.merge.step"])
+    def test_hummock_task_killed_at_any_point(self, tmp_path, site):
+        d = str(tmp_path / f"hm_{site.replace('.', '_')}")
+        st = HummockStateStore(data_dir=d, inline_compaction=False)
+        _fill(st)
+        pre_runs = set(st.manager.version.all_runs())
+        task = st.manager.get_compact_task(force=True)
+        with failpoints(**{site: OSError}):
+            with pytest.raises(OSError):
+                run_compact_task(st.object_store, task)
+        st.manager.cancel_compact_task(task.task_id)
+        # consistent pre-compaction version, no lost epochs
+        st2 = HummockStateStore(data_dir=d, inline_compaction=False)
+        assert st2.committed_epoch == 7
+        assert dict(st2.iter_table(7)) == _expect(range(1, 8))
+        assert set(st2.manager.version.all_runs()) == pre_runs
+        # half-written outputs (if any) are orphans: vacuum removes them,
+        # then a rescheduled task converges
+        st2.vacuum()
+        assert set(st2.object_store.list(SST_PREFIX)) == pre_runs
+        st2.compact()
+        st3 = HummockStateStore(data_dir=d)
+        assert dict(st3.iter_table(7)) == _expect(range(1, 8))
+
+    def test_inline_background_compaction_failure_contained(self):
+        from risingwave_tpu.storage.object_store import MemObjectStore
+        st = HummockStateStore(object_store=MemObjectStore(),
+                               l0_compact_trigger=3,
+                               inline_compaction=True)
+        from risingwave_tpu.common.failpoint import arm, disarm
+        arm("compactor.output.write", OSError)
+        try:
+            _fill(st)                  # triggers background compaction
+            st.wait_compaction()
+        finally:
+            disarm()
+        # the fold failed; the store still answers and later compaction
+        # converges
+        assert dict(st.iter_table(7)) == _expect(range(1, 8))
+        st.compact()
+        st2 = HummockStateStore(object_store=st.object_store)
+        assert dict(st2.iter_table(7)) == _expect(range(1, 8))

@@ -1,0 +1,113 @@
+"""NOT IN (SELECT ...) NULL semantics (ISSUE 2 satellite).
+
+PostgreSQL: ``x NOT IN (sub)`` is TRUE iff x is non-NULL, x matches no
+subquery value, AND the subquery produced no NULL (x <> NULL is unknown).
+The planner makes the anti join null-aware: NULL probe keys are filtered
+below the join; a NULL from the subquery yields zero rows in batch and a
+loud, actionable error in streaming (a silent divergence was the bug)."""
+
+import pytest
+
+from risingwave_tpu.frontend import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.run_sql("CREATE TABLE a (x BIGINT, tag BIGINT)")
+    s.run_sql("CREATE TABLE b (y BIGINT)")
+    yield s
+    s.close()
+
+
+class TestBatchNotInNull:
+    def test_null_probe_key_never_passes(self, sess):
+        sess.run_sql("INSERT INTO a VALUES (1,1),(2,2),(NULL,3),(4,4)")
+        sess.run_sql("INSERT INTO b VALUES (1),(3)")
+        sess.flush()
+        rows = sorted(sess.run_sql(
+            "SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)"))
+        assert rows == [(2,), (4,)]          # NULL-keyed row 3 excluded
+
+    def test_null_in_subquery_yields_no_rows(self, sess):
+        sess.run_sql("INSERT INTO a VALUES (1,1),(2,2),(4,4)")
+        sess.run_sql("INSERT INTO b VALUES (1),(NULL)")
+        sess.flush()
+        assert sess.run_sql(
+            "SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)") == []
+
+    def test_in_semantics_unchanged(self, sess):
+        sess.run_sql("INSERT INTO a VALUES (1,1),(NULL,3),(4,4)")
+        sess.run_sql("INSERT INTO b VALUES (1),(NULL)")
+        sess.flush()
+        rows = sorted(sess.run_sql(
+            "SELECT tag FROM a WHERE x IN (SELECT y FROM b)"))
+        assert rows == [(1,)]
+
+    def test_known_divergence_null_probe_empty_subquery(self, sess):
+        """Documented divergence (frontend/planner.py _plan_in_subquery):
+        PG keeps a NULL probe row when the subquery is EMPTY; the static
+        probe filter drops it regardless. Pinned here so a behavior
+        change is a conscious one."""
+        sess.run_sql("INSERT INTO a VALUES (NULL, 1), (5, 2)")
+        sess.flush()                           # b stays empty
+        rows = sorted(sess.run_sql(
+            "SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)"))
+        assert rows == [(2,)]                  # PG would return [(1,),(2,)]
+
+    def test_filtered_subquery_restores_rows(self, sess):
+        sess.run_sql("INSERT INTO a VALUES (1,1),(2,2)")
+        sess.run_sql("INSERT INTO b VALUES (1),(NULL)")
+        sess.flush()
+        rows = sess.run_sql(
+            "SELECT tag FROM a WHERE x NOT IN "
+            "(SELECT y FROM b WHERE y IS NOT NULL)")
+        assert rows == [(2,)]
+
+
+class TestStreamingNotInNull:
+    def test_null_probe_key_excluded_from_mv(self, sess):
+        sess.run_sql("""CREATE MATERIALIZED VIEW m AS
+            SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)""")
+        sess.run_sql("INSERT INTO a VALUES (1,1),(2,2),(NULL,3),(4,4)")
+        sess.run_sql("INSERT INTO b VALUES (1),(3)")
+        sess.flush()
+        assert sorted(sess.mv_rows("m")) == [(2,), (4,)]
+
+    def test_null_in_subquery_fails_loud_not_wrong(self, sess):
+        sess.run_sql("""CREATE MATERIALIZED VIEW m AS
+            SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)""")
+        sess.run_sql("INSERT INTO a VALUES (1,1),(2,2)")
+        sess.run_sql("INSERT INTO b VALUES (NULL)")
+        with pytest.raises(Exception):
+            sess.flush()
+        # the actionable root cause is on the job's failure record
+        job = sess.jobs["m"]
+        assert job._failure is not None
+        assert "NOT IN" in str(job._failure)
+
+    def test_plan_marks_anti_join_null_aware(self, sess):
+        from risingwave_tpu.frontend.parser import parse_one
+        stmt = parse_one(
+            "SELECT tag FROM a WHERE x NOT IN (SELECT y FROM b)")
+        plan = sess._plan(stmt.select)
+        found = []
+
+        def walk(n):
+            if type(n).__name__ == "PJoin":
+                found.append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
+        assert found and found[0].kind == "left_anti"
+        assert found[0].null_aware is True
+        # ... and the null-aware flag survives the plan-JSON boundary
+        # (the contract a remote worker rebuilds the job from)
+        from risingwave_tpu.frontend.plan_json import (
+            plan_from_json, plan_to_json,
+        )
+        rt = plan_from_json(plan_to_json(plan), sess.catalog)
+        found.clear()
+        walk(rt)
+        assert found and found[0].null_aware is True

@@ -1,0 +1,97 @@
+"""SSTable unit tests: build/read roundtrip, block index, bloom filter,
+tombstones, ordering enforcement, merge semantics, corruption detection."""
+
+import pytest
+
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.sstable import (
+    CorruptSst, Sstable, SstBuilder, build_sst, load_sst, merge_iter,
+)
+
+
+def _sst(entries, block=64):
+    return Sstable(build_sst(entries, block_target_bytes=block))
+
+
+class TestSstable:
+    def test_roundtrip_multiblock(self):
+        entries = [(1, b"k%04d" % i, b"v%d" % i) for i in range(500)]
+        s = _sst(entries, block=128)          # many blocks
+        assert s.n_entries == 500
+        assert len(s.meta["index"]) > 5
+        assert list(s.iter_entries()) == entries
+        for i in (0, 1, 250, 498, 499):
+            assert s.lookup(1, b"k%04d" % i) == (True, b"v%d" % i)
+        assert s.lookup(1, b"k9999") == (False, None)
+        assert s.lookup(2, b"k0001") == (False, None)
+
+    def test_multi_table_composite_order(self):
+        entries = ([(1, b"z", b"a")] + [(2, b"a", b"b")]
+                   + [(7, b"m", b"c")])
+        s = _sst(entries)
+        assert s.table_ids == [1, 2, 7]
+        assert s.key_range() == ((1, b"z"), (7, b"m"))
+        assert s.lookup(2, b"a") == (True, b"b")
+
+    def test_tombstone_found_and_distinct_from_missing(self):
+        s = _sst([(1, b"dead", None), (1, b"live", b"v")])
+        assert s.lookup(1, b"dead") == (True, None)    # tombstone
+        assert s.lookup(1, b"gone") == (False, None)   # absent
+        assert s.meta["n_tombstones"] == 1
+
+    def test_out_of_order_rejected(self):
+        b = SstBuilder()
+        b.add(1, b"b", b"x")
+        with pytest.raises(ValueError, match="strictly increasing"):
+            b.add(1, b"a", b"y")
+        with pytest.raises(ValueError, match="strictly increasing"):
+            b.add(1, b"b", b"y")               # duplicate
+
+    def test_empty_sst(self):
+        s = _sst([])
+        assert s.n_entries == 0
+        assert s.key_range() is None
+        assert s.lookup(1, b"k") == (False, None)
+        assert list(s.iter_entries()) == []
+
+    def test_bloom_negative_short_circuit(self):
+        s = _sst([(1, b"k%03d" % i, b"v") for i in range(100)])
+        # absent keys overwhelmingly answer without a block scan
+        misses = sum(s.may_contain(1, b"absent%d" % i) for i in range(500))
+        assert misses < 50                     # ~1% fp at 10 bits/key
+
+    def test_corruption_detected(self):
+        data = build_sst([(1, b"k", b"v")])
+        with pytest.raises(CorruptSst):
+            Sstable(data[:-4])                 # truncated footer
+        with pytest.raises(CorruptSst):
+            Sstable(data[:8])                  # hopeless
+        bad = data[:-8] + b"NOTMAGIC"
+        with pytest.raises(CorruptSst):
+            Sstable(bad)
+
+    def test_load_via_object_store(self):
+        os_ = MemObjectStore()
+        os_.put("x.sst", build_sst([(3, b"a", b"1")]))
+        s = load_sst(os_, "x.sst")
+        assert s.lookup(3, b"a") == (True, b"1")
+        with pytest.raises(FileNotFoundError):
+            load_sst(os_, "missing.sst")
+
+
+class TestMergeIter:
+    def test_newest_wins_and_tombstones_pass(self):
+        newest = _sst([(1, b"a", b"NEW"), (1, b"b", None)])
+        oldest = _sst([(1, b"a", b"OLD"), (1, b"b", b"OLD"),
+                       (1, b"c", b"keep")])
+        merged = list(merge_iter([newest, oldest]))
+        assert merged == [(1, b"a", b"NEW"), (1, b"b", None),
+                          (1, b"c", b"keep")]
+
+    def test_three_way_merge_order(self):
+        r0 = _sst([(1, b"b", b"r0")])
+        r1 = _sst([(1, b"a", b"r1"), (1, b"b", b"r1")])
+        r2 = _sst([(1, b"c", b"r2"), (2, b"a", b"r2")])
+        merged = list(merge_iter([r0, r1, r2]))
+        assert merged == [(1, b"a", b"r1"), (1, b"b", b"r0"),
+                          (1, b"c", b"r2"), (2, b"a", b"r2")]
