@@ -140,8 +140,8 @@ def _ctl(args) -> int:
         # storage-only inspection: no session (and no job recovery) —
         # read the version manifest straight off the object store
         from .meta.hummock import HummockManager
-        from .storage.object_store import LocalFsObjectStore
-        mgr = HummockManager(LocalFsObjectStore(args.data_dir))
+        from .storage.object_store import open_object_store
+        mgr = HummockManager(open_object_store(args.data_dir))
         if not mgr.exists():
             raise SystemExit(
                 f"{args.data_dir!r} holds no hummock version manifest")

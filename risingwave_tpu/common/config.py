@@ -53,6 +53,72 @@ class StorageConfig:
 
 
 @dataclasses.dataclass
+class FaultConfig:
+    """Fault-tolerance knobs for every external boundary (common/retry.py,
+    storage/object_store.py, connector/broker.py, stream/sink.py,
+    frontend/remote.py). Reference capability: object-store retry config +
+    sink retry/decouple knobs (src/common/src/config.rs storage.object
+    retry section; sink decouple system params)."""
+
+    # object-store IO retry (RetryingObjectStore under hummock/segment/
+    # compactor/backup)
+    io_retry_attempts: int = 5
+    io_retry_base_ms: float = 10.0
+    io_retry_max_ms: float = 1000.0
+    io_retry_deadline_ms: float = 30_000.0
+    # sink delivery retry + degrade (stream/sink.py): past
+    # ``sink_degrade_after`` consecutive failed epochs the sink job
+    # degrades (log accumulates, barriers keep committing) instead of
+    # failing the epoch; past ``sink_log_cap_rows`` logged-undelivered
+    # rows it fails loudly (bounded-log backpressure)
+    sink_retry_attempts: int = 3
+    sink_retry_base_ms: float = 20.0
+    sink_retry_deadline_ms: float = 2000.0
+    sink_degrade_after: int = 3
+    sink_log_cap_rows: int = 1_000_000
+    # broker client reconnect-with-backoff (connector/broker.py)
+    broker_reconnect_attempts: int = 6
+    broker_reconnect_base_ms: float = 25.0
+    broker_reconnect_max_ms: float = 1000.0
+    # worker control-frame deadlines (frontend/remote.py): a wedged
+    # worker trips these instead of hanging the session forever
+    worker_request_timeout_s: float = 120.0
+    worker_epoch_timeout_s: float = 300.0
+    # seeded object-store fault injection (tests / sim chaos only)
+    inject_object_store_transient_rate: float = 0.0
+    inject_object_store_torn_write_rate: float = 0.0
+    inject_object_store_seed: int = 0
+
+    def io_retry_policy(self):
+        from .retry import RetryPolicy
+        from ..storage.object_store import PermanentObjectStoreError
+        return RetryPolicy(
+            max_attempts=self.io_retry_attempts,
+            base_delay_ms=self.io_retry_base_ms,
+            max_delay_ms=self.io_retry_max_ms,
+            deadline_ms=self.io_retry_deadline_ms,
+            retryable=(OSError, ConnectionError, TimeoutError),
+            non_retryable=(PermanentObjectStoreError,))
+
+    def sink_retry_policy(self):
+        from .retry import RetryPolicy
+        return RetryPolicy(
+            max_attempts=self.sink_retry_attempts,
+            base_delay_ms=self.sink_retry_base_ms,
+            max_delay_ms=max(self.sink_retry_base_ms * 8, 250.0),
+            deadline_ms=self.sink_retry_deadline_ms,
+            retryable=(Exception,))
+
+    def broker_retry_policy(self):
+        from .retry import RetryPolicy
+        return RetryPolicy(
+            max_attempts=self.broker_reconnect_attempts,
+            base_delay_ms=self.broker_reconnect_base_ms,
+            max_delay_ms=self.broker_reconnect_max_ms,
+            retryable=(OSError, ConnectionError, TimeoutError))
+
+
+@dataclasses.dataclass
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 4566
@@ -65,6 +131,7 @@ class RwConfig:
     streaming: StreamingConfig = dataclasses.field(
         default_factory=StreamingConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
 
 def load_config(path: Optional[str] = None, **overrides: Any) -> RwConfig:

@@ -19,9 +19,12 @@ DEBEZIUM_NEEDS_PK = (
 
 
 def make_reader(connector: str, options: dict, schema,
-                chunk_capacity: int, seed: int = 42) -> Optional[object]:
+                chunk_capacity: int, seed: int = 42,
+                fault=None) -> Optional[object]:
     """Instantiate a connector's SplitReader; None for declared-schema
-    sources fed only by tests (empty connector string)."""
+    sources fed only by tests (empty connector string). ``fault`` (a
+    FaultConfig) tunes boundary retry policies, e.g. the broker client's
+    reconnect budget."""
     if connector == "nexmark":
         from .nexmark_split import NexmarkReader
         table = str(options.get("nexmark_table",
@@ -55,7 +58,9 @@ def make_reader(connector: str, options: dict, schema,
             schema, address, topic, fmt=fmt,
             avro_schema=options.get("avro.schema"),
             avro_framing=str(options.get("avro.framing", "raw")),
-            rows_per_chunk=chunk_capacity)
+            rows_per_chunk=chunk_capacity,
+            reconnect_policy=(fault.broker_retry_policy()
+                              if fault is not None else None))
     if connector == "":
         return None
     raise ConnectorError(f"unsupported connector {connector!r}")

@@ -106,17 +106,29 @@ class BrokerSink(Sink):
     (reference: the Kafka sink's changelog-JSON shape,
     src/connector/src/sink/kafka.rs). Delivery position = messages
     published; the broker log is append-only so truncation is logical
-    (consumers use offsets), matching at-least-once like the reference's
-    non-transactional Kafka sink."""
+    (consumers use offsets) — ACROSS CRASHES this is at-least-once like
+    the reference's non-transactional Kafka sink.
+
+    Within one process, though, the SinkExecutor's retry loop replays
+    the SAME batch after a failed attempt, so a landed-but-unacked
+    prefix must not be republished: the sink tracks how many of its
+    messages actually reached the partition (the client's offset cursor
+    is authoritative even across mid-batch reconnects) and skips that
+    prefix on the retry — delivery order is deterministic (log rows in
+    (epoch, seq) order), so the prefix is exactly the duplicate set."""
 
     def __init__(self, address: str, topic: str, schema: Schema,
-                 partition: int = 0):
+                 partition: int = 0, reconnect_policy=None):
         from .broker import BrokerClient
-        self.client = BrokerClient(address)
+        self.client = BrokerClient(address,
+                                   reconnect_policy=reconnect_policy)
         self.topic = topic
         self.schema = schema
         self.partition = partition
-        self._published = 0
+        self._published = 0          # executor-view position (monotone)
+        self._base_off: Optional[int] = None
+        self._session_landed = 0     # messages landed by THIS instance
+        self._session_published = 0  # ...of which acked to the executor
 
     def write_rows(self, rows: Sequence[Row]) -> None:
         payloads = []
@@ -125,11 +137,26 @@ class BrokerSink(Sink):
             for f, v in zip(self.schema, values):
                 obj[f.name] = v          # already python-typed (sink.py)
             payloads.append(json.dumps(obj, default=str).encode())
-        # pipelined batch: one RTT per epoch flush, not per row. One
-        # partition per sink keeps the changelog totally ordered (the
-        # reference's kafka sink orders per key via key-hash partitioning;
-        # pick the partition with the topic.partition option)
-        self.client.publish_many(self.topic, self.partition, payloads)
+        if self._base_off is None:
+            self._base_off = self.client.partition_len(
+                self.topic, self.partition)
+        # retry dedup: a previous failed attempt may have landed a prefix
+        # of this same batch — skip exactly those messages
+        already = max(0, self._session_landed - self._session_published)
+        send = payloads[min(already, len(payloads)):]
+        try:
+            if send:
+                # pipelined batch: one RTT per epoch flush, not per row.
+                # One partition per sink keeps the changelog totally
+                # ordered (the reference's kafka sink orders per key via
+                # key-hash partitioning; pick the partition with the
+                # topic.partition option)
+                self.client.publish_many(self.topic, self.partition, send)
+        finally:
+            cur = self.client.published_through(self.topic, self.partition)
+            if cur is not None:
+                self._session_landed = max(0, cur - self._base_off)
+        self._session_published = self._session_landed
         self._published += len(payloads)
 
     def position(self) -> int:
@@ -142,8 +169,10 @@ class BrokerSink(Sink):
         self.client.close()
 
 
-def build_sink(connector: str, options: dict, schema: Schema) -> Sink:
-    """Sink registry (reference: SinkImpl::new, sink/mod.rs:150)."""
+def build_sink(connector: str, options: dict, schema: Schema,
+               fault=None) -> Sink:
+    """Sink registry (reference: SinkImpl::new, sink/mod.rs:150).
+    ``fault`` (a FaultConfig) tunes boundary retry policies."""
     c = connector.lower()
     if c in ("blackhole", ""):
         return BlackHoleSink()
@@ -157,5 +186,7 @@ def build_sink(connector: str, options: dict, schema: Schema) -> Sink:
         from .broker import parse_broker_options
         address, topic = parse_broker_options(options)
         return BrokerSink(address, topic, schema,
-                          partition=int(options.get("topic.partition", 0)))
+                          partition=int(options.get("topic.partition", 0)),
+                          reconnect_policy=(fault.broker_retry_policy()
+                                            if fault is not None else None))
     raise ValueError(f"unsupported sink connector {connector!r}")

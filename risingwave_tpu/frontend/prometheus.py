@@ -95,6 +95,36 @@ def render_metrics(session) -> str:
             lines.append(
                 f'rw_compactor_up{{worker="{c["worker"]}"}} '
                 f'{0 if c.get("dead") else 1}')
+    retry = m.get("retry") or {}
+    if retry:
+        lines += ["# HELP rw_retry_total Per-site boundary retry "
+                  "counters (object store / broker / sink delivery).",
+                  "# TYPE rw_retry_total counter"]
+        for site, counters in retry.items():
+            for event, value in counters.items():
+                lines.append(
+                    f'rw_retry_total{{site="{_sanitize(site)}",'
+                    f'event="{_sanitize(event)}"}} {value}')
+    sinks = m.get("sinks") or {}
+    if sinks:
+        lines += ["# HELP rw_sink_degraded Sink delivery health "
+                  "(1 = degraded: backend down, log accumulating).",
+                  "# TYPE rw_sink_degraded gauge",
+                  "# HELP rw_sink_stat Sink-decouple counters "
+                  "(pending undelivered rows, delivery failures, "
+                  "delivered epoch).",
+                  "# TYPE rw_sink_stat gauge"]
+        for name, h in sinks.items():
+            lines.append(
+                f'rw_sink_degraded{{sink="{_sanitize(name)}"}} '
+                f'{1 if h.get("degraded") else 0}')
+            for stat, value in h.items():
+                if not isinstance(value, (int, float)) \
+                        or isinstance(value, bool):
+                    continue
+                lines.append(
+                    f'rw_sink_stat{{sink="{_sanitize(name)}",'
+                    f'stat="{_sanitize(stat)}"}} {value}')
     return "\n".join(lines) + "\n"
 
 

@@ -21,9 +21,12 @@ import sys
 import time
 from typing import Optional
 
+from ..common.config import FaultConfig as _FaultConfig
 from ..rpc.wire import message_to_wire, read_frame, write_frame
 from ..stream.message import Message
 from .runtime import ChangelogBus, QueueSource
+
+_FAULT_DEFAULTS = _FaultConfig()
 
 
 class WorkerDied(RuntimeError):
@@ -34,6 +37,16 @@ class RemoteWorker:
     """Spawn + drive one worker process over a multiplexed socket."""
 
     SPAWN_TIMEOUT_S = 60.0
+    #: default deadline on control-frame request/reply cycles: a worker
+    #: wedged before replying (accelerator hang, livelock) used to hang
+    #: handle_create_job/scan forever — now it trips WorkerDied and the
+    #: recovery machinery. Defaults come from FaultConfig (the single
+    #: source of the numbers; configurable via rw_config fault.*).
+    REQUEST_TIMEOUT_S = _FAULT_DEFAULTS.worker_request_timeout_s
+    #: deadline on barrier collection per epoch: a worker that stops
+    #: acking barriers without closing its socket is declared failed
+    #: (fail-stop) so the heartbeat-TTL scoped recovery can respawn it
+    EPOCH_TIMEOUT_S = _FAULT_DEFAULTS.worker_epoch_timeout_s
 
     def __init__(self, data_dir: str, worker_id: int, loop,
                  permits: int = 32):
@@ -41,6 +54,8 @@ class RemoteWorker:
         self.worker_id = worker_id
         self.loop = loop
         self.permits = permits
+        self.request_timeout = self.REQUEST_TIMEOUT_S
+        self.epoch_timeout = self.EPOCH_TIMEOUT_S
         self.dead = False
         self.proc: Optional[subprocess.Popen] = None
         self._rid = itertools.count(1)
@@ -210,14 +225,32 @@ class RemoteWorker:
             self._mark_dead()
             raise WorkerDied("worker connection lost") from None
 
-    async def request(self, obj: dict) -> dict:
+    async def request(self, obj: dict,
+                      timeout: Optional[float] = None) -> dict:
+        """Request/reply with a DEFAULT deadline (``request_timeout``; a
+        worker wedged before replying is declared dead instead of hanging
+        the caller forever). Pass ``timeout=0`` to wait unbounded."""
         rid = next(self._rid)
         obj = {**obj, "rid": rid}
         fut = self.loop.create_future()
         self._pending[rid] = fut
+        t = self.request_timeout if timeout is None else timeout
         try:
             await self.send(obj)
-            resp = await fut
+            if t and t > 0:
+                try:
+                    resp = await asyncio.wait_for(fut, t)
+                except asyncio.TimeoutError:
+                    # fail-stop: a worker that missed a control deadline
+                    # is indistinguishable from a dead one — mark it so
+                    # recovery (respawn over durable state) takes over
+                    self._mark_dead()
+                    raise WorkerDied(
+                        f"worker {self.worker_id} request "
+                        f"{obj.get('type')!r} timed out after {t}s") \
+                        from None
+            else:
+                resp = await fut
         finally:
             # a caller-side wait_for timeout cancels ``fut`` but would
             # otherwise leave its rid in _pending forever (the late
@@ -295,21 +328,43 @@ class RemoteWorker:
         await self.send({"type": "barrier", "epoch": epoch,
                          "checkpoint": False, "generate": False,
                          "only": [name], "init": True})
-        frame = await self._init_fut
-        self._init_fut = None
+        try:
+            if self.epoch_timeout and self.epoch_timeout > 0:
+                frame = await asyncio.wait_for(self._init_fut,
+                                               self.epoch_timeout)
+            else:
+                frame = await self._init_fut
+        except asyncio.TimeoutError:
+            self._mark_dead()
+            raise WorkerDied(
+                f"worker {self.worker_id} init barrier for {name!r} "
+                f"timed out after {self.epoch_timeout}s") from None
+        finally:
+            self._init_fut = None
         if frame.get("ok", True) is False:
             raise RuntimeError(
                 f"remote job {name!r} failed at init: {frame.get('error')}")
 
     async def wait_epoch(self, epoch: int) -> bool:
-        """True iff the worker collected the epoch cleanly."""
+        """True iff the worker collected the epoch cleanly. Bounded by
+        ``epoch_timeout``: a worker that stops acking barriers while its
+        socket stays open (SIGSTOP, accelerator wedge) is declared dead
+        instead of deadlocking the conductor — the heartbeat-TTL scoped
+        recovery then respawns it over durable state."""
         if self.dead:
             return False
         err = self._epoch_errors.get(epoch)
         if err:
             raise RuntimeError(f"remote job failed: {err}")
         ev = self._epoch_events.setdefault(epoch, asyncio.Event())
-        await ev.wait()
+        if self.epoch_timeout and self.epoch_timeout > 0:
+            try:
+                await asyncio.wait_for(ev.wait(), self.epoch_timeout)
+            except asyncio.TimeoutError:
+                self._mark_dead()
+                return False
+        else:
+            await ev.wait()
         # NOT popped here: several RemoteJobs on this worker wait the same
         # epoch; entries are pruned by inject_barrier's horizon instead
         err = self._epoch_errors.get(epoch)

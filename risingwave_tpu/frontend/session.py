@@ -54,6 +54,11 @@ class SqlError(ValueError):
     pass
 
 
+def _retry_snapshot() -> dict:
+    from ..common.retry import GLOBAL_RETRY_METRICS
+    return GLOBAL_RETRY_METRICS.snapshot()
+
+
 def _locked(fn):
     """Serialize a public Session entry point on the session's API lock.
 
@@ -188,7 +193,8 @@ class Session:
                  workers: int = 0,
                  state_store: Optional[str] = None,
                  compactors: int = 0,
-                 rw_config=None):
+                 rw_config=None,
+                 fault_config=None):
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
         # style). Reference: load_config + SystemParams (config.rs:128).
@@ -226,6 +232,14 @@ class Session:
                 join_key_capacity=st.join_key_capacity,
                 join_bucket_width=st.join_bucket_width,
                 topn_table_capacity=st.topn_table_capacity)
+        # fault-tolerance knobs for every external boundary (object-store
+        # retry, sink degrade, broker reconnect, worker deadlines) —
+        # common/config.py FaultConfig; explicit fault_config wins over
+        # the rw_config section
+        from ..common.config import FaultConfig
+        self.fault = (fault_config
+                      or (rw_config.fault if rw_config is not None
+                          else FaultConfig()))
         self.catalog = Catalog()
         self.data_dir = data_dir
         if data_dir is not None:
@@ -251,16 +265,28 @@ class Session:
                     "store; opening it as 'hummock' would recover an "
                     "empty store (drop the explicit state_store to "
                     "auto-detect)")
+            # durable-tier object store: local FS → optional seeded fault
+            # injection (tests/sim chaos) → retry layer, per the fault
+            # config (storage/object_store.py open_object_store)
+            from ..storage.object_store import open_object_store
+            _obj = open_object_store(
+                data_dir, self.fault.io_retry_policy(),
+                fault_transient_rate=(
+                    self.fault.inject_object_store_transient_rate),
+                fault_seed=self.fault.inject_object_store_seed,
+                fault_torn_write_rate=(
+                    self.fault.inject_object_store_torn_write_rate))
             if state_store == "hummock":
                 from ..storage.hummock import HummockStateStore
                 # a dedicated compactor role takes over compaction; with
                 # none configured the store folds in-process (background
                 # thread), mirroring the segment log
                 self.store: MemoryStateStore = HummockStateStore(
-                    data_dir, inline_compaction=(compactors == 0))
+                    data_dir, object_store=_obj,
+                    inline_compaction=(compactors == 0))
             elif state_store == "segment":
                 from ..storage.checkpoint import DurableStateStore
-                self.store = DurableStateStore(data_dir)
+                self.store = DurableStateStore(data_dir, object_store=_obj)
             else:
                 raise ValueError(
                     f"unknown state_store {state_store!r} "
@@ -345,6 +371,11 @@ class Session:
                 w = RemoteWorker(_os.path.join(base, f"worker_{k}"), k,
                                  self.loop,
                                  permits=self.config.exchange_permits)
+                # control-frame deadlines: a wedged worker trips these
+                # (and the heartbeat-TTL recovery) instead of hanging the
+                # session forever
+                w.request_timeout = self.fault.worker_request_timeout_s
+                w.epoch_timeout = self.fault.worker_epoch_timeout_s
                 w.spawn()
                 self._await(w.connect())
                 self.workers.append(w)
@@ -854,6 +885,9 @@ class Session:
             "chunks_per_tick": self.chunks_per_tick,
             "chunk_capacity": self.source_chunk_capacity,
             "seed": self.seed,
+            # fault knobs travel with the job: worker-hosted broker
+            # readers honor the same reconnect budget as local ones
+            "fault": dataclasses.asdict(self.fault),
             # session-restart replay of a channel-fed job rebuilds fresh
             # from the upstream snapshot (the changelog between the
             # worker's and the session's last commits is unrecoverable);
@@ -1010,12 +1044,21 @@ class Session:
         self._maybe_rebackfill(ctx_tids + (log_tid, prog_tid),
                                scan_leaf_queues)
         visible_schema = Schema(tuple(schema)[:n_visible])
-        sink = build_sink(connector, dict(stmt.with_options), visible_schema)
+        sink = build_sink(connector, dict(stmt.with_options), visible_schema,
+                          fault=self.fault)
+        # delivery decoupling knobs: per-sink WITH options override the
+        # session fault config (reference: sink decouple + retry params)
+        opts = stmt.with_options
         ex = SinkExecutor(
             pipeline, sink,
             StateTable(self.store, log_tid, log_table_schema(schema), [0, 1]),
             StateTable(self.store, prog_tid, PROGRESS_SCHEMA, [0]),
-            n_visible=n_visible, recovering=self._recovering)
+            n_visible=n_visible, recovering=self._recovering,
+            retry_policy=self.fault.sink_retry_policy(),
+            degrade_after=int(opts.get("sink.degrade_after",
+                                       self.fault.sink_degrade_after)),
+            log_cap_rows=int(opts.get("sink.log_cap_rows",
+                                      self.fault.sink_log_cap_rows)))
         sdef = SinkDef(stmt.name, schema, connector, dict(stmt.with_options),
                        from_name=stmt.from_name or "", table_id=log_tid,
                        progress_table_id=prog_tid)
@@ -1205,6 +1248,19 @@ class Session:
         """The live Sink instance of a sink job (inspection/testing)."""
         job = self.jobs.get(name)
         return getattr(job.pipeline, "sink", None) if job else None
+
+    @_locked
+    def resume_sink(self, name: str) -> None:
+        """Re-arm delivery on a DEGRADED sink job (the ALTER SINK ...
+        RESUME shape): the logged backlog drains at the next barrier.
+        No-op on a healthy sink."""
+        if name not in self.catalog.sinks:
+            raise SqlError(f"sink {name!r} not found")
+        job = self.jobs.get(name)
+        resume = getattr(job.pipeline, "resume", None) if job else None
+        if resume is None:
+            raise SqlError(f"sink {name!r} has no live delivery loop")
+        resume()
 
     # ------------------------------------------------- scoped job recovery --
 
@@ -1432,7 +1488,8 @@ class Session:
         from ..connector.factory import ConnectorError, make_reader
         try:
             return make_reader(src.connector, src.options, src.schema,
-                               self.source_chunk_capacity, self.seed)
+                               self.source_chunk_capacity, self.seed,
+                               fault=self.fault)
         except ConnectorError as e:
             raise SqlError(str(e)) from None
 
@@ -2027,9 +2084,12 @@ class Session:
                 import base64 as _b64
 
                 from ..common.row import decode_value_row
+                # data-plane request: a big batch stage may legitimately
+                # outlive the control-frame deadline — unbounded here;
+                # wedge detection stays the barrier deadline's job
                 resp = self._await(worker.request(
                     {"type": "batch_task", "job": name,
-                     "plan": plan_json, "defs": defs_json}))
+                     "plan": plan_json, "defs": defs_json}, timeout=0))
                 return [decode_value_row(_b64.b64decode(b), types)
                         for b in resp["rows"]]
 
@@ -2204,8 +2264,11 @@ class Session:
 
         from ..common.row import decode_value_row
         spec = self._remote_specs[name]
+        # data-plane request: scanning a huge MV may exceed the control
+        # deadline without the worker being wedged — unbounded
         resp = self._await(
-            spec["worker"].request({"type": "scan", "name": name}))
+            spec["worker"].request({"type": "scan", "name": name},
+                                   timeout=0))
         types = [f.type for f in schema]
         out = []
         for b in resp["rows"]:
@@ -2248,6 +2311,16 @@ class Session:
                 for se in self._slow_epochs
             ],
             "storage": self._storage_metrics(),
+            # per-site retry counters from every boundary (object store,
+            # broker, sink delivery) — common/retry.py global registry
+            "retry": _retry_snapshot(),
+            # sink-decouple health: degraded flag, undelivered backlog,
+            # delivery failure counters per sink job
+            "sinks": {
+                name: job.pipeline.sink_health()
+                for name, job in self.jobs.items()
+                if hasattr(job.pipeline, "sink_health")
+            },
         }
         worker_stats = self._federate_worker_stats()
         for wid, st in sorted(worker_stats.items()):

@@ -27,12 +27,23 @@ from .frontend.session import Session
 class SimCluster:
     def __init__(self, data_dir: str, seed: int = 0, kill_rate: float = 0.3,
                  checkpoint_frequency: int = 2, workers: int = 0,
+                 transient_fault_rate: float = 0.0,
+                 broker=None, broker_restart_rate: float = 0.0,
                  **session_kw):
         """``workers`` > 0 runs MV jobs on worker PROCESSES and arms
         per-component kills: the chaos step randomly SIGKILLs one worker
         (scoped heartbeat-TTL recovery) instead of always restarting the
         whole cluster — the madsim individual-node kill
-        (reference: cluster.rs:498-510)."""
+        (reference: cluster.rs:498-510).
+
+        ``transient_fault_rate`` > 0 arms SEEDED transient object-store
+        faults for the whole workload (every durable-tier IO may fail and
+        be retried — storage/object_store.py FaultInjectingObjectStore
+        under the retry layer), proving the exactly-once machinery holds
+        under flaky IO, not just clean kills. ``broker`` (a BrokerServer
+        with a durable data_dir) + ``broker_restart_rate`` add broker
+        restarts to the chaos menu: readers/sinks must survive via the
+        reconnecting BrokerClient."""
         self.data_dir = data_dir
         self.rng = random.Random(seed)
         self.kill_rate = kill_rate
@@ -40,6 +51,19 @@ class SimCluster:
                                checkpoint_frequency=checkpoint_frequency)
         if workers:
             self.session_kw["workers"] = workers
+        if transient_fault_rate > 0.0 and \
+                "fault_config" not in self.session_kw:
+            from .common.config import FaultConfig
+            self.session_kw["fault_config"] = FaultConfig(
+                inject_object_store_transient_rate=transient_fault_rate,
+                inject_object_store_seed=self.rng.randrange(1 << 30),
+                # faults at rate p need attempts n with p**n ≈ 0:
+                # 8 attempts at p=0.2 leaves ~3e-6 per op
+                io_retry_attempts=8, io_retry_base_ms=1.0,
+                io_retry_max_ms=20.0)
+        self.broker = broker
+        self.broker_restart_rate = broker_restart_rate
+        self.broker_restarts = 0
         self.session = Session(data_dir=data_dir, **self.session_kw)
         self.kills = 0
         self.worker_kills = 0
@@ -69,6 +93,11 @@ class SimCluster:
     # -- chaos ----------------------------------------------------------------
 
     def maybe_kill(self) -> bool:
+        # broker restarts draw independently: a flaky broker AND a
+        # crashing cluster may strike in the same step
+        if (self.broker is not None and self.broker_restart_rate > 0
+                and self.rng.random() < self.broker_restart_rate):
+            self.restart_broker()
         if self.rng.random() >= self.kill_rate:
             return False
         if getattr(self.session, "workers", None) and \
@@ -77,6 +106,19 @@ class SimCluster:
         else:
             self.kill()
         return True
+
+    def restart_broker(self) -> None:
+        """Bounce the external broker on the SAME address (durable
+        segments reload): in-flight client commands fail and must be
+        absorbed by BrokerClient's reconnect-with-backoff."""
+        from .connector.broker import BrokerServer
+        old = self.broker
+        host, port = old.host, old.port
+        old.close()
+        self.broker = BrokerServer(
+            host=host, port=port, n_partitions=old.n_partitions,
+            data_dir=old.data_dir).start()
+        self.broker_restarts += 1
 
     def kill_worker(self) -> None:
         """SIGKILL one worker process (per-component failure): the

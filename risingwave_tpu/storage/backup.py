@@ -12,6 +12,11 @@ captures the SAME two tiers:
   excluded, exactly like unreferenced SSTs),
 * the meta tier (``meta/meta.jsonl`` — catalog, DDL log, system params).
 
+All data-file reads and writes go through the retried object-store layer
+(storage/object_store.py): the backup of a flaky volume retries with
+backoff instead of dying on the first EIO, exactly like the checkpoint
+path it snapshots.
+
 The snapshot is self-describing (``backup.json`` with id, epoch and the
 captured file list) and restore refuses to overwrite a non-empty target,
 mirroring the reference's restore precondition that the new cluster must
@@ -22,15 +27,32 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import time
 from typing import Optional
+
+from .object_store import open_object_store
 
 _BACKUP_META = "backup.json"
 
 
 class BackupError(RuntimeError):
     pass
+
+
+def _write_descriptor(dest: str, desc: dict) -> None:
+    tmp = os.path.join(dest, _BACKUP_META + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(desc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dest, _BACKUP_META))
+
+
+def _copy_meta_tier(src_store, dest_store, files: list) -> None:
+    raw = src_store.get("meta/meta.jsonl")
+    if raw is not None:
+        dest_store.put("meta/meta.jsonl", raw)
+        files.append("meta/meta.jsonl")
 
 
 def create_backup(data_dir: str, dest: str,
@@ -40,35 +62,30 @@ def create_backup(data_dir: str, dest: str,
     segment log (manifest.json) or Hummock-lite (hummock/version.json)."""
     if os.path.exists(os.path.join(data_dir, "hummock", "version.json")):
         return _create_backup_hummock(data_dir, dest, backup_id)
-    manifest_path = os.path.join(data_dir, "manifest.json")
-    if not os.path.exists(manifest_path):
+    src = open_object_store(data_dir)
+    manifest_raw = src.get("manifest.json")
+    if manifest_raw is None:
         raise BackupError(f"{data_dir!r} has no checkpoint manifest")
-    with open(manifest_path, "rb") as f:
-        manifest_raw = f.read()
     manifest = json.loads(manifest_raw)
     os.makedirs(dest, exist_ok=True)
     if os.path.exists(os.path.join(dest, _BACKUP_META)):
         raise BackupError(f"{dest!r} already contains a backup")
+    out = open_object_store(dest)
 
     files = []
     # 1. the manifest itself (fixed bytes: the version being captured)
-    with open(os.path.join(dest, "manifest.json"), "wb") as f:
-        f.write(manifest_raw)
+    out.put("manifest.json", manifest_raw)
     files.append("manifest.json")
     # 2. every segment the manifest references — and nothing else
     for seg in manifest.get("segments", []):
-        src = os.path.join(data_dir, seg)
-        if not os.path.exists(src):
+        data = src.get(seg)
+        if data is None:
             raise BackupError(
                 f"manifest references missing segment {seg!r}")
-        shutil.copy2(src, os.path.join(dest, seg))
+        out.put(seg, data)
         files.append(seg)
     # 3. the meta tier (catalog / DDL log / params)
-    meta_src = os.path.join(data_dir, "meta", "meta.jsonl")
-    if os.path.exists(meta_src):
-        os.makedirs(os.path.join(dest, "meta"), exist_ok=True)
-        shutil.copy2(meta_src, os.path.join(dest, "meta", "meta.jsonl"))
-        files.append("meta/meta.jsonl")
+    _copy_meta_tier(src, out, files)
 
     desc = {
         "backup_id": backup_id or f"backup-{int(time.time())}",
@@ -76,12 +93,7 @@ def create_backup(data_dir: str, dest: str,
         "files": files,
         "source_dir": os.path.abspath(data_dir),
     }
-    tmp = os.path.join(dest, _BACKUP_META + ".tmp")
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(desc, f, indent=2)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(dest, _BACKUP_META))
+    _write_descriptor(dest, desc)
     return desc
 
 
@@ -97,41 +109,35 @@ def _create_backup_hummock(data_dir: str, dest: str,
     os.makedirs(dest, exist_ok=True)
     if os.path.exists(os.path.join(dest, _BACKUP_META)):
         raise BackupError(f"{dest!r} already contains a backup")
-    version_path = os.path.join(data_dir, "hummock", "version.json")
+    src = open_object_store(data_dir)
+    out = open_object_store(dest)
     for attempt in range(8):
-        with open(version_path, "rb") as f:
-            version_raw = f.read()
+        version_raw = src.get("hummock/version.json")
+        if version_raw is None:
+            raise BackupError(f"{data_dir!r} has no hummock version")
         version = json.loads(version_raw)
         runs = list(version.get("l0", [])) + list(version.get("l1", []))
-        try:
-            staged = []
-            for rel in runs:
-                src = os.path.join(data_dir, rel)
-                if not os.path.exists(src):
-                    raise FileNotFoundError(rel)
-                staged.append(rel)
-            files = []
-            os.makedirs(os.path.join(dest, "hummock"), exist_ok=True)
-            with open(os.path.join(dest, "hummock", "version.json"),
-                      "wb") as f:
-                f.write(version_raw)
-            files.append("hummock/version.json")
-            for rel in staged:
-                dst = os.path.join(dest, rel)
-                os.makedirs(os.path.dirname(dst), exist_ok=True)
-                shutil.copy2(os.path.join(data_dir, rel), dst)
-                files.append(rel)
+        # copy ONE SST at a time (never the whole store in memory); if a
+        # referenced run vanished mid-copy (vacuumed by a live
+        # compactor), re-read the manifest and start over — SSTs already
+        # copied are simply overwritten or orphaned in the backup dir
+        files = ["hummock/version.json"]
+        out.put("hummock/version.json", version_raw)
+        vanished = False
+        for rel in runs:
+            data = src.get(rel)
+            if data is None:
+                vanished = True
+                break
+            out.put(rel, data)
+            files.append(rel)
+        if not vanished:
             break
-        except FileNotFoundError:
-            if attempt == 7:
-                raise BackupError(
-                    "version kept referencing vanished SSTs (live "
-                    "compactor racing the backup?)")
-    meta_src = os.path.join(data_dir, "meta", "meta.jsonl")
-    if os.path.exists(meta_src):
-        os.makedirs(os.path.join(dest, "meta"), exist_ok=True)
-        shutil.copy2(meta_src, os.path.join(dest, "meta", "meta.jsonl"))
-        files.append("meta/meta.jsonl")
+        if attempt == 7:
+            raise BackupError(
+                "version kept referencing vanished SSTs (live "
+                "compactor racing the backup?)")
+    _copy_meta_tier(src, out, files)
     desc = {
         "backup_id": backup_id or f"backup-{int(time.time())}",
         "committed_epoch": version.get("committed_epoch"),
@@ -140,12 +146,7 @@ def _create_backup_hummock(data_dir: str, dest: str,
         "files": files,
         "source_dir": os.path.abspath(data_dir),
     }
-    tmp = os.path.join(dest, _BACKUP_META + ".tmp")
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(desc, f, indent=2)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(dest, _BACKUP_META))
+    _write_descriptor(dest, desc)
     return desc
 
 
@@ -162,14 +163,13 @@ def restore_backup(backup_dir: str, data_dir: str) -> dict:
         raise BackupError(
             f"restore target {data_dir!r} is not empty (refusing to "
             "overwrite a live data dir)")
-    os.makedirs(data_dir, exist_ok=True)
+    src = open_object_store(backup_dir)
+    out = open_object_store(data_dir)
     for rel in desc["files"]:
-        src = os.path.join(backup_dir, rel)
-        if not os.path.exists(src):
+        data = src.get(rel)
+        if data is None:
             raise BackupError(f"backup is missing file {rel!r}")
-        dst = os.path.join(data_dir, rel)
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        shutil.copy2(src, dst)
+        out.put(rel, data)
     return desc
 
 

@@ -35,7 +35,7 @@ import struct
 import threading
 from typing import Optional
 
-from .object_store import LocalFsObjectStore, ObjectStore
+from .object_store import ObjectStore, open_object_store, wrap_object_store
 from .state_store import MemoryStateStore
 
 _MANIFEST = "manifest.json"
@@ -54,13 +54,16 @@ PLAN_FORMAT_VERSION = 3
 class CheckpointLog:
     def __init__(self, data_dir: Optional[str] = None,
                  object_store: Optional[ObjectStore] = None,
-                 compact_after: Optional[int] = None):
+                 compact_after: Optional[int] = None,
+                 retry_policy=None):
         if object_store is None:
             if data_dir is None:
                 raise ValueError("need data_dir or object_store")
-            object_store = LocalFsObjectStore(data_dir)
+            object_store = open_object_store(data_dir, retry_policy)
         self.dir = data_dir
-        self.store = object_store
+        # every IO below the manifest/segment discipline goes through the
+        # retry layer (idempotent whole-object ops; common/retry.py)
+        self.store = wrap_object_store(object_store, retry_policy)
         if compact_after is not None:
             self.COMPACT_AFTER = compact_after
         # serializes manifest read-modify-write cycles between the barrier
@@ -337,10 +340,12 @@ class DurableStateStore(MemoryStateStore):
 
     def __init__(self, data_dir: Optional[str] = None,
                  object_store: Optional[ObjectStore] = None,
-                 compact_after: Optional[int] = None):
+                 compact_after: Optional[int] = None,
+                 retry_policy=None):
         super().__init__()
         self.log = CheckpointLog(data_dir, object_store=object_store,
-                                 compact_after=compact_after)
+                                 compact_after=compact_after,
+                                 retry_policy=retry_policy)
         if self.log.exists():
             epoch, tables = self.log.load_tables()
             self._committed = tables

@@ -34,7 +34,7 @@ import uuid
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from .checkpoint import PLAN_FORMAT_VERSION
-from .object_store import LocalFsObjectStore, ObjectStore
+from .object_store import ObjectStore, open_object_store, wrap_object_store
 from .sstable import Sstable, SstBuilder, load_sst, merge_iter
 from .state_store import MemoryStateStore
 
@@ -283,13 +283,18 @@ class HummockStateStore(MemoryStateStore):
     def __init__(self, data_dir: Optional[str] = None,
                  object_store: Optional[ObjectStore] = None,
                  l0_compact_trigger: Optional[int] = None,
-                 inline_compaction: bool = True):
+                 inline_compaction: bool = True,
+                 retry_policy=None):
         super().__init__()
         if object_store is None:
             if data_dir is None:
                 raise ValueError("need data_dir or object_store")
-            object_store = LocalFsObjectStore(data_dir)
-        self.object_store = object_store
+            object_store = open_object_store(data_dir, retry_policy)
+        # SST/manifest IO under the retry layer (idempotent whole-object
+        # ops; common/retry.py) — the version manager shares the SAME
+        # wrapped handle so vacuum and publish retry identically
+        self.object_store = wrap_object_store(object_store, retry_policy)
+        object_store = self.object_store
         from ..meta.hummock import HummockManager
         self.manager = HummockManager(object_store, l0_compact_trigger)
         self.log = _LogFacade(self)

@@ -11,13 +11,22 @@ in-memory (tests/sim).
 Only whole-object operations: segments are written once and read whole —
 the streaming/range reads the reference needs for LSM blocks do not arise
 (device state is merged in HBM; a segment is one compact delta).
+
+Fault tolerance (the boundary discipline): every durable-tier consumer
+opens its store through ``open_object_store``/``wrap_object_store``, which
+layer ``RetryingObjectStore`` (common/retry.py policy — whole-object ops
+are idempotent, so a blind re-put/re-get is always safe) and, for tests
+and the sim, a seeded ``FaultInjectingObjectStore`` with transient-rate,
+permanent-path, and torn-write modes. Raw backend construction outside
+this module is lint-rejected by scripts/check.sh.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 
 class ObjectStore:
@@ -129,3 +138,163 @@ class MemObjectStore(ObjectStore):
     def delete(self, path: str) -> None:
         with self._lock:
             self._objects.pop(path, None)
+
+
+# -- fault-tolerance layers ---------------------------------------------------
+
+
+class TransientObjectStoreError(OSError):
+    """A fault a retry may absorb (throttling, flaky network, torn put)."""
+
+
+class PermanentObjectStoreError(RuntimeError):
+    """A fault retrying cannot fix (permissions, bad bucket): surfaces
+    immediately through the retry layer."""
+
+
+class FaultInjectingObjectStore(ObjectStore):
+    """Seeded chaos wrapper for tests and the sim (the in-tree analogue of
+    the reference's storage failpoints + madsim IO faults). Modes:
+
+    * ``transient_rate`` — each op fails with TransientObjectStoreError
+      with this probability BEFORE touching the backend,
+    * ``torn_write_rate`` — a ``put`` writes a truncated prefix, then
+      fails (the mid-write crash shape the manifest discipline must
+      survive; never applied to ``atomic_put``, whose contract is
+      no-torn-state),
+    * ``permanent_paths`` — path prefixes that always fail permanently.
+
+    Thread-safe: the seeded RNG is shared by the barrier path and the
+    background compactor."""
+
+    def __init__(self, inner: ObjectStore, seed: int = 0,
+                 transient_rate: float = 0.0,
+                 torn_write_rate: float = 0.0,
+                 permanent_paths: Sequence[str] = ()):
+        self.inner = inner
+        self.transient_rate = float(transient_rate)
+        self.torn_write_rate = float(torn_write_rate)
+        self.permanent_paths = tuple(permanent_paths)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.faults_injected = 0
+        self.torn_writes = 0
+
+    def _roll(self, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            hit = self._rng.random() < rate
+            if hit:
+                self.faults_injected += 1
+            return hit
+
+    def _maybe_fault(self, op: str, path: str) -> None:
+        for p in self.permanent_paths:
+            if path.startswith(p):
+                raise PermanentObjectStoreError(
+                    f"injected permanent fault: {op} {path!r}")
+        if self._roll(self.transient_rate):
+            raise TransientObjectStoreError(
+                f"injected transient fault: {op} {path!r}")
+
+    def put(self, path: str, data: bytes) -> None:
+        self._maybe_fault("put", path)
+        if self._roll(self.torn_write_rate):
+            with self._lock:
+                self.torn_writes += 1
+            self.inner.put(path, data[: max(1, len(data) // 2)])
+            raise TransientObjectStoreError(
+                f"injected torn write: put {path!r}")
+        self.inner.put(path, data)
+
+    def atomic_put(self, path: str, data: bytes) -> None:
+        # atomic_put may fail but never tear (that is its contract)
+        self._maybe_fault("atomic_put", path)
+        self.inner.atomic_put(path, data)
+
+    def get(self, path: str) -> Optional[bytes]:
+        self._maybe_fault("get", path)
+        return self.inner.get(path)
+
+    def list(self, prefix: str = "") -> List[str]:
+        self._maybe_fault("list", prefix)
+        return self.inner.list(prefix)
+
+    def delete(self, path: str) -> None:
+        self._maybe_fault("delete", path)
+        self.inner.delete(path)
+
+    def exists(self, path: str) -> bool:
+        self._maybe_fault("exists", path)
+        return self.inner.exists(path)
+
+
+#: default policy for object-store IO; callers override via rw_config
+#: fault.* knobs (common/config.py, the single source of the default
+#: numbers) threaded through open_object_store
+def default_io_retry_policy():
+    from ..common.config import FaultConfig
+    return FaultConfig().io_retry_policy()
+
+
+class RetryingObjectStore(ObjectStore):
+    """Retry/backoff layer over any backend. Safe by construction: every
+    op is whole-object and idempotent (a re-put rewrites the same bytes;
+    a torn first put is fully overwritten by the retry), so the wrapper
+    retries blindly on retryable errors and surfaces
+    PermanentObjectStoreError / RetryError past the budget. Per-op
+    counters land in the global retry registry under
+    ``object_store.<op>`` sites."""
+
+    def __init__(self, inner: ObjectStore, policy=None,
+                 site_prefix: str = "object_store"):
+        self.inner = inner
+        self.policy = policy or default_io_retry_policy()
+        self._prefix = site_prefix
+
+    def _run(self, op: str, fn, *args):
+        return self.policy.run(f"{self._prefix}.{op}", fn, *args)
+
+    def put(self, path: str, data: bytes) -> None:
+        self._run("put", self.inner.put, path, data)
+
+    def atomic_put(self, path: str, data: bytes) -> None:
+        self._run("atomic_put", self.inner.atomic_put, path, data)
+
+    def get(self, path: str) -> Optional[bytes]:
+        return self._run("get", self.inner.get, path)
+
+    def list(self, prefix: str = "") -> List[str]:
+        return self._run("list", self.inner.list, prefix)
+
+    def delete(self, path: str) -> None:
+        self._run("delete", self.inner.delete, path)
+
+    def exists(self, path: str) -> bool:
+        return self._run("exists", self.inner.exists, path)
+
+
+def wrap_object_store(store: ObjectStore, policy=None) -> ObjectStore:
+    """Canonical retry wrapping: idempotent (an already-retrying store is
+    returned as-is) so every durable-tier entry point can call it
+    unconditionally."""
+    if isinstance(store, RetryingObjectStore):
+        return store
+    return RetryingObjectStore(store, policy)
+
+
+def open_object_store(data_dir: str, retry_policy=None,
+                      fault_transient_rate: float = 0.0,
+                      fault_seed: int = 0,
+                      fault_torn_write_rate: float = 0.0) -> ObjectStore:
+    """THE way the durable tier opens a local-FS-backed store: backend →
+    (optional seeded fault injection, tests/sim) → retry layer. Raw
+    ``LocalFsObjectStore(...)`` construction outside this module is a
+    lint error (scripts/check.sh) — it would bypass the retry boundary."""
+    store: ObjectStore = LocalFsObjectStore(data_dir)
+    if fault_transient_rate > 0.0 or fault_torn_write_rate > 0.0:
+        store = FaultInjectingObjectStore(
+            store, seed=fault_seed, transient_rate=fault_transient_rate,
+            torn_write_rate=fault_torn_write_rate)
+    return wrap_object_store(store, retry_policy)

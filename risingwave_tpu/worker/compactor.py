@@ -42,14 +42,16 @@ from ..rpc.wire import (
     read_frame, read_frame_sync, write_frame, write_frame_sync,
 )
 from ..storage.hummock import CompactTask, run_compact_task
-from ..storage.object_store import LocalFsObjectStore
+from ..storage.object_store import open_object_store
 
 
 class CompactorHost:
     """One compactor process: object store handle + task loop."""
 
     def __init__(self, data_dir: str, worker_id: int = 0):
-        self.store = LocalFsObjectStore(data_dir)
+        # retried IO: a transient read/write fault mid-merge costs a
+        # backoff, not a failed task report + rescheduled compaction
+        self.store = open_object_store(data_dir)
         self.worker_id = worker_id
         self.stats = {
             "tasks_completed": 0,

@@ -133,6 +133,10 @@ class WorkerHost:
         self.chunks_per_tick = 1
         self.chunk_capacity = 1024
         self.seed = 42
+        # session-propagated fault-tolerance knobs (create_job frames):
+        # worker-hosted broker readers must honor the SAME reconnect
+        # budget as session-hosted ones
+        self.fault = None
         self._next_shard = worker_id * 4096 + 1
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock = asyncio.Lock()
@@ -154,7 +158,8 @@ class WorkerHost:
         q = QueueSource(src.schema)
         from ..connector.factory import make_reader
         reader = make_reader(src.connector, src.options, src.schema,
-                             self.chunk_capacity, self.seed)
+                             self.chunk_capacity, self.seed,
+                             fault=self.fault)
         start_seq = 0
         if reader is not None:
             st = StateTable(store, next_table_id(),
@@ -244,6 +249,9 @@ class WorkerHost:
             raise ValueError(
                 f"cannot build remote leaf {type(leaf).__name__}")
 
+        if req.get("fault"):
+            from ..common.config import FaultConfig
+            self.fault = FaultConfig(**req["fault"])
         cfg = BuildConfig(**req.get("config", {}))
         ctx = BuildContext(store, next_table_id, factory, cfg,
                            durable=True)

@@ -2,7 +2,9 @@
 # CI gate: bytecode-compile the whole package, then run the storage-tier
 # test subset — including the vacuum-leak assertion (after drop + vacuum,
 # ObjectStore.list() shows no orphaned SSTs) so object-store growth stays
-# bounded in tests. Usage: scripts/check.sh [extra pytest args]
+# bounded in tests — plus the robustness subset (retry layer, sink
+# decoupling, chaos) and the boundary-IO lint. Usage:
+# scripts/check.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,6 +24,28 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_failpoints.py \
     tests/test_backup_restore.py \
     "$@"
+
+echo "== robustness tests (retry / sink decouple / chaos) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_retry.py \
+    tests/test_fault_injection.py \
+    tests/test_sink_decouple.py \
+    tests/test_broker.py \
+    "$@"
+
+echo "== boundary-IO lint =="
+# Every durable-tier consumer must open its store via
+# open_object_store/wrap_object_store (the retry boundary). A raw
+# LocalFsObjectStore(...) anywhere else means some barrier-path module
+# performs unwrapped single-shot IO — reject it.
+bad=$(grep -rn "LocalFsObjectStore(" risingwave_tpu --include='*.py' \
+      | grep -v "risingwave_tpu/storage/object_store.py" || true)
+if [ -n "$bad" ]; then
+    echo "raw object-store construction outside the retry boundary:"
+    echo "$bad"
+    exit 1
+fi
+echo "boundary-IO lint: OK"
 
 echo "== vacuum-leak assertion =="
 python - <<'EOF'

@@ -1,0 +1,183 @@
+"""RetryPolicy unit contract (deadline, jitter bounds, classification,
+counters) + the object-store retry/fault-injection wrappers (ISSUE 3
+tentpole: the fault-tolerance primitive every boundary shares)."""
+
+import random
+
+import pytest
+
+from risingwave_tpu.common.retry import (
+    GLOBAL_RETRY_METRICS, RetryError, RetryPolicy, _RetryMetrics,
+)
+from risingwave_tpu.storage.object_store import (
+    FaultInjectingObjectStore, MemObjectStore, PermanentObjectStoreError,
+    RetryingObjectStore, TransientObjectStoreError, wrap_object_store,
+)
+
+
+def _no_sleep(_s):
+    pass
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        m = _RetryMetrics()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=5, base_delay_ms=1.0)
+        assert p.run("t.site", flaky, sleep=_no_sleep, metrics=m) == "ok"
+        assert len(calls) == 3
+        snap = m.snapshot()["t.site"]
+        assert snap["attempts"] == 3
+        assert snap["retries"] == 2
+        assert snap["successes"] == 1
+        assert snap["give_ups"] == 0
+
+    def test_attempt_cap_gives_up_with_cause(self):
+        m = _RetryMetrics()
+
+        def always():
+            raise ConnectionError("down")
+
+        p = RetryPolicy(max_attempts=3, base_delay_ms=0.0)
+        with pytest.raises(RetryError) as ei:
+            p.run("t.cap", always, sleep=_no_sleep, metrics=m)
+        assert isinstance(ei.value.__cause__, ConnectionError)
+        snap = m.snapshot()["t.cap"]
+        assert snap["attempts"] == 3 and snap["give_ups"] == 1
+
+    def test_deadline_cuts_attempts_short(self):
+        m = _RetryMetrics()
+        clock = {"t": 0.0}
+
+        def slow_fail():
+            clock["t"] += 1.0          # each attempt "takes" 1s
+            raise OSError("slow boundary")
+
+        import risingwave_tpu.common.retry as retry_mod
+        real_monotonic = retry_mod.time.monotonic
+        try:
+            retry_mod.time.monotonic = lambda: clock["t"]
+            p = RetryPolicy(max_attempts=100, base_delay_ms=0.0,
+                            deadline_ms=2500.0)
+            with pytest.raises(RetryError) as ei:
+                p.run("t.deadline", slow_fail, sleep=_no_sleep, metrics=m)
+        finally:
+            retry_mod.time.monotonic = real_monotonic
+        assert "deadline" in str(ei.value)
+        # deadline of 2.5s with 1s attempts: attempt 3 crosses it —
+        # far short of the 100-attempt cap
+        assert m.snapshot()["t.deadline"]["attempts"] == 3
+
+    def test_non_retryable_passes_straight_through(self):
+        m = _RetryMetrics()
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise PermanentObjectStoreError("no such bucket")
+
+        p = RetryPolicy(max_attempts=5,
+                        retryable=(OSError, RuntimeError),
+                        non_retryable=(PermanentObjectStoreError,))
+        with pytest.raises(PermanentObjectStoreError):
+            p.run("t.perm", bad, sleep=_no_sleep, metrics=m)
+        assert len(calls) == 1        # no second attempt
+        assert m.snapshot()["t.perm"]["non_retryable"] == 1
+
+        def unexpected():
+            raise ValueError("programming error")
+
+        with pytest.raises(ValueError):   # unclassified != retryable
+            p.run("t.perm", unexpected, sleep=_no_sleep, metrics=m)
+
+    def test_jitter_bounds_full_jitter(self):
+        p = RetryPolicy(base_delay_ms=10.0, max_delay_ms=100.0)
+        rng = random.Random(7)
+        for attempt in range(1, 12):
+            cap = min(100.0, 10.0 * 2 ** (attempt - 1))
+            for _ in range(50):
+                d = p.backoff_ms(attempt, rng)
+                assert 0.0 <= d <= cap
+        # jitter actually spreads (not constant)
+        samples = {round(p.backoff_ms(3, rng), 3) for _ in range(20)}
+        assert len(samples) > 1
+
+    def test_sleep_durations_respect_deadline(self):
+        slept = []
+
+        def always():
+            raise OSError("x")
+
+        p = RetryPolicy(max_attempts=4, base_delay_ms=5.0,
+                        deadline_ms=10_000.0)
+        with pytest.raises(RetryError):
+            p.run("t.sleep", always, sleep=slept.append,
+                  metrics=_RetryMetrics(), rng=random.Random(1))
+        assert len(slept) == 3         # one backoff between attempts
+        assert all(s >= 0 for s in slept)
+
+
+class TestRetryingObjectStore:
+    def test_transient_faults_absorbed(self):
+        inner = FaultInjectingObjectStore(
+            MemObjectStore(), seed=3, transient_rate=0.4)
+        st = RetryingObjectStore(
+            inner, RetryPolicy(max_attempts=10, base_delay_ms=0.0))
+        for i in range(50):
+            st.put(f"k{i}", b"v%d" % i)
+        for i in range(50):
+            assert st.get(f"k{i}") == b"v%d" % i
+        assert st.list("k") and inner.faults_injected > 0
+        snap = GLOBAL_RETRY_METRICS.snapshot()
+        assert snap["object_store.put"]["retries"] > 0
+
+    def test_torn_write_fully_overwritten_by_retry(self):
+        inner = FaultInjectingObjectStore(
+            MemObjectStore(), seed=1, torn_write_rate=1.0)
+        st = RetryingObjectStore(
+            inner, RetryPolicy(max_attempts=3, base_delay_ms=0.0))
+        # every attempt tears: past the budget the torn object is visible
+        # to the BACKEND but the caller got a loud error (the manifest
+        # discipline above never references it)
+        with pytest.raises(RetryError):
+            st.put("seg", b"full-payload-bytes")
+        assert inner.torn_writes == 3
+        assert inner.inner.get("seg") != b"full-payload-bytes"
+        # now the fault clears: the retry rewrites the WHOLE object
+        inner.torn_write_rate = 0.0
+        st.put("seg", b"full-payload-bytes")
+        assert st.get("seg") == b"full-payload-bytes"
+
+    def test_permanent_path_not_retried(self):
+        inner = FaultInjectingObjectStore(
+            MemObjectStore(), permanent_paths=("locked/",))
+        st = wrap_object_store(
+            inner, RetryPolicy(max_attempts=5, base_delay_ms=0.0,
+                               non_retryable=(PermanentObjectStoreError,)))
+        with pytest.raises(PermanentObjectStoreError):
+            st.put("locked/x", b"v")
+        st.put("open/x", b"v")         # other paths unaffected
+        assert st.get("open/x") == b"v"
+
+    def test_wrap_is_idempotent(self):
+        st = wrap_object_store(MemObjectStore())
+        assert wrap_object_store(st) is st
+
+    def test_atomic_put_never_tears(self):
+        inner = FaultInjectingObjectStore(
+            MemObjectStore(), seed=5, transient_rate=0.5)
+        inner.inner.put("m", b"old")
+        st = wrap_object_store(
+            inner, RetryPolicy(max_attempts=12, base_delay_ms=0.0))
+        for i in range(30):
+            st.atomic_put("m", b"new%03d" % i)
+            raw = inner.inner.get("m")
+            assert raw == b"new%03d" % i    # old or new, never a mix
+        assert isinstance(TransientObjectStoreError("x"), OSError)

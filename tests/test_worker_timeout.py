@@ -1,0 +1,63 @@
+"""ISSUE 3 satellite: a worker wedged before replying (SIGSTOP — socket
+open, no frames) must trip the epoch deadline + heartbeat-TTL scoped
+recovery instead of deadlocking ``wait_epoch``/``handle_create_job``
+forever."""
+
+import os
+import signal
+
+from risingwave_tpu.common.config import FaultConfig
+from risingwave_tpu.frontend import Session
+
+
+def test_wedged_worker_trips_scoped_recovery(tmp_path):
+    s = Session(data_dir=str(tmp_path / "db"), workers=1,
+                checkpoint_frequency=2,
+                fault_config=FaultConfig(worker_epoch_timeout_s=2.0,
+                                         worker_request_timeout_s=60.0))
+    try:
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT sum(v) AS n FROM t")        # worker-hosted
+        s.run_sql("INSERT INTO t VALUES (1, 10)")
+        s.run_sql("FLUSH")
+        assert s.mv_rows("m") == [(10,)]
+
+        w = s.workers[0]
+        wedged_pid = w.proc.pid
+        os.kill(wedged_pid, signal.SIGSTOP)           # wedged, not dead
+
+        # barriers keep completing: the epoch deadline declares the
+        # worker failed (fail-stop) and the TTL detector recovers the job
+        # on subsequent ticks — none of these calls may hang
+        s.run_sql("INSERT INTO t VALUES (2, 5)")
+        recovered = False
+        for _ in range(12):
+            s.tick()
+            if not w.dead and w.proc.pid != wedged_pid:
+                recovered = True
+                break
+        assert recovered, "worker was not respawned after wedging"
+        s.run_sql("FLUSH")
+        assert s.mv_rows("m") == [(15,)]              # nothing lost
+    finally:
+        s.close()
+
+
+def test_request_timeout_raises_instead_of_hanging(tmp_path):
+    """A control request against a wedged worker raises WorkerDied after
+    the configured deadline (short here) rather than awaiting forever."""
+    import pytest
+
+    from risingwave_tpu.frontend.remote import WorkerDied
+    s = Session(data_dir=str(tmp_path / "db"), workers=1,
+                fault_config=FaultConfig(worker_request_timeout_s=1.5,
+                                         worker_epoch_timeout_s=2.0))
+    try:
+        w = s.workers[0]
+        os.kill(w.proc.pid, signal.SIGSTOP)
+        with pytest.raises(WorkerDied, match="timed out"):
+            s._await(w.request({"type": "scan", "name": "nope"}))
+        assert w.dead
+    finally:
+        s.close()
