@@ -28,14 +28,36 @@ def _build_session(args):
         kwargs["state_store"] = args.state_store
     if getattr(args, "compactors", 0):
         kwargs["compactors"] = args.compactors
+    fp = getattr(args, "fragment_parallelism", 1)
+    if fp and fp != 1:
+        from .frontend.build import BuildConfig
+        kwargs["config"] = BuildConfig(fragment_parallelism=fp)
     return Session(**kwargs)
+
+
+#: one default shared by every session-building subcommand, so a durable
+#: data dir deployed from any of them recovers under the same topology
+#: (the library default, BuildConfig/StreamingConfig fragment_parallelism
+#: = 1, stays single-actor for embedded/API use)
+FRAGMENT_PARALLELISM_DEFAULT = 2
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="risingwave_tpu")
     sub = p.add_subparsers(dest="command", required=True)
 
-    pg = sub.add_parser("playground",
+    # shared by playground / sql / sql-file / ctl via parents=[...]
+    fp_arg = argparse.ArgumentParser(add_help=False)
+    fp_arg.add_argument(
+        "--fragment-parallelism", type=int,
+        default=FRAGMENT_PARALLELISM_DEFAULT,
+        help="parallel actors per fragmentable operator (grouped aggs / "
+        "joins run as multi-fragment jobs with hash-dispatch exchanges; "
+        "1 = single actor; must match the value a durable data dir was "
+        "deployed with so recovery and `ctl fragments` reflect the live "
+        "topology; reference: streaming.default_parallelism)")
+
+    pg = sub.add_parser("playground", parents=[fp_arg],
                         help="serve SQL over the Postgres wire protocol")
     pg.add_argument("--host", default="127.0.0.1")
     pg.add_argument("--port", type=int, default=4566)
@@ -64,17 +86,20 @@ def main(argv=None) -> int:
                     help="serve the meta dashboard (cluster / fragment "
                     "graphs / await-tree) on this port")
 
-    q = sub.add_parser("sql", help="run SQL statements and print results")
+    q = sub.add_parser("sql", parents=[fp_arg],
+                       help="run SQL statements and print results")
     q.add_argument("statement")
     q.add_argument("--data-dir", default=None)
 
-    qf = sub.add_parser("sql-file", help="run a SQL script file")
+    qf = sub.add_parser("sql-file", parents=[fp_arg],
+                        help="run a SQL script file")
     qf.add_argument("path")
     qf.add_argument("--data-dir", default=None)
 
     ctl = sub.add_parser(
-        "ctl", help="admin inspection of a durable data dir "
-                    "(reference: risectl)")
+        "ctl", parents=[fp_arg],
+        help="admin inspection of a durable data dir "
+             "(reference: risectl)")
     ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
                                       "metrics", "trace", "backup",
                                       "restore", "backup-info",

@@ -26,6 +26,10 @@ class StreamingConfig:
     join_key_capacity: int = 1 << 13
     join_bucket_width: int = 16
     topn_table_capacity: int = 1 << 16
+    # actor parallelism per fragmentable operator (grouped aggs, joins):
+    # >1 builds multi-fragment jobs with hash-dispatch exchanges
+    # (frontend/fragments.py; reference: streaming.default_parallelism)
+    fragment_parallelism: int = 1
     # observability (common/tracing.py): span ring size per process, and
     # the slow-epoch detector — an epoch whose inject→collect latency
     # meets the threshold gets its span tree snapshotted for post-hoc

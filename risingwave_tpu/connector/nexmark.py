@@ -272,7 +272,12 @@ class DeviceBidGenerator:
         """Traceable ``(start_event_id, key) -> StreamChunk`` producing ONE
         flat chunk — the fusion surface for single-dispatch epochs
         (ops/fused_epoch.py): callers compose it INSIDE their own jit, so
-        generation fuses with downstream projection/aggregation."""
+        generation fuses with downstream projection/aggregation — or with
+        BOTH sides of the q7 windowed join (fused_source_join_epoch): the
+        bucketed interval join derives its probe rows AND its per-window
+        aggregate build side from the same generated chunk, where the
+        executor bench path needs two same-seed generators producing the
+        stream twice."""
         def fn(start, key):
             ch = self._gen_impl(start, key, 1)
             return StreamChunk(

@@ -231,7 +231,8 @@ class Session:
                 agg_table_capacity=st.agg_table_capacity,
                 join_key_capacity=st.join_key_capacity,
                 join_bucket_width=st.join_bucket_width,
-                topn_table_capacity=st.topn_table_capacity)
+                topn_table_capacity=st.topn_table_capacity,
+                fragment_parallelism=st.fragment_parallelism)
         # fault-tolerance knobs for every external boundary (object-store
         # retry, sink degrade, broker reconnect, worker deadlines) —
         # common/config.py FaultConfig; explicit fault_config wins over

@@ -1,4 +1,7 @@
 from .hash_table import (  # noqa: F401
     DeviceHashTable, ht_lookup, ht_lookup_or_insert, ht_new, scatter_reduce,
 )
+from .interval_join import (  # noqa: F401
+    IntervalJoinCore, IntervalJoinState,
+)
 from .join_state import JoinCore, JoinState, JoinType  # noqa: F401

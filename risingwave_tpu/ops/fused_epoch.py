@@ -1,5 +1,5 @@
 """Single-dispatch epochs: source generation → projection → aggregation
-fused into ONE jitted ``lax.scan``.
+(or windowed join) fused into ONE jitted ``lax.scan``.
 
 The dispatch-boundary ladder this removes (BASELINE.md "residual
 headroom"; VERDICT r4 item 1): generating an epoch's ChunkBatch is one
@@ -11,10 +11,17 @@ into the aggregation update, so no intermediate epoch batch ever exists
 at HBM granularity (the scan carry is the agg state; each iteration's
 chunk lives only inside the step).
 
-This is the generic fusion surface: any traceable ``chunk_fn(start,
-key) -> StreamChunk`` source (connector/nexmark.py
-``DeviceBidGenerator.chunk_fn``) composes with any expression list and
-any ``AggCore``. The reference has no equivalent — its engine is
+Two fusion surfaces now exist (docs/performance.md):
+
+* ``fused_source_agg_epoch`` — the q5 shape: source → project → AggCore.
+* ``fused_source_join_epoch`` — the q7 shape: source → project → bucketed
+  interval join (ops/interval_join.py), INCLUDING the barrier flush (the
+  per-window max delta applied to the stored arena) so a whole epoch —
+  k chunks of ingest+probe plus the build-side update — is one dispatch.
+
+Both take any traceable ``chunk_fn(start, key) -> StreamChunk`` source
+(connector/nexmark.py ``DeviceBidGenerator.chunk_fn``) and any
+expression list. The reference has no equivalent — its engine is
 interpreter-style row batches (src/stream/src/executor/hash_agg.rs);
 this is what designing for a compiler buys.
 """
@@ -52,6 +59,55 @@ def fused_source_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
         state, _ = jax.lax.scan(body, state,
                                 jnp.arange(k, dtype=jnp.int64))
         return state
+
+    donate_argnums = ((0,) if donate and jax.default_backend() == "tpu"
+                      else ())
+    return jax.jit(epoch, static_argnums=(3,),
+                   donate_argnums=donate_argnums)
+
+
+def fused_source_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
+                            core, rows_per_chunk: int,
+                            donate: bool = True) -> Callable:
+    """Build ``epoch(state, start_event, key, k)`` for the q7 join shape:
+    ONE compiled dispatch generating + projecting + probe-inserting ``k``
+    chunks into ``core`` (ops/interval_join.IntervalJoinCore), then —
+    still inside the same dispatch — computing the barrier flush (the
+    per-window aggregate delta joined against the stored probe arena)
+    and advancing the downstream-visible build rows.
+
+    Returns ``(state, probe_out, del_mask, ins_mask, old_emitted_max,
+    packed)``:
+
+    * ``probe_out``: stacked [k, cap] StreamChunk of probe-time matches
+      (a ChunkBatch-shaped pytree; flatten_shards + gather_units_window
+      compact it downstream).
+    * ``del_mask``/``ins_mask``/``old_emitted_max``: inputs for
+      ``core.gather_flush`` (the only remaining per-epoch host work is
+      reading ``packed`` and gathering output windows).
+    * ``packed``: [n_flush_units, lane_overflow, ring_clobber,
+      saw_delete, n_probe_units] — ONE scalar fetch per epoch covers
+      every host-checked flag AND both emission counts, exactly the
+      packed-probe idiom of the executor barriers.
+    """
+    exprs = tuple(exprs)
+
+    def epoch(state, start, key, k: int):
+        def body(st, i):
+            ch = chunk_fn(start + i * rows_per_chunk,
+                          jax.random.fold_in(key, i))
+            projected = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+            st, out = core.apply_chunk(st, projected)
+            return st, out
+
+        state, probe_out = jax.lax.scan(
+            body, state, jnp.arange(k, dtype=jnp.int64))
+        old_emitted_max = state.emitted_max
+        del_mask, ins_mask, packed = core.flush_plan(state)
+        state = core.finish_flush(state)
+        packed = jnp.concatenate(
+            [packed, jnp.sum(probe_out.vis).astype(jnp.int64)[None]])
+        return state, probe_out, del_mask, ins_mask, old_emitted_max, packed
 
     donate_argnums = ((0,) if donate and jax.default_backend() == "tpu"
                       else ())

@@ -82,21 +82,19 @@ def _kernel(ident_i_ref, ident_j_ref, m_ref, r_ref, t_ref):
         preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def rank_totals_pallas(ident: jax.Array, matches: jax.Array,
-                       interpret: bool = False):
+def rank_totals_pallas_call(ident: jax.Array, matches: jax.Array,
+                            interpret: bool = False):
+    """The raw pallas_call — no backend guard. Callers guarantee the tile
+    divisibility; the compile CI proxy (tests/test_pallas_compile.py)
+    lowers THIS for TPU from any host to catch kernel breakage without a
+    chip."""
     from jax.experimental import pallas as pl
 
     n, w = matches.shape
     ti = min(TILE_I, n)
     tj = min(TILE_J, n)
-    if (n % ti or n % tj
-            or (not interpret and jax.default_backend() != "tpu")):
-        # ragged capacities, or a backend with no Pallas lowering, fall
-        # back to the jnp formulation (identical results)
-        return rank_totals_jnp(ident, matches)
     grid = (n // ti, n // tj)
-    r, t = pl.pallas_call(
+    return pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
@@ -114,6 +112,20 @@ def rank_totals_pallas(ident: jax.Array, matches: jax.Array,
         ],
         interpret=interpret,
     )(ident, ident, matches)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rank_totals_pallas(ident: jax.Array, matches: jax.Array,
+                       interpret: bool = False):
+    n, w = matches.shape
+    ti = min(TILE_I, n)
+    tj = min(TILE_J, n)
+    if (n % ti or n % tj
+            or (not interpret and jax.default_backend() != "tpu")):
+        # ragged capacities, or a backend with no Pallas lowering, fall
+        # back to the jnp formulation (identical results)
+        return rank_totals_jnp(ident, matches)
+    r, t = rank_totals_pallas_call(ident, matches, interpret=interpret)
     return (jnp.round(r).astype(jnp.int32),
             jnp.round(t).astype(jnp.int32))
 

@@ -20,13 +20,19 @@ from .executor import Executor
 from .message import Barrier, Watermark
 
 
-async def align_streams(inputs: Mapping[Hashable, Executor]) -> AsyncIterator[tuple]:
+async def align_streams(inputs: Mapping[Hashable, Executor],
+                        batched: bool = False) -> AsyncIterator[tuple]:
     """Align barriers across named inputs.
 
     Yields ("chunk", name, chunk) / ("watermark", name, wm) /
     ("barrier", barrier) events; terminates after a stop barrier or when all
     inputs are exhausted. An input holding a barrier is not polled again
-    until the barrier is resolved (the alignment backpressure)."""
+    until the barrier is resolved (the alignment backpressure).
+
+    ``batched=True``: a ChunkBatch arriving on any input is forwarded
+    whole as a ("batch", name, batch) event for consumers with a
+    single-dispatch batched step (stream/hash_join.py); the default
+    unstacks so batches are never silently dropped."""
     names = list(inputs)
     its = {s: inputs[s].execute().__aiter__() for s in names}
     pending: dict = {}
@@ -57,10 +63,13 @@ async def align_streams(inputs: Mapping[Hashable, Executor]) -> AsyncIterator[tu
                 elif isinstance(msg, StreamChunk):
                     yield ("chunk", s, msg)
                 elif isinstance(msg, ChunkBatch):
-                    # multi-input executors have no batched step yet; unstack
-                    # so batches from upstream are never silently dropped
-                    for i in range(msg.num_chunks):
-                        yield ("chunk", s, msg.at(i))
+                    if batched:
+                        yield ("batch", s, msg)
+                    else:
+                        # consumer has no batched step; unstack so batches
+                        # from upstream are never silently dropped
+                        for i in range(msg.num_chunks):
+                            yield ("chunk", s, msg.at(i))
                 elif isinstance(msg, Watermark):
                     yield ("watermark", s, msg)
             live = [s for s in names if s not in finished]
@@ -79,7 +88,9 @@ async def align_streams(inputs: Mapping[Hashable, Executor]) -> AsyncIterator[tu
             task.cancel()
 
 
-async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]:
+async def barrier_align(left: Executor, right: Executor,
+                        batched: bool = False) -> AsyncIterator[tuple]:
     """Two-input alignment with "left"/"right" naming (join-style callers)."""
-    async for ev in align_streams({"left": left, "right": right}):
+    async for ev in align_streams({"left": left, "right": right},
+                                  batched=batched):
         yield ev

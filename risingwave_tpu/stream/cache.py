@@ -42,3 +42,13 @@ class LruClock:
             return None
         self._step += 1
         return jnp.asarray(self._step, jnp.int32)
+
+    def advance(self, k: int) -> Optional[jnp.ndarray]:
+        """k consecutive stamps at once (int32[k]) for batched scan steps;
+        None when disabled."""
+        if not self.enabled:
+            return None
+        arr = jnp.arange(self._step + 1, self._step + k + 1,
+                         dtype=jnp.int32)
+        self._step += k
+        return arr

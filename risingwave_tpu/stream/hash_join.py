@@ -26,8 +26,8 @@ import numpy as np
 
 from ..common.chunk import (
     physical_chunk,
-    DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units, gather_units_window,
-    make_chunk,
+    DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units, flatten_shards,
+    gather_units_window, make_chunk,
 )
 from ..ops.join_state import (
     JoinCore, JoinSideState, JoinState, JoinType, apply_evict_side,
@@ -112,6 +112,9 @@ class HashJoinExecutor(Executor):
         self.out_capacity = out_capacity
         # chunks applied per host sync (optimistic batched emission)
         self.emit_batch = 16
+        # chunks scanned per dispatch when a whole ChunkBatch arrives
+        # (memory-bounds the stacked emission grids of the scan)
+        self.batch_chunks = 8
         self.strict = strict
         self.max_state_cells = 1 << 26    # growth ceiling (cap * W)
         self.state_tables = {"left": left_state_table,
@@ -142,6 +145,36 @@ class HashJoinExecutor(Executor):
             "right": jax.jit(lambda st, ch, step=None:
                              core.apply_chunk(st, ch, side="right", step=step)),
         }
+
+        # batched single-dispatch ingest: ONE lax.scan applies a whole
+        # sub-batch of chunks to one side and stacks each chunk's packed
+        # stats + emission grid — K chunks cost one dispatch and one stats
+        # transfer instead of K of each (the ChunkBatch amortization the
+        # agg path has had since round 3; docs/performance.md)
+        def _apply_batch(state: JoinState, batched_chunk, steps, side: str):
+            # steps=None (no LRU budget) traces the stamp-free variant —
+            # the per-chunk path's static elision of the three lru
+            # scatter-maxes, preserved under the scan
+            def body(st, x):
+                ch, step = x if steps is not None else (x, None)
+                st, big = core.apply_chunk(st, ch, side=side, step=step)
+                return st, (_pack_stats_of(st, big), big)
+
+            xs = (batched_chunk, steps) if steps is not None \
+                else batched_chunk
+            state, (stats, bigs) = jax.lax.scan(body, state, xs)
+            return state, stats, bigs
+
+        self._apply_batch = {
+            "left": jax.jit(functools.partial(_apply_batch, side="left")),
+            "right": jax.jit(functools.partial(_apply_batch, side="right")),
+        }
+
+        def _gather_at(bigs, k, lo):
+            big = jax.tree_util.tree_map(lambda x: x[k], bigs)
+            return gather_units_window(big, lo, self.out_capacity)
+
+        self._gather_at = jax.jit(_gather_at)
         self._evict_plan = jax.jit(join_evict_plan, static_argnums=(1,))
 
         def _live_counts(state: JoinState):
@@ -160,18 +193,7 @@ class HashJoinExecutor(Executor):
             lambda ch, lo: gather_units_window(ch, lo, self.out_capacity))
         self._count_units = jax.jit(count_units)
 
-        def _pack_stats(state: JoinState, big) -> jax.Array:
-            # every host-read scalar of one applied chunk in ONE vector:
-            # [l.lane_ovf, l.ht_ovf, r.lane_ovf, r.ht_ovf, n_units]
-            return jnp.stack([
-                state.left.lane_overflow.astype(jnp.int64),
-                state.left.ht_overflow.astype(jnp.int64),
-                state.right.lane_overflow.astype(jnp.int64),
-                state.right.ht_overflow.astype(jnp.int64),
-                count_units(big),
-            ])
-
-        self._pack_stats = jax.jit(_pack_stats)
+        self._pack_stats = jax.jit(_pack_stats_of)
         self._clear_ckpt = jax.jit(_clear_ckpt_marks)
         self._clean_side = jax.jit(clean_side_below, static_argnums=(1,))
 
@@ -263,14 +285,73 @@ class HashJoinExecutor(Executor):
         self._pending.clear()
         self._rewind_state = None
 
+    # -- batched single-dispatch ingest ---------------------------------------
+    # A ChunkBatch arriving on either side is scanned on device in
+    # sub-batches of ``batch_chunks``: one dispatch applies the chunks in
+    # order, one transfer fetches all their packed stats — the unstack-
+    # and-loop default paid K dispatches + K syncs per batch.
+
+    def _consume_batch(self, side: str, batch):
+        if self.null_aware_anti and side == "right":
+            self._reject_null_build_keys(flatten_shards(batch.chunk))
+        if self._evicted:
+            hits = self._evicted_hits(side, flatten_shards(batch.chunk))
+            if hits:
+                self._fault_in(hits)
+        for lo in range(0, batch.num_chunks, self.batch_chunks):
+            sub = jax.tree_util.tree_map(
+                lambda x: x[lo:lo + self.batch_chunks], batch.chunk)
+            yield from self._apply_subbatch(side, sub)
+
+    def _apply_subbatch(self, side: str, sub_chunk):
+        stats = self.stats
+        k = sub_chunk.ops.shape[0]
+        steps = self._lru_clock.advance(k)    # None without an LRU budget
+        rewind = self.state
+        new_state, packed, bigs = self._apply_batch[side](
+            self.state, sub_chunk, steps)
+        self.state = new_state
+        rows = np.asarray(packed)             # ONE transfer for k chunks
+        if not rows[:, :4].any():
+            for kk in range(k):
+                n_units = int(rows[kk, 4])
+                for lo in range(0, n_units, self.out_capacity // 2):
+                    stats.chunks_out += 1
+                    yield self._gather_at(bigs, jnp.int32(kk),
+                                          jnp.int64(lo))
+        else:
+            # overflow inside the scanned sub-batch: rewind and replay
+            # chunk-by-chunk through the growing path (functional state
+            # makes the rewind exact, as in the optimistic path above)
+            self.state = rewind
+            for kk in range(k):
+                ch = jax.tree_util.tree_map(lambda x: x[kk], sub_chunk)
+                big = self._apply_growing(side, ch)
+                n_units = int(self._count_units(big))
+                for lo in range(0, n_units, self.out_capacity // 2):
+                    stats.chunks_out += 1
+                    yield self._gather(big, jnp.int64(lo))
+
     async def execute(self):
         from .metrics import barrier_timer
         stats = self.stats
         self._pending: list = []
         self._rewind_state = None
-        async for ev in barrier_align(self.left, self.right):
+        async for ev in barrier_align(self.left, self.right, batched=True):
             kind = ev[0]
-            if kind == "chunk":
+            if kind == "batch":
+                _, side, batch = ev
+                stats.batches_in += 1
+                stats.batch_chunks_in += batch.num_chunks
+                stats.capacity_rows_in += (batch.num_chunks
+                                           * batch.chunk_capacity)
+                # scanned batches and the optimistic per-chunk window must
+                # not interleave rewinds — flush pending output first
+                for out in self._flush_pending():
+                    yield out
+                for out in self._consume_batch(side, batch):
+                    yield out
+            elif kind == "chunk":
                 _, side, chunk = ev
                 stats.chunks_in += 1
                 stats.capacity_rows_in += chunk.capacity
@@ -619,6 +700,18 @@ class HashJoinExecutor(Executor):
             shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
             out.extend(r for r, s in zip(batch, shard) if int(s) == idx)
         return out
+
+
+def _pack_stats_of(state: JoinState, big) -> jax.Array:
+    """Every host-read scalar of one applied chunk in ONE vector:
+    [l.lane_ovf, l.ht_ovf, r.lane_ovf, r.ht_ovf, n_units]."""
+    return jnp.stack([
+        state.left.lane_overflow.astype(jnp.int64),
+        state.left.ht_overflow.astype(jnp.int64),
+        state.right.lane_overflow.astype(jnp.int64),
+        state.right.ht_overflow.astype(jnp.int64),
+        count_units(big),
+    ])
 
 
 def _clear_ckpt_marks(state: JoinState) -> JoinState:

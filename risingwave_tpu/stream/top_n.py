@@ -133,6 +133,18 @@ class TopNExecutor(SingleInputExecutor):
         self.n_fast_flushes = 0      # observability: incremental flushes…
         self.n_refills = 0           # …vs full-sort refills
         self._apply = jax.jit(self._apply_impl)
+
+        def _apply_batch_impl(state: TopNState, batched_chunk):
+            def body(st, ch):
+                return self._apply_impl(st, ch), None
+
+            state, _ = jax.lax.scan(body, state, batched_chunk)
+            return state
+
+        # whole-ChunkBatch ingest in ONE dispatch (lax.scan keeps the
+        # epoch loop on device; the default unstack-and-loop pays one
+        # dispatch per chunk) — same amortization as hash_agg's
+        self._apply_batch = jax.jit(_apply_batch_impl)
         self._compute_flush = jax.jit(self._compute_flush_impl)
         self._flush_fast = jax.jit(self._flush_fast_impl)
         self._flush_refill = jax.jit(self._flush_refill_impl)
@@ -205,6 +217,12 @@ class TopNExecutor(SingleInputExecutor):
 
     async def map_chunk(self, chunk: StreamChunk):
         self.state = self._apply(self.state, chunk)
+        self._dirty = True
+        if False:
+            yield
+
+    async def map_chunk_batch(self, batch):
+        self.state = self._apply_batch(self.state, batch.chunk)
         self._dirty = True
         if False:
             yield

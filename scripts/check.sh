@@ -33,6 +33,23 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_broker.py \
     "$@"
 
+echo "== pallas compile proxy (StableHLO/Mosaic lowering, no chip) =="
+# Both TPU kernels (ops/pallas_rank.py, ops/interval_join.py) are lowered
+# for platform "tpu" WITHOUT executing — kernel tracing errors, Mosaic-
+# unsupported ops, and block-spec mismatches fail here even while the
+# chip tunnel is down.
+python -m pytest -q -p no:cacheprovider \
+    tests/test_pallas_compile.py \
+    "$@"
+
+echo "== fused-epoch / interval-join / batched-ingest subset =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_fused_epoch.py \
+    tests/test_interval_join.py \
+    tests/test_batched_ingest.py \
+    tests/test_cli_fragments.py \
+    "$@"
+
 echo "== boundary-IO lint =="
 # Every durable-tier consumer must open its store via
 # open_object_store/wrap_object_store (the retry boundary). A raw

