@@ -103,10 +103,21 @@ def main(argv=None) -> int:
     ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
                                       "metrics", "trace", "backup",
                                       "restore", "backup-info",
-                                      "hummock", "vacuum"])
+                                      "hummock", "vacuum", "cluster"])
+    ctl.add_argument("sub", nargs="?", default=None,
+                     help="subcommand for `ctl cluster` "
+                     "(currently: fragments — dump the persisted "
+                     "fragment→worker placement and per-edge permit "
+                     "state of spanning jobs)")
     ctl.add_argument("--data-dir", required=True)
     ctl.add_argument("--backup-dir",
                      help="backup location for backup/restore/backup-info")
+    ctl.add_argument("--workers", type=int, default=0,
+                     help="worker processes to recover the cluster with "
+                     "(metrics/trace/cluster over a data dir deployed "
+                     "with --workers N needs the same N; `cluster "
+                     "fragments` infers it from the persisted placement "
+                     "when omitted)")
     ctl.add_argument("--force", action="store_true",
                      help="vacuum: actually delete (default is a dry "
                      "run; only safe with no live session on the dir)")
@@ -161,6 +172,10 @@ def _ctl(args) -> int:
             desc = list_backup(args.backup_dir)
         print(_json.dumps(desc, indent=2))
         return 0
+    if args.what == "cluster":
+        if args.sub != "fragments":
+            raise SystemExit("usage: ctl cluster fragments --data-dir DIR")
+        return _ctl_cluster_fragments(args, _json)
     if args.what in ("hummock", "vacuum"):
         # storage-only inspection: no session (and no job recovery) —
         # read the version manifest straight off the object store
@@ -196,6 +211,58 @@ def _ctl(args) -> int:
     session = _build_session(args)
     try:
         _ctl_dispatch(args, session, _json)
+    finally:
+        session.close()
+    return 0
+
+
+def _ctl_cluster_fragments(args, _json) -> int:
+    """`ctl cluster fragments`: where each spanning job ACTUALLY runs.
+    Reads the persisted fragment→worker placement straight off the meta
+    store (offline-safe, no job recovery), then — when the cluster can
+    be brought up (--workers, or inferred from the placements) — attaches
+    live per-edge permit state from the workers' exchange counters."""
+    import os
+    from .meta.service import MetaService
+    path = os.path.join(args.data_dir, "meta", "meta.jsonl")
+    if not os.path.exists(path):
+        raise SystemExit(f"{args.data_dir!r} holds no meta store")
+    meta = MetaService(data_dir=os.path.join(args.data_dir, "meta"))
+    placements = meta.all_placements()
+    meta.store.close()
+    n_workers = args.workers
+    for p in placements.values():
+        n_workers = max(n_workers, max(p.workers()) + 1)
+    for job, p in sorted(placements.items()):
+        print(f"-- {job} (root worker {p.root_worker})")
+        for fid in sorted(p.actors):
+            for a in p.actors[fid]:
+                print(f"Fragment {fid} actor {a.actor}: "
+                      f"worker {a.worker} "
+                      f"vnodes [{a.vnode_start}, {a.vnode_end})")
+    if not placements:
+        print("(no spanning jobs placed)")
+        return 0
+    # live per-edge permit state: recover the cluster and scrape the
+    # workers' exchange counters (skipped if bring-up fails — the
+    # persisted placement above is still authoritative for WHERE)
+    args.workers = n_workers
+    try:
+        session = _build_session(args)
+    except Exception as e:  # noqa: BLE001 - offline dump already printed
+        print(f"(live edge state unavailable: {type(e).__name__}: {e})")
+        return 0
+    try:
+        edges = session.metrics().get("exchange") or []
+        print("-- live exchange edges")
+        if not edges:
+            print("(none reported)")
+        for e in edges:
+            print(f"{e.get('edge')} [{e.get('dir')}] worker {e.get('worker')}"
+                  f" -> peer {e.get('peer_worker')}: chunks={e.get('chunks')}"
+                  f" bytes={e.get('bytes')}"
+                  f" permits_waited={e.get('permits_waited')}"
+                  f" backlog={e.get('backlog')}")
     finally:
         session.close()
     return 0

@@ -135,7 +135,7 @@ def build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
 def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
     cfg = ctx.config
     if isinstance(plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues,
-                         P.PRemoteFragment)):
+                         P.PRemoteFragment, P.PExchange)):
         return ctx.source_factory(plan)
 
     if isinstance(plan, P.PProject):
@@ -370,7 +370,7 @@ def collect_leaves(plan: P.PlanNode) -> list:
     if not plan.children:
         return [plan] if isinstance(
             plan, (P.PSource, P.PTableScan, P.PMvScan, P.PValues,
-                   P.PRemoteFragment)) else []
+                   P.PRemoteFragment, P.PExchange)) else []
     out = []
     for c in plan.children:
         out.extend(collect_leaves(c))

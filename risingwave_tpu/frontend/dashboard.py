@@ -44,6 +44,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 (load in Perfetto / chrome://tracing)</p>
 <h2>cluster</h2><pre id="cluster">loading…</pre>
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
+<h2>exchange edges</h2><pre id="exchange">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
 <h2>storage tier</h2><pre id="storage">loading…</pre>
@@ -59,6 +60,8 @@ async function loadStorage() {
   const m = await r.json();
   document.getElementById("storage").textContent =
     JSON.stringify(m.storage || {}, null, 2);
+  document.getElementById("exchange").textContent =
+    JSON.stringify(m.exchange || [], null, 2);
   document.getElementById("metrics").textContent =
     JSON.stringify(m, null, 2);
 }
@@ -96,6 +99,14 @@ def cluster_info(session) -> dict:
         },
         "jobs": sorted(session.jobs),
         "remote_jobs": sorted(getattr(session, "_remote_specs", {})),
+        # spanning jobs: persisted fragment→worker placement (vnode
+        # ranges per actor), the deployed counterpart of the planner-side
+        # fragment graphs below
+        "spanning_jobs": {
+            name: spec["placement"].to_json()
+            for name, spec in sorted(
+                getattr(session, "_spanning_specs", {}).items())
+        },
     }
 
 

@@ -27,8 +27,8 @@ stream/scale.rs:657 expressed as a reload filter.
 from __future__ import annotations
 
 from ..stream.dispatch import (
-    ChannelSource, HashDispatcher, MergeExecutor, PermitChannel,
-    SimpleDispatcher,
+    ChannelSource, HashDispatcher, MergeExecutor, SimpleDispatcher,
+    open_channel,
 )
 from ..stream.hash_agg import HashAggExecutor, agg_state_schema
 from ..stream.hash_join import HashJoinExecutor
@@ -52,8 +52,8 @@ def build_fragmented_agg(plan, ctx):
         agg_state_schema(key_fields, plan.agg_calls),
         list(range(len(plan.group_keys))))
 
-    in_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
-    out_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
+    in_chans = [open_channel(cfg.exchange_permits) for _ in range(n)]
+    out_chans = [open_channel(cfg.exchange_permits) for _ in range(n)]
     dispatcher = HashDispatcher(in_chans, plan.group_keys, upstream.schema)
 
     aggs = []
@@ -111,9 +111,9 @@ def build_fragmented_join(plan, ctx, join_types):
     rst0 = ctx.state_table(plan.right.schema,
                            join_state_pk(plan.right_keys, plan.right.pk))
 
-    l_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
-    r_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
-    out_chans = [PermitChannel(cfg.exchange_permits) for _ in range(n)]
+    l_chans = [open_channel(cfg.exchange_permits) for _ in range(n)]
+    r_chans = [open_channel(cfg.exchange_permits) for _ in range(n)]
+    out_chans = [open_channel(cfg.exchange_permits) for _ in range(n)]
     l_disp = HashDispatcher(l_chans, plan.left_keys, left_up.schema)
     r_disp = HashDispatcher(r_chans, plan.right_keys, right_up.schema)
 

@@ -29,6 +29,7 @@ _PLAN_CLASSES = {
         P.PSource, P.PTableScan, P.PMvScan, P.PProject, P.PFilter,
         P.PHopWindow, P.PAgg, P.PJoin, P.PTopN, P.PDynFilter, P.PUnion,
         P.PValues, P.POverWindow, P.PProjectSet, P.PTemporalJoin,
+        P.PExchange,
     ]
 }
 _AUX_CLASSES = {

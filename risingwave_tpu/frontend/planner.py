@@ -79,6 +79,23 @@ class PMvScan(PlanNode):
 
 
 @dataclasses.dataclass
+class PExchange(PlanNode):
+    """Leaf standing in for a remote-exchange edge inside a SHIPPED
+    fragment subtree: the fragment below this point runs in a different
+    fragment (possibly on a different worker process), and its output
+    arrives here over permit-metered exchange channels (reference: the
+    ExchangeNode leaves the fragmenter leaves behind,
+    src/frontend/src/stream_fragmenter/mod.rs:115). ``upstream`` names
+    the feeding fragment id in the job's span graph; the worker's build
+    factory resolves it to a merge over the edge's channels."""
+
+    upstream: int = -1
+
+    def _describe(self):
+        return f"Exchange {{upstream=f{self.upstream}, pk={list(self.pk)}}}"
+
+
+@dataclasses.dataclass
 class PRemoteFragment(PlanNode):
     """A batch stage shipped to the worker PROCESS hosting its state; the
     session sees only the stage's output rows (reference: distributed

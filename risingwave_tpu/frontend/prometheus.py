@@ -95,6 +95,23 @@ def render_metrics(session) -> str:
             lines.append(
                 f'rw_compactor_up{{worker="{c["worker"]}"}} '
                 f'{0 if c.get("dead") else 1}')
+    exchange = m.get("exchange") or []
+    if exchange:
+        lines += ["# HELP rw_exchange_stat Per-exchange-edge counters "
+                  "(chunks/bytes forwarded, permit waits, backlog depth) "
+                  "for cross-worker fragment edges.",
+                  "# TYPE rw_exchange_stat gauge"]
+        for e in exchange:
+            labels = (f'edge="{_sanitize(str(e.get("edge")))}",'
+                      f'dir="{_sanitize(str(e.get("dir")))}",'
+                      f'worker="{e.get("worker")}"')
+            for stat in ("chunks", "bytes", "permits_waited", "barriers",
+                         "backlog"):
+                value = e.get(stat)
+                if isinstance(value, (int, float)):
+                    lines.append(
+                        f'rw_exchange_stat{{{labels},'
+                        f'stat="{stat}"}} {value}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

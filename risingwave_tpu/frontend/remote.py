@@ -192,9 +192,14 @@ class RemoteWorker:
                 if sem is not None:
                     sem.release()
             elif t == "barrier_complete":
+                # per-JOB failure map: one poisoned or peer-starved job
+                # must not read as a whole-worker failure (legacy
+                # ok/error frames fold into the wildcard entry)
+                failed = dict(frame.get("failed") or {})
                 if frame.get("ok", True) is False:
-                    self._epoch_errors[frame["epoch"]] = frame.get(
-                        "error", "worker job failed")
+                    failed["*"] = frame.get("error", "worker job failed")
+                if failed:
+                    self._epoch_errors[frame["epoch"]] = failed
                 if frame.get("init") and self._init_fut is not None:
                     if not self._init_fut.done():
                         self._init_fut.set_result(frame)
@@ -309,12 +314,17 @@ class RemoteWorker:
     # -- barrier conduction ----------------------------------------------------
 
     async def inject_barrier(self, epoch: int, checkpoint: bool,
-                             generate: bool, mutation=None) -> None:
+                             generate: bool, mutation=None,
+                             exclude=None) -> None:
         for old in [e for e in self._epoch_events if e < epoch - 64]:
             self._epoch_events.pop(old, None)
             self._epoch_errors.pop(old, None)
         frame = {"type": "barrier", "epoch": epoch, "checkpoint": checkpoint,
                  "generate": generate}
+        if exclude:
+            # jobs the session already declared dead (spanning jobs with
+            # a killed peer): the worker must not feed or wait on them
+            frame["exclude"] = sorted(exclude)
         if mutation is not None:
             frame["mutation"] = mutation.kind.value
             if isinstance(mutation.payload, str):
@@ -341,20 +351,39 @@ class RemoteWorker:
                 f"timed out after {self.epoch_timeout}s") from None
         finally:
             self._init_fut = None
+        failed = dict(frame.get("failed") or {})
         if frame.get("ok", True) is False:
+            failed["*"] = frame.get("error")
+        err = failed.get(name) or failed.get("*")
+        if err:
             raise RuntimeError(
-                f"remote job {name!r} failed at init: {frame.get('error')}")
+                f"remote job {name!r} failed at init: {err}")
 
-    async def wait_epoch(self, epoch: int) -> bool:
-        """True iff the worker collected the epoch cleanly. Bounded by
-        ``epoch_timeout``: a worker that stops acking barriers while its
-        socket stays open (SIGSTOP, accelerator wedge) is declared dead
-        instead of deadlocking the conductor — the heartbeat-TTL scoped
-        recovery then respawns it over durable state."""
+    def _job_error(self, epoch: int, job: Optional[str]) -> Optional[str]:
+        failed = self._epoch_errors.get(epoch)
+        if not failed:
+            return None
+        if isinstance(failed, dict):
+            if job is not None:
+                return failed.get(job) or failed.get("*")
+            return "; ".join(f"{k}: {v}" for k, v in sorted(failed.items()))
+        return str(failed)
+
+    async def wait_epoch(self, epoch: int, job: Optional[str] = None) -> bool:
+        """True iff the worker collected the epoch cleanly for ``job``
+        (all jobs when None). Bounded by ``epoch_timeout``: a worker that
+        stops acking barriers while its socket stays open (SIGSTOP,
+        accelerator wedge) is declared dead instead of deadlocking the
+        conductor — the heartbeat-TTL scoped recovery then respawns it
+        over durable state. A ``PEER_LOST`` per-job error (this worker's
+        fragment lost its exchange peer) also returns False — it is a
+        kill signal for scoped recovery, not a poisoned job."""
         if self.dead:
             return False
-        err = self._epoch_errors.get(epoch)
+        err = self._job_error(epoch, job)
         if err:
+            if err.startswith("PEER_LOST"):
+                return False
             raise RuntimeError(f"remote job failed: {err}")
         ev = self._epoch_events.setdefault(epoch, asyncio.Event())
         if self.epoch_timeout and self.epoch_timeout > 0:
@@ -367,13 +396,18 @@ class RemoteWorker:
             await ev.wait()
         # NOT popped here: several RemoteJobs on this worker wait the same
         # epoch; entries are pruned by inject_barrier's horizon instead
-        err = self._epoch_errors.get(epoch)
+        err = self._job_error(epoch, job)
         if err:
+            if err.startswith("PEER_LOST"):
+                return False
             raise RuntimeError(f"remote job failed: {err}")
         return not self.dead
 
-    async def commit(self, epoch: int) -> None:
-        await self.send({"type": "commit", "epoch": epoch})
+    async def commit(self, epoch: int, skip_jobs=None) -> None:
+        frame = {"type": "commit", "epoch": epoch}
+        if skip_jobs:
+            frame["skip_jobs"] = sorted(skip_jobs)
+        await self.send(frame)
 
     async def get_stats(self, timeout: float = 10.0,
                         span_ack: Optional[int] = None) -> dict:
@@ -412,7 +446,7 @@ class RemoteJob:
 
     async def wait_barrier(self, epoch: int) -> None:
         try:
-            ok = await self.worker.wait_epoch(epoch)
+            ok = await self.worker.wait_epoch(epoch, job=self.name)
         except RuntimeError:
             self._failure = self._failure or RuntimeError("remote job failed")
             raise
@@ -428,3 +462,43 @@ class RemoteJob:
                 await t
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+
+
+class SpanningJob:
+    """StreamJob-shaped adapter for a job whose FRAGMENT GRAPH spans
+    several worker processes: an epoch completes only when EVERY
+    participating worker collected it for this job (each worker's ack
+    asserts all of ITS fragment actors forwarded the barrier — so the
+    epoch's data crossed every remote exchange edge before the session
+    may commit: exactly-once across the wire). Any participant's death —
+    its socket, its deadline, or a surviving peer's PEER_LOST report —
+    presents as a killed actor so the heartbeat-TTL scoped recovery
+    rebuilds the job's fragments from their per-worker durable state."""
+
+    def __init__(self, name: str, workers: list[RemoteWorker]):
+        self.name = name
+        self.workers = list(workers)
+        self.sources: list[QueueSource] = []
+        self.bus = ChangelogBus()
+        self.pipeline = None
+        self.table = None
+        self._failure: Optional[BaseException] = None
+        self._task = None
+
+    async def wait_barrier(self, epoch: int) -> None:
+        results = await asyncio.gather(
+            *(w.wait_epoch(epoch, job=self.name) for w in self.workers),
+            return_exceptions=True)
+        hard = [r for r in results if isinstance(r, BaseException)
+                and not isinstance(r, (WorkerDied,))]
+        if hard:
+            self._failure = self._failure or hard[0]
+            raise RuntimeError(
+                f"spanning job {self.name!r} failed") from hard[0]
+        if not all(r is True for r in results):
+            self._failure = asyncio.CancelledError()
+            raise RuntimeError(
+                f"a worker of spanning job {self.name!r} died")
+
+    async def stop(self) -> None:
+        return None

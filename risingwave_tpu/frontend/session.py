@@ -79,6 +79,12 @@ def _locked(fn):
 
 from ..connector.factory import DEBEZIUM_NEEDS_PK as _DEBEZIUM_NEEDS_PK
 
+#: state-table id range reserved per fragment of a spanning job: each
+#: fragment's build allocates ids from its own deterministic window, so
+#: actors of one fragment (different workers, disjoint stores) share ids
+#: while fragments never collide — and recovery replays identically
+_SPAN_ID_STRIDE = 256
+
 
 def _values_chunk(leaf: PValues) -> StreamChunk:
     """Constant-fold VALUES expressions into one chunk (row-less exprs are
@@ -362,6 +368,13 @@ class Session:
         # placed round-robin on workers; tables/sinks/batch stay local.
         self.workers: list = []
         self._remote_specs: dict[str, dict] = {}
+        # spanning jobs: one MV's fragment graph across SEVERAL worker
+        # processes (meta/fragment.py scheduler + stream/remote_exchange)
+        self._spanning_specs: dict[str, dict] = {}
+        import itertools as _it
+        # worker↔worker exchange channel ids, disjoint from the per-worker
+        # session-channel space (worker_id * 100_000 + n)
+        self._next_span_chan = _it.count(10_000_000)
         self._next_remote = 0
         if workers:
             import tempfile
@@ -380,6 +393,10 @@ class Session:
                 w.spawn()
                 self._await(w.connect())
                 self.workers.append(w)
+                # fragment-placement target registry (reference: compute
+                # nodes registering with the meta ClusterManager)
+                self.meta.register_compute(w.worker_id, "127.0.0.1",
+                                           w.port)
         # dedicated compactor workers (reference: standalone compactor
         # nodes, src/storage/compactor/src/server.rs:57): stateless
         # processes over the SAME object-store root; the session plays
@@ -774,7 +791,35 @@ class Session:
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
         if self.workers and not pk_prefix:
             # index arrangements always build session-local (they scan
-            # session-owned base state); worker placement is for plain MVs
+            # session-owned base state); worker placement is for plain MVs.
+            # With ≥2 workers, source-fed plans deploy as CROSS-WORKER
+            # fragment graphs (vnode-mapped placement, remote exchange);
+            # unsupported shapes fall back to whole-job placement.
+            from ..meta.fragment import SpanUnsupported
+            # a replayed MV with a persisted placement MUST re-deploy as
+            # the same spanning graph: falling through to whole-job
+            # placement would resume fresh=False over per-worker stores
+            # laid out for FRAGMENTS — refuse loudly instead of decoding
+            # another layout's tables
+            was_spanning = (self._recovering
+                            and self.meta.load_placement(stmt.name)
+                            is not None)
+            if len(self.workers) >= 2:
+                try:
+                    return self._create_mv_spanning(stmt)
+                except SpanUnsupported as e:
+                    if was_spanning:
+                        raise SqlError(
+                            f"MV {stmt.name!r} was deployed as a "
+                            f"spanning fragment graph but cannot be "
+                            f"re-deployed ({e}); restart with the same "
+                            "multi-worker topology (or DROP and "
+                            "re-CREATE it)") from e
+            elif was_spanning:
+                raise SqlError(
+                    f"MV {stmt.name!r} was deployed as a spanning "
+                    "fragment graph; restart with the same multi-worker "
+                    "topology (or DROP and re-CREATE it)")
             return self._create_mv_remote(stmt)
         n_feeds0 = len(self.feeds)
         n_bf0 = len(self.backfills)
@@ -842,7 +887,7 @@ class Session:
                 channels[i] = worker.alloc_chan()
                 ups[i] = (leaf.table.name, leaf.schema)
             elif isinstance(leaf, PMvScan):
-                if leaf.mv.name in self._remote_specs:
+                if self._mv_worker(leaf.mv.name) is not None:
                     raise SqlError(
                         "an MV over a worker-hosted MV is not supported "
                         "yet; chain MVs in-process or via a table")
@@ -989,6 +1034,290 @@ class Session:
             "recovery", {"jobs": [name], "epoch": self.epoch})
         return [name]
 
+    # ------------------------------------------ spanning fragment-graph jobs --
+
+    def _create_mv_spanning(self, stmt: A.CreateMaterializedView) -> list:
+        """CREATE MATERIALIZED VIEW as a fragment graph SPANNING worker
+        processes: the meta scheduler places fragments by vnode mapping,
+        each worker builds only its fragments, and the edges between them
+        cross the wire protocol with permit-based credit (reference: the
+        DdlController + scheduler splitting one streaming job's fragment
+        graph over compute nodes, src/meta/src/stream/stream_graph/ +
+        scale.rs vnode mappings)."""
+        from ..meta.fragment import (
+            FragmentScheduler, SpanUnsupported, span_plan,
+        )
+        from .plan_json import defs_to_json
+        from .remote import SpanningJob
+        plan = self._plan(stmt.query, lenient=self._recovering)
+        graph = span_plan(plan)              # raises SpanUnsupported
+        # placement targets come from the meta compute-node registry,
+        # reconciled with the live process handles (reference: the
+        # scheduler reads the ClusterManager's worker set)
+        for w in self.workers:
+            self.meta.cluster.set_compute_state(
+                w.worker_id, "DOWN" if w.dead else "RUNNING")
+        worker_ids = [n.worker_id
+                      for n in self.meta.cluster.live_compute_nodes()]
+        if len(worker_ids) < 2:
+            raise SpanUnsupported("fewer than two live workers")
+        placement = None
+        fresh = not self._recovering
+        if self._recovering:
+            # a restarted session MUST re-place fragments where their
+            # per-worker durable state lives: the persisted mapping wins
+            prev = self.meta.load_placement(stmt.name)
+            if prev is not None:
+                if set(prev.actors) == set(graph.fragments) \
+                        and set(prev.workers()) <= set(worker_ids):
+                    placement = prev
+                else:
+                    # re-placing over stale per-worker stores with
+                    # fresh=False would reload other shards' state —
+                    # refuse loudly instead of corrupting silently
+                    raise RuntimeError(
+                        f"spanning MV {stmt.name!r} was deployed on "
+                        f"workers {prev.workers()} "
+                        f"({len(prev.actors)} fragments) but this "
+                        f"session has workers {worker_ids}; restart "
+                        "with the same --workers topology (or DROP and "
+                        "re-CREATE the MV)")
+            else:
+                # no persisted placement (pre-spanning data dir or a
+                # wiped meta store): rebuild from scratch — wiping is
+                # consistent, resuming over unknown layouts is not
+                fresh = True
+        if placement is None:
+            placement = FragmentScheduler().place(
+                stmt.name, graph, worker_ids,
+                parallelism=self.config.fragment_parallelism)
+        defs, seen = [], set()
+        for frag in graph.fragments.values():
+            for leaf in collect_leaves(frag.plan):
+                if isinstance(leaf, PSource) \
+                        and leaf.source.name not in seen:
+                    seen.add(leaf.source.name)
+                    defs.append(leaf.source)
+        id_rollback = self.catalog._next_table_id
+        mv_table_id = self.catalog.next_table_id()
+        id_start = self.catalog._next_table_id
+        id_end = id_start + len(graph.fragments) * _SPAN_ID_STRIDE
+        self.catalog._next_table_id = id_end
+        by_id = {w.worker_id: w for w in self.workers}
+        involved = [by_id[wid] for wid in placement.workers()]
+        spec = {"graph": graph, "placement": placement,
+                "workers": involved,
+                "root_worker": by_id[placement.root_worker],
+                "mv_table_id": mv_table_id, "id_start": id_start,
+                "defs": defs_to_json(defs)}
+        recover_at = None
+        if not fresh:
+            # session-restart replay: participants may sit one phase-2
+            # frame apart (a worker killed between prepare and commit) —
+            # settle every store on the cluster-decided cut first
+            recover_at = self._span_decided_epoch(stmt.name, involved)
+        reqs = self._span_requests(stmt.name, spec, fresh=fresh,
+                                   recover_at=recover_at)
+        created, state_table_ids = [], []
+        try:
+            for w in involved:
+                resp = self._await(w.request(reqs[w.worker_id]))
+                created.append(w)
+                state_table_ids.extend(resp.get("state_table_ids", ()))
+        except BaseException:
+            # id-replay determinism + no half-deployed graph: roll the
+            # counter back and tear down what was already built
+            self.catalog._next_table_id = id_rollback
+            for w in created:
+                try:
+                    self._await(w.request(
+                        {"type": "drop_job", "name": stmt.name,
+                         "epoch": self._injected + 1}))
+                except Exception:  # noqa: BLE001 - best-effort undo
+                    pass
+            raise
+        n_visible = sum(1 for f in plan.schema
+                        if not f.name.startswith("_"))
+        mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
+                                 table_id=mv_table_id, definition="")
+        mv.n_visible = n_visible  # type: ignore[attr-defined]
+        mv.state_table_ids = tuple(state_table_ids)  # type: ignore[attr-defined]
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (id_start, id_end)  # type: ignore[attr-defined]
+        mv.span_workers = placement.workers()  # type: ignore[attr-defined]
+        self.catalog_writer.add_mv(mv)
+        self.meta.save_placement(placement)
+        self.jobs[stmt.name] = SpanningJob(stmt.name, involved)
+        self._spanning_specs[stmt.name] = spec
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+
+        async def _init_all() -> None:
+            # every participant acks once ITS actors saw the init cut —
+            # the barrier reaches non-source fragments over the wire, so
+            # the waits must run concurrently
+            await asyncio.gather(*(w.init_barrier(stmt.name, self.epoch)
+                                   for w in involved))
+
+        self._await(_init_all())
+        return []
+
+    def _span_requests(self, name: str, spec: dict, fresh: bool,
+                       recover_at: Optional[int] = None) -> dict[int, dict]:
+        """Per-worker ``create_fragments`` requests for one spanning job.
+        Re-run at recovery with FRESH channel ids and the workers'
+        CURRENT ports (a respawned worker listens on a new ephemeral
+        port), so edge specs always name live peers."""
+        from .plan_json import plan_to_json
+        graph, placement = spec["graph"], spec["placement"]
+        by_id = {w.worker_id: w for w in self.workers}
+        consumers: dict[int, int] = {}            # u_fid -> d_fid
+        for d_fid, frag in graph.fragments.items():
+            for u_fid in frag.upstream:
+                consumers[u_fid] = d_fid
+        chan_of: dict[tuple, int] = {}
+        for u_fid, d_fid in consumers.items():
+            for ua in range(len(placement.actors[u_fid])):
+                for da in range(len(placement.actors[d_fid])):
+                    chan_of[(u_fid, ua, d_fid, da)] = \
+                        next(self._next_span_chan)
+
+        def edge(u_fid, ua, d_fid, da) -> str:
+            return f"{name}:f{u_fid}.{ua}->f{d_fid}.{da}"
+
+        cfg = self.config
+        frag_specs: dict[int, list] = {w.worker_id: []
+                                       for w in spec["workers"]}
+        for fid in sorted(graph.fragments):
+            frag = graph.fragments[fid]
+            plan_json = plan_to_json(frag.plan)   # same for every actor
+            for ap in placement.actors[fid]:
+                inputs = []
+                for u_fid in frag.upstream:
+                    chans = []
+                    for up in placement.actors[u_fid]:
+                        chans.append({
+                            "chan": chan_of[(u_fid, up.actor, fid,
+                                             ap.actor)],
+                            "from_worker": up.worker,
+                            "edge": edge(u_fid, up.actor, fid, ap.actor),
+                        })
+                    inputs.append({"up_fid": u_fid, "chans": chans})
+                out = None
+                if not frag.is_root:
+                    d_fid = consumers[fid]
+                    downs = placement.actors[d_fid]
+                    if len(downs) > 1 and not frag.dist_keys:
+                        raise RuntimeError(
+                            f"fragment {fid} has {len(downs)} downstream "
+                            "actors but no distribution keys")
+                    out = {
+                        "kind": "hash" if frag.dist_keys else "simple",
+                        "keys": list(frag.dist_keys),
+                        "targets": [{
+                            "chan": chan_of[(fid, ap.actor, d_fid,
+                                             dp.actor)],
+                            "worker": dp.worker,
+                            "host": "127.0.0.1",
+                            "port": by_id[dp.worker].port,
+                            "edge": edge(fid, ap.actor, d_fid, dp.actor),
+                        } for dp in downs],
+                    }
+                frag_specs[ap.worker].append({
+                    "fid": fid, "actor": ap.actor,
+                    "plan": plan_json,
+                    "id_start": spec["id_start"] + fid * _SPAN_ID_STRIDE,
+                    "shard_base": fid * 16,
+                    "is_root": frag.is_root,
+                    "inputs": inputs, "output": out,
+                })
+        reqs = {}
+        for w in spec["workers"]:
+            reqs[w.worker_id] = {
+                "type": "create_fragments", "name": name,
+                "defs": spec["defs"],
+                "mv_table_id": spec["mv_table_id"],
+                "id_stride": _SPAN_ID_STRIDE,
+                "permits": cfg.exchange_permits,
+                "config": {
+                    "chunk_capacity": cfg.chunk_capacity,
+                    "agg_table_capacity": cfg.agg_table_capacity,
+                    "join_key_capacity": cfg.join_key_capacity,
+                    "join_bucket_width": cfg.join_bucket_width,
+                    "topn_table_capacity": cfg.topn_table_capacity,
+                    "agg_hbm_budget": cfg.agg_hbm_budget,
+                },
+                "chunks_per_tick": self.chunks_per_tick,
+                "chunk_capacity": self.source_chunk_capacity,
+                "seed": self.seed,
+                "fault": dataclasses.asdict(self.fault),
+                "fresh": fresh,
+                "fragments": frag_specs[w.worker_id],
+            }
+            if recover_at is not None:
+                reqs[w.worker_id]["recover_at"] = recover_at
+        return reqs
+
+    def _span_decided_epoch(self, name: str, workers) -> int:
+        """The cluster-decided checkpoint cut for a spanning job: the MAX
+        committed epoch across its participants. A commit frame is only
+        sent after EVERY participant durably prepared the epoch, so any
+        participant behind the max still holds that epoch prepared and
+        rolls forward — all stores settle on one consistent cut
+        (phase-2 asymmetry healed; reference: meta-owned atomic Hummock
+        versions make this a non-problem in the reference)."""
+        committed = []
+        for w in workers:
+            resp = self._await(w.request({"type": "job_epochs",
+                                          "name": name}))
+            committed.append(int(resp.get("committed", 0)))
+        return max(committed) if committed else 0
+
+    def _recover_spanning_job(self, name: str) -> list[str]:
+        """Scoped recovery of a SPANNING job: respawn dead participants,
+        drop the surviving fragments WITHOUT touching durable state, and
+        re-deploy the same placement — every fragment reloads from its
+        own worker's store at the last committed checkpoint and the
+        deterministic sources replay the gap (reference: recovery.rs:110
+        scoped to one job's actor set; unrelated jobs on the same workers
+        keep running untouched)."""
+        from .remote import SpanningJob, WorkerDied
+        self._drain_inflight()
+        spec = self._spanning_specs[name]
+        job = self.jobs.pop(name, None)
+        if job is not None:
+            self._await(job.stop())
+            self._unsubscribe_job(job)
+            self.meta.deregister_job(name)
+            self._dead_jobs.discard(name)
+        for w in spec["workers"]:
+            if w.dead:
+                w.respawn(self._await)
+                self._worker_span_ack.pop(w.worker_id, None)
+                self.meta.register_compute(w.worker_id, "127.0.0.1",
+                                           w.port)
+        for w in spec["workers"]:
+            try:
+                self._await(w.request(
+                    {"type": "drop_job", "name": name,
+                     "epoch": self._injected + 1, "drop_state": False}))
+            except (WorkerDied, RuntimeError):
+                pass                 # fresh respawn or wedged: no-op
+        decided = self._span_decided_epoch(name, spec["workers"])
+        reqs = self._span_requests(name, spec, fresh=False,
+                                   recover_at=decided)
+        for w in spec["workers"]:
+            self._await(w.request(reqs[w.worker_id]))
+        self.jobs[name] = SpanningJob(name, spec["workers"])
+
+        async def _init_all() -> None:
+            await asyncio.gather(*(w.init_barrier(name, self.epoch)
+                                   for w in spec["workers"]))
+
+        self._await(_init_all())
+        self.meta.notifications.notify(
+            "recovery", {"jobs": [name], "epoch": self.epoch})
+        return [name]
+
     def _create_sink(self, stmt: A.CreateSink) -> list:
         """CREATE SINK: a stream job whose terminal is a SinkExecutor over
         a log store instead of a MaterializeExecutor (reference:
@@ -1011,7 +1340,7 @@ class Session:
             if kind == "source":
                 raise SqlError("CREATE SINK FROM a source is not supported; "
                                "use CREATE SINK ... AS SELECT")
-            if stmt.from_name in self._remote_specs:
+            if self._mv_worker(stmt.from_name) is not None:
                 raise SqlError(
                     f"CREATE SINK FROM worker-hosted MV "
                     f"{stmt.from_name!r} is not supported yet")
@@ -1096,7 +1425,7 @@ class Session:
         if mv is None:
             raise SqlError(f"materialized view {name!r} not found "
                            "(only MV jobs reschedule)")
-        if name in self._remote_specs:
+        if self._mv_worker(name) is not None:
             raise SqlError("reschedule of a worker-hosted MV is not "
                            "supported yet; drop and re-create it")
         self.flush()                       # all state durable + quiesced
@@ -1322,6 +1651,8 @@ class Session:
         or sink job falls back to requiring a session restart (state is
         durable). Returns the recovered subtree's job names (the caller
         dedups overlapping recovery requests with it)."""
+        if name in self._spanning_specs:
+            return self._recover_spanning_job(name)
         if name in self._remote_specs:
             return self._recover_remote_job(name)
         job = self.jobs.get(name)
@@ -1440,7 +1771,7 @@ class Session:
             return ex, q, []
         if isinstance(leaf, (PTableScan, PMvScan)):
             name = leaf.table.name if isinstance(leaf, PTableScan) else leaf.mv.name
-            if name in self._remote_specs:
+            if self._mv_worker(name) is not None:
                 raise SqlError(
                     f"{name!r} is a worker-hosted MV; jobs consuming it "
                     "must also be worker-hosted (not supported yet)")
@@ -1550,6 +1881,19 @@ class Session:
                          "epoch": self._injected + 1}))
                 except (WorkerDied, RuntimeError):
                     pass             # worker gone; its state dir is stale
+            span = self._spanning_specs.pop(stmt.name, None)
+            if span is not None:
+                from .remote import WorkerDied
+                self.meta.drop_placement(stmt.name)
+                for w in span["workers"]:
+                    if w.dead:
+                        continue     # its state dir is stale; respawn wipes
+                    try:
+                        self._await(w.request(
+                            {"type": "drop_job", "name": stmt.name,
+                             "epoch": self._injected + 1}))
+                    except (WorkerDied, RuntimeError):
+                        pass
         if existed and obj is not None:
             self.dml.unregister_table(obj.table_id)
             for tid in ((obj.table_id,)
@@ -1745,15 +2089,22 @@ class Session:
                     q.push(barrier)
             if self.workers:
                 from .remote import WorkerDied
+                dead_jobs = sorted(self._dead_jobs)
 
                 async def _inject_remote() -> None:
                     for w in self.workers:
                         if w.dead:
                             continue
                         try:
+                            # jobs already declared dead (a spanning job
+                            # with a killed peer) are excluded: feeding
+                            # them would advance readers past rows the
+                            # job never processed, and waiting on them
+                            # would wedge the worker's healthy jobs
                             await w.inject_barrier(
                                 epoch, checkpoint,
-                                generate and not self.paused, mutation)
+                                generate and not self.paused, mutation,
+                                exclude=dead_jobs)
                         except WorkerDied:
                             pass        # collect marks its jobs dead
                 self._await(_inject_remote())
@@ -1885,15 +2236,21 @@ class Session:
             # phase 2 of the cluster checkpoint: workers sealed and
             # acked; only now may their staged epochs become durable
             # (a worker killed before this frame recovers one
-            # checkpoint back and its deterministic sources replay)
+            # checkpoint back and its deterministic sources replay).
+            # Dead jobs are excluded: a spanning job with a killed peer
+            # may have staged a TORN epoch on its surviving workers —
+            # committing it would fork history against the recovery
+            # rebuild (the session-store analogue is
+            # discard_pending_tables above)
             from .remote import WorkerDied
+            dead_jobs = sorted(self._dead_jobs)
 
             async def _commit_remote() -> None:
                 for w in self.workers:
                     if w.dead:
                         continue
                     try:
-                        await w.commit(e)
+                        await w.commit(e, skip_jobs=dead_jobs)
                     except WorkerDied:
                         pass
             self._await(_commit_remote())
@@ -2074,11 +2431,10 @@ class Session:
         def make_fragment(node):
             base = chain_base(node)
             name = base.mv.name
-            spec = self._remote_specs[name]
             from .plan_json import defs_to_json, plan_to_json
             plan_json = plan_to_json(node)
             defs_json = defs_to_json([base.mv])
-            worker = spec["worker"]
+            worker = self._mv_worker(name)
             types = [f.type for f in node.schema]
 
             def fetch():
@@ -2100,7 +2456,7 @@ class Session:
         def rewrite(node):
             base = chain_base(node)
             if (isinstance(base, PMvScan)
-                    and base.mv.name in self._remote_specs):
+                    and self._mv_worker(base.mv.name) is not None):
                 return make_fragment(node)
             kids = list(node.children)
             if not kids:
@@ -2128,11 +2484,11 @@ class Session:
         # stream-fold below
         from ..batch.executors import BatchFallback, run_batch
         from ..batch.lower import lower_plan
-        if self._remote_specs:
+        if self._remote_specs or self._spanning_specs:
             plan = self._push_remote_fragments(plan)
         remote_mvs = {l.mv.name for l in collect_leaves(plan)
                       if isinstance(l, PMvScan)
-                      and l.mv.name in self._remote_specs}
+                      and self._mv_worker(l.mv.name) is not None}
         try:
             # a remote MV's rows live in the worker's store, not ours —
             # the local-scan fast path would silently read empty tables
@@ -2169,7 +2525,7 @@ class Session:
                 if isinstance(leaf, PRemoteFragment):
                     rows = leaf.fetch()       # stage ran on the worker
                 elif (isinstance(leaf, PMvScan)
-                        and leaf.mv.name in self._remote_specs):
+                        and self._mv_worker(leaf.mv.name) is not None):
                     rows = self._remote_scan(leaf.mv.name, schema,
                                              physical=True)
                 else:
@@ -2247,7 +2603,7 @@ class Session:
         if mv is None:
             raise SqlError(f"materialized view {name!r} not found")
         n_vis = getattr(mv, "n_visible", len(mv.schema))
-        if name in self._remote_specs:
+        if self._mv_worker(name) is not None:
             return [tuple(r[:n_vis])
                     for r in self._remote_scan(name, mv.schema)]
         job = self.jobs[name]
@@ -2258,18 +2614,29 @@ class Session:
                 for i, v in enumerate(phys[:n_vis])))
         return rows
 
+    def _mv_worker(self, name: str):
+        """The worker process holding an MV's materialized table: the
+        hosting worker for whole-job placement, the ROOT-fragment worker
+        for a spanning job, None for session-local MVs."""
+        spec = self._remote_specs.get(name)
+        if spec is not None:
+            return spec["worker"]
+        span = self._spanning_specs.get(name)
+        if span is not None:
+            return span["root_worker"]
+        return None
+
     def _remote_scan(self, name: str, schema: Schema,
                      physical: bool = False) -> list:
         """Fetch a worker-hosted MV's rows over the scan RPC."""
         import base64
 
         from ..common.row import decode_value_row
-        spec = self._remote_specs[name]
         # data-plane request: scanning a huge MV may exceed the control
         # deadline without the worker being wedged — unbounded
         resp = self._await(
-            spec["worker"].request({"type": "scan", "name": name},
-                                   timeout=0))
+            self._mv_worker(name).request({"type": "scan", "name": name},
+                                          timeout=0))
         types = [f.type for f in schema]
         out = []
         for b in resp["rows"]:
@@ -2324,6 +2691,7 @@ class Session:
             },
         }
         worker_stats = self._federate_worker_stats()
+        exchange: list = []
         for wid, st in sorted(worker_stats.items()):
             # live local jobs win over cached worker snapshots of the
             # same name (an MV recreated in-process after worker death)
@@ -2331,6 +2699,11 @@ class Session:
                 out["jobs"].setdefault(name, jm)
             for name, nb in st.get("state_bytes", {}).items():
                 out["state_bytes"].setdefault(name, nb)
+            # per-exchange-edge counters (permits waited, chunks/bytes
+            # forwarded, backlog) from every worker hosting an endpoint
+            for e in st.get("exchange", ()) or ():
+                exchange.append({"worker": wid, **e})
+        out["exchange"] = exchange
         out["workers"] = [
             {"worker": w.worker_id,
              "pid": getattr(getattr(w, "proc", None), "pid", None),

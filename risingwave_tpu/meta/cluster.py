@@ -27,14 +27,51 @@ class WorkerNode:
     last_heartbeat: float = 0.0
 
 
+@dataclasses.dataclass
+class ComputeNode:
+    """One worker PROCESS the fragment scheduler may place fragments on
+    (reference: the compute-node entries of cluster.rs:64 — here kept
+    separate from the per-JOB heartbeat registry above, which predates
+    multi-worker placement and measures liveness per job)."""
+
+    worker_id: int
+    host: str
+    port: int                        # exchange/control socket port
+    parallelism: int = 1
+    state: str = "RUNNING"           # RUNNING | DOWN
+
+
 class ClusterManager:
     def __init__(self, heartbeat_ttl_s: float = 30.0,
                  clock: Optional[Callable[[], float]] = None):
         self.heartbeat_ttl_s = heartbeat_ttl_s
         self.clock = clock or time.monotonic
         self.workers: Dict[int, WorkerNode] = {}
+        self.compute_nodes: Dict[int, ComputeNode] = {}
         self._next_id = 1
         self._failure_listeners: List[Callable[[WorkerNode], None]] = []
+
+    # -- compute-node registry (fragment placement targets) --------------------
+
+    def register_compute(self, worker_id: int, host: str, port: int,
+                         parallelism: int = 1) -> ComputeNode:
+        """Idempotent upsert: a respawned worker re-registers under the
+        same id with its NEW port (ephemeral ports change across kills),
+        so persisted placements keep naming a stable worker id while the
+        live address is always current."""
+        node = ComputeNode(worker_id, host, port, parallelism)
+        self.compute_nodes[worker_id] = node
+        return node
+
+    def set_compute_state(self, worker_id: int, state: str) -> None:
+        node = self.compute_nodes.get(worker_id)
+        if node is not None:
+            node.state = state
+
+    def live_compute_nodes(self) -> List[ComputeNode]:
+        return [n for n in sorted(self.compute_nodes.values(),
+                                  key=lambda n: n.worker_id)
+                if n.state == "RUNNING"]
 
     def add_worker(self, host: str, parallelism: int) -> WorkerNode:
         w = WorkerNode(self._next_id, host, parallelism,

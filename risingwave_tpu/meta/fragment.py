@@ -114,6 +114,238 @@ def _dist_of(node: P.PlanNode) -> str:
     return "inherit"
 
 
+# -- cross-worker span graphs -------------------------------------------------
+# The fragment cut above describes the topology; a SPAN graph is the
+# deployable form: each fragment's subtree is rewritten so its cut-point
+# children become PExchange leaves (the serialized plan the worker
+# receives names its exchange inputs explicitly), and the root fragment —
+# the one that materializes — is part of the graph too. This is what the
+# FragmentScheduler places onto worker processes by vnode mapping
+# (reference: the meta DdlController turning the fragment graph into
+# per-compute-node actor builds, src/meta/src/stream/stream_graph/).
+
+
+class SpanUnsupported(ValueError):
+    """Plan shape the cross-worker spanning path cannot deploy; the
+    caller falls back to whole-job placement."""
+
+
+@dataclasses.dataclass
+class SpanFragment:
+    fragment_id: int
+    plan: P.PlanNode                 # subtree with PExchange cut leaves
+    distribution: str                # "hash" | "single" | "source" | "inherit"
+    dist_keys: Tuple[int, ...]       # keys of this fragment's OUTPUT exchange
+    upstream: Tuple[int, ...]        # feeding fragment ids, PExchange order
+    is_root: bool = False            # materializing fragment
+
+
+@dataclasses.dataclass
+class SpanGraph:
+    fragments: Dict[int, SpanFragment]
+    root_id: int
+
+    def explain(self) -> str:
+        lines = []
+        for fid in sorted(self.fragments):
+            f = self.fragments[fid]
+            up = f" <- {list(f.upstream)}" if f.upstream else ""
+            keys = f" keys={list(f.dist_keys)}" if f.dist_keys else ""
+            root = " ROOT" if f.is_root else ""
+            lines.append(f"Fragment {fid} [{f.distribution}{keys}]{root}"
+                         f"{up}: {f.plan.label()}")
+        return "\n".join(lines)
+
+
+#: node kinds the spanning deployment understands. Anything else (over
+#: windows, temporal joins, dynamic filters, project-set, ...) keeps the
+#: whole-job placement path — correctness first, coverage grows per shape.
+_SPAN_NODES = (P.PSource, P.PProject, P.PFilter, P.PHopWindow, P.PAgg,
+               P.PJoin, P.PTopN, P.PUnion)
+_ROW_WISE = (P.PProject, P.PFilter, P.PHopWindow)
+
+
+def span_plan(plan: P.PlanNode) -> SpanGraph:
+    """Cut a plan tree into a deployable span graph: the same cut points
+    as ``fragment_plan`` with each parent's cut child replaced by a
+    ``PExchange`` leaf naming the feeding fragment. Raises
+    ``SpanUnsupported`` for shapes outside the supported node set or
+    plans with non-source leaves (scans need the session-side bus)."""
+
+    def check(node: P.PlanNode) -> None:
+        if not isinstance(node, _SPAN_NODES):
+            raise SpanUnsupported(
+                f"cannot span {type(node).__name__} across workers")
+        for c in node.children:
+            check(c)
+
+    check(plan)
+    fragments: Dict[int, SpanFragment] = {}
+    counter = {"next": 0}
+
+    def new_fragment(root, distribution, dist_keys=(), upstream=()):
+        fid = counter["next"]
+        counter["next"] += 1
+        fragments[fid] = SpanFragment(fid, root, distribution,
+                                      tuple(dist_keys), tuple(upstream))
+        return fid
+
+    def cut(child: P.PlanNode, dist_keys, child_up) -> P.PExchange:
+        fid = new_fragment(child, _dist_of(child), dist_keys, child_up)
+        return P.PExchange(schema=child.schema, pk=tuple(child.pk),
+                           upstream=fid)
+
+    def visit(node: P.PlanNode) -> Tuple[P.PlanNode, List[int]]:
+        """Returns (node with PExchange splices, upstream fragment ids
+        feeding the CURRENT fragment, in exchange-leaf order)."""
+        if isinstance(node, P.PAgg):
+            child, child_up = visit(node.input)
+            exch = cut(child, tuple(node.group_keys), child_up)
+            return dataclasses.replace(node, input=exch), [exch.upstream]
+        if isinstance(node, P.PJoin):
+            left, lup = visit(node.left)
+            right, rup = visit(node.right)
+            lex = cut(left, tuple(node.left_keys), lup)
+            rex = cut(right, tuple(node.right_keys), rup)
+            return (dataclasses.replace(node, left=lex, right=rex),
+                    [lex.upstream, rex.upstream])
+        if isinstance(node, P.PTopN) and not node.group_by:
+            child, child_up = visit(node.input)
+            exch = cut(child, (), child_up)      # gather to singleton
+            return dataclasses.replace(node, input=exch), [exch.upstream]
+        if isinstance(node, P.PUnion):
+            new_inputs, ups = [], []
+            for inp in node.inputs:
+                c, cup = visit(inp)
+                exch = cut(c, (), cup)
+                new_inputs.append(exch)
+                ups.append(exch.upstream)
+            return dataclasses.replace(node, inputs=tuple(new_inputs)), ups
+        if isinstance(node, (P.PSource,)):
+            return node, []
+        # single-input pass-through nodes stay inside the current fragment
+        child, child_up = visit(node.input)
+        return dataclasses.replace(node, input=child), child_up
+
+    root, ups = visit(plan)
+    root_id = new_fragment(root, _dist_of(root), (), ups)
+    fragments[root_id].is_root = True
+    if len(fragments) < 2:
+        raise SpanUnsupported("plan has no exchange cut; nothing to span")
+    return SpanGraph(fragments, root_id)
+
+
+def shardable(frag: SpanFragment) -> bool:
+    """True if the fragment may run as MULTIPLE actors with its input
+    exchange hash-split: a single grouped-agg core (cut directly below by
+    its group keys) under any chain of row-wise operators. Each actor
+    then owns a disjoint group-key shard, exactly the in-process
+    multi-actor agg layout (frontend/fragments.py)."""
+    if len(frag.upstream) != 1 or frag.is_root:
+        return False
+    node = frag.plan
+    while isinstance(node, _ROW_WISE):
+        node = node.input
+    return (isinstance(node, P.PAgg) and bool(node.group_keys)
+            and isinstance(node.input, P.PExchange))
+
+
+# -- fragment placement (vnode mapping onto worker processes) -----------------
+
+@dataclasses.dataclass
+class ActorPlacement:
+    fragment_id: int
+    actor: int                       # index within the fragment
+    worker: int                      # worker process id
+    vnode_start: int                 # owned vnode range [start, end)
+    vnode_end: int
+
+
+@dataclasses.dataclass
+class FragmentPlacement:
+    """Persisted fragment→worker mapping of one spanning job (reference:
+    the persisted vnode mappings of manager/catalog/fragment.rs)."""
+
+    job: str
+    actors: Dict[int, List[ActorPlacement]]      # fragment -> its actors
+    root_worker: int
+
+    def workers(self) -> List[int]:
+        out: List[int] = []
+        for acts in self.actors.values():
+            for a in acts:
+                if a.worker not in out:
+                    out.append(a.worker)
+        return sorted(out)
+
+    def to_json(self) -> dict:
+        return {
+            "job": self.job,
+            "root_worker": self.root_worker,
+            "fragments": {
+                str(fid): [dataclasses.asdict(a) for a in acts]
+                for fid, acts in self.actors.items()
+            },
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FragmentPlacement":
+        return cls(
+            job=d["job"],
+            root_worker=int(d["root_worker"]),
+            actors={int(fid): [ActorPlacement(**a) for a in acts]
+                    for fid, acts in d["fragments"].items()},
+        )
+
+
+class FragmentScheduler:
+    """Meta-side placement of span-graph fragments onto worker processes
+    by vnode mapping (reference: the meta scheduler splitting the vnode
+    ring across parallel units, src/meta/src/stream/scale.rs +
+    docs/consistent-hash.md). Shardable hash fragments get one actor per
+    assigned worker, each owning a contiguous vnode range — the SAME
+    contiguous-range mapping ``vnode_to_shard`` applies on the dispatch
+    path, so the persisted placement IS the routing function. Placement
+    balances total owned vnodes per worker; singleton/source fragments
+    own the whole ring on their one worker."""
+
+    def __init__(self, vnode_count: Optional[int] = None):
+        if vnode_count is None:
+            from ..common.hashing import VNODE_COUNT
+            vnode_count = VNODE_COUNT
+        self.vnode_count = vnode_count
+
+    def place(self, job: str, graph: SpanGraph, worker_ids: List[int],
+              parallelism: int = 1) -> FragmentPlacement:
+        if not worker_ids:
+            raise ValueError("no live workers to place fragments on")
+        vnodes_of: Dict[int, int] = {w: 0 for w in worker_ids}
+        actors: Dict[int, List[ActorPlacement]] = {}
+
+        def pick(exclude=()) -> int:
+            free = [w for w in worker_ids if w not in exclude]
+            return min(free, key=lambda w: (vnodes_of[w], w))
+
+        for fid in sorted(graph.fragments):
+            frag = graph.fragments[fid]
+            n = 1
+            if shardable(frag):
+                n = max(1, min(parallelism, len(worker_ids)))
+            per = self.vnode_count // n
+            acts = []
+            chosen: List[int] = []
+            for a in range(n):
+                w = pick(exclude=chosen)       # actors on distinct workers
+                chosen.append(w)
+                start = a * per
+                end = self.vnode_count if a == n - 1 else (a + 1) * per
+                vnodes_of[w] += end - start
+                acts.append(ActorPlacement(fid, a, w, start, end))
+            actors[fid] = acts
+        return FragmentPlacement(job, actors,
+                                 root_worker=actors[graph.root_id][0].worker)
+
+
 class FragmentManager:
     """Registry of fragment graphs per streaming job (reference:
     FragmentManager, manager/catalog/fragment.rs)."""

@@ -100,6 +100,43 @@ class MetaService:
     def on_job_failure(self, fn: Callable[[str], None]) -> None:
         self.cluster.on_failure(lambda w: fn(w.host))
 
+    # -- compute nodes + fragment placement ------------------------------------
+
+    def register_compute(self, worker_id: int, host: str, port: int,
+                         parallelism: int = 1):
+        return self.cluster.register_compute(worker_id, host, port,
+                                             parallelism)
+
+    def save_placement(self, placement) -> None:
+        """Persist a spanning job's fragment→worker mapping (reference:
+        the fragment catalog's persisted vnode mappings,
+        manager/catalog/fragment.rs). Durable when the store is — a
+        session restart re-places the SAME fragments onto the SAME
+        workers, whose per-worker stores hold those fragments' state."""
+        key = f"placement/{placement.job}"
+        self.store.put(key, json.dumps(placement.to_json()))
+        self.notifications.notify(
+            "placement", {"job": placement.job,
+                          "workers": placement.workers()})
+
+    def load_placement(self, job: str):
+        from .fragment import FragmentPlacement
+        raw = self.store.get(f"placement/{job}")
+        if raw is None:
+            return None
+        return FragmentPlacement.from_json(json.loads(raw))
+
+    def drop_placement(self, job: str) -> None:
+        self.store.delete(f"placement/{job}")
+
+    def all_placements(self) -> dict:
+        from .fragment import FragmentPlacement
+        out = {}
+        for key, raw in self.store.list_prefix("placement/"):
+            p = FragmentPlacement.from_json(json.loads(raw))
+            out[p.job] = p
+        return out
+
     # -- barrier conduction publishing ----------------------------------------
 
     def publish_barrier(self, epoch: int, checkpoint: bool) -> None:

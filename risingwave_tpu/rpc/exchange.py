@@ -1,0 +1,198 @@
+"""Worker↔worker remote-exchange wire layer.
+
+Counterpart of the reference's ExchangeService data plane
+(reference: src/compute/src/rpc/service/exchange_service.rs:74-133 served
+by every compute node; src/rpc_client/src/compute_client.rs opening
+streams to peers; exchange/permit.rs:35-107 credit flow). Each worker
+process's ONE listening socket serves both the session's control
+connection and any number of PEER connections; a peer connection opens
+with an ``exg_hello`` frame and then carries only exchange frames:
+
+    producer → consumer   {"type": "exg_data", "chan": C, "msg": <wire>}
+    consumer → producer   {"type": "exg_ack",  "chan": C}
+
+Credit flow mirrors ``PermitChannel`` end-to-end across the process
+boundary: StreamChunk frames consume a permit on the PRODUCER before the
+bytes are written and the permit returns only when the consumer's
+executor TAKES the chunk (consumption-acked, not receipt-acked);
+barriers and watermarks always pass so the control stream can never
+deadlock behind data — the invariant the two-phase checkpoint depends
+on. One client connection per (host, port) pair multiplexes every edge
+between the two processes, like the reference's pooled compute clients.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+from .wire import MAX_FRAME, read_frame
+
+_LEN = struct.Struct("<I")
+
+
+class PeerLost(ConnectionError):
+    """The remote end of an exchange edge is gone (process death, socket
+    reset). Distinguished from executor logic errors so barrier
+    collection can classify it as a KILL — the heartbeat-TTL scoped
+    recovery path — rather than a poisoned job."""
+
+
+class EdgeStats:
+    """Per-exchange-edge counters, one side of one edge. Surfaced through
+    worker stats frames into ``Session.metrics()["exchange"]``,
+    Prometheus (``rw_exchange_stat``), and the dashboard."""
+
+    __slots__ = ("edge", "direction", "peer_worker", "chunks", "bytes",
+                 "permits_waited", "barriers")
+
+    def __init__(self, edge: str, direction: str, peer_worker: int):
+        self.edge = edge              # "job:f<u>a<i>->f<d>a<j>"
+        self.direction = direction    # "out" | "in"
+        self.peer_worker = peer_worker
+        self.chunks = 0
+        self.bytes = 0
+        self.permits_waited = 0
+        self.barriers = 0
+
+    def snapshot(self, backlog: int = 0) -> dict:
+        return {"edge": self.edge, "dir": self.direction,
+                "peer_worker": self.peer_worker, "chunks": self.chunks,
+                "bytes": self.bytes, "permits_waited": self.permits_waited,
+                "barriers": self.barriers, "backlog": backlog}
+
+
+class ExchangePeerClient:
+    """Producer-side connection to ONE peer worker's exchange server.
+    Owns the socket, the per-channel permit semaphores, and the ack read
+    loop. All edges from this process to that peer share the connection
+    (per-channel credit keeps them independent)."""
+
+    def __init__(self, host: str, port: int, from_worker: int):
+        self.host = host
+        self.port = port
+        self.from_worker = from_worker
+        self.broken = False
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+        self._sems: Dict[int, asyncio.Semaphore] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._connect_lock = asyncio.Lock()
+
+    def register(self, chan: int, permits: int) -> None:
+        self._sems[chan] = asyncio.Semaphore(permits)
+
+    def unregister(self, chan: int) -> None:
+        self._sems.pop(chan, None)
+
+    async def _ensure_connected(self) -> None:
+        async with self._connect_lock:
+            if self._writer is not None or self.broken:
+                return
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.host, self.port)
+            except OSError as e:
+                self._mark_broken()
+                raise PeerLost(
+                    f"exchange peer {self.host}:{self.port}: {e}") from None
+            self._writer = writer
+            writer.write(self._pack({"type": "exg_hello",
+                                     "worker": self.from_worker}))
+            await writer.drain()
+            self._reader_task = asyncio.ensure_future(
+                self._ack_loop(reader))
+
+    @staticmethod
+    def _pack(obj: dict) -> bytes:
+        body = json.dumps(obj).encode()
+        if len(body) > MAX_FRAME:
+            raise ValueError(f"oversized exchange frame: {len(body)} bytes")
+        return _LEN.pack(len(body)) + body
+
+    async def _ack_loop(self, reader) -> None:
+        while True:
+            frame = await read_frame(reader)
+            if frame is None:
+                self._mark_broken()
+                return
+            if frame.get("type") == "exg_ack":
+                sem = self._sems.get(frame["chan"])
+                if sem is not None:
+                    sem.release()
+
+    def _mark_broken(self) -> None:
+        self.broken = True
+        for sem in self._sems.values():
+            sem.release()        # unblock senders; send() raises PeerLost
+
+    async def send(self, chan: int, wire_msg: dict, is_data: bool,
+                   stats: Optional[EdgeStats] = None) -> int:
+        """Ship one message on an edge; returns bytes written. Data
+        consumes a permit (blocking the SENDING actor when the consumer's
+        credit is exhausted — end-to-end backpressure); control frames
+        always pass."""
+        await self._ensure_connected()
+        if is_data:
+            sem = self._sems.get(chan)
+            if sem is not None:
+                if stats is not None and sem.locked():
+                    stats.permits_waited += 1
+                await sem.acquire()
+        if self.broken or self._writer is None:
+            raise PeerLost(
+                f"exchange peer {self.host}:{self.port} is down")
+        buf = self._pack({"type": "exg_data", "chan": chan,
+                          "msg": wire_msg})
+        try:
+            async with self._wlock:
+                self._writer.write(buf)
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._mark_broken()
+            raise PeerLost(
+                f"exchange peer {self.host}:{self.port}: {e}") from None
+        return len(buf)
+
+    async def aclose(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._reader_task = None
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:  # noqa: BLE001 - already dying
+                pass
+            self._writer = None
+
+
+class PeerClientPool:
+    """One ``ExchangePeerClient`` per (host, port) target, shared by every
+    edge this process produces toward that peer (reference: the pooled
+    compute clients of rpc_client/src/lib.rs). A broken client is
+    replaced on next lookup so recovery's re-created edges (same worker,
+    NEW port after respawn) never reuse a dead socket."""
+
+    def __init__(self, from_worker: int):
+        self.from_worker = from_worker
+        self._clients: Dict[Tuple[str, int], ExchangePeerClient] = {}
+
+    def get(self, host: str, port: int) -> ExchangePeerClient:
+        key = (host, port)
+        client = self._clients.get(key)
+        if client is None or client.broken:
+            client = ExchangePeerClient(host, port, self.from_worker)
+            self._clients[key] = client
+        return client
+
+    async def aclose(self) -> None:
+        for client in self._clients.values():
+            await client.aclose()
+        self._clients.clear()

@@ -67,6 +67,7 @@ class SimCluster:
         self.session = Session(data_dir=data_dir, **self.session_kw)
         self.kills = 0
         self.worker_kills = 0
+        self.spanning_kills = 0
         self._unacked: List[str] = []     # DML since the last FLUSH
 
     # -- client API -----------------------------------------------------------
@@ -102,7 +103,14 @@ class SimCluster:
             return False
         if getattr(self.session, "workers", None) and \
                 self.rng.random() < 0.5:
-            self.kill_worker()
+            # spanning fragment graphs get their own chaos entry: kill a
+            # worker that hosts ONE fragment of a multi-worker graph
+            # (scoped rebuild of that graph, every other job untouched)
+            if getattr(self.session, "_spanning_specs", None) and \
+                    self.rng.random() < 0.5:
+                self.kill_spanning_worker()
+            else:
+                self.kill_worker()
         else:
             self.kill()
         return True
@@ -132,6 +140,28 @@ class SimCluster:
             if not w.dead:
                 return
         raise AssertionError("killed worker was not recovered")
+
+    def kill_spanning_worker(self) -> None:
+        """SIGKILL one worker hosting a FRAGMENT of a spanning graph:
+        surviving peers report PEER_LOST on their exchange edges, the
+        TTL declares the job dead, and scoped recovery must rebuild ONLY
+        the affected fragment graph (respawned worker + surviving
+        fragments reloaded at the last commit) and converge — asserted
+        here, cross-checked against the control session by the caller."""
+        specs = self.session._spanning_specs
+        name = self.rng.choice(sorted(specs))
+        w = self.rng.choice(specs[name]["workers"])
+        w.kill9()
+        self.worker_kills += 1
+        self.spanning_kills += 1
+        for _ in range(16):               # TTL + scoped rebuild in-tick
+            self.session.tick()
+            job = self.session.jobs.get(name)
+            if not w.dead and job is not None and job._failure is None:
+                return
+        raise AssertionError(
+            f"spanning job {name!r} did not converge after a "
+            "participant kill")
 
     def kill(self) -> None:
         """Abandon the session with no shutdown (uncommitted state and

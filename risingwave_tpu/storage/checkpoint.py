@@ -86,10 +86,11 @@ class CheckpointLog:
         raw = self.store.get(_MANIFEST)
         if raw is None:
             return {"committed_epoch": 0, "segments": [], "ddl": [],
-                    "dropped_tables": [],
+                    "dropped_tables": [], "prepared": {},
                     "plan_format": PLAN_FORMAT_VERSION}
         m = json.loads(raw)
         m.setdefault("dropped_tables", [])
+        m.setdefault("prepared", {})
         stored = m.setdefault("plan_format", 1)
         if stored != PLAN_FORMAT_VERSION and not self._format_warned:
             self._format_warned = True
@@ -201,6 +202,76 @@ class CheckpointLog:
             n_segments = len(manifest["segments"])
         if n_segments > self.COMPACT_AFTER:
             self._spawn_compact()
+
+    # -- two-phase epochs (spanning jobs) -------------------------------------
+    # A job whose fragment graph spans worker processes needs the cluster
+    # checkpoint cut to be CONSISTENT across several independent stores.
+    # Phase 1 (barrier ack) therefore makes the epoch's deltas DURABLE
+    # without committing them: the segment object is written and recorded
+    # in the manifest's ``prepared`` map. Phase 2 (the session's commit
+    # frame) promotes it into the committed chain. A process killed
+    # between ack and commit can then be ROLLED FORWARD at recovery to
+    # whatever epoch the rest of the cluster committed — without this,
+    # one participant recovering a checkpoint behind its peers forks the
+    # job's history (reference: Hummock solves the same problem by giving
+    # the META node one atomic version for the whole cluster;
+    # src/meta/src/hummock/manager/ commit_epoch).
+
+    def prepare_epoch(self, epoch: int,
+                      deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
+        """Phase 1: durably stage an epoch's deltas without committing."""
+        name = None
+        if deltas:
+            name = f"epoch_{epoch:012d}.prepared.seg"
+            self._write_segment(name, deltas)
+        with self._mlock:
+            manifest = self._read_manifest()
+            manifest["prepared"][str(epoch)] = name
+            self._write_manifest(manifest)
+
+    def prepared_epochs(self) -> list[int]:
+        with self._mlock:
+            return sorted(int(e) for e in self._read_manifest()["prepared"])
+
+    def recovery_info(self) -> tuple[int, list[int]]:
+        """(committed epoch, prepared epochs) — what this store durably
+        holds, for the session's recovery negotiation."""
+        with self._mlock:
+            m = self._read_manifest()
+        return (int(m["committed_epoch"]),
+                sorted(int(e) for e in m["prepared"]))
+
+    def settle_prepared(self, decided_epoch: int,
+                        discard_beyond: bool = True) -> None:
+        """Roll prepared epochs ≤ ``decided_epoch`` forward into the
+        committed chain. With ``discard_beyond`` (the RECOVERY path),
+        prepared epochs beyond it are DELETED — the cluster never
+        decided them, and committing them would replay rows the rest of
+        the graph does not have. The normal phase-2 path passes False:
+        with pipelined checkpoints a LATER epoch may already be durably
+        prepared when this epoch's commit frame arrives, and it must
+        survive for its own commit."""
+        victims: list[str] = []
+        with self._mlock:
+            manifest = self._read_manifest()
+            prepared = manifest["prepared"]
+            if not prepared:
+                return
+            for e in sorted(int(x) for x in prepared):
+                name = prepared[str(e)]
+                if e <= decided_epoch:
+                    prepared.pop(str(e))
+                    if name is not None:
+                        manifest["segments"].append(name)
+                    manifest["committed_epoch"] = max(
+                        manifest["committed_epoch"], e)
+                elif discard_beyond:
+                    prepared.pop(str(e))
+                    if name is not None:
+                        victims.append(name)
+            self._write_manifest(manifest)
+        for name in victims:
+            self.store.delete(name)
 
     def log_ddl(self, sql: str) -> None:
         with self._mlock:
@@ -341,27 +412,61 @@ class DurableStateStore(MemoryStateStore):
     def __init__(self, data_dir: Optional[str] = None,
                  object_store: Optional[ObjectStore] = None,
                  compact_after: Optional[int] = None,
-                 retry_policy=None):
+                 retry_policy=None,
+                 recover_at: Optional[int] = None):
         super().__init__()
         self.log = CheckpointLog(data_dir, object_store=object_store,
                                  compact_after=compact_after,
                                  retry_policy=retry_policy)
+        self._prepared_epochs: set[int] = set()
         if self.log.exists():
+            if recover_at is not None:
+                # spanning-job recovery: the session names the epoch the
+                # CLUSTER decided; prepared-but-uncommitted epochs up to
+                # it roll forward, later ones are discarded — every
+                # participant recovers the same cut
+                self.log.settle_prepared(recover_at)
             epoch, tables = self.log.load_tables()
             self._committed = tables
             self.committed_epoch = epoch
+
+    def _pending_deltas(self, epoch: int) -> dict:
+        deltas: dict[int, dict[bytes, Optional[bytes]]] = {}
+        for e in sorted(k for k in self._pending if k <= epoch):
+            for table_id, buf in self._pending[e].items():
+                deltas.setdefault(table_id, {}).update(buf)
+        return deltas
+
+    def prepare(self, epoch: int) -> None:
+        """Phase 1 of the cluster checkpoint: durably stage pending
+        deltas ≤ ``epoch`` (the in-memory view is untouched; ``commit``
+        later applies and publishes them)."""
+        if epoch <= self.committed_epoch or epoch in self._prepared_epochs:
+            return
+        from ..common.tracing import CAT_STORAGE, trace_span
+        deltas = self._pending_deltas(epoch)
+        with trace_span("DurableStateStore.prepare", CAT_STORAGE,
+                        epoch=epoch, tid="storage", tables=len(deltas)):
+            self.log.prepare_epoch(epoch, deltas)
+        self._prepared_epochs.add(epoch)
 
     def commit(self, epoch: int) -> None:
         if epoch <= self.committed_epoch:
             return
         from ..common.tracing import CAT_STORAGE, trace_span
-        deltas: dict[int, dict[bytes, Optional[bytes]]] = {}
-        for e in sorted(k for k in self._pending if k <= epoch):
-            for table_id, buf in self._pending[e].items():
-                deltas.setdefault(table_id, {}).update(buf)
-        with trace_span("DurableStateStore.commit", CAT_STORAGE,
-                        epoch=epoch, tid="storage", tables=len(deltas)):
-            self.log.append_epoch(epoch, deltas)
+        prepared = {e for e in self._prepared_epochs if e <= epoch}
+        if prepared:
+            # phase 2: promote the durably staged segment(s); epochs
+            # prepared BEYOND this commit (pipelined checkpoints) keep
+            # their staged segments for their own commit frames
+            self.log.settle_prepared(epoch, discard_beyond=False)
+            self._prepared_epochs -= prepared
+        else:
+            deltas = self._pending_deltas(epoch)
+            with trace_span("DurableStateStore.commit", CAT_STORAGE,
+                            epoch=epoch, tid="storage",
+                            tables=len(deltas)):
+                self.log.append_epoch(epoch, deltas)
         super().commit(epoch)
 
     def drop_table(self, table_id: int) -> None:

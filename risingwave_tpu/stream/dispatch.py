@@ -107,6 +107,15 @@ class PermitChannel:
         self._q.put_nowait(("ctl", None))
 
 
+def open_channel(permits: int = 32) -> PermitChannel:
+    """THE way to obtain an exchange channel outside this module. Every
+    exchange edge — in-process fragment fabric, worker-local span edges —
+    goes through here so flow-control policy stays in one place
+    (scripts/check.sh lints direct ``PermitChannel(...)`` construction
+    outside the fabric the same way raw object-store opens are linted)."""
+    return PermitChannel(permits)
+
+
 class ChannelSource(Executor):
     """Executor view of a PermitChannel's receiving end."""
 

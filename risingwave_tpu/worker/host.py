@@ -120,6 +120,7 @@ class WorkerHost:
     """One worker process: jobs + durable store + the session socket."""
 
     def __init__(self, data_dir: str, worker_id: int = 0):
+        from ..rpc.exchange import PeerClientPool
         self.data_dir = data_dir
         self.worker_id = worker_id
         # one durable store per JOB: recovery scope and id space are both
@@ -130,6 +131,12 @@ class WorkerHost:
         self.jobs: dict[str, StreamJob] = {}
         self.feeds: list[_Feed] = []
         self.channels: dict[int, _ChannelSource] = {}
+        # cross-worker exchange state (stream/remote_exchange.py): inputs
+        # fed by peer connections, worker-local span channels, and the
+        # pooled client connections toward peer workers
+        self.exchange_inputs: dict[int, object] = {}
+        self.span_chans: dict[int, object] = {}
+        self.peer_pool = PeerClientPool(worker_id)
         self.chunks_per_tick = 1
         self.chunk_capacity = 1024
         self.seed = 42
@@ -152,8 +159,19 @@ class WorkerHost:
 
     # -- job construction ------------------------------------------------------
 
+    def span_chan(self, chan: int, permits: int):
+        """Get-or-create a worker-LOCAL span edge channel (both endpoint
+        fragments of the edge live in this process). Registered by id so
+        whichever side builds first wires the same channel."""
+        ch = self.span_chans.get(chan)
+        if ch is None:
+            from ..stream.dispatch import open_channel
+            ch = open_channel(permits)
+            self.span_chans[chan] = ch
+        return ch
+
     def _source_leaf(self, leaf: PSource, job_name: str, store,
-                     next_table_id) -> Executor:
+                     next_table_id, shard_id: Optional[int] = None) -> Executor:
         src = leaf.source
         q = QueueSource(src.schema)
         from ..connector.factory import make_reader
@@ -172,8 +190,14 @@ class WorkerHost:
                 start_seq = reader.rows_emitted()
             self.feeds.append(_Feed(q, reader, st, job_name))
         ex: Executor = _RowIdAppend(q, leaf.schema)
+        # span fragments pin their shard id from the session (stable
+        # across drop-and-rebuild recovery, so replayed rows reproduce
+        # their pre-crash row ids — the exactly-once upsert condition for
+        # row-id-keyed MVs); whole-job placement keeps the process-local
+        # counter
         ex = RowIdGenExecutor(ex, row_id_index=leaf.row_id_index,
-                              shard_id=self._alloc_shard(),
+                              shard_id=(self._alloc_shard()
+                                        if shard_id is None else shard_id),
                               start_seq=start_seq)
         if src.watermark is not None:
             col, delay = src.watermark
@@ -273,6 +297,62 @@ class WorkerHost:
         return {"ok": True, "state_table_ids": ctx.state_table_ids,
                 "ids_end": next(ids)}
 
+    async def handle_create_fragments(self, req: dict) -> dict:
+        """Build this worker's fragments of a SPANNING job (the fragment
+        scheduler placed the graph across workers; exchange edges name
+        remote peers). Reference: stream_service.rs:46 build_actors — one
+        request per compute node, naming the actors it hosts."""
+        from ..stream.remote_exchange import build_fragments
+        name = req["name"]
+        if req.get("fresh"):
+            import shutil
+            shutil.rmtree(self._job_dir(name), ignore_errors=True)
+            self.stores.pop(name, None)
+        store = self.stores.get(name)
+        created_store = store is None
+        if store is None:
+            # recover_at: the cluster-decided checkpoint cut — prepared
+            # epochs ≤ it roll forward, later ones are discarded, so all
+            # participants of the span rebuild the SAME epoch
+            store = DurableStateStore(self._job_dir(name),
+                                      recover_at=req.get("recover_at"))
+            self.stores[name] = store
+        self._register_defs(req["defs"])
+        self.chunks_per_tick = req.get("chunks_per_tick", 1)
+        self.chunk_capacity = req.get("chunk_capacity", 1024)
+        self.seed = req.get("seed", 42)
+        if req.get("fault"):
+            from ..common.config import FaultConfig
+            self.fault = FaultConfig(**req["fault"])
+        feeds0 = len(self.feeds)
+        try:
+            # (build_fragments rolls its own endpoint registrations back)
+            job = build_fragments(self, req, store)
+        except Exception:
+            self.feeds = self.feeds[:feeds0]
+            if created_store:
+                # a retry must re-run recover_at against the on-disk
+                # manifest, not reuse this half-initialized instance
+                self.stores.pop(name, None)
+            raise
+        self.jobs[name] = job
+        job.start()
+        return {"ok": True,
+                "state_table_ids": job.state_table_ids}
+
+    def _release_span_job(self, job) -> None:
+        """Unregister a FragmentJob's exchange endpoints so a later
+        incarnation (recovery re-creates with FRESH channel ids) never
+        collides with stale registrations."""
+        for inp in getattr(job, "exchange_inputs", ()):
+            if self.exchange_inputs.get(inp.chan) is inp:
+                self.exchange_inputs.pop(inp.chan, None)
+            inp.put_local(None)           # unblock a parked merge recv
+        for out in getattr(job, "exchange_outputs", ()):
+            out.client.unregister(out.chan)
+        for chan in getattr(job, "local_chan_ids", ()):
+            self.span_chans.pop(chan, None)
+
     async def handle_drop_job(self, req: dict) -> dict:
         name = req["name"]
         job = self.jobs.pop(name, None)
@@ -282,10 +362,14 @@ class WorkerHost:
                            mutation=Mutation(MutationKind.STOP))
         for q in job.sources:
             q.push(stop)
-        for ch in _channel_roots(job):
-            ch.queue.put_nowait(stop)
-            self.channels.pop(ch.chan, None)
-        await job.stop()
+        if getattr(job, "spanning", False):
+            await job.stop()              # actors cancel mid-exchange
+            self._release_span_job(job)
+        else:
+            for ch in _channel_roots(job):
+                ch.queue.put_nowait(stop)
+                self.channels.pop(ch.chan, None)
+            await job.stop()
         self.feeds = [f for f in self.feeds if f.job != name]
         self.stores.pop(name, None)
         if req.get("drop_state", True):
@@ -297,12 +381,17 @@ class WorkerHost:
 
     async def handle_barrier(self, req: dict) -> None:
         """Inject this epoch into worker-driven roots, then collect all
-        in-scope jobs and ack. Runs as its own task so data frames keep
-        flowing while executors work (barrier pipelining)."""
+        in-scope jobs and ack with a PER-JOB failure map. Runs as its own
+        task so data frames keep flowing while executors work (barrier
+        pipelining). ``exclude`` names jobs the session already declared
+        dead (a spanning job with a killed peer): they must be neither
+        fed nor waited on — one starved job must not wedge this worker's
+        healthy jobs."""
         epoch = int(req["epoch"])
         checkpoint = bool(req.get("checkpoint", False))
         only = req.get("only")
         scope = set(only) if only is not None else set(self.jobs)
+        scope -= set(req.get("exclude") or ())
         mut = None
         if req.get("mutation"):
             mut = Mutation(MutationKind(req["mutation"]),
@@ -323,26 +412,38 @@ class WorkerHost:
         if req.get("init", False):
             # init cut for a just-created job: its channel roots have no
             # live upstream stream yet, so the barrier is injected locally
+            # (span fragments skip this — their exchange inputs have live
+            # peers and the init barrier arrives over the wire)
             for name in scope:
                 job = self.jobs.get(name)
-                if job is not None:
+                if job is not None and not getattr(job, "spanning", False):
                     for ch in _channel_roots(job):
                         ch.queue.put_nowait(barrier)
-        try:
-            from ..common.tracing import CAT_EPOCH, trace_span
-            with trace_span("barrier.collect", CAT_EPOCH, epoch=epoch,
-                            tid="conductor", checkpoint=checkpoint):
-                for name in scope:
-                    job = self.jobs.get(name)
-                    if job is not None:
-                        await job.wait_barrier(epoch)
-        except BaseException as e:   # noqa: BLE001 - surfaced to the session
-            await self.send({"type": "barrier_complete", "epoch": epoch,
-                             "ok": False, "error": repr(e)})
-            raise
+        failed: dict[str, str] = {}
+
+        async def collect(name: str, job) -> None:
+            from ..rpc.exchange import PeerLost
+            try:
+                await job.wait_barrier(epoch)
+            except PeerLost as e:
+                failed[name] = f"PEER_LOST: {e}"
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 - shipped per job
+                if isinstance(getattr(job, "_failure", None), PeerLost):
+                    failed[name] = f"PEER_LOST: {job._failure}"
+                else:
+                    failed[name] = repr(e)
+
+        from ..common.tracing import CAT_EPOCH, trace_span
+        with trace_span("barrier.collect", CAT_EPOCH, epoch=epoch,
+                        tid="conductor", checkpoint=checkpoint):
+            await asyncio.gather(
+                *(collect(n, self.jobs[n]) for n in scope
+                  if n in self.jobs))
         if checkpoint:
             for feed in self.feeds:
-                if feed.job not in scope:
+                if feed.job not in scope or feed.job in failed:
                     continue
                 latest = None
                 for oe in sorted(list(feed.offsets_at_epoch)):
@@ -353,8 +454,37 @@ class WorkerHost:
                         feed.state_table.insert(
                             (VARCHAR.to_physical(sid), int(off)))
                     feed.state_table.commit(epoch)
+            # spanning jobs: phase 1 of the cluster 2PC — this ack asserts
+            # the epoch is DURABLY staged (state + offsets), so a kill
+            # between ack and the session's commit frame can be rolled
+            # FORWARD at recovery to the epoch the peers committed
+            for name in scope:
+                job = self.jobs.get(name)
+                if job is None or name in failed \
+                        or not getattr(job, "spanning", False):
+                    continue
+                store = self.stores.get(name)
+                if store is not None:
+                    store.prepare(epoch)
         await self.send({"type": "barrier_complete", "epoch": epoch,
+                         "failed": failed,
                          "init": bool(req.get("init", False))})
+
+    def handle_job_epochs(self, req: dict) -> dict:
+        """Recovery negotiation: what this worker durably holds for one
+        job — its committed epoch and any prepared-but-uncommitted
+        epochs. The session takes the MAX committed across participants
+        as the decided cut and every store settles to it (roll forward
+        or discard) via ``create_fragments``' ``recover_at``."""
+        from ..storage.checkpoint import CheckpointLog
+        name = req["name"]
+        store = self.stores.get(name)
+        log = store.log if store is not None \
+            else CheckpointLog(self._job_dir(name))
+        if not log.exists():
+            return {"ok": True, "committed": 0, "prepared": []}
+        committed, prepared = log.recovery_info()
+        return {"ok": True, "committed": committed, "prepared": prepared}
 
     # -- distributed batch stage ----------------------------------------------
 
@@ -415,11 +545,16 @@ class WorkerHost:
             if len(self._span_outbox) > cap:
                 del self._span_outbox[:-cap]
             self._span_seq += 1
+        from ..stream.remote_exchange import exchange_stats
         return {
             "ok": True, "worker_id": self.worker_id,
             "jobs": jobs, "trees": trees, "state_bytes": state_bytes,
             "queue_depths": {str(c): ch.queue.qsize()
                              for c, ch in self.channels.items()},
+            # per-exchange-edge counters (permits waited, chunks/bytes
+            # forwarded, backlog) for every cross-worker edge endpoint
+            # this process hosts — federated into metrics()["exchange"]
+            "exchange": exchange_stats(self),
             "spans": list(self._span_outbox), "span_seq": self._span_seq,
         }
 
@@ -452,12 +587,58 @@ class WorkerHost:
         await self.send(resp)
 
     async def handle_conn(self, reader: asyncio.StreamReader,
-                          writer: asyncio.StreamWriter) -> None:
-        self._writer = writer
-        tasks: list[asyncio.Task] = []
+                          writer: asyncio.StreamWriter) -> str:
+        """Dispatch a fresh inbound connection: the session's control
+        socket, or a PEER worker's exchange socket (first frame is its
+        ``exg_hello``). Returns which kind this was so the server only
+        exits when the SESSION goes away."""
+        first = await read_frame(reader)
+        if first is None:
+            # closed before identifying itself: a peer killed between
+            # connect and its exg_hello, or a port probe. Treating it as
+            # the session would clobber the real session's writer and
+            # self-terminate a healthy worker.
+            writer.close()
+            return "empty"
+        if first.get("type") == "exg_hello":
+            await self._handle_peer_conn(reader, writer, first)
+            return "peer"
+        await self._handle_session_conn(reader, writer, first)
+        return "session"
+
+    async def _handle_peer_conn(self, reader, writer, hello: dict) -> None:
+        """Exchange data plane from one peer worker: route exg_data
+        frames to their registered inputs; the same socket carries the
+        consumption acks back (reference: exchange_service.rs:74-133).
+        On disconnect every edge fed by this peer is failed loudly —
+        a silently starved merge would wedge barrier collection."""
+        wlock = asyncio.Lock()
+        fed: set[int] = set()
         try:
             while True:
                 frame = await read_frame(reader)
+                if frame is None:
+                    break
+                if frame.get("type") == "exg_data":
+                    chan = frame["chan"]
+                    inp = self.exchange_inputs.get(chan)
+                    if inp is not None:
+                        fed.add(chan)
+                        inp.feed_wire(frame["msg"], writer, wlock)
+        finally:
+            for chan in fed:
+                inp = self.exchange_inputs.get(chan)
+                if inp is not None:
+                    inp.peer_lost()
+            writer.close()
+
+    async def _handle_session_conn(self, reader, writer,
+                                   first: Optional[dict]) -> None:
+        self._writer = writer
+        tasks: list[asyncio.Task] = []
+        frame = first
+        try:
+            while True:
                 if frame is None:
                     break                        # session died: exit
                 t = frame["type"]
@@ -470,11 +651,22 @@ class WorkerHost:
                         asyncio.ensure_future(self.handle_barrier(frame)))
                 elif t == "commit":
                     # phase 2 of the cluster checkpoint: every job's
-                    # staged state for the epoch becomes durable
-                    for store in self.stores.values():
-                        store.commit(int(frame["epoch"]))
+                    # staged state for the epoch becomes durable —
+                    # except jobs the session excludes (a spanning job
+                    # with a dead peer must not have its SURVIVING
+                    # fragments' torn epochs committed under it)
+                    skip = set(frame.get("skip_jobs") or ())
+                    for jname, store in self.stores.items():
+                        if jname not in skip:
+                            store.commit(int(frame["epoch"]))
                 elif t == "create_job":
                     await self._reply(frame, self.handle_create_job)
+                elif t == "create_fragments":
+                    await self._reply(frame, self.handle_create_fragments)
+                elif t == "job_epochs":
+                    async def _je(f):
+                        return self.handle_job_epochs(f)
+                    await self._reply(frame, _je)
                 elif t == "drop_job":
                     await self._reply(frame, self.handle_drop_job)
                 elif t == "scan":
@@ -498,6 +690,7 @@ class WorkerHost:
                                      "rid": frame.get("rid"),
                                      "ok": False,
                                      "error": f"unknown frame {t!r}"})
+                frame = await read_frame(reader)
         finally:
             for t in tasks:
                 if not t.done():
@@ -531,9 +724,21 @@ async def amain(data_dir: str, worker_id: int, port: int) -> None:
     done = asyncio.Event()
 
     async def conn(reader, writer):
+        kind = None
         try:
-            await host.handle_conn(reader, writer)
+            kind = await host.handle_conn(reader, writer)
         finally:
+            # peer (worker↔worker exchange) connections come and go with
+            # jobs. Losing the SESSION's control socket — or an
+            # unexpected handler crash (kind still None) — ends the
+            # process. An "empty" close (no frame before EOF) is a stray
+            # probe IF a session already attached; before any session
+            # ever attached it can only be the spawning session dying
+            # mid-connect — exit rather than orphan the process.
+            if kind == "peer":
+                return
+            if kind == "empty" and host._writer is not None:
+                return
             done.set()
 
     server = await asyncio.start_server(conn, "127.0.0.1", port)

@@ -50,6 +50,28 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_cli_fragments.py \
     "$@"
 
+echo "== distribution tests (cross-worker fragment graphs) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_distributed.py \
+    tests/test_multiprocess.py \
+    "$@"
+
+echo "== exchange-boundary lint =="
+# Every exchange edge must go through the dispatch fabric
+# (stream/dispatch.py open_channel / the frontend fragment builders) or
+# the remote-exchange subsystem. A raw PermitChannel(...) anywhere else
+# means some module wired its own flow control outside the subsystem
+# boundary — reject it (same shape as the raw-object-store lint below).
+bad=$(grep -rn "PermitChannel(" risingwave_tpu --include='*.py' \
+      | grep -v "risingwave_tpu/stream/dispatch.py" \
+      | grep -v "risingwave_tpu/frontend/fragments.py" || true)
+if [ -n "$bad" ]; then
+    echo "raw exchange-channel construction outside the dispatch fabric:"
+    echo "$bad"
+    exit 1
+fi
+echo "exchange-boundary lint: OK"
+
 echo "== boundary-IO lint =="
 # Every durable-tier consumer must open its store via
 # open_object_store/wrap_object_store (the retry boundary). A raw

@@ -1,0 +1,384 @@
+"""Distributed dataflow plane: ONE streaming job's fragment graph
+spanning multiple worker processes, with exchange edges crossing the
+wire protocol (VERDICT r5 tentpole).
+
+What these tests pin:
+  * NEXmark q5 / q7 MVs deploy as fragment graphs over 2 workers
+    (vnode-mapped placement, sharded agg fragments, remote merge) and
+    stay BIT-EXACT against the single-process pipeline at every epoch
+    boundary — including the retraction churn grouped aggs emit (U-/U+
+    pairs crossing hash exchanges under the update-pair split rule);
+  * kill -9 of one participating worker (root or not) trips PEER_LOST /
+    heartbeat-TTL scoped recovery: only the affected fragment graph is
+    rebuilt from its per-worker durable state, sources replay the gap,
+    and the result matches an uninterrupted control run (exactly-once
+    across the remote edges, two-phase checkpoint end-to-end);
+  * placement persists in the meta store and a restarted session
+    re-places the SAME fragments onto the SAME workers;
+  * per-exchange-edge counters surface in metrics()/Prometheus.
+
+The parity harness pins the schedule the way test_interval_join.py does:
+both sides run the same generate cadence and are compared at quiesced
+epoch boundaries (mv_rows drains in-flight barriers), where streaming
+state is schedule-independent.
+
+Reference: exchange_service.rs:74-133, exchange/permit.rs:35-107,
+stream_graph placement + scale.rs vnode mappings, recovery.rs:110.
+"""
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.build import BuildConfig
+
+CAP = 64
+
+BID_DDL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,
+channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)
+WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+Q5 = """CREATE MATERIALIZED VIEW q5 AS
+    SELECT AuctionBids.auction, AuctionBids.num FROM (
+        SELECT bid.auction, count(*) AS num, window_start AS starttime
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY window_start, bid.auction
+    ) AS AuctionBids
+    JOIN (
+        SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+        FROM (
+            SELECT count(*) AS num, window_start AS starttime_c
+            FROM HOP(bid, date_time, INTERVAL '2' SECOND,
+                     INTERVAL '10' SECOND)
+            GROUP BY bid.auction, window_start
+        ) AS CountBids
+        GROUP BY CountBids.starttime_c
+    ) AS MaxBids
+    ON AuctionBids.starttime = MaxBids.starttime_c
+       AND AuctionBids.num = MaxBids.maxn"""
+
+Q7 = """CREATE MATERIALIZED VIEW q7 AS
+    SELECT B.auction, B.price, B.bidder, B.date_time
+    FROM bid B
+    JOIN (
+        SELECT MAX(price) AS maxprice, window_end as date_time
+        FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+        GROUP BY window_end
+    ) B1 ON B.price = B1.maxprice
+    WHERE B.date_time BETWEEN B1.date_time - INTERVAL '10' SECOND
+          AND B1.date_time"""
+
+AGG = ("CREATE MATERIALIZED VIEW q AS SELECT auction, count(*) AS n, "
+       "max(price) AS mx FROM bid GROUP BY auction")
+
+
+def spanning_session(seed=42, data_dir=None, parallelism=2) -> Session:
+    return Session(workers=2, seed=seed, data_dir=data_dir,
+                   source_chunk_capacity=CAP,
+                   config=BuildConfig(fragment_parallelism=parallelism))
+
+
+def local_run(mv_sql: str, name: str, ticks: int, seed=42) -> list:
+    s = Session(seed=seed, source_chunk_capacity=CAP)
+    s.run_sql(BID_DDL)
+    s.run_sql(mv_sql)
+    rows = []
+    for _ in range(ticks):
+        s.tick()
+    s.flush()
+    rows = sorted(s.mv_rows(name))
+    s.close()
+    return rows
+
+
+class TestSpanningParity:
+    def test_q5_spans_two_workers_bit_exact_per_epoch(self):
+        """q5 (join of two sharded hop-window aggs) as a 6-fragment graph
+        over 2 workers: every hash fragment's actors own disjoint vnode
+        ranges on DIFFERENT workers, and the MV is bit-exact vs the
+        single-process pipeline at EVERY epoch boundary."""
+        s = spanning_session()
+        s.run_sql(BID_DDL)
+        s.run_sql(Q5)
+        assert "q5" in s._spanning_specs, "q5 did not deploy as a span"
+        placement = s._spanning_specs["q5"]["placement"]
+        assert len(placement.workers()) == 2
+        # at least one fragment is sharded: actors on distinct workers
+        # with complementary vnode ranges
+        sharded = [acts for acts in placement.actors.values()
+                   if len(acts) == 2]
+        assert sharded, "no fragment was vnode-sharded across workers"
+        for acts in sharded:
+            assert {a.worker for a in acts} == set(placement.workers())
+            assert acts[0].vnode_end == acts[1].vnode_start
+            assert (acts[0].vnode_start, acts[1].vnode_end) == (0, 256)
+
+        control = Session(seed=42, source_chunk_capacity=CAP)
+        control.run_sql(BID_DDL)
+        control.run_sql(Q5)
+        try:
+            for _ in range(3):
+                s.tick()
+                control.tick()
+                assert sorted(s.mv_rows("q5")) == \
+                    sorted(control.mv_rows("q5"))
+            s.flush()
+            control.flush()
+            got = sorted(s.mv_rows("q5"))
+            assert got == sorted(control.mv_rows("q5"))
+            assert len(got) > 0
+        finally:
+            s.close()
+            control.close()
+
+    def test_retraction_churn_crosses_exchanges(self):
+        """Grouped agg over a live stream: every new bid RETRACTS the
+        group's previous (count, max) row — those U-/U+ pairs cross the
+        hash exchange (update-pair split rule) and the remote merge.
+        Bit-exact per epoch against the in-process pipeline."""
+        s = spanning_session(seed=11)
+        s.run_sql(BID_DDL)
+        s.run_sql(AGG)
+        assert "q" in s._spanning_specs
+        control = Session(seed=11, source_chunk_capacity=CAP)
+        control.run_sql(BID_DDL)
+        control.run_sql(AGG)
+        try:
+            for _ in range(4):
+                s.tick()
+                control.tick()
+                assert sorted(s.mv_rows("q")) == sorted(control.mv_rows("q"))
+            # retractions actually happened: groups were updated in place
+            rows = s.mv_rows("q")
+            assert any(n > 1 for _, n, _ in rows)
+        finally:
+            s.close()
+            control.close()
+
+
+class TestSpanningRecovery:
+    def test_q5_kill9_participant_exactly_once(self, tmp_path):
+        """checkpoint → kill -9 one NON-root participant → scoped
+        recovery (respawn + rebuild ONLY this fragment graph from
+        per-worker durable state) → converge bit-exact with an
+        uninterrupted control run. Barriers commit exactly-once across
+        the remote edges: the torn epoch is never committed."""
+        s = spanning_session(seed=7, data_dir=str(tmp_path / "c"))
+        s.run_sql(BID_DDL)
+        s.run_sql(Q5)
+        spec = s._spanning_specs["q5"]
+        victim = [w for w in spec["workers"]
+                  if w is not spec["root_worker"]][0]
+        for _ in range(2):
+            s.tick()
+        s.flush()                          # checkpoint cut
+        _ = s.mv_rows("q5")
+        pid0 = victim.proc.pid
+        victim.kill9()
+        for _ in range(12):                # TTL + scoped rebuild in-tick
+            s.tick()
+            if not victim.dead and s.jobs["q5"]._failure is None:
+                break
+        assert not victim.dead, "participant was not respawned"
+        assert victim.proc.pid != pid0
+        for _ in range(2):
+            s.tick()
+        s.flush()
+        got = sorted(s.mv_rows("q5"))
+        s.close()
+        # effective generate ticks: 2 pre-kill (committed by the flush)
+        # + 2 post-recovery; dead-window ticks feed the job nothing and
+        # the uncommitted pre-death generate replays from the seek
+        assert got == local_run(Q5, "q5", ticks=4, seed=7)
+
+    def test_q7_kill9_root_worker_exactly_once(self, tmp_path):
+        """Same cycle killing the ROOT worker (hosts the materialize):
+        q7's join output is keyed by the bid row ids, so replay must
+        reproduce the SAME hidden row ids (pinned shard ids) or rows
+        would duplicate."""
+        s = spanning_session(seed=42, data_dir=str(tmp_path / "c"))
+        s.run_sql(BID_DDL)
+        s.run_sql(Q7)
+        spec = s._spanning_specs["q7"]
+        root = spec["root_worker"]
+        for _ in range(3):
+            s.tick()
+        s.flush()
+        _ = s.mv_rows("q7")
+        root.kill9()
+        for _ in range(12):
+            s.tick()
+            if not root.dead and s.jobs["q7"]._failure is None:
+                break
+        assert not root.dead, "root worker was not respawned"
+        for _ in range(3):
+            s.tick()
+        s.flush()
+        got = sorted(s.mv_rows("q7"))
+        s.close()
+        want = local_run(Q7, "q7", ticks=6, seed=42)
+        assert got == want and len(got) > 0
+
+    def test_sim_chaos_spanning_kill_converges(self, tmp_path):
+        """sim.py chaos menu entry: kill one worker of a spanning
+        fragment graph mid-workload; the cluster converges and the final
+        MV matches a never-killed control session."""
+        from risingwave_tpu.sim import SimCluster
+        sim = SimCluster(str(tmp_path / "chaos"), seed=3, kill_rate=0.0,
+                         workers=2, source_chunk_capacity=CAP,
+                         config=BuildConfig(fragment_parallelism=2))
+        control = Session(seed=42, source_chunk_capacity=CAP,
+                          checkpoint_frequency=2)
+        try:
+            for sess in (sim.session, control):
+                sess.run_sql(BID_DDL)
+                sess.run_sql(AGG)
+            assert "q" in sim.session._spanning_specs
+            for _ in range(2):
+                sim.tick()
+                control.tick()
+            sim.flush()                    # committed == generated
+            control.flush()
+            sim.kill_spanning_worker()     # in-tick TTL + scoped rebuild
+            for _ in range(2):             # aligned post-recovery load
+                sim.tick()
+                control.tick()
+            sim.verify_against(control, ["q"])
+            assert sim.spanning_kills == 1
+        finally:
+            sim.session.close()
+            control.close()
+
+
+class TestTwoPhasePrepare:
+    """Durable phase 1 of the cluster checkpoint (CheckpointLog
+    prepare/settle): the machinery that keeps a spanning job's cut
+    consistent across independent per-worker stores."""
+
+    def test_pipelined_prepares_survive_earlier_commit(self, tmp_path):
+        """Phase-2 promotion of epoch N must NOT discard epoch N+1's
+        durably prepared segment — with pipelined checkpoints both are
+        staged before either commit frame arrives."""
+        from risingwave_tpu.storage.checkpoint import DurableStateStore
+        d = str(tmp_path / "s")
+        st = DurableStateStore(d)
+        st.ingest(7, 1, {b"k1": b"v1"}, set())
+        st.prepare(1)
+        st.ingest(7, 2, {b"k2": b"v2"}, set())
+        st.prepare(2)
+        st.commit(1)
+        assert st.log.prepared_epochs() == [2], \
+            "commit(1) destroyed the pipelined prepare of epoch 2"
+        st.commit(2)
+        assert st.log.prepared_epochs() == []
+        re = DurableStateStore(d)
+        assert re.committed_epoch == 2
+        assert re.committed_view(7) == {b"k1": b"v1", b"k2": b"v2"}
+
+    def test_recovery_rolls_forward_and_discards(self, tmp_path):
+        """A participant killed between ack and commit settles on the
+        cluster-decided epoch: prepared ≤ decided rolls forward,
+        prepared > decided is discarded (never decided)."""
+        from risingwave_tpu.storage.checkpoint import DurableStateStore
+        d = str(tmp_path / "s")
+        st = DurableStateStore(d)
+        st.ingest(7, 1, {b"k1": b"v1"}, set())
+        st.prepare(1)
+        st.ingest(7, 2, {b"k2": b"v2"}, set())
+        st.prepare(2)
+        # process dies here; the cluster decided epoch 1
+        re = DurableStateStore(d, recover_at=1)
+        assert re.committed_epoch == 1
+        assert re.committed_view(7) == {b"k1": b"v1"}
+        assert re.log.prepared_epochs() == []
+        committed, prepared = re.log.recovery_info()
+        assert (committed, prepared) == (1, [])
+
+
+class TestSpanningOps:
+    def test_placement_persists_and_restart_reuses_it(self, tmp_path):
+        d = str(tmp_path / "c")
+        s = spanning_session(seed=7, data_dir=d)
+        s.run_sql(BID_DDL)
+        s.run_sql(AGG)
+        p1 = {fid: [(a.actor, a.worker, a.vnode_start, a.vnode_end)
+                    for a in acts]
+              for fid, acts in
+              s._spanning_specs["q"]["placement"].actors.items()}
+        assert s.meta.load_placement("q") is not None
+        for _ in range(3):
+            s.tick()
+        s.flush()
+        r1 = sorted(s.mv_rows("q"))
+        s.close()
+        s2 = spanning_session(seed=7, data_dir=d)
+        try:
+            assert "q" in s2._spanning_specs, "restart lost the span"
+            p2 = {fid: [(a.actor, a.worker, a.vnode_start, a.vnode_end)
+                        for a in acts]
+                  for fid, acts in
+                  s2._spanning_specs["q"]["placement"].actors.items()}
+            assert p1 == p2, "restart re-placed fragments elsewhere"
+            assert sorted(s2.mv_rows("q")) == r1
+            s2.run_sql("DROP MATERIALIZED VIEW q")
+            assert "q" not in s2._spanning_specs
+            assert s2.meta.load_placement("q") is None
+        finally:
+            s2.close()
+
+    def test_exchange_counters_in_metrics_and_prometheus(self):
+        from risingwave_tpu.frontend.prometheus import render_metrics
+        s = spanning_session(seed=11)
+        s.run_sql(BID_DDL)
+        s.run_sql(AGG)
+        for _ in range(3):
+            s.tick()
+        s.flush()
+        try:
+            edges = s.metrics()["exchange"]
+            assert edges, "no exchange edges reported"
+            outs = [e for e in edges if e["dir"] == "out"]
+            ins = [e for e in edges if e["dir"] == "in"]
+            assert outs and ins
+            assert all(set(e) >= {"edge", "chunks", "bytes",
+                                  "permits_waited", "backlog", "worker"}
+                       for e in edges)
+            assert sum(e["chunks"] for e in outs) > 0
+            assert sum(e["bytes"] for e in outs) > 0
+            # both endpoints of one edge agree on delivered chunks
+            by_edge = {e["edge"]: e for e in outs}
+            for e in ins:
+                if e["edge"] in by_edge:
+                    assert e["chunks"] == by_edge[e["edge"]]["chunks"]
+            text = render_metrics(s)
+            assert "rw_exchange_stat" in text
+        finally:
+            s.close()
+
+    def test_table_fed_mv_falls_back_to_whole_job(self):
+        """Scan-fed plans keep the session-bus forwarder path: with 2
+        workers a table-fed MV still deploys whole onto one worker."""
+        s = spanning_session(seed=5)
+        try:
+            s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+            s.run_sql("CREATE MATERIALIZED VIEW m AS "
+                      "SELECT k, v * 2 AS d FROM t")
+            assert "m" in s._remote_specs
+            assert "m" not in s._spanning_specs
+            s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+            s.flush()
+            assert sorted(s.mv_rows("m")) == [(1, 20), (2, 40)]
+        finally:
+            s.close()
+
+    def test_ctl_cluster_fragments_dumps_placement(self, tmp_path, capsys):
+        d = str(tmp_path / "c")
+        s = spanning_session(seed=7, data_dir=d)
+        s.run_sql(BID_DDL)
+        s.run_sql(AGG)
+        s.tick()
+        s.flush()
+        s.close()
+        from risingwave_tpu.cli import main as cli_main
+        rc = cli_main(["ctl", "cluster", "fragments", "--data-dir", d])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "-- q" in out and "Fragment" in out and "vnodes" in out
+        assert "live exchange edges" in out
