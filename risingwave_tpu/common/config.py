@@ -30,6 +30,12 @@ class StreamingConfig:
     # >1 builds multi-fragment jobs with hash-dispatch exchanges
     # (frontend/fragments.py; reference: streaming.default_parallelism)
     fragment_parallelism: int = 1
+    # epoch co-scheduling (stream/coschedule.py): eligible MVs (NEXmark
+    # bid source → projection → grouped agg) created while this is true
+    # are batched into ONE fused XLA dispatch per tick for the whole
+    # group instead of one executor pipeline each; ineligible shapes
+    # fall back to the solo executor path (docs/performance.md)
+    coschedule: bool = False
     # observability (common/tracing.py): span ring size per process, and
     # the slow-epoch detector — an epoch whose inject→collect latency
     # meets the threshold gets its span tree snapshotted for post-hoc

@@ -1,0 +1,96 @@
+"""TPC-H order/lineitem event stream generated ON DEVICE — the q3 bench
+source (reference workload: e2e_test/tpch/ streaming q3).
+
+Same design as ``DeviceBidGenerator`` (connector/nexmark.py): the datagen
+is a compute kernel, so fused epochs pay two scalars of host→device
+traffic per epoch. Events interleave one ORDER row followed by
+``lineitems_per_order`` LINEITEM rows of that order (orders always
+precede their lineitems — the stream-order guarantee
+``ops/stream_q3.Q3Core`` relies on). All attribute randomness is
+counter-based splitmix64 hashing of the event/order id, so generation is
+deterministic and replayable from the event id alone (no PRNG key
+threading needed; the ``key`` argument of ``chunk_fn`` is accepted and
+ignored for interface parity with the NEXmark source).
+
+Value distributions (synthetic, selectivity-tuned rather than
+spec-exact): o_orderdate uniform in [cutoff-30, cutoff+30) days — the
+``o_orderdate < cutoff`` filter passes ~50%; o_mktsegment uniform over 5
+segments (segment 0 = 'BUILDING', ~20% pass); l_shipdate = o_orderdate +
+[-10, 40) days; prices in cents, discounts in basis points (int64
+end-to-end — see stream_q3.py on integral money)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.hashing import _splitmix64
+from ..common.types import INT64, Schema
+
+#: unified order/lineitem event schema (kind 0 = order, 1 = lineitem;
+#: order rows zero the l_* columns and vice versa)
+Q3_EVENT_SCHEMA = Schema.of(
+    ("kind", INT64), ("orderkey", INT64), ("o_orderdate", INT64),
+    ("o_shippriority", INT64), ("o_mktsegment", INT64),
+    ("l_extendedprice", INT64), ("l_discount_bp", INT64),
+    ("l_shipdate", INT64),
+)
+
+#: 1995-03-15 as days since the unix epoch — the q3 date parameter
+Q3_CUTOFF_DAYS = 9204
+
+
+@dataclasses.dataclass
+class TpchQ3Config:
+    chunk_capacity: int = 1024
+    lineitems_per_order: int = 3
+    cutoff_days: int = Q3_CUTOFF_DAYS
+    n_segments: int = 5            # o_mktsegment ∈ [0, n_segments)
+
+
+class DeviceQ3Generator:
+    """Traceable q3 event chunks; compose ``chunk_fn()`` inside a fused
+    epoch (ops/fused_epoch.fused_source_q3_epoch)."""
+
+    def __init__(self, config: TpchQ3Config = TpchQ3Config()):
+        self.cfg = config
+
+    def chunk_fn(self):
+        cfg = self.cfg
+        cap = cfg.chunk_capacity
+        gsize = 1 + cfg.lineitems_per_order
+
+        def fn(start, key=None):
+            eids = start + jnp.arange(cap, dtype=jnp.int64)
+            g = eids // gsize                       # orderkey
+            pos = eids % gsize
+            kind = (pos != 0).astype(jnp.int64)
+            ho = _splitmix64(g.astype(jnp.uint64)).astype(jnp.int64)
+            ho = ho & jnp.int64(0x7FFFFFFFFFFFFFFF)
+            odate = cfg.cutoff_days - 30 + (ho % 60)
+            mkt = (ho >> 8) % cfg.n_segments
+            prio = (ho >> 16) % 3
+            hl = _splitmix64((eids + jnp.int64(0x9E37)).astype(
+                jnp.uint64)).astype(jnp.int64)
+            hl = hl & jnp.int64(0x7FFFFFFFFFFFFFFF)
+            price = 100_00 + hl % 9_000_00          # cents
+            disc = (hl >> 20) % 1001                # basis points, ≤ 10%
+            ship = odate + ((hl >> 40) % 50) - 10
+            is_li = kind == 1
+
+            def mk(vals, on):
+                return Column(jnp.where(on, vals, 0),
+                              jnp.ones(cap, jnp.bool_))
+
+            cols = (
+                Column(kind, jnp.ones(cap, jnp.bool_)),
+                Column(g, jnp.ones(cap, jnp.bool_)),
+                mk(odate, ~is_li), mk(prio, ~is_li), mk(mkt, ~is_li),
+                mk(price, is_li), mk(disc, is_li), mk(ship, is_li),
+            )
+            return StreamChunk(jnp.zeros(cap, jnp.int8),
+                               jnp.ones(cap, jnp.bool_), cols)
+
+        return fn

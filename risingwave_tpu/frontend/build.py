@@ -58,6 +58,12 @@ class BuildConfig:
     # concurrency vs device sharding); ignored for batch builds.
     fragment_parallelism: int = 1
     exchange_permits: int = 32
+    # Epoch co-scheduling (stream/coschedule.py): CREATE MATERIALIZED
+    # VIEW routes eligible source+agg plans into a fused multi-job
+    # dispatch group — K co-scheduled MVs tick in ONE jit dispatch.
+    # Opt-in ([streaming] coschedule = true); ineligible shapes build
+    # the normal executor pipeline.
+    coschedule: bool = False
     # HBM pressure: cap on live groups per grouped-agg executor; coldest
     # groups evict to the state table at checkpoints and fault back in on
     # access (reference: cache/managed_lru.rs). None = grow-or-raise.

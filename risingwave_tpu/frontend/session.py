@@ -238,7 +238,8 @@ class Session:
                 join_key_capacity=st.join_key_capacity,
                 join_bucket_width=st.join_bucket_width,
                 topn_table_capacity=st.topn_table_capacity,
-                fragment_parallelism=st.fragment_parallelism)
+                fragment_parallelism=st.fragment_parallelism,
+                coschedule=st.coschedule)
         # fault-tolerance knobs for every external boundary (object-store
         # retry, sink degrade, broker reconnect, worker deadlines) —
         # common/config.py FaultConfig; explicit fault_config wins over
@@ -334,6 +335,14 @@ class Session:
         # would instantly expire every worker registered at clock 0
         self.meta.advance_epoch_clock(self.epoch)
         self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
+        # epoch co-scheduler: eligible MVs' epochs batched into one
+        # dispatch per tick (stream/coschedule.py; [streaming]
+        # coschedule = true). Engines map job -> (flush HashAggExecutor,
+        # output queue, device source cursor).
+        from ..stream.coschedule import CoScheduler
+        self._cosched = CoScheduler()
+        self._cosched_engines: dict[str, tuple] = {}
+        self._cosched_markers: set[str] = set()
         self.feeds: list[_SourceFeed] = []
         self.backfills: list[_BackfillRef] = []
         # DML rendezvous (reference: DmlManager, src/source/src/
@@ -434,12 +443,21 @@ class Session:
         resched_cfg: dict[str, object] = {}
         for piece in ddl:
             line = piece.strip()
+            if line.startswith("-- coschedule"):
+                # the job was built as a co-scheduled fused group member
+                # (stream/coschedule.py); its durable layout only decodes
+                # on that path — _create_mv refuses a mismatched replay
+                self._cosched_markers.add(
+                    line[len("-- coschedule"):].strip())
+                continue
             if not line.startswith("-- reschedule"):
-                if resched_cfg and "drop" in line.lower():
+                if (resched_cfg or self._cosched_markers) \
+                        and "drop" in line.lower():
                     try:
                         for stmt in parse_sql(piece):
                             if isinstance(stmt, A.DropStatement):
                                 resched_cfg.pop(stmt.name, None)
+                                self._cosched_markers.discard(stmt.name)
                     except Exception:  # noqa: BLE001 - replay parses below
                         pass
                 continue
@@ -467,7 +485,8 @@ class Session:
         self._recovering = True
         try:
             for piece in ddl:
-                if piece.strip().startswith("-- reschedule"):
+                if piece.strip().startswith(("-- reschedule",
+                                             "-- coschedule")):
                     continue
                 for stmt in parse_sql(piece):
                     name = getattr(stmt, "name", None)
@@ -706,12 +725,15 @@ class Session:
         self.last_select_schema = [("QUERY PLAN", VARCHAR)]
         return [(line,) for line in plan.explain().split("\n")]
 
-    def _build_query_pipeline(self, query: A.Select):
+    def _build_query_pipeline(self, query: A.Select, plan=None):
         """Shared CREATE MV / CREATE SINK AS SELECT plumbing: plan, build
         executors via the stream-leaf factory, collect session-driven
         queues + their init feeds and (under recovery) the scan leaves
-        whose backfill may need re-running."""
-        plan = self._plan(query, lenient=self._recovering)
+        whose backfill may need re-running. ``plan`` reuses a plan the
+        caller already built (the coschedule match) instead of planning
+        the same query twice."""
+        if plan is None:
+            plan = self._plan(query, lenient=self._recovering)
         queues: list[QueueSource] = []
         init_msgs: list[tuple[QueueSource, list[Message]]] = []
         scan_leaf_queues: list[tuple[list, StreamJob]] = []
@@ -821,11 +843,36 @@ class Session:
                     "fragment graph; restart with the same multi-worker "
                     "topology (or DROP and re-CREATE it)")
             return self._create_mv_remote(stmt)
+        cosched_plan = None
+        if not pk_prefix and getattr(self.config, "coschedule", False) \
+                and self.config.mesh is None \
+                and self.config.fragment_parallelism <= 1 \
+                and self.config.agg_hbm_budget is None \
+                and (not self._recovering
+                     or stmt.name in self._cosched_markers):
+            # agg_hbm_budget: the co-scheduled flush has no eviction
+            # path, so budgeted configs stay on the executor pipeline.
+            # Recovery gate: a solo-created MV's table-id layout differs
+            # from the co-scheduled one — replay it down the path that
+            # wrote it, marker-directed in BOTH directions.
+            res, cosched_plan = self._try_coschedule_mv(stmt)
+            if res is not None:
+                return res
+        if self._recovering and stmt.name in self._cosched_markers:
+            # the durable agg/split tables were laid out by the
+            # co-scheduled builder; decoding them through the executor
+            # path would shift table ids — refuse loudly
+            raise SqlError(
+                f"MV {stmt.name!r} was created co-scheduled; reopen the "
+                "session with [streaming] coschedule = true and a "
+                "co-schedulable config (no mesh, fragment_parallelism 1, "
+                "no agg_hbm_budget) — or DROP and re-CREATE it")
         n_feeds0 = len(self.feeds)
         n_bf0 = len(self.backfills)
         id0 = self.catalog._next_table_id   # for reschedule id replay
         (plan, pipeline, ctx, queues, init_msgs,
-         scan_leaf_queues) = self._build_query_pipeline(stmt.query)
+         scan_leaf_queues) = self._build_query_pipeline(
+            stmt.query, plan=cosched_plan)
         mv_table_id = self.catalog.next_table_id()
         mv_pk = list(plan.pk)
         if pk_prefix:
@@ -869,6 +916,146 @@ class Session:
             q.push(Barrier.new(self.epoch))
         self._await(job.wait_barrier(self.epoch))
         return []
+
+    # ------------------------------------------------- co-scheduled MV jobs --
+
+    def _try_coschedule_mv(self, stmt: A.CreateMaterializedView):
+        """Route an eligible source+agg plan into the epoch co-scheduler
+        (stream/coschedule.py): the group of all such MVs ticks in ONE
+        fused dispatch per epoch. Returns ``(result, plan)``; result is
+        None when the shape is ineligible (the solo executor fallback —
+        which reuses ``plan`` instead of planning the query twice)."""
+        from ..stream.coschedule import match_coschedulable
+        if not any(sd.connector == "nexmark"
+                   for sd in self.catalog.sources.values()):
+            # cheap gate: without an eligible source no plan can match —
+            # skip the extra planning pass the match would need
+            return None, None
+        plan = self._plan(stmt.query, lenient=self._recovering)
+        m = match_coschedulable(plan)
+        if m is None:
+            return None, plan
+        return self._create_mv_coscheduled(stmt, plan, m), plan
+
+    def _create_mv_coscheduled(self, stmt: A.CreateMaterializedView,
+                               plan, m) -> list:
+        """Build one co-scheduled fused MV job: ingest happens inside the
+        group's single vmapped dispatch; a real HashAggExecutor (over a
+        dummy source, never executed) is kept as the flush/persistence
+        engine so state-table checkpointing and recovery load are the
+        executor path's own code; the MV pipeline is a plain
+        QueueSource → Materialize fed by the group's barrier flush."""
+        from ..common.types import INT64, VARCHAR
+        from ..connector import NexmarkConfig
+        from ..connector.nexmark import DeviceBidGenerator
+        from ..stream.coschedule import (
+            DeviceSourceCursor, FusedJobSpec, agg_signature,
+            declared_chunk_fn,
+        )
+        from ..stream.hash_agg import HashAggExecutor, agg_state_schema
+        from ..stream.project import ProjectExecutor
+        from ..stream.source import MockSource
+
+        id0 = self.catalog._next_table_id
+        proj = ProjectExecutor(MockSource(m.source.schema, []),
+                               list(m.exprs), names=m.proj_names)
+        key_fields = [proj.schema[i] for i in m.group_keys]
+        st = StateTable(self.store, self.catalog.next_table_id(),
+                        agg_state_schema(key_fields, m.agg_calls),
+                        list(range(len(m.group_keys))))
+        agg = HashAggExecutor(
+            proj, list(m.group_keys), list(m.agg_calls), state_table=st,
+            table_capacity=self.config.agg_table_capacity,
+            out_capacity=self.config.chunk_capacity)
+        # split-state table: the device generator's event/epoch cursor,
+        # persisted per checkpoint epoch exactly like a connector reader
+        split_st = StateTable(
+            self.store, self.catalog.next_table_id(),
+            Schema((Field("split_id", VARCHAR),
+                    Field("next_offset", INT64))), [0])
+        cursor = DeviceSourceCursor()
+        if self._recovering:
+            offsets = {VARCHAR.to_python(r[0]): int(r[1])
+                       for r in split_st.scan_all()}
+            if offsets:
+                cursor.seek(offsets)
+        mv_table_id = self.catalog.next_table_id()
+        q = QueueSource(plan.schema)
+        mat = MaterializeExecutor(
+            q, StateTable(self.store, mv_table_id, plan.schema,
+                          list(plan.pk)))
+        # honor the declared source's rows_per_chunk exactly like the
+        # host reader does (connector/factory.py make_reader)
+        rate = (m.source.options or {}).get("rows_per_chunk")
+        rows_per_chunk = int(rate) if rate else self.source_chunk_capacity
+        # seed parity with the solo executor path: every nexmark reader
+        # is seeded with the session seed (factory.make_reader), so the
+        # same CREATE yields the same stream regardless of the flag
+        src_cfg = NexmarkConfig(chunk_capacity=rows_per_chunk)
+        gen = DeviceBidGenerator(src_cfg, seed=self.seed)
+        source_sig = ("nexmark_bid", src_cfg.chunk_capacity,
+                      src_cfg.events_per_second, src_cfg.active_people,
+                      src_cfg.in_flight_auctions, src_cfg.start_time_us,
+                      m.col_map,
+                      tuple(sorted((m.source.options or {}).items())))
+        spec = FusedJobSpec(
+            kind="agg",
+            signature=agg_signature(agg.core, m.exprs, rows_per_chunk,
+                                    source_sig),
+            chunk_fn=declared_chunk_fn(gen.chunk_fn(), m.col_map),
+            exprs=tuple(m.exprs), core=agg.core,
+            rows_per_chunk=rows_per_chunk, seed=self.seed)
+
+        mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
+                                 table_id=mv_table_id, definition="")
+        mv.n_visible = sum(  # type: ignore[attr-defined]
+            1 for f in plan.schema if not f.name.startswith("_"))
+        mv.state_table_ids = (st.table_id,)  # type: ignore[attr-defined]
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (  # type: ignore[attr-defined]
+            id0, self.catalog._next_table_id)
+        self.catalog_writer.add_mv(mv)
+        job = StreamJob(stmt.name, mat, [q])
+        self.jobs[stmt.name] = job
+        job.start(self.loop)
+        self.feeds.append(_SourceFeed(q, lambda: None, reader=cursor,
+                                      state_table=split_st,
+                                      job=stmt.name))
+        self._cosched.add(stmt.name, spec, agg.state,
+                          start=cursor.events, batch_no=cursor.epochs)
+        self._cosched_engines[stmt.name] = (agg, q, cursor)
+        if self.data_dir is not None and not self._recovering:
+            self.store.log.log_ddl(  # type: ignore[attr-defined]
+                f"-- coschedule {stmt.name}")
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+        q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def _cosched_tick(self, epoch: int, checkpoint: bool,
+                      generate: bool) -> None:
+        """Per-tick driver: ONE fused dispatch per group covers every
+        member MV's epoch; the group flush feeds each job's Materialize
+        queue; checkpoint barriers reuse the HashAggExecutor's own
+        state-table delta flush, then restack once."""
+        k = self.chunks_per_tick
+        for group in list(self._cosched.groups.values()):
+            if generate and k > 0:
+                group.run_epoch(k)
+            outs = group.flush()
+            ckpt_states = []
+            for j, name in enumerate(group.names):
+                agg, q, cursor = self._cosched_engines[name]
+                cursor.events = group.starts[j]
+                cursor.epochs = group.batch_nos[j]
+                for ch in outs[name]:
+                    q.push(ch)
+                if checkpoint:
+                    agg.state = group.state_of(name)
+                    agg._checkpoint_to_state_table(epoch)
+                    ckpt_states.append(agg.state)
+            if checkpoint:
+                group.set_states(ckpt_states)
 
     # ------------------------------------------------------ remote MV jobs --
 
@@ -1863,6 +2050,9 @@ class Session:
             # the job's source feeds die with it: free their split-state
             # tables (collect BEFORE teardown filters them away)
             dead_feeds = [f for f in self.feeds if f.job == stmt.name]
+            self._cosched.remove(stmt.name)
+            self._cosched_engines.pop(stmt.name, None)
+            self._cosched_markers.discard(stmt.name)
             if stmt.name in self.jobs:
                 job = self.jobs.pop(stmt.name)
                 # full shared teardown: also clears _dead_jobs / worker
@@ -2076,6 +2266,12 @@ class Session:
                     chunk = feed.generator()
                     if chunk is not None:
                         feed.queue.push(chunk)
+        if self._cosched.jobs:
+            # co-scheduled groups: one fused dispatch per group covers
+            # every member MV's epoch; flush chunks land on the job
+            # queues BEFORE the barrier below
+            self._cosched_tick(epoch, checkpoint,
+                               generate and not self.paused)
         from ..common.tracing import CAT_EPOCH, trace_span
         with trace_span("barrier.inject", CAT_EPOCH, epoch=epoch,
                         tid="conductor", checkpoint=checkpoint):
@@ -2679,6 +2875,9 @@ class Session:
                 for se in self._slow_epochs
             ],
             "storage": self._storage_metrics(),
+            # epoch co-scheduler: group membership + epochs run
+            # (stream/coschedule.py)
+            "coschedule": self._cosched.stats(),
             # per-site retry counters from every boundary (object store,
             # broker, sink delivery) — common/retry.py global registry
             "retry": _retry_snapshot(),

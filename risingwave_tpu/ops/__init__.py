@@ -5,3 +5,7 @@ from .interval_join import (  # noqa: F401
     IntervalJoinCore, IntervalJoinState,
 )
 from .join_state import JoinCore, JoinState, JoinType  # noqa: F401
+from .session_window import (  # noqa: F401
+    SessionWindowCore, SessionWindowState,
+)
+from .stream_q3 import Q3Core, Q3State  # noqa: F401

@@ -1,0 +1,179 @@
+"""Multi-job fused epochs — MANY MVs' epochs in ONE XLA dispatch.
+
+The co-scheduling layer of the dispatch ladder (docs/performance.md):
+PR 4 collapsed one pipeline's epoch into a single ``lax.scan`` dispatch;
+when hundreds of small MVs tick together (the "heavy traffic from
+millions of users" shape — SURVEY §2.9 pipeline scaling) each job still
+paid its own dispatch, so per-tick overhead grew linearly with job
+count. Here compatible jobs' states are STACKED under a leading job
+axis ``[J, ...]`` and the *same epoch body* the solo path jits
+(ops/fused_epoch.agg_epoch_body / join_epoch_body) is ``vmap``-ed over
+that axis inside one jit: K jobs tick in exactly one dispatch, and —
+because vmap batches each primitive without changing its per-slice
+semantics — job j's slice of the stacked state is bit-identical to what
+the solo fused epoch would have produced (tests/test_coschedule.py pins
+this, including across a checkpoint export/import cycle).
+
+Grouping contract (enforced by stream/coschedule.py): jobs stack only
+when their traced computation is identical — same core config, same
+projection exprs, same chunk_fn family and rows_per_chunk. Per-job
+variation rides as DATA: start-event cursors ``starts[J]`` and PRNG
+keys ``keys[J]``. Anything else (different window literals, different
+agg calls) is a different trace → a different group (or solo fallback).
+
+Barrier work stays O(1) dispatches in J too: ``multi_agg_probe`` /
+``multi_agg_finish`` vmap the probe/finish steps, so the whole group's
+packed stats arrive in ONE [J, 3] fetch. Only the per-job output
+gathers remain per-job — they ARE per-job data — and
+``gather_job_flush_chunk`` traces the job index, so one compiled gather
+serves every job.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..expr import Expr
+from .fused_epoch import _donate, agg_epoch_body, join_epoch_body
+
+
+# -- job-axis state layout ---------------------------------------------------
+
+
+def stack_states(states: Sequence):
+    """Per-job state pytrees → ONE stacked pytree with a leading [J]
+    axis on every leaf (the co-scheduler's resident layout)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def index_state(stacked, j):
+    """Job ``j``'s slice of a stacked pytree — the solo-shaped state, as
+    device views (bit-exact vs the solo path; used for per-job export,
+    checkpoint and group-membership changes)."""
+    return jax.tree_util.tree_map(lambda x: x[j], stacked)
+
+
+def unstack_states(stacked, n_jobs: int):
+    return [index_state(stacked, j) for j in range(n_jobs)]
+
+
+def append_state(stacked, state):
+    """Grow the job axis by one (new group member)."""
+    return jax.tree_util.tree_map(
+        lambda xs, x: jnp.concatenate([xs, x[None]]), stacked, state)
+
+
+def remove_state(stacked, j: int):
+    """Drop job ``j`` from the job axis (DROP MATERIALIZED VIEW)."""
+    def rm(x):
+        return jnp.concatenate([x[:j], x[j + 1:]])
+    return jax.tree_util.tree_map(rm, stacked)
+
+
+# -- multi-job epochs ---------------------------------------------------------
+
+
+def fused_multi_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
+                          core, rows_per_chunk: int,
+                          donate: bool = True) -> Callable:
+    """Build ``epoch(stacked_state, starts[J], keys[J], k) ->
+    stacked_state``: K source+agg jobs' epochs in ONE dispatch. The body
+    is the solo epoch body vmapped over the job axis."""
+    body = agg_epoch_body(chunk_fn, exprs, core, rows_per_chunk)
+    vm = jax.vmap(body, in_axes=(0, 0, 0, None))
+
+    def epoch(stacked, starts, keys, k: int):
+        return vm(stacked, starts, keys, k)
+
+    return jax.jit(epoch, static_argnums=(3,),
+                   donate_argnums=_donate(donate))
+
+
+def fused_multi_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
+                           core, rows_per_chunk: int,
+                           donate: bool = True) -> Callable:
+    """Build ``epoch(stacked_state, starts[J], keys[J], k)`` for K
+    source+join jobs (ops/interval_join.IntervalJoinCore): one dispatch
+    runs every job's ingest AND its barrier flush plan. Returns the
+    solo epoch's tuple with a leading [J] axis on every element —
+    ``packed`` becomes [J, 5], so ONE scalar fetch covers the whole
+    group's flags and emission counts."""
+    body = join_epoch_body(chunk_fn, exprs, core, rows_per_chunk)
+    vm = jax.vmap(body, in_axes=(0, 0, 0, None))
+
+    def epoch(stacked, starts, keys, k: int):
+        return vm(stacked, starts, keys, k)
+
+    return jax.jit(epoch, static_argnums=(3,),
+                   donate_argnums=_donate(donate))
+
+
+def build_group_epoch(kind: str, chunk_fn: Callable, exprs: Sequence[Expr],
+                      core, rows_per_chunk: int, donate: bool = True):
+    """The co-scheduler's production epoch (stream/coschedule.CoGroup):
+    per-job PRNG-key folding + the vmapped solo body in ONE jit, so the
+    fold costs zero extra dispatches and stays bit-identical to the solo
+    path's host-side ``jax.random.fold_in``. Signature:
+    ``epoch(stacked, starts[J], base_keys[J], batch_nos[J], k)``.
+    common/dispatch_count.py counts this as
+    ``build_group_epoch.<locals>.coscheduled_epoch``. The explicit-keys
+    builders above are the unfolded primitives (parity tests drive them
+    with host-folded keys); all share the same epoch bodies."""
+    body = (agg_epoch_body if kind == "agg" else join_epoch_body)(
+        chunk_fn, exprs, core, rows_per_chunk)
+    vm = jax.vmap(body, in_axes=(0, 0, 0, None))
+
+    def coscheduled_epoch(stacked, starts, base_keys, batch_nos, k: int):
+        keys = jax.vmap(jax.random.fold_in)(base_keys, batch_nos)
+        return vm(stacked, starts, keys, k)
+
+    return jax.jit(coscheduled_epoch, static_argnums=(4,),
+                   donate_argnums=_donate(donate))
+
+
+# -- group barrier steps (agg shape) ------------------------------------------
+
+
+def multi_agg_probe(core) -> Callable:
+    """``probe(stacked) -> (packed [J, 3], rank [J, cap])`` — the whole
+    group's barrier probe in one dispatch / one fetch."""
+
+    def probe_one(st):
+        rank = core.flush_rank(st)
+        packed = jnp.stack([rank[-1],
+                            st.overflow.astype(jnp.int32),
+                            jnp.zeros((), jnp.int32)])
+        return packed, rank
+
+    vm = jax.vmap(probe_one)
+
+    def probe(stacked):
+        return vm(stacked)
+
+    return jax.jit(probe)
+
+
+def multi_agg_finish(core) -> Callable:
+    """``finish(stacked) -> stacked`` — every job's flush finish in one
+    dispatch."""
+    vm = jax.vmap(core.finish_flush)
+
+    def finish(stacked):
+        return vm(stacked)
+
+    return jax.jit(finish)
+
+
+def gather_job_flush_chunk(core) -> Callable:
+    """``gather(stacked, ranks, j, lo) -> StreamChunk`` — job ``j``'s
+    flush window [lo, lo+G). ``j`` is traced, so ONE compiled function
+    serves every job in the group."""
+
+    def gather(stacked, ranks, j, lo):
+        st = index_state(stacked, j)
+        return core.gather_flush_chunk(st, ranks[j], lo)
+
+    return jax.jit(gather)

@@ -1,0 +1,318 @@
+"""Session-gap window core — the NEXmark q8 shape as device-resident state.
+
+q8 monitors user activity: events are grouped per key (bidder/seller)
+into *sessions* — maximal runs of events where consecutive gaps stay
+within ``gap_us`` — and a session row (key, start, end, n_events) is
+emitted once the session CLOSES (a later event opens a new session, or
+the watermark passes ``last_ts + gap``). Unlike tumble/hop windows the
+window boundaries are data-dependent, so there is no static window id to
+bucket by; instead the state is a hash table keyed by the session key
+(ops/hash_table.py — the same open-addressing table AggCore uses) with
+three lanes per key (open-session start / last event time / count) plus
+a fixed-capacity **closed-session buffer** that accumulates emissions
+between barriers.
+
+Vectorization of the data-dependent part (reference capability:
+src/expr/src/window_function/session.rs — per-partition scans; here one
+chunk is segmented wholesale): rows are sorted by (key-slot, ts) — two
+stable argsorts, the interval-join lane-assignment trick — and a
+*segment* starts where the key changes or the within-chunk gap exceeds
+``gap_us``. Segment aggregates fall out of prefix-max/count arithmetic
+in sorted space; sessions close where a segment ends but its key-run
+continues (a later same-key segment exists), where a key-run's first
+segment does not extend the stored open session, and at flush time for
+open sessions the watermark has passed. All closures append to the
+closed buffer via rank-scatters; the barrier flush snapshots the buffer
+and clears it.
+
+Assumptions (enforced with sticky flags, the IntervalJoinCore idiom):
+
+* append-only input (a delete sets ``saw_delete``; sessions cannot
+  un-happen),
+* per-key event time non-decreasing ACROSS chunks (the NEXmark clock is
+  globally monotone; within a chunk any order is handled by the sort; a
+  cross-chunk violation sets ``out_of_order`` instead of silently
+  rewinding a session),
+* the closed buffer outlasts one epoch's closures (``closed_overflow``
+  trips otherwise — size it to the epoch's expected closure count),
+* hash-table capacity bounds distinct keys ever seen (``overflow``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_INSERT, OP_UPDATE_INSERT, Column, StreamChunk,
+)
+from ..common.types import Field, INT64, Schema, TIMESTAMP
+from .hash_table import DeviceHashTable, ht_lookup_or_insert, ht_new
+
+_NONE = jnp.int64(-1)
+
+
+@struct.dataclass
+class SessionWindowState:
+    table: DeviceHashTable
+    sess_start: jax.Array      # int64[cap]: open session start; -1 = none
+    last_ts: jax.Array         # int64[cap]: open session's last event time
+    count: jax.Array           # int64[cap]: open session's event count
+    closed_key: jax.Array      # int64[ccap]: closed-session buffer
+    closed_start: jax.Array    # int64[ccap]
+    closed_end: jax.Array      # int64[ccap]
+    closed_cnt: jax.Array      # int64[ccap]
+    closed_fill: jax.Array     # int32 scalar: buffer occupancy
+    overflow: jax.Array        # bool scalar, sticky: key table full
+    closed_overflow: jax.Array  # bool scalar, sticky: buffer full
+    saw_delete: jax.Array      # bool scalar, sticky: non-insert input row
+    out_of_order: jax.Array    # bool scalar, sticky: per-key time rewind
+
+
+class SessionWindowCore:
+    """Static config + pure steps for one session-window operator.
+
+    ``key_col``/``ts_col``: input columns (key must be an int64 type —
+    the q8 ids); ``gap_us``: the session gap. Output schema:
+    (key, session_start, session_end, n_events)."""
+
+    def __init__(self, in_schema: Schema, key_col: int, ts_col: int,
+                 gap_us: int, capacity: int = 1 << 16,
+                 closed_capacity: int = 1 << 16):
+        if gap_us <= 0:
+            raise ValueError("gap_us must be positive")
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self.in_schema = in_schema
+        self.key_col = key_col
+        self.ts_col = ts_col
+        self.key_type = in_schema[key_col].type
+        self.gap_us = int(gap_us)
+        self.capacity = int(capacity)
+        self.closed_capacity = int(closed_capacity)
+        self.out_schema = Schema((
+            Field(in_schema[key_col].name, self.key_type),
+            Field("session_start", TIMESTAMP),
+            Field("session_end", TIMESTAMP),
+            Field("n_events", INT64),
+        ))
+
+    # -- state ----------------------------------------------------------------
+
+    def init_state(self) -> SessionWindowState:
+        cap, ccap = self.capacity, self.closed_capacity
+        return SessionWindowState(
+            table=ht_new((self.key_type,), cap),
+            sess_start=jnp.full(cap, _NONE, jnp.int64),
+            last_ts=jnp.zeros(cap, jnp.int64),
+            count=jnp.zeros(cap, jnp.int64),
+            closed_key=jnp.zeros(ccap, jnp.int64),
+            closed_start=jnp.zeros(ccap, jnp.int64),
+            closed_end=jnp.zeros(ccap, jnp.int64),
+            closed_cnt=jnp.zeros(ccap, jnp.int64),
+            closed_fill=jnp.zeros((), jnp.int32),
+            overflow=jnp.zeros((), jnp.bool_),
+            closed_overflow=jnp.zeros((), jnp.bool_),
+            saw_delete=jnp.zeros((), jnp.bool_),
+            out_of_order=jnp.zeros((), jnp.bool_),
+        )
+
+    # -- chunk step ------------------------------------------------------------
+
+    def apply_chunk(self, state: SessionWindowState,
+                    chunk: StreamChunk) -> SessionWindowState:
+        cap, ccap = self.capacity, self.closed_capacity
+        N = chunk.capacity
+        key = chunk.columns[self.key_col]
+        ts = chunk.columns[self.ts_col]
+        is_ins = (chunk.ops == OP_INSERT) | (chunk.ops == OP_UPDATE_INSERT)
+        saw_delete = state.saw_delete | jnp.any(chunk.vis & ~is_ins)
+        valid = chunk.vis & is_ins & key.mask & ts.mask
+        table, slots, _, ovf = ht_lookup_or_insert(state.table, [key], valid)
+        t64 = ts.data.astype(jnp.int64)
+
+        # ---- sort rows by (slot, ts): valid rows first, grouped per key,
+        # time-ascending inside the group (two stable argsorts — the
+        # interval-join lane-assignment idiom)
+        sort_slot = jnp.where(valid, slots, cap).astype(jnp.int32)
+        o1 = jnp.argsort(t64, stable=True)
+        perm = o1[jnp.argsort(sort_slot[o1], stable=True)]
+        s = sort_slot[perm]
+        t = t64[perm]
+        v = valid[perm]
+        kv = key.data.astype(jnp.int64)[perm]
+        idx = jnp.arange(N, dtype=jnp.int32)
+
+        run_start = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), s[1:] != s[:-1]])
+        t_prev = jnp.concatenate([t[:1], t[:-1]])
+
+        safe_s = jnp.clip(s, 0, cap - 1)
+        st_start = state.sess_start[safe_s]
+        st_last = state.last_ts[safe_s]
+        st_cnt = state.count[safe_s]
+        has_open = st_start >= 0
+
+        # segment = maximal gap-free run of one key inside this chunk
+        seg_flag = v & (run_start | (t - t_prev > self.gap_us))
+        continues = run_start & v & has_open & (t - st_last <= self.gap_us)
+        # per-key time rewind across chunks: flagged sticky (the chunk is
+        # still folded in; downstream decides whether to trust sessions)
+        out_of_order = state.out_of_order | jnp.any(
+            v & run_start & has_open & (t < st_last))
+        seg_start_idx = jax.lax.cummax(jnp.where(seg_flag, idx, 0))
+        seg_first_ts = t[seg_start_idx]
+        seg_cnt = (idx - seg_start_idx + 1).astype(jnp.int64)
+        # does THIS row's segment extend the stored open session? (only a
+        # run's first segment can)
+        seg_cont = run_start[seg_start_idx] & continues[seg_start_idx]
+
+        nxt_v = jnp.concatenate([v[1:], jnp.zeros(1, jnp.bool_)])
+        nxt_seg = jnp.concatenate([seg_flag[1:], jnp.zeros(1, jnp.bool_)])
+        nxt_s = jnp.concatenate([s[1:], jnp.full(1, cap, jnp.int32)])
+        seg_last = v & (~nxt_v | nxt_seg | (nxt_s != s))
+        run_last = v & (~nxt_v | (nxt_s != s))
+
+        # ---- closures: (a) the stored open session, superseded by a
+        # non-extending first segment; (b) every segment followed by a
+        # later same-key segment (its session can never extend again)
+        close_state = v & run_start & has_open & ~continues
+        close_seg = seg_last & ~run_last
+        cs_start = jnp.where(seg_cont, st_start, seg_first_ts)
+        cs_cnt = jnp.where(seg_cont, st_cnt + seg_cnt, seg_cnt)
+
+        na = jnp.sum(close_state)
+        ra = jnp.cumsum(close_state) - 1
+        rb = na + jnp.cumsum(close_seg) - 1
+        posa = jnp.where(close_state, state.closed_fill + ra, ccap)
+        posb = jnp.where(close_seg, state.closed_fill + rb, ccap)
+
+        def put(buf, va, vb):
+            return buf.at[posa].set(va, mode="drop").at[posb].set(
+                vb, mode="drop")
+
+        closed_key = put(state.closed_key, kv, kv)
+        closed_start = put(state.closed_start, st_start, cs_start)
+        closed_end = put(state.closed_end, st_last, t)
+        closed_cnt = put(state.closed_cnt, st_cnt, cs_cnt)
+        n_new = na + jnp.sum(close_seg)
+        closed_overflow = state.closed_overflow | (
+            state.closed_fill + n_new > ccap)
+        closed_fill = jnp.minimum(
+            state.closed_fill + n_new, ccap).astype(jnp.int32)
+
+        # ---- open-session update: the run's LAST segment stays open
+        tgt = jnp.where(run_last, s, cap)
+        sess_start = state.sess_start.at[tgt].set(
+            jnp.where(seg_cont, st_start, seg_first_ts), mode="drop")
+        last_ts = state.last_ts.at[tgt].set(t, mode="drop")
+        count = state.count.at[tgt].set(
+            jnp.where(seg_cont, st_cnt + seg_cnt, seg_cnt), mode="drop")
+
+        return state.replace(
+            table=table, sess_start=sess_start, last_ts=last_ts,
+            count=count, closed_key=closed_key, closed_start=closed_start,
+            closed_end=closed_end, closed_cnt=closed_cnt,
+            closed_fill=closed_fill, overflow=state.overflow | ovf,
+            closed_overflow=closed_overflow, saw_delete=saw_delete,
+            out_of_order=out_of_order,
+        )
+
+    # -- barrier flush ---------------------------------------------------------
+
+    def flush_plan(self, state: SessionWindowState, watermark):
+        """Close open sessions the watermark has passed (``last_ts + gap
+        <= watermark``) into the buffer. Returns (state, packed
+        [n_closed, overflow, closed_overflow, saw_delete,
+        out_of_order]) — ONE scalar fetch covers the emission count and
+        every sticky flag."""
+        cap, ccap = self.capacity, self.closed_capacity
+        wm = jnp.asarray(watermark, jnp.int64)
+        openm = (state.table.occupied & (state.sess_start >= 0)
+                 & (state.last_ts + self.gap_us <= wm))
+        rank = jnp.cumsum(openm) - 1
+        pos = jnp.where(openm, state.closed_fill + rank, ccap)
+        kv = state.table.key_data[0].astype(jnp.int64)
+        closed_key = state.closed_key.at[pos].set(kv, mode="drop")
+        closed_start = state.closed_start.at[pos].set(
+            state.sess_start, mode="drop")
+        closed_end = state.closed_end.at[pos].set(state.last_ts, mode="drop")
+        closed_cnt = state.closed_cnt.at[pos].set(state.count, mode="drop")
+        n = jnp.sum(openm)
+        closed_overflow = state.closed_overflow | (
+            state.closed_fill + n > ccap)
+        closed_fill = jnp.minimum(state.closed_fill + n, ccap).astype(
+            jnp.int32)
+        state = state.replace(
+            sess_start=jnp.where(openm, _NONE, state.sess_start),
+            count=jnp.where(openm, 0, state.count),
+            closed_key=closed_key, closed_start=closed_start,
+            closed_end=closed_end, closed_cnt=closed_cnt,
+            closed_fill=closed_fill, closed_overflow=closed_overflow,
+        )
+        packed = jnp.stack([
+            closed_fill.astype(jnp.int64),
+            state.overflow.astype(jnp.int64),
+            closed_overflow.astype(jnp.int64),
+            state.saw_delete.astype(jnp.int64),
+            state.out_of_order.astype(jnp.int64),
+        ])
+        return state, packed
+
+    def snapshot_closed(self, state: SessionWindowState):
+        """The epoch's emission payload (buffer arrays; fused epochs
+        return this, then ``finish_flush`` clears the buffer)."""
+        return (state.closed_key, state.closed_start,
+                state.closed_end, state.closed_cnt)
+
+    def finish_flush(self, state: SessionWindowState) -> SessionWindowState:
+        return state.replace(closed_fill=jnp.zeros((), jnp.int32))
+
+    def gather_closed(self, snapshot, n_closed, lo,
+                      out_capacity: int) -> StreamChunk:
+        """Closed sessions with buffer rank in [lo, lo+out_capacity) as
+        one INSERT chunk (session outputs are append-only — a session
+        closes exactly once). Pure + shape-static; drive as
+        ``for lo in range(0, n_closed, out_capacity)``."""
+        ck, cs, ce, cn = snapshot
+        ccap = ck.shape[0]
+        j = lo + jnp.arange(out_capacity, dtype=jnp.int64)
+        vis = j < jnp.asarray(n_closed, jnp.int64)
+        src = jnp.clip(j, 0, ccap - 1).astype(jnp.int32)
+        cols = (
+            Column(ck[src].astype(self.key_type.dtype), vis),
+            Column(cs[src], vis),
+            Column(ce[src], vis),
+            Column(cn[src], vis),
+        )
+        return StreamChunk(jnp.zeros(out_capacity, jnp.int8), vis, cols)
+
+    # -- checkpoint / recovery -------------------------------------------------
+
+    def export_host(self, state: SessionWindowState) -> dict:
+        import numpy as np
+        host = jax.device_get(state)
+        out = {f: np.asarray(getattr(host, f)) for f in (
+            "sess_start", "last_ts", "count", "closed_key", "closed_start",
+            "closed_end", "closed_cnt", "closed_fill", "overflow",
+            "closed_overflow", "saw_delete", "out_of_order")}
+        out["table_key_data"] = [np.asarray(a) for a in host.table.key_data]
+        out["table_key_mask"] = [np.asarray(a) for a in host.table.key_mask]
+        out["table_occupied"] = np.asarray(host.table.occupied)
+        return out
+
+    def import_host(self, payload: dict) -> SessionWindowState:
+        return SessionWindowState(
+            table=DeviceHashTable(
+                key_data=tuple(jnp.asarray(a)
+                               for a in payload["table_key_data"]),
+                key_mask=tuple(jnp.asarray(a)
+                               for a in payload["table_key_mask"]),
+                occupied=jnp.asarray(payload["table_occupied"])),
+            **{f: jnp.asarray(payload[f]) for f in (
+                "sess_start", "last_ts", "count", "closed_key",
+                "closed_start", "closed_end", "closed_cnt", "closed_fill",
+                "overflow", "closed_overflow", "saw_delete",
+                "out_of_order")},
+        )

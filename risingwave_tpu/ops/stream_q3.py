@@ -1,0 +1,263 @@
+"""TPC-H q3 streaming-MV core — join + agg + top-n as pure device steps.
+
+The q3 MV (reference workload e2e_test/tpch/q3, streaming form) is
+
+    SELECT l_orderkey, SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+           o_orderdate, o_shippriority
+    FROM orders JOIN lineitem ON l_orderkey = o_orderkey
+    WHERE o_mktsegment = 'BUILDING' AND o_orderdate < :date
+      AND l_shipdate > :date
+    GROUP BY l_orderkey, o_orderdate, o_shippriority
+    ORDER BY revenue DESC, l_orderkey LIMIT 10
+
+This core exploits the same structural facts the interval join does for
+q7: the build side (orders) is keyed by its PRIMARY KEY, so there is at
+most one build row per join key — probing is a hash lookup + gather,
+never a candidate scan — and both inputs are append-only, so the only
+retraction surface is the OUTPUT (a group leaving/entering the top-10,
+or its revenue changing). Composition per chunk:
+
+1. qualifying ORDER rows (segment + date filter applied AT INSERT — a
+   filtered-out order is simply never stored, which IS the join+filter
+   semantics) land in an open-addressing table
+   (``ops/hash_table.py``) with o_orderdate / o_shippriority lanes;
+2. qualifying LINEITEM rows probe that table (read-only ``ht_lookup``);
+   matches become a synthetic joined chunk folded into a plain
+   ``ops/grouped_agg.AggCore`` — SUM(revenue) plus MAX lanes carrying
+   the functionally-dependent o_orderdate/o_shippriority;
+3. the barrier flush recomputes the top-10 wholesale from the agg lanes
+   (one masked lexicographic sort — the ops/topn.py full-sort lesson:
+   recomputing membership beats pointer-chasing on a vector machine)
+   and emits exactly the churn an executor TopN would: DELETE departed
+   rows, INSERT arrived ones, identical rows suppressed.
+
+Money stays integral: prices ride as cents, discounts as basis points,
+``revenue_cents = price * (10000 - disc_bp) / 10000`` in int64 — no
+float in the state, so fused/unfused parity is bit-exact.
+
+Event-stream assumptions (sticky flags otherwise): append-only input
+(``saw_delete``), an order precedes none of its lineitems being probed
+within the SAME apply call is fine (orders of a chunk insert before its
+lineitems probe), and a lineitem whose order was filtered out simply
+never matches. Orders capacity bounds qualifying orders ever stored
+(``orders_overflow``); agg capacity bounds live groups.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_INSERT, Column, StreamChunk,
+)
+from ..common.types import Field, INT64, Schema
+from ..expr.agg import AggCall
+from .grouped_agg import AggCore, AggState
+from .hash_table import DeviceHashTable, ht_lookup, ht_lookup_or_insert, ht_new
+
+_BIG = jnp.iinfo(jnp.int64).max
+
+
+@struct.dataclass
+class Q3State:
+    orders: DeviceHashTable     # keyed by o_orderkey (qualifying only)
+    odate: jax.Array            # int64[cap]: o_orderdate lane
+    prio: jax.Array             # int64[cap]: o_shippriority lane
+    agg: AggState               # revenue SUM + odate/prio MAX lanes
+    emitted_key: jax.Array      # int64[K]: top-n rows downstream has seen
+    emitted_rev: jax.Array      # int64[K]
+    emitted_odate: jax.Array    # int64[K]
+    emitted_prio: jax.Array     # int64[K]
+    emitted_valid: jax.Array    # bool[K]
+    orders_overflow: jax.Array  # bool scalar, sticky
+    saw_delete: jax.Array       # bool scalar, sticky
+
+
+class Q3Core:
+    """Static config + pure steps for the q3 streaming MV.
+
+    Input chunks use the unified order/lineitem event schema produced by
+    ``connector/tpch.DeviceQ3Generator`` (column indices are
+    constructor parameters so the core stays schema-agnostic):
+    kind (0=order, 1=lineitem), orderkey, o_orderdate, o_shippriority,
+    o_mktsegment, l_extendedprice_cents, l_discount_bp, l_shipdate.
+
+    Output schema: (l_orderkey, revenue_cents, o_orderdate,
+    o_shippriority) — the MV rows, emitted as top-``limit`` churn."""
+
+    def __init__(self, cutoff_days: int, mktsegment: int = 0,
+                 orders_capacity: int = 1 << 16,
+                 agg_capacity: int = 1 << 16, limit: int = 10,
+                 kind_col: int = 0, okey_col: int = 1, odate_col: int = 2,
+                 prio_col: int = 3, mkt_col: int = 4, price_col: int = 5,
+                 disc_col: int = 6, ship_col: int = 7):
+        self.cutoff_days = int(cutoff_days)
+        self.mktsegment = int(mktsegment)
+        self.orders_capacity = int(orders_capacity)
+        self.limit = int(limit)
+        self.kind_col, self.okey_col = kind_col, okey_col
+        self.odate_col, self.prio_col = odate_col, prio_col
+        self.mkt_col, self.price_col = mkt_col, price_col
+        self.disc_col, self.ship_col = disc_col, ship_col
+        # revenue SUM + MAX lanes for the functionally-dependent order
+        # attributes (constant per group, so MAX is the identity carry)
+        self.agg = AggCore(
+            key_types=(INT64,), group_keys=(0,),
+            agg_calls=(AggCall("sum", 1, INT64), AggCall("max", 2, INT64),
+                       AggCall("max", 3, INT64)),
+            table_capacity=agg_capacity, out_capacity=2 * limit)
+        self.out_schema = Schema((
+            Field("l_orderkey", INT64), Field("revenue_cents", INT64),
+            Field("o_orderdate", INT64), Field("o_shippriority", INT64),
+        ))
+
+    # -- state ----------------------------------------------------------------
+
+    def init_state(self) -> Q3State:
+        cap, K = self.orders_capacity, self.limit
+        return Q3State(
+            orders=ht_new((INT64,), cap),
+            odate=jnp.zeros(cap, jnp.int64),
+            prio=jnp.zeros(cap, jnp.int64),
+            agg=self.agg.init_state(),
+            emitted_key=jnp.zeros(K, jnp.int64),
+            emitted_rev=jnp.zeros(K, jnp.int64),
+            emitted_odate=jnp.zeros(K, jnp.int64),
+            emitted_prio=jnp.zeros(K, jnp.int64),
+            emitted_valid=jnp.zeros(K, jnp.bool_),
+            orders_overflow=jnp.zeros((), jnp.bool_),
+            saw_delete=jnp.zeros((), jnp.bool_),
+        )
+
+    # -- chunk step ------------------------------------------------------------
+
+    def apply_chunk(self, state: Q3State, chunk: StreamChunk) -> Q3State:
+        cap = self.orders_capacity
+        cols = chunk.columns
+        is_ins = (chunk.ops == OP_INSERT) | (chunk.ops == OP_UPDATE_INSERT)
+        saw_delete = state.saw_delete | jnp.any(chunk.vis & ~is_ins)
+        valid = chunk.vis & is_ins
+        kind = cols[self.kind_col].data.astype(jnp.int64)
+        okey = Column(cols[self.okey_col].data.astype(jnp.int64),
+                      cols[self.okey_col].mask)
+        odate = cols[self.odate_col].data.astype(jnp.int64)
+        prio = cols[self.prio_col].data.astype(jnp.int64)
+        mkt = cols[self.mkt_col].data.astype(jnp.int64)
+        price = cols[self.price_col].data.astype(jnp.int64)
+        disc = cols[self.disc_col].data.astype(jnp.int64)
+        ship = cols[self.ship_col].data.astype(jnp.int64)
+
+        # ---- orders: filter at insert (mktsegment + date cutoff)
+        qual = (valid & (kind == 0) & okey.mask
+                & (odate < self.cutoff_days) & (mkt == self.mktsegment))
+        orders, slots, _, ovf = ht_lookup_or_insert(state.orders, [okey],
+                                                    qual)
+        tgt = jnp.where(qual, slots, cap)
+        odate_lane = state.odate.at[tgt].set(odate, mode="drop")
+        prio_lane = state.prio.at[tgt].set(prio, mode="drop")
+
+        # ---- lineitems: shipdate filter, then probe the (just-updated)
+        # orders table; a miss == the order was filtered out
+        is_li = valid & (kind == 1) & okey.mask & (ship > self.cutoff_days)
+        pslots, found = ht_lookup(orders, [okey], is_li)
+        match = is_li & found
+        safe = jnp.clip(pslots, 0, cap - 1)
+        revenue = price * (10000 - disc) // 10000
+        joined = StreamChunk(
+            jnp.zeros(chunk.capacity, jnp.int8), match,
+            (Column(okey.data, match), Column(revenue, match),
+             Column(odate_lane[safe], match), Column(prio_lane[safe], match)))
+        agg = self.agg.apply_chunk(state.agg, joined)
+
+        return state.replace(
+            orders=orders, odate=odate_lane, prio=prio_lane, agg=agg,
+            orders_overflow=state.orders_overflow | ovf,
+            saw_delete=saw_delete)
+
+    # -- barrier flush ---------------------------------------------------------
+
+    def flush(self, state: Q3State):
+        """Recompute the top-``limit`` by (revenue DESC, orderkey ASC)
+        and emit churn vs the previously emitted rows. Returns
+        (state, out_chunk [2*limit rows: deletes then inserts], packed
+        [n_out, orders_overflow, agg_overflow, saw_delete])."""
+        K = self.limit
+        lanes = state.agg.lanes
+        live = lanes[0] > 0
+        ofs = self.agg.call_lane_ofs
+        rev, odate, prio = lanes[ofs[0]], lanes[ofs[1]], lanes[ofs[2]]
+        okey = state.agg.table.key_data[0].astype(jnp.int64)
+
+        o1 = jnp.argsort(jnp.where(live, okey, _BIG), stable=True)
+        perm = o1[jnp.argsort(jnp.where(live, -rev, _BIG)[o1],
+                              stable=True)][:K]
+        new_valid = live[perm]
+        new_key, new_rev = okey[perm], rev[perm]
+        new_odate, new_prio = odate[perm], prio[perm]
+
+        same = (state.emitted_valid[:, None] & new_valid[None, :]
+                & (state.emitted_key[:, None] == new_key[None, :])
+                & (state.emitted_rev[:, None] == new_rev[None, :])
+                & (state.emitted_odate[:, None] == new_odate[None, :])
+                & (state.emitted_prio[:, None] == new_prio[None, :]))
+        del_m = state.emitted_valid & ~jnp.any(same, axis=1)
+        ins_m = new_valid & ~jnp.any(same, axis=0)
+
+        ops = jnp.concatenate([jnp.full(K, OP_DELETE, jnp.int8),
+                               jnp.full(K, OP_INSERT, jnp.int8)])
+        vis = jnp.concatenate([del_m, ins_m])
+
+        def col(old, new):
+            return Column(jnp.concatenate([old, new]), vis)
+
+        out = StreamChunk(ops, vis, (
+            col(state.emitted_key, new_key),
+            col(state.emitted_rev, new_rev),
+            col(state.emitted_odate, new_odate),
+            col(state.emitted_prio, new_prio)))
+        packed = jnp.stack([
+            jnp.sum(del_m) + jnp.sum(ins_m),
+            state.orders_overflow.astype(jnp.int64),
+            state.agg.overflow.astype(jnp.int64),
+            state.saw_delete.astype(jnp.int64),
+        ])
+        state = state.replace(
+            emitted_key=new_key, emitted_rev=new_rev,
+            emitted_odate=new_odate, emitted_prio=new_prio,
+            emitted_valid=new_valid)
+        return state, out, packed
+
+    # -- checkpoint / recovery -------------------------------------------------
+
+    def export_host(self, state: Q3State) -> dict:
+        import numpy as np
+        host = jax.device_get(state)
+        out = {f: np.asarray(getattr(host, f)) for f in (
+            "odate", "prio", "emitted_key", "emitted_rev", "emitted_odate",
+            "emitted_prio", "emitted_valid", "orders_overflow",
+            "saw_delete")}
+        out["orders_key_data"] = [np.asarray(a)
+                                  for a in host.orders.key_data]
+        out["orders_key_mask"] = [np.asarray(a)
+                                  for a in host.orders.key_mask]
+        out["orders_occupied"] = np.asarray(host.orders.occupied)
+        out["agg"] = jax.tree_util.tree_map(np.asarray, host.agg)
+        return out
+
+    def import_host(self, payload: dict) -> Q3State:
+        agg = jax.tree_util.tree_map(jnp.asarray, payload["agg"])
+        return Q3State(
+            orders=DeviceHashTable(
+                key_data=tuple(jnp.asarray(a)
+                               for a in payload["orders_key_data"]),
+                key_mask=tuple(jnp.asarray(a)
+                               for a in payload["orders_key_mask"]),
+                occupied=jnp.asarray(payload["orders_occupied"])),
+            agg=agg,
+            **{f: jnp.asarray(payload[f]) for f in (
+                "odate", "prio", "emitted_key", "emitted_rev",
+                "emitted_odate", "emitted_prio", "emitted_valid",
+                "orders_overflow", "saw_delete")},
+        )

@@ -34,21 +34,32 @@ python -m pytest -q -p no:cacheprovider \
     "$@"
 
 echo "== pallas compile proxy (StableHLO/Mosaic lowering, no chip) =="
-# Both TPU kernels (ops/pallas_rank.py, ops/interval_join.py) are lowered
-# for platform "tpu" WITHOUT executing — kernel tracing errors, Mosaic-
-# unsupported ops, and block-spec mismatches fail here even while the
-# chip tunnel is down.
+# Both TPU kernels (ops/pallas_rank.py, ops/interval_join.py) AND every
+# fused-epoch surface — q8 session windows, TPC-H q3, the co-scheduled
+# multi-job epoch — are lowered for platform "tpu" WITHOUT executing:
+# kernel tracing errors, Mosaic-unsupported ops, block-spec mismatches
+# and fused-core lowering breakage fail here even while the chip tunnel
+# is down.
 python -m pytest -q -p no:cacheprovider \
     tests/test_pallas_compile.py \
     "$@"
 
-echo "== fused-epoch / interval-join / batched-ingest subset =="
+echo "== fused-epoch / interval-join / co-schedule subset =="
 python -m pytest -q -p no:cacheprovider \
     tests/test_fused_epoch.py \
+    tests/test_fused_q8_q3.py \
+    tests/test_coschedule.py \
     tests/test_interval_join.py \
     tests/test_batched_ingest.py \
     tests/test_cli_fragments.py \
+    tests/test_bench_hardening.py -m 'not slow' \
     "$@"
+
+echo "== bench smoke (single tiny phase, 1-dispatch invariants) =="
+# seconds, not minutes: fused q5/q8/q3 epochs + a 4-job co-scheduled
+# group run end to end on the CPU backend with the
+# one-dispatch-per-epoch invariant asserted (bench.py --smoke)
+python bench.py --smoke
 
 echo "== distribution tests (cross-worker fragment graphs) =="
 python -m pytest -q -p no:cacheprovider \

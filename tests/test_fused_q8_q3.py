@@ -1,0 +1,316 @@
+"""The two new fused surfaces: q8 session windows
+(ops/session_window.py) and the TPC-H q3 streaming MV
+(ops/stream_q3.py). Each is pinned three ways: semantics against a
+plain-Python host model over the SAME generated events, fused epoch
+bit-exact against the unfused per-chunk fold (the executor-style
+driving of the same cores), and exactly ONE jit dispatch per epoch with
+per-epoch dispatch totals independent of k — including across a
+checkpoint export/import cycle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common import INT64, TIMESTAMP
+from risingwave_tpu.common.chunk import OP_DELETE, OP_INSERT
+from risingwave_tpu.common.dispatch_count import count_dispatches
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.connector import NexmarkConfig
+from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+from risingwave_tpu.connector.tpch import (
+    DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+)
+from risingwave_tpu.expr import col
+from risingwave_tpu.ops.fused_epoch import (
+    fused_source_q3_epoch, fused_source_session_epoch,
+)
+from risingwave_tpu.ops.session_window import SessionWindowCore
+from risingwave_tpu.ops.stream_q3 import Q3Core
+
+CAP = 256
+GAP = 5_000
+Q8_EPOCH_FN = "fused_source_session_epoch.<locals>.epoch"
+Q3_EPOCH_FN = "fused_source_q3_epoch.<locals>.epoch"
+
+
+def _q8_parts(capacity=1 << 12, closed=1 << 13):
+    exprs = [col(1, INT64), col(5, TIMESTAMP)]     # bidder, date_time
+    schema = Schema((Field("bidder", INT64), Field("ts", TIMESTAMP)))
+    core = SessionWindowCore(schema, key_col=0, ts_col=1, gap_us=GAP,
+                             capacity=capacity, closed_capacity=closed)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+    return exprs, core, gen
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# q8: session-gap windows
+# ---------------------------------------------------------------------------
+
+
+def test_session_core_matches_host_model():
+    """Closed sessions (incl. the watermark close) == a plain python
+    per-key sessionization of the same generated events."""
+    exprs, core, gen = _q8_parts()
+    fused = fused_source_session_epoch(gen.chunk_fn(), exprs, core, CAP)
+    key = jax.random.PRNGKey(7)
+    k = 8
+    end_ts = 1_600_000_000_000_000 + k * CAP * 100
+    st, snap, packed = fused(core.init_state(), jnp.int64(0), key, k,
+                             jnp.int64(end_ts))
+    n = int(packed[0])
+    assert not any(int(x) for x in packed[1:])
+
+    fn = gen.chunk_fn()
+    events: dict = {}
+    for i in range(k):
+        ch = fn(jnp.int64(i * CAP), jax.random.fold_in(key, i))
+        for b, t in zip(np.asarray(ch.columns[1].data),
+                        np.asarray(ch.columns[5].data)):
+            events.setdefault(int(b), []).append(int(t))
+    expected = set()
+    for kk, ts in events.items():
+        ts.sort()
+        start, last, cnt = ts[0], ts[0], 1
+        for t in ts[1:]:
+            if t - last > GAP:
+                expected.add((kk, start, last, cnt))
+                start, last, cnt = t, t, 1
+            else:
+                last, cnt = t, cnt + 1
+        if last + GAP <= end_ts:            # watermark-closed
+            expected.add((kk, start, last, cnt))
+    got = set()
+    ck, cs, ce, cn = (np.asarray(a) for a in snap)
+    for j in range(n):
+        got.add((int(ck[j]), int(cs[j]), int(ce[j]), int(cn[j])))
+    assert got == expected and len(expected) > 0
+
+
+def test_session_fused_matches_per_chunk_fold():
+    exprs, core, gen = _q8_parts()
+    fused = fused_source_session_epoch(gen.chunk_fn(), exprs, core, CAP)
+    key = jax.random.PRNGKey(11)
+    k = 6
+    wm = jnp.int64(1_600_000_000_000_000 + k * CAP * 100 - GAP)
+    st, snap, packed = fused(core.init_state(), jnp.int64(0), key, k, wm)
+
+    fn = gen.chunk_fn()
+    s2 = core.init_state()
+    ap = jax.jit(core.apply_chunk)
+    for i in range(k):
+        ch = fn(jnp.int64(i * CAP), jax.random.fold_in(key, i))
+        ch = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+        s2 = ap(s2, ch)
+    s2, packed2 = jax.jit(core.flush_plan)(s2, wm)
+    snap2 = core.snapshot_closed(s2)
+    s2 = jax.jit(core.finish_flush)(s2)
+
+    np.testing.assert_array_equal(np.asarray(packed), np.asarray(packed2))
+    _assert_tree_equal(snap, snap2)
+    _assert_tree_equal(st, s2)
+
+    # gather_closed packs the emission windows as INSERT chunks
+    n = int(packed[0])
+    out = jax.jit(core.gather_closed,
+                  static_argnames=("out_capacity",))(
+        snap, jnp.int64(n), jnp.int64(0), out_capacity=512)
+    assert int(np.asarray(out.vis).sum()) == min(n, 512)
+    assert (np.asarray(out.ops)[np.asarray(out.vis)] == OP_INSERT).all()
+
+
+def test_session_epoch_one_dispatch_k_independent():
+    with count_dispatches() as c:
+        exprs, core, gen = _q8_parts()
+        fused = fused_source_session_epoch(gen.chunk_fn(), exprs, core,
+                                           CAP)
+
+        def epoch(state, start, bno, k):
+            key = jax.random.fold_in(jax.random.PRNGKey(3), bno)
+            wm = jnp.int64(0)       # nothing watermark-closes; pure count
+            state, snap, packed = fused(state, jnp.int64(start), key, k,
+                                        wm)
+            assert not any(int(x) for x in jax.device_get(packed)[1:])
+            return state
+
+        state = epoch(core.init_state(), 0, 0, 4)       # compile
+        c.reset()
+        state = epoch(state, 4 * CAP, 1, 4)
+        assert c.counts[Q8_EPOCH_FN] == 1
+        n4 = c.total
+        c.reset()
+        state = epoch(state, 8 * CAP, 2, 8)
+        assert c.counts[Q8_EPOCH_FN] == 1
+        assert c.total == n4          # per-epoch dispatches independent of k
+
+
+def test_session_out_of_order_sets_sticky_flag():
+    """A per-key time rewind across chunks (anything but the monotone
+    NEXmark clock) trips the sticky out_of_order flag instead of
+    silently rewinding sessions."""
+    from risingwave_tpu.common.chunk import make_chunk
+    _, core, _ = _q8_parts()
+    in_schema = Schema((Field("bidder", INT64), Field("ts", TIMESTAMP)))
+    ap = jax.jit(core.apply_chunk)
+    t0 = 1_000_000
+    st = ap(core.init_state(), make_chunk(in_schema, [(7, t0)]))
+    assert not bool(st.out_of_order)
+    # same key, EARLIER timestamp in a later chunk
+    st = ap(st, make_chunk(in_schema, [(7, t0 - 1)]))
+    assert bool(st.out_of_order)
+    _, packed = jax.jit(core.flush_plan)(st, jnp.int64(0))
+    assert int(packed[4]) == 1          # surfaced in the packed fetch
+
+
+def test_session_checkpoint_roundtrip_bit_exact():
+    """export_host → import_host mid-stream, then continue both — the
+    recovered path stays bit-exact (checkpoint/recovery cycle)."""
+    exprs, core, gen = _q8_parts()
+    fused = fused_source_session_epoch(gen.chunk_fn(), exprs, core, CAP)
+    key = jax.random.PRNGKey(5)
+    k = 4
+    wm = jnp.int64(1_600_000_000_000_000 + 4 * CAP * 100 - GAP)
+    st, _, _ = fused(core.init_state(), jnp.int64(0), key, k, wm)
+
+    restored = core.import_host(core.export_host(st))
+    _assert_tree_equal(st, restored)
+    wm2 = jnp.int64(1_600_000_000_000_000 + 8 * CAP * 100 - GAP)
+    a = fused(st, jnp.int64(4 * CAP), key, k, wm2)
+    b = fused(restored, jnp.int64(4 * CAP), key, k, wm2)
+    for x, y in zip(a, b):
+        _assert_tree_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# TPC-H q3: join + agg + top-n
+# ---------------------------------------------------------------------------
+
+
+def _q3_parts(orders=1 << 11, agg=1 << 11):
+    gen = DeviceQ3Generator(TpchQ3Config(chunk_capacity=CAP))
+    core = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=orders,
+                  agg_capacity=agg)
+    return gen, core
+
+
+def test_q3_core_matches_host_model():
+    """Emitted top-10 == a plain python join+filter+agg+sort over the
+    same generated order/lineitem events (ties broken by orderkey)."""
+    gen, core = _q3_parts()
+    fused = fused_source_q3_epoch(gen.chunk_fn(), core, CAP)
+    key = jax.random.PRNGKey(0)
+    k = 8
+    st, out, packed = fused(core.init_state(), jnp.int64(0), key, k)
+    assert not any(int(x) for x in jax.device_get(packed)[1:])
+
+    fn = gen.chunk_fn()
+    rows = []
+    for i in range(k):
+        ch = fn(jnp.int64(i * CAP), key)
+        rows.extend(zip(*[np.asarray(c.data) for c in ch.columns]))
+    orders = {}
+    for r in rows:
+        if r[0] == 0 and r[2] < Q3_CUTOFF_DAYS and r[4] == 0:
+            orders[r[1]] = (int(r[2]), int(r[3]))
+    rev: dict = {}
+    for r in rows:
+        if r[0] == 1 and r[7] > Q3_CUTOFF_DAYS and r[1] in orders:
+            rev[r[1]] = rev.get(r[1], 0) + int(
+                r[5] * (10000 - r[6]) // 10000)
+    top = sorted(rev.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+    expected = [(int(kk), vv) + orders[kk] for kk, vv in top]
+    host = jax.device_get(st)
+    got = [(int(a), int(b), int(c), int(d)) for a, b, c, d, v in zip(
+        host.emitted_key, host.emitted_rev, host.emitted_odate,
+        host.emitted_prio, host.emitted_valid) if v]
+    assert got == expected and len(got) == 10
+
+
+def test_q3_fused_matches_per_chunk_fold_and_emits_retractions():
+    gen, core = _q3_parts()
+    fused = fused_source_q3_epoch(gen.chunk_fn(), core, CAP)
+    key = jax.random.PRNGKey(0)
+    k = 6
+    st, out, packed = fused(core.init_state(), jnp.int64(0), key, k)
+
+    fn = gen.chunk_fn()
+    s2 = core.init_state()
+    ap = jax.jit(core.apply_chunk)
+    for i in range(k):
+        s2 = ap(s2, fn(jnp.int64(i * CAP), jax.random.fold_in(key, i)))
+    s2, out2, packed2 = jax.jit(core.flush)(s2)
+    np.testing.assert_array_equal(np.asarray(packed), np.asarray(packed2))
+    _assert_tree_equal(out, out2)
+    _assert_tree_equal(st, s2)
+
+    # epoch 1 emits only inserts (nothing previously emitted)...
+    ops1 = np.asarray(out.ops)[np.asarray(out.vis)]
+    assert (ops1 == OP_INSERT).all() and len(ops1) == 10
+    # ...epoch 2's churn retracts departed/changed rows: the top-n output
+    # carries retractions even though both inputs are append-only
+    st, out, packed = fused(st, jnp.int64(k * CAP), key, k)
+    ops2 = np.asarray(out.ops)[np.asarray(out.vis)]
+    assert (ops2 == OP_DELETE).any() and (ops2 == OP_INSERT).any()
+
+
+def test_q3_epoch_one_dispatch_k_independent():
+    with count_dispatches() as c:
+        gen, core = _q3_parts()
+        fused = fused_source_q3_epoch(gen.chunk_fn(), core, CAP)
+
+        def epoch(state, start, bno, k):
+            key = jax.random.fold_in(jax.random.PRNGKey(9), bno)
+            state, out, packed = fused(state, jnp.int64(start), key, k)
+            assert not any(int(x) for x in jax.device_get(packed)[1:])
+            return state
+
+        state = epoch(core.init_state(), 0, 0, 4)       # compile
+        c.reset()
+        state = epoch(state, 4 * CAP, 1, 4)
+        assert c.counts[Q3_EPOCH_FN] == 1
+        n4 = c.total
+        c.reset()
+        state = epoch(state, 8 * CAP, 2, 8)
+        assert c.counts[Q3_EPOCH_FN] == 1
+        assert c.total == n4
+
+
+def test_q3_checkpoint_roundtrip_bit_exact():
+    gen, core = _q3_parts()
+    fused = fused_source_q3_epoch(gen.chunk_fn(), core, CAP)
+    key = jax.random.PRNGKey(2)
+    st, _, _ = fused(core.init_state(), jnp.int64(0), key, 4)
+
+    restored = core.import_host(core.export_host(st))
+    _assert_tree_equal(st, restored)
+    a = fused(st, jnp.int64(4 * CAP), key, 4)
+    b = fused(restored, jnp.int64(4 * CAP), key, 4)
+    for x, y in zip(a, b):
+        _assert_tree_equal(x, y)
+
+
+def test_q3_orders_filter_is_join_filter():
+    """A lineitem whose order was filtered out (wrong segment / late
+    order date) contributes nothing — the at-insert filter IS the join
+    filter."""
+    gen, core = _q3_parts()
+    fn = gen.chunk_fn()
+    st = core.init_state()
+    ap = jax.jit(core.apply_chunk)
+    for i in range(4):
+        ch = fn(jnp.int64(i * CAP), None)
+        st = ap(st, ch)
+    host = jax.device_get(st)
+    stored = set(np.asarray(host.orders.key_data[0])[
+        np.asarray(host.orders.occupied)].tolist())
+    live = np.asarray(host.agg.lanes[0]) > 0
+    grouped = set(np.asarray(host.agg.table.key_data[0])[live].tolist())
+    assert grouped <= stored            # every revenue group has its order
+    # and the filter actually filtered: far fewer stored than seen orders
+    n_orders_seen = 4 * CAP // 4        # one order per 4 events
+    assert 0 < len(stored) < n_orders_seen // 2

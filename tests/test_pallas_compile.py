@@ -56,3 +56,82 @@ def test_lowering_is_compile_only():
     text = _lower_tpu(lambda a, m: rank_totals_pallas_call(a, m),
                       ident, matches)
     assert len(text) > 0
+
+
+# ---------------------------------------------------------------------------
+# New fused surfaces (q8 session windows, TPC-H q3, multi-job co-scheduled
+# epochs): lowered for platform "tpu" WITHOUT executing, so a fused core
+# that stopped compiling for the chip fails CI while the tunnel is down —
+# same contract as the Pallas kernels above.
+# ---------------------------------------------------------------------------
+
+
+def _lower_tpu_jitted(jitted, *args) -> str:
+    return jitted.trace(*args).lower(lowering_platforms=("tpu",)).as_text()
+
+
+def test_fused_session_epoch_lowers_for_tpu():
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import col
+    from risingwave_tpu.ops.fused_epoch import fused_source_session_epoch
+    from risingwave_tpu.ops.session_window import SessionWindowCore
+
+    core = SessionWindowCore(
+        Schema((Field("bidder", INT64), Field("ts", TIMESTAMP))),
+        key_col=0, ts_col=1, gap_us=500_000,
+        capacity=1 << 12, closed_capacity=1 << 12)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=512))
+    fused = fused_source_session_epoch(
+        gen.chunk_fn(), [col(1, INT64), col(5, TIMESTAMP)], core, 512,
+        donate=False)
+    text = _lower_tpu_jitted(fused, core.init_state(), jnp.int64(0),
+                             jax.random.PRNGKey(0), 4, jnp.int64(0))
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+
+
+def test_fused_q3_epoch_lowers_for_tpu():
+    from risingwave_tpu.connector.tpch import (
+        DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+    )
+    from risingwave_tpu.ops.fused_epoch import fused_source_q3_epoch
+    from risingwave_tpu.ops.stream_q3 import Q3Core
+
+    core = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=1 << 12,
+                  agg_capacity=1 << 12)
+    gen = DeviceQ3Generator(TpchQ3Config(chunk_capacity=512))
+    fused = fused_source_q3_epoch(gen.chunk_fn(), core, 512, donate=False)
+    text = _lower_tpu_jitted(fused, core.init_state(), jnp.int64(0),
+                             jax.random.PRNGKey(0), 4)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+
+
+def test_multi_job_epoch_lowers_for_tpu():
+    """The co-scheduled group epoch (vmapped over the job axis) lowers
+    for the chip — the tentpole surface compiles even while the tunnel
+    is down."""
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.connector import BID_SCHEMA, NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.ops import fused_multi as fm
+    from risingwave_tpu.stream import HashAggExecutor, ProjectExecutor
+    from risingwave_tpu.stream.source import MockSource
+
+    exprs = [call("tumble_start", col(5, TIMESTAMP),
+                  Literal(1_000_000, INT64)), col(0, INT64)]
+    proj = ProjectExecutor(MockSource(BID_SCHEMA, []), exprs,
+                           names=("ws", "a"))
+    agg = HashAggExecutor(proj, [0, 1], [count_star()],
+                          table_capacity=1 << 12, out_capacity=512)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=512))
+    multi = fm.fused_multi_agg_epoch(gen.chunk_fn(), exprs, agg.core,
+                                     512, donate=False)
+    stacked = fm.stack_states([agg.core.init_state() for _ in range(8)])
+    starts = jnp.zeros(8, jnp.int64)
+    keys = jnp.stack([jax.random.PRNGKey(j) for j in range(8)])
+    text = _lower_tpu_jitted(multi, stacked, starts, keys, 4)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
