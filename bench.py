@@ -125,6 +125,17 @@ COSCHED_TICKS = 12
 COSCHED_WARMUP_TICKS = 3
 COSCHED_SMOKE_CHUNK = 256      # ops-level shapes for --smoke
 COSCHED_SMOKE_TABLE = 1 << 12
+# mesh-sharded fused phase (ops/fused_sharded.py + parallel/fused.py):
+# the fused q5/q7 epochs promoted to the whole mesh — one dispatch per
+# epoch across all chips, state hash-partitioned via the in-dispatch
+# all_to_all. On the CPU stand-in the mesh is virtual
+# (XLA_FLAGS=--xla_force_host_platform_device_count); on a healthy chip
+# it is the real slice. Aggregate rows/s recorded per shard count.
+SHARDED_SHARD_COUNTS = (1, 4, 8)
+SHARDED_N_CHUNKS = 128
+SHARDED_WARMUP_CHUNKS = 32
+SHARDED_Q7_N_CHUNKS = 64
+SHARDED_VIRTUAL_DEVICES = 8    # CPU stand-in virtual mesh size
 
 
 def _emit(obj: dict) -> None:
@@ -517,6 +528,147 @@ def measure_q3_fused(n_chunks: int) -> float:
     return n_chunks * CHUNK / elapsed
 
 
+def measure_q5_sharded_fused(n_chunks: int, n_shards: int) -> float:
+    """Aggregate source rows/s of the q5 core MESH-SHARDED: generation,
+    projection, the in-dispatch vnode all_to_all shuffle, and per-shard
+    aggregation fused into one dispatch per epoch across ``n_shards``
+    devices (ops/fused_sharded.py). The flush is one packed fetch for
+    every shard + per-shard churn gathers — the solo fused barrier
+    cadence, at mesh width."""
+    import jax
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.ops.grouped_agg import AggCore
+    from risingwave_tpu.parallel.fused import ShardedFusedAgg
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(WINDOW_US, INT64)),
+        col(0, INT64),
+    ]
+    # capacities are PER SHARD: the group set partitions across the mesh
+    core = AggCore([INT64, INT64], [0, 1], [count_star()],
+                   max((1 << 21) // n_shards, 1 << 16), CHUNK)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    sf = ShardedFusedAgg(make_mesh(n_shards), core, gen.chunk_fn(),
+                         exprs, CHUNK)
+
+    def run(n, start_event, batch_no):
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)
+            done += per
+            key = jax.random.fold_in(jax.random.PRNGKey(17), batch_no)
+            batch_no += 1
+            sf.run_epoch(start_event, key, per)
+            start_event += per * CHUNK
+            sf.flush()
+        return start_event, batch_no
+
+    start_event, batch_no = run(SHARDED_WARMUP_CHUNKS, 0, 0)
+    jax.block_until_ready(sf.stacked.lanes)
+    t0 = time.perf_counter()
+    run(n_chunks, start_event, batch_no)
+    jax.block_until_ready(sf.stacked.lanes)
+    return n_chunks * CHUNK / (time.perf_counter() - t0)
+
+
+def measure_q7_sharded_fused(n_chunks: int, n_shards: int) -> float:
+    """Aggregate source rows/s of the q7 core MESH-SHARDED: the bucketed
+    interval join's ring partitions by window vnode across the mesh
+    (per-shard ring ≈ solo/n — windows spread uniformly under the hash),
+    and one dispatch per epoch covers every shard's ingest AND flush
+    plan; ONE [n, 6] packed fetch covers all flags and counts."""
+    import jax
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.ops.interval_join import IntervalJoinCore
+    from risingwave_tpu.parallel.fused import ShardedFusedJoin
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP),
+             Literal(Q7_WINDOW_US, INT64)),
+        col(0, INT64),
+        col(2, INT64),
+    ]
+    probe_schema = Schema((Field("window_start", TIMESTAMP),
+                           Field("auction", INT64), Field("price", INT64)))
+    core = IntervalJoinCore(
+        probe_schema, ts_col=0, val_col=2, window_us=Q7_WINDOW_US,
+        # per-shard ring: 2x the expected windows-per-shard share
+        n_buckets=max(2 * Q7_BUCKETS // n_shards, 1 << 10),
+        lane_width=Q7_LANES)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    sf = ShardedFusedJoin(make_mesh(n_shards), core, gen.chunk_fn(),
+                          exprs, CHUNK)
+
+    def run(n, start_event, batch_no):
+        last = None
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)
+            done += per
+            key = jax.random.fold_in(jax.random.PRNGKey(23), batch_no)
+            batch_no += 1
+            sf.run_epoch(start_event, key, per)
+            start_event += per * CHUNK
+            probe, churn = sf.flush(out_capacity=CHUNK)
+            if churn:
+                last = churn[-1]
+            elif probe:
+                last = probe[-1]
+        if last is not None:
+            jax.block_until_ready(last)
+        return start_event, batch_no
+
+    start_event, batch_no = run(SHARDED_WARMUP_CHUNKS, 0, 0)
+    jax.block_until_ready(sf.stacked.cur_max)
+    t0 = time.perf_counter()
+    run(n_chunks, start_event, batch_no)
+    jax.block_until_ready(sf.stacked.cur_max)
+    return n_chunks * CHUNK / (time.perf_counter() - t0)
+
+
+def run_sharded_phase(n_chunks: int, q7_chunks: int) -> None:
+    """Child entry for the mesh-sharded fused phase: measure q5/q7 at
+    every shard count this process's backend can host, print one JSON
+    line (MULTICHIP-style: n_devices + ok + per-shard-count rates)."""
+    import jax
+    n_devices = len(jax.devices())
+    by_shards: dict = {}
+    for n in SHARDED_SHARD_COUNTS:
+        if n > n_devices:
+            continue
+        entry = {"q5_rows_per_sec": round(
+            measure_q5_sharded_fused(n_chunks, n), 1)}
+        if n == max(c for c in SHARDED_SHARD_COUNTS if c <= n_devices):
+            # q7 once, at the widest mesh (it is the slow measurement)
+            entry["q7_rows_per_sec"] = round(
+                measure_q7_sharded_fused(q7_chunks, n), 1)
+        by_shards[str(n)] = entry
+    widest = max((int(k) for k in by_shards), default=0)
+    _emit({
+        "metric": "sharded_fused_epochs",
+        "unit": "rows/s",
+        "n_devices": n_devices,
+        "ok": bool(by_shards),
+        "backend": jax.default_backend(),
+        "sharded_fused_shards": widest,
+        "sharded_fused_by_shards": by_shards,
+        "q5_sharded_fused_rows_per_sec": (
+            by_shards.get(str(widest), {}).get("q5_rows_per_sec")),
+        "q7_sharded_fused_rows_per_sec": (
+            by_shards.get(str(widest), {}).get("q7_rows_per_sec")),
+    })
+
+
 def _cosched_parts():
     """Ops-level build for the --smoke dispatch-count check: one small
     q5-shaped agg core + projection over the device bid source."""
@@ -766,6 +918,43 @@ def measure_cpu_standin() -> dict:
                                       Q8_CPU_N_CHUNKS, Q3_CPU_N_CHUNKS))
 
 
+_SHARDED_RESULT_FIELDS = (
+    "sharded_fused_shards", "sharded_fused_by_shards",
+    "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
+)
+
+
+def measure_sharded_cpu() -> dict:
+    """The mesh-sharded fused phase on the CPU stand-in: a virtual
+    8-device mesh (XLA_FLAGS=--xla_force_host_platform_device_count) in
+    a fresh subprocess. The record persisted to BENCH_partial.json is the
+    MULTICHIP-style sub-record (n_devices / ok / per-shard-count rates)
+    the driver's dryrun artifacts established."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count="
+                 f"{SHARDED_VIRTUAL_DEVICES}").strip()
+    env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags,
+           "PALLAS_AXON_POOL_IPS": None, "TPU_LIBRARY_PATH": None}
+    return _spawn_phase("sharded_fused_cpu", env,
+                        ["--sharded-phase", str(SHARDED_N_CHUNKS),
+                         str(SHARDED_Q7_N_CHUNKS)])
+
+
+def measure_sharded_tpu(cache_env: dict) -> tuple:
+    """(result | None, error | None): one attempt of the sharded phase on
+    the real mesh — only meaningful on a multi-chip slice; a single-chip
+    backend still records a 1-shard point. Non-fatal: a failure here
+    never costs the round its headline numbers."""
+    try:
+        return _spawn_phase("sharded_fused_tpu", dict(cache_env),
+                            ["--sharded-phase", str(SHARDED_N_CHUNKS),
+                             str(SHARDED_Q7_N_CHUNKS)]), None
+    except Exception as e:  # noqa: BLE001 - attributed, not fatal
+        sys.stderr.write(f"bench: sharded tpu phase: {e}\n")
+        return None, str(e)
+
+
 def _tpu_cache_env() -> dict:
     """One persistent XLA compilation cache shared by EVERY tpu attempt
     of this run: a retry after a mid-phase wedge skips the compiles the
@@ -775,6 +964,9 @@ def _tpu_cache_env() -> dict:
     cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if not cache:
         cache = tempfile.mkdtemp(prefix="rwtpu_jaxcache_")
+        # memoize for the run: every later phase (retries, the sharded
+        # TPU phase) must land in the SAME cache dir
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = cache
     return {"JAX_COMPILATION_CACHE_DIR": cache,
             "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0"}
 
@@ -827,6 +1019,11 @@ _SHARED_FIELDS = (
     "coscheduled_sequential_rows_per_sec", "coschedule_speedup",
     "coscheduled_n_mvs",
     "p99_barrier_ms", "p50_barrier_ms", "p99_barrier_ms_inflight4",
+    # mesh-sharded fused epochs (ops/fused_sharded.py): aggregate rows/s
+    # + shard counts, present on EVERY backend so the TPU-outage fallback
+    # record stays schema-stable
+    "sharded_fused_shards", "sharded_fused_by_shards",
+    "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
 )
 
 
@@ -844,8 +1041,36 @@ def main() -> int:
         out["phases"] = PHASE_LOG
         _emit(out)
         return 2
+    # mesh-sharded fused phase (virtual 8-device mesh): merged into the
+    # CPU record so the shared-field copy below keeps the fallback
+    # record schema-stable; non-fatal — a sharded regression must not
+    # cost the round its headline numbers
+    try:
+        sharded_cpu = measure_sharded_cpu()
+        for f in _SHARDED_RESULT_FIELDS:
+            cpu[f] = sharded_cpu.get(f)
+    except Exception as e:  # noqa: BLE001 - attributed below
+        sys.stderr.write(f"bench: sharded cpu phase failed: {e}\n")
+        cpu["sharded_fused_error"] = str(e)
     cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     tpu, tpu_err = measure_tpu()
+    if tpu is not None:
+        sharded_env = _tpu_cache_env()
+        if tpu.get("rank_kernel") == "jnp_fallback":
+            # the main TPU phase only succeeded with the Pallas kernels
+            # disabled — the sharded phase must run the same way or it
+            # re-hits the kernel failure and loses the whole record
+            sharded_env["RWTPU_PALLAS"] = "0"
+        sharded_tpu, sharded_tpu_err = measure_sharded_tpu(sharded_env)
+        if sharded_tpu is not None:
+            for f in _SHARDED_RESULT_FIELDS:
+                tpu[f] = sharded_tpu.get(f)
+            tpu["sharded_fused_n_devices"] = sharded_tpu.get("n_devices")
+        else:
+            tpu["sharded_fused_error"] = sharded_tpu_err
+            # keep the record schema-stable with the stand-in's numbers
+            for f in _SHARDED_RESULT_FIELDS:
+                tpu.setdefault(f, cpu.get(f))
     if tpu is None:
         # tunnel/chip unavailable: fall back to the CPU streaming
         # measurement as the round's headline — a real, nonzero number
@@ -990,6 +1215,24 @@ def run_smoke() -> int:
         assert n == 1, f"q3 epoch took {n} dispatches"
         assert not any(int(x) for x in jax.device_get(packed3)[1:])
         checks.append("q3=1 dispatch/epoch")
+
+        # mesh-sharded fused epoch (ops/fused_sharded.py) on whatever
+        # mesh this backend can host (CI pins CPU without a virtual
+        # mesh, so usually 1 device — the invariant is identical)
+        from risingwave_tpu.parallel.fused import ShardedFusedAgg
+        from risingwave_tpu.parallel.sharded_agg import make_mesh
+        n_dev = min(len(jax.devices()), 4)
+        exprs2, agg2, chunk_fn2 = _cosched_parts()
+        sf = ShardedFusedAgg(make_mesh(n_dev), agg2.core, chunk_fn2,
+                             exprs2, COSCHED_SMOKE_CHUNK)
+        sf.run_epoch(0, jax.random.PRNGKey(0), k)
+        sf.flush()
+        c.reset()
+        sf.run_epoch(k * COSCHED_SMOKE_CHUNK, jax.random.PRNGKey(1), k)
+        n = c.counts["sharded_agg_epoch.<locals>.epoch"]
+        assert n == 1, f"sharded epoch took {n} dispatches"
+        sf.flush()
+        checks.append(f"sharded[{n_dev}]=1 dispatch/epoch")
     _emit({"metric": "bench_smoke", "value": round(
         time.perf_counter() - t0, 2), "unit": "s",
         "backend": jax.default_backend(), "checks": checks})
@@ -997,7 +1240,8 @@ def run_smoke() -> int:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] in ("--phase", "--probe"):
+    if len(sys.argv) > 1 and sys.argv[1] in ("--phase", "--probe",
+                                             "--sharded-phase"):
         watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
@@ -1014,6 +1258,19 @@ if __name__ == "__main__":
             except Exception as e:
                 _emit(_fail_line(f"probe failed: {type(e).__name__}: {e}"))
                 raise SystemExit(2)
+            raise SystemExit(0)
+        if sys.argv[1] == "--sharded-phase":
+            watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                run_sharded_phase(int(sys.argv[2]), int(sys.argv[3]))
+            except Exception as e:
+                _emit(_fail_line(
+                    f"sharded phase failed: {type(e).__name__}: {e}"))
+                raise SystemExit(2)
+            finally:
+                watchdog.cancel()
             raise SystemExit(0)
         n = int(sys.argv[2])
         n7 = int(sys.argv[3])

@@ -29,9 +29,16 @@ def _build_session(args):
     if getattr(args, "compactors", 0):
         kwargs["compactors"] = args.compactors
     fp = getattr(args, "fragment_parallelism", 1)
-    if fp and fp != 1:
+    mesh_n = getattr(args, "mesh", 0)
+    if (fp and fp != 1) or mesh_n:
         from .frontend.build import BuildConfig
-        kwargs["config"] = BuildConfig(fragment_parallelism=fp)
+        mesh = None
+        if mesh_n:
+            # refuses loudly (MeshUnavailableError) when the process has
+            # fewer devices than asked for — see [streaming] mesh_shape
+            from .parallel.sharded_agg import make_mesh
+            mesh = make_mesh(mesh_n)
+        kwargs["config"] = BuildConfig(fragment_parallelism=fp, mesh=mesh)
     return Session(**kwargs)
 
 
@@ -56,6 +63,15 @@ def main(argv=None) -> int:
         "1 = single actor; must match the value a durable data dir was "
         "deployed with so recovery and `ctl fragments` reflect the live "
         "topology; reference: streaming.default_parallelism)")
+    fp_arg.add_argument(
+        "--mesh", type=int, default=0, metavar="N",
+        help="shard operator state across an N-device mesh "
+        "(BuildConfig.mesh / [streaming] mesh_shape): grouped aggs and "
+        "joins run the mesh-sharded executors, and eligible fused MVs "
+        "tick as one dispatch per epoch across all chips. Refuses "
+        "loudly when the process has fewer than N devices (on CPU set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N); 0 = "
+        "single-chip")
 
     pg = sub.add_parser("playground", parents=[fp_arg],
                         help="serve SQL over the Postgres wire protocol")

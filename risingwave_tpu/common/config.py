@@ -15,6 +15,18 @@ import dataclasses
 from typing import Any, Optional
 
 
+class MeshUnavailableError(RuntimeError):
+    """A requested or persisted device-mesh topology needs more devices
+    than the process has. Raised loudly instead of silently degrading to
+    a single-chip layout (frontend/build.py config_from_json,
+    parallel/sharded_agg.py make_mesh): recovering a mesh-sharded job
+    without its mesh would quietly fall back to an unsharded plan. Either
+    restart with enough devices (on CPU:
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``) or re-shard
+    explicitly (``config_from_json(..., allow_reshard=True)`` — the
+    sharded state re-shards by replaying the vnode mapping on load)."""
+
+
 @dataclasses.dataclass
 class StreamingConfig:
     # reference: config.rs streaming section + system params
@@ -36,6 +48,14 @@ class StreamingConfig:
     # group instead of one executor pipeline each; ineligible shapes
     # fall back to the solo executor path (docs/performance.md)
     coschedule: bool = False
+    # device mesh for the mesh-sharded paths (parallel/): N >= 1 builds a
+    # 1-D mesh over the first N local devices (BuildConfig.mesh) so
+    # grouped aggs/joins shard across chips — and, with ``coschedule``
+    # on, eligible fused MVs take the mesh-sharded fused-epoch path
+    # (ops/fused_sharded.py): one dispatch per epoch across ALL chips.
+    # Refuses loudly (MeshUnavailableError) when the process has fewer
+    # devices. 0/None = single-chip.
+    mesh_shape: Optional[int] = None
     # observability (common/tracing.py): span ring size per process, and
     # the slow-epoch detector — an epoch whose inject→collect latency
     # meets the threshold gets its span tree snapshotted for post-hoc
@@ -144,14 +164,63 @@ class RwConfig:
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
 
+def _parse_toml_subset(text: str) -> dict:
+    """Fallback parser for the config-file TOML subset (``[section]`` +
+    scalar ``key = value`` lines) on interpreters without ``tomllib``
+    (< 3.11, no vendored tomli). Enough for every rw_config knob: ints,
+    floats, bools, quoted strings."""
+    data: dict = {}
+    section: dict = data
+    for raw in text.splitlines():
+        # strip comments, but only a '#' OUTSIDE quotes starts one
+        line = raw
+        quote = None
+        for i, ch in enumerate(raw):
+            if quote:
+                if ch == quote:
+                    quote = None
+            elif ch in "'\"":
+                quote = ch
+            elif ch == "#":
+                line = raw[:i]
+                break
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = data.setdefault(line[1:-1].strip(), {})
+            continue
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"unparseable config line: {raw!r}")
+        key, val = key.strip(), val.strip()
+        if val.startswith(("'", '"')) and val.endswith(val[0]):
+            section[key] = val[1:-1]
+        elif val in ("true", "false"):
+            section[key] = val == "true"
+        else:
+            try:
+                section[key] = int(val)
+            except ValueError:
+                section[key] = float(val)
+    return data
+
+
 def load_config(path: Optional[str] = None, **overrides: Any) -> RwConfig:
     """defaults ← TOML file ← dotted-key overrides
     (e.g. ``load_config("rw.toml", **{"streaming.checkpoint_frequency": 4})``)."""
     cfg = RwConfig()
     if path is not None:
-        import tomllib
-        with open(path, "rb") as f:
-            data = tomllib.load(f)
+        try:
+            import tomllib
+        except ModuleNotFoundError:
+            tomllib = None
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                data = _parse_toml_subset(f.read())
         for section, values in data.items():
             _apply_section(cfg, section, values)
     for dotted, v in overrides.items():

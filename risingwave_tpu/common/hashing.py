@@ -71,3 +71,30 @@ def vnode_to_shard(vnode: jax.Array, num_shards: int) -> jax.Array:
     (docs/consistent-hash.md)."""
     per = VNODE_COUNT // num_shards
     return jnp.minimum(vnode // per, num_shards - 1).astype(jnp.int32)
+
+
+def shard_rows(key_types: Sequence, rows: Sequence, n_shards: int) -> list:
+    """Host-side partition of key-prefixed rows by the SAME vnode mapping
+    the device paths route with (``vnode_of → vnode_to_shard``): returns
+    ``n_shards`` row lists. Shared by every reload/re-shard surface
+    (stream/hash_agg.py shard filtering, parallel/fused.py recovery) so
+    durable-row placement can never diverge from live routing."""
+    import numpy as np
+
+    rows = list(rows)
+    out: list[list] = [[] for _ in range(n_shards)]
+    nk = len(key_types)
+    bs = 1024
+    for i in range(0, len(rows), bs):
+        batch = rows[i:i + bs]
+        cols = []
+        for c in range(nk):
+            vals = [r[c] for r in batch]
+            data = np.array([v if v is not None else 0 for v in vals],
+                            dtype=key_types[c].np_dtype)
+            mask = np.array([v is not None for v in vals])
+            cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+        shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
+        for r, s in zip(batch, shard):
+            out[int(s)].append(r)
+    return out

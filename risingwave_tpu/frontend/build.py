@@ -348,9 +348,18 @@ def config_to_json(cfg: BuildConfig) -> str:
     return json.dumps(d, sort_keys=True)
 
 
-def config_from_json(s: str) -> BuildConfig:
-    """Rebuild a BuildConfig from its durable form. Raises RuntimeError if
-    the mesh topology needs more devices than the process has."""
+def config_from_json(s: str, allow_reshard: bool = False) -> BuildConfig:
+    """Rebuild a BuildConfig from its durable form.
+
+    When the persisted mesh topology needs more devices than the process
+    has this REFUSES loudly (``MeshUnavailableError``) — the silent
+    alternative was an N-shard job quietly reopening on the session's
+    default (unsharded) layout. ``allow_reshard=True`` is the explicit
+    escape hatch: a 1-D mesh shrinks to the available device count, which
+    is safe because the sharded executors and the fused sharded path
+    re-shard durable state by replaying the vnode mapping on load
+    (parallel/fused.load_shard_states, ShardedHashAggExecutor's
+    load-shard filter)."""
     import json
     d = json.loads(s)
     mesh_spec = d.pop("mesh", None)
@@ -360,13 +369,20 @@ def config_from_json(s: str) -> BuildConfig:
         import math
         import jax
         import numpy as _np
-        n = math.prod(mesh_spec["shape"])
+        from ..common.config import MeshUnavailableError
+        shape = list(mesh_spec["shape"])
+        n = math.prod(shape)
         devs = jax.devices()
         if len(devs) < n:
-            raise RuntimeError(
-                f"persisted mesh needs {n} devices, process has {len(devs)}")
+            if allow_reshard and len(shape) == 1 and devs:
+                shape = [len(devs)]
+                n = len(devs)
+            else:
+                raise MeshUnavailableError(
+                    f"persisted mesh needs {n} devices, process has "
+                    f"{len(devs)}")
         cfg = dataclasses.replace(cfg, mesh=jax.sharding.Mesh(
-            _np.array(devs[:n]).reshape(mesh_spec["shape"]),
+            _np.array(devs[:n]).reshape(shape),
             tuple(mesh_spec["axis_names"])))
     return cfg
 

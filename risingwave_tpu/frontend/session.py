@@ -26,6 +26,7 @@ from ..common.chunk import (
     OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
     chunk_to_rows, make_chunk,
 )
+from ..common.config import MeshUnavailableError
 from ..common.types import Field, Schema
 from ..connector.nexmark import (
     AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig, NexmarkGenerator,
@@ -232,6 +233,16 @@ class Session:
             from ..common.tracing import GLOBAL_TRACE
             if st.trace_ring_capacity != GLOBAL_TRACE.capacity:
                 GLOBAL_TRACE.set_capacity(st.trace_ring_capacity)
+            mesh = None
+            if st.mesh_shape:
+                # [streaming] mesh_shape: a 1-D device mesh for the
+                # sharded paths, built over the first N local devices —
+                # N = 1 included, so the knob agrees with `--mesh 1`
+                # (a durable job created either way recovers under the
+                # other). make_mesh refuses loudly (MeshUnavailableError)
+                # when the process has fewer devices than configured.
+                from ..parallel.sharded_agg import make_mesh
+                mesh = make_mesh(st.mesh_shape)
             config = config or BuildConfig(
                 chunk_capacity=st.chunk_capacity,
                 agg_table_capacity=st.agg_table_capacity,
@@ -239,7 +250,8 @@ class Session:
                 join_bucket_width=st.join_bucket_width,
                 topn_table_capacity=st.topn_table_capacity,
                 fragment_parallelism=st.fragment_parallelism,
-                coschedule=st.coschedule)
+                coschedule=st.coschedule,
+                mesh=mesh)
         # fault-tolerance knobs for every external boundary (object-store
         # retry, sink degrade, broker reconnect, worker deadlines) —
         # common/config.py FaultConfig; explicit fault_config wins over
@@ -343,6 +355,13 @@ class Session:
         self._cosched = CoScheduler()
         self._cosched_engines: dict[str, tuple] = {}
         self._cosched_markers: set[str] = set()
+        # mesh-sharded fused MVs (ops/fused_sharded.py): with a mesh AND
+        # the coschedule opt-in, eligible MVs tick as ONE dispatch per
+        # epoch across all chips. Engines map job -> (flush/persistence
+        # HashAggExecutor, output queue, device source cursor,
+        # parallel/fused.ShardedFusedAgg).
+        self._shardfused_engines: dict[str, tuple] = {}
+        self._shardfused_markers: set[str] = set()
         self.feeds: list[_SourceFeed] = []
         self.backfills: list[_BackfillRef] = []
         # DML rendezvous (reference: DmlManager, src/source/src/
@@ -450,14 +469,25 @@ class Session:
                 self._cosched_markers.add(
                     line[len("-- coschedule"):].strip())
                 continue
+            if line.startswith("-- shardfused"):
+                # mesh-sharded fused MV (ops/fused_sharded.py): replay
+                # routes back down that path (re-sharding onto THIS
+                # session's mesh by replaying the vnode mapping) or
+                # refuses loudly — marker-directed in both directions,
+                # like the coschedule marker above
+                self._shardfused_markers.add(
+                    line[len("-- shardfused"):].strip())
+                continue
             if not line.startswith("-- reschedule"):
-                if (resched_cfg or self._cosched_markers) \
+                if (resched_cfg or self._cosched_markers
+                        or self._shardfused_markers) \
                         and "drop" in line.lower():
                     try:
                         for stmt in parse_sql(piece):
                             if isinstance(stmt, A.DropStatement):
                                 resched_cfg.pop(stmt.name, None)
                                 self._cosched_markers.discard(stmt.name)
+                                self._shardfused_markers.discard(stmt.name)
                     except Exception:  # noqa: BLE001 - replay parses below
                         pass
                 continue
@@ -471,11 +501,30 @@ class Session:
                     "session's default BuildConfig")
                 continue
             try:
+                import os as _os
                 from .build import config_from_json
-                resched_cfg[mv_name] = config_from_json(cfg_json)
+                # RWTPU_ALLOW_MESH_RESHARD=1 is the operator's EXPLICIT
+                # consent to shrink a saved mesh to the available devices
+                # (state re-shards by vnode replay on load)
+                allow = _os.environ.get(
+                    "RWTPU_ALLOW_MESH_RESHARD") == "1"
+                resched_cfg[mv_name] = config_from_json(
+                    cfg_json, allow_reshard=allow)
+            except MeshUnavailableError as e:
+                # the saved mesh topology needs more devices than this
+                # process has. The old behavior degraded SILENTLY to the
+                # session default (an 8-shard job quietly reopening
+                # unsharded); refuse loudly instead — the operator either
+                # restores the device count or re-shards explicitly
+                raise RuntimeError(
+                    f"reschedule {mv_name}: {e}. Restart with at least "
+                    "that many devices (on CPU: XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N), or "
+                    "re-shard explicitly onto the available devices by "
+                    "reopening with RWTPU_ALLOW_MESH_RESHARD=1"
+                ) from e
             except Exception as e:  # noqa: BLE001 - corrupt/unportable cfg
-                # covers both "not enough devices" (RuntimeError) and a
-                # corrupt/truncated log line (JSONDecodeError/KeyError):
+                # a corrupt/truncated log line (JSONDecodeError/KeyError):
                 # every job still recovers under the default config
                 import warnings
                 warnings.warn(
@@ -845,6 +894,27 @@ class Session:
             return self._create_mv_remote(stmt)
         cosched_plan = None
         if not pk_prefix and getattr(self.config, "coschedule", False) \
+                and self.config.mesh is not None \
+                and self.config.agg_hbm_budget is None \
+                and (not self._recovering
+                     or stmt.name in self._shardfused_markers):
+            # mesh-sharded fused path (ops/fused_sharded.py): with a mesh
+            # AND the fused opt-in, an eligible MV's whole epoch runs as
+            # one dispatch across all chips; ineligible shapes fall
+            # through to the mesh-sharded EXECUTORS (parallel/
+            # executors.py) below. Recovery is marker-directed in both
+            # directions, and re-shards onto THIS session's mesh size by
+            # replaying the vnode mapping over the committed rows.
+            res, cosched_plan = self._try_shardfused_mv(stmt)
+            if res is not None:
+                return res
+        if self._recovering and stmt.name in self._shardfused_markers:
+            raise SqlError(
+                f"MV {stmt.name!r} was created mesh-sharded fused; reopen "
+                "the session with a device mesh ([streaming] mesh_shape / "
+                "BuildConfig.mesh) and [streaming] coschedule = true — or "
+                "DROP and re-CREATE it")
+        if not pk_prefix and getattr(self.config, "coschedule", False) \
                 and self.config.mesh is None \
                 and self.config.fragment_parallelism <= 1 \
                 and self.config.agg_hbm_budget is None \
@@ -1056,6 +1126,139 @@ class Session:
                     ckpt_states.append(agg.state)
             if checkpoint:
                 group.set_states(ckpt_states)
+
+    # ------------------------------------------- mesh-sharded fused MV jobs --
+
+    def _try_shardfused_mv(self, stmt: A.CreateMaterializedView):
+        """Route an eligible source+agg plan onto the mesh-sharded fused
+        path (ops/fused_sharded.py + parallel/fused.py): the MV's whole
+        epoch — generation, projection, the in-dispatch all_to_all vnode
+        shuffle, aggregation — is ONE dispatch across every chip of
+        ``config.mesh``. Eligibility is exactly the co-scheduler's shape
+        match; anything else returns ``(None, plan)`` and builds the
+        mesh-sharded executor pipeline instead."""
+        from ..stream.coschedule import match_coschedulable
+        if not any(sd.connector == "nexmark"
+                   for sd in self.catalog.sources.values()):
+            return None, None
+        plan = self._plan(stmt.query, lenient=self._recovering)
+        m = match_coschedulable(plan)
+        if m is None:
+            return None, plan
+        return self._create_mv_sharded_fused(stmt, plan, m), plan
+
+    def _create_mv_sharded_fused(self, stmt: A.CreateMaterializedView,
+                                 plan, m) -> list:
+        """Build one mesh-sharded fused MV job. Mirrors
+        ``_create_mv_coscheduled``: a real HashAggExecutor (never
+        executed) is the flush/persistence engine, so the state-table
+        checkpoint delta and the durable layout are the executor path's
+        own code; the MV pipeline is QueueSource → Materialize fed by
+        the sharded group flush. The ONE difference is state placement:
+        per-shard AggCore states live stacked under ``P('shard')`` and
+        recovery re-shards the committed rows onto THIS session's mesh
+        by replaying the vnode mapping (parallel/fused.py
+        ``load_shard_states``) — an 8-shard checkpoint reopens cleanly
+        on a 4-shard mesh."""
+        from ..common.types import INT64, VARCHAR
+        from ..connector import NexmarkConfig
+        from ..connector.nexmark import DeviceBidGenerator
+        from ..parallel.fused import ShardedFusedAgg, load_shard_states
+        from ..stream.coschedule import DeviceSourceCursor, declared_chunk_fn
+        from ..stream.hash_agg import HashAggExecutor, agg_state_schema
+        from ..stream.project import ProjectExecutor
+        from ..stream.source import MockSource
+
+        id0 = self.catalog._next_table_id
+        proj = ProjectExecutor(MockSource(m.source.schema, []),
+                               list(m.exprs), names=m.proj_names)
+        key_fields = [proj.schema[i] for i in m.group_keys]
+        st = StateTable(self.store, self.catalog.next_table_id(),
+                        agg_state_schema(key_fields, m.agg_calls),
+                        list(range(len(m.group_keys))))
+        # state_table attached AFTER construction: the executor's own
+        # recovery load would pull EVERY shard's rows into one solo
+        # table — the sharded load below re-partitions them instead
+        agg = HashAggExecutor(
+            proj, list(m.group_keys), list(m.agg_calls), state_table=None,
+            table_capacity=self.config.agg_table_capacity,
+            out_capacity=self.config.chunk_capacity)
+        agg.state_table = st
+        mesh = self.config.mesh
+        n_shards = mesh.devices.size
+        states = None
+        if self._recovering:
+            rows = list(st.scan_all())
+            if rows:
+                states = load_shard_states(agg.core, rows, n_shards)
+        split_st = StateTable(
+            self.store, self.catalog.next_table_id(),
+            Schema((Field("split_id", VARCHAR),
+                    Field("next_offset", INT64))), [0])
+        cursor = DeviceSourceCursor()
+        if self._recovering:
+            offsets = {VARCHAR.to_python(r[0]): int(r[1])
+                       for r in split_st.scan_all()}
+            if offsets:
+                cursor.seek(offsets)
+        mv_table_id = self.catalog.next_table_id()
+        q = QueueSource(plan.schema)
+        mat = MaterializeExecutor(
+            q, StateTable(self.store, mv_table_id, plan.schema,
+                          list(plan.pk)))
+        rate = (m.source.options or {}).get("rows_per_chunk")
+        rows_per_chunk = int(rate) if rate else self.source_chunk_capacity
+        src_cfg = NexmarkConfig(chunk_capacity=rows_per_chunk)
+        gen = DeviceBidGenerator(src_cfg, seed=self.seed)
+        sf = ShardedFusedAgg(
+            mesh, agg.core, declared_chunk_fn(gen.chunk_fn(), m.col_map),
+            tuple(m.exprs), rows_per_chunk, states=states)
+
+        mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
+                                 table_id=mv_table_id, definition="")
+        mv.n_visible = sum(  # type: ignore[attr-defined]
+            1 for f in plan.schema if not f.name.startswith("_"))
+        mv.state_table_ids = (st.table_id,)  # type: ignore[attr-defined]
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (  # type: ignore[attr-defined]
+            id0, self.catalog._next_table_id)
+        self.catalog_writer.add_mv(mv)
+        job = StreamJob(stmt.name, mat, [q])
+        self.jobs[stmt.name] = job
+        job.start(self.loop)
+        self.feeds.append(_SourceFeed(q, lambda: None, reader=cursor,
+                                      state_table=split_st,
+                                      job=stmt.name))
+        self._shardfused_engines[stmt.name] = (agg, q, cursor, sf)
+        self._shardfused_markers.add(stmt.name)
+        if self.data_dir is not None and not self._recovering:
+            self.store.log.log_ddl(  # type: ignore[attr-defined]
+                f"-- shardfused {stmt.name}")
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+        q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def _shardfused_tick(self, epoch: int, checkpoint: bool,
+                         generate: bool) -> None:
+        """Per-tick driver: each mesh-sharded fused MV advances its whole
+        epoch in ONE dispatch across all chips; the flush (one packed
+        fetch for every shard) feeds the Materialize queue; checkpoint
+        barriers write every shard's delta through the engine's own
+        state-table flush."""
+        import jax
+        k = self.chunks_per_tick
+        for name, (agg, q, cursor, sf) in self._shardfused_engines.items():
+            if generate and k > 0:
+                key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                         cursor.epochs)
+                sf.run_epoch(cursor.events, key, k)
+                cursor.events += k * sf.rows_per_chunk
+                cursor.epochs += 1
+            for ch in sf.flush():
+                q.push(ch)
+            if checkpoint:
+                sf.checkpoint(agg, epoch)
 
     # ------------------------------------------------------ remote MV jobs --
 
@@ -2053,6 +2256,8 @@ class Session:
             self._cosched.remove(stmt.name)
             self._cosched_engines.pop(stmt.name, None)
             self._cosched_markers.discard(stmt.name)
+            self._shardfused_engines.pop(stmt.name, None)
+            self._shardfused_markers.discard(stmt.name)
             if stmt.name in self.jobs:
                 job = self.jobs.pop(stmt.name)
                 # full shared teardown: also clears _dead_jobs / worker
@@ -2272,6 +2477,11 @@ class Session:
             # queues BEFORE the barrier below
             self._cosched_tick(epoch, checkpoint,
                                generate and not self.paused)
+        if self._shardfused_engines:
+            # mesh-sharded fused MVs: one dispatch per MV per epoch
+            # across ALL chips (ops/fused_sharded.py)
+            self._shardfused_tick(epoch, checkpoint,
+                                  generate and not self.paused)
         from ..common.tracing import CAT_EPOCH, trace_span
         with trace_span("barrier.inject", CAT_EPOCH, epoch=epoch,
                         tid="conductor", checkpoint=checkpoint):
@@ -2878,6 +3088,15 @@ class Session:
             # epoch co-scheduler: group membership + epochs run
             # (stream/coschedule.py)
             "coschedule": self._cosched.stats(),
+            # mesh-sharded fused MVs: shard count + epochs + grow-retry
+            # events per job (ops/fused_sharded.py, parallel/fused.py)
+            "shardfused": {
+                name: {"shards": sf.n, "epochs_run": sf.epochs_run,
+                       "recv_width": sf.recv_width,
+                       "route_grows": sf.route_grows}
+                for name, (_, _, _, sf) in
+                self._shardfused_engines.items()
+            },
             # per-site retry counters from every boundary (object store,
             # broker, sink delivery) — common/retry.py global registry
             "retry": _retry_snapshot(),

@@ -1,0 +1,224 @@
+"""Mesh-sharded fused epochs — one dispatch per epoch across ALL chips.
+
+Fifth fusion surface (docs/performance.md): the single-dispatch epochs of
+ops/fused_epoch.py (generate → project → stateful core, one ``lax.scan``)
+promoted from one device to the whole mesh. The epoch body runs UNCHANGED
+per shard under ``shard_map``; the hash-partitioned operator state —
+AggCore tables, IntervalJoinCore bucket rings — lives sharded across the
+mesh axis with a leading ``[n_shards]`` axis (``P('shard')``), and rows
+are routed to their owner shard IN-DISPATCH with one ``lax.all_to_all``
+per scan iteration, keyed by ``vnode_to_shard`` from common/hashing.py —
+the exact contiguous vnode mapping remote exchange and the executor-path
+sharded recovery filter use, so cross-worker routing, in-chip sharding
+and durable re-sharding always agree.
+
+Epoch anatomy (one jit call — ``common/dispatch_count.py`` counts it as
+exactly ONE dispatch regardless of shard count or ``k``):
+
+* shard ``s`` of ``n`` generates the global chunk indices ``{i·n + s}``
+  (interleaved), so the union over shards of one epoch's generated chunks
+  is EXACTLY the solo epoch's chunk sequence ``0..k-1`` — same start
+  offsets, same ``fold_in(key, i)`` — and interleaving keeps global chunk
+  order aligned with scan-iteration order, which keeps per-window lane
+  fill order identical to the solo path.
+* ``k`` need not divide ``n``: trailing iterations generate a chunk whose
+  rows are masked invisible (``gi >= k``), which the shuffle drops.
+* after projection the chunk all-to-alls by route key; the received
+  ``[n·C]`` buffer is COMPACTED to ``recv_width·C`` rows (a rank/scatter
+  pass) so per-shard work actually shrinks with the mesh instead of
+  staying at the solo chunk cost. Hot-key skew (NEXmark's 90% hot
+  auctions) can overflow the compacted width — a sticky ``route_ovf``
+  flag per shard reports it, and the driver (parallel/fused.py) grows
+  the width and retries the epoch on the UNTOUCHED previous state, the
+  same functional grow-retry the sharded hash join uses. For that retry
+  to be exact the sharded epochs never donate their state buffers.
+* the barrier flush stays inside the dispatch (join) or one vmapped
+  probe away (agg — ops/fused_multi.py's group-barrier steps serve the
+  shard axis exactly as they serve the co-scheduler's job axis), so the
+  per-epoch host fetch is ONE packed stats array covering every shard,
+  not one fetch per shard.
+
+Bit-exactness contract (tests/test_fused_sharded.py): hash partitioning
+sends every group / window wholly to one shard, and the per-shard body is
+the solo body, so the union over shards of group values, probe emissions
+and flush churn is bit-identical to the solo fused epoch over the same
+``(start, key, k)`` — including U-/U+ retraction pairs and checkpoint
+round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..expr import Expr
+
+
+def compact_chunk(chunk: StreamChunk, cap: int):
+    """Compact a mostly-invisible chunk into ``cap`` rows, preserving
+    visible-row order (rank = running count of visible rows). Returns
+    ``(chunk[cap], overflow)`` — overflow is sticky-style: visible rows
+    past ``cap`` are DROPPED and flagged, never silently lost."""
+    vis = chunk.vis
+    rank = jnp.cumsum(vis) - 1
+    dest = jnp.where(vis & (rank < cap), rank, cap)
+    ovf = jnp.sum(vis) > cap
+
+    def mv(arr):
+        return jnp.zeros((cap,), arr.dtype).at[dest].set(arr, mode="drop")
+
+    cols = tuple(Column(mv(c.data), mv(c.mask)) for c in chunk.columns)
+    return StreamChunk(mv(chunk.ops), mv(vis), cols), ovf
+
+
+def _squeeze(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _unsqueeze(tree):
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+def _shard_scan_parts(mesh, recv_width: int):
+    """Shared pieces of both sharded epoch builders: lazy parallel-layer
+    imports (ops must stay importable without the parallel package's
+    executor dependencies) and the (n, recv_cap-fn) pair."""
+    from ..parallel.sharded_agg import (  # noqa: PLC0415 — layering
+        SHARD_AXIS, shard_map_compat, shuffle_chunk_local,
+    )
+    n = mesh.devices.size
+    if recv_width < 1:
+        raise ValueError("recv_width must be >= 1")
+    width = min(recv_width, n)
+    return SHARD_AXIS, shard_map_compat, shuffle_chunk_local, n, width
+
+
+def sharded_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
+                      rows_per_chunk: int, mesh,
+                      recv_width: int = 2) -> Callable:
+    """Build ``epoch(stacked_state, start, key, k) -> (stacked_state,
+    route_ovf[n])``: the q5 source+project+agg epoch sharded over
+    ``mesh``. ``stacked_state`` carries a leading ``[n_shards]`` axis
+    (``NamedSharding(mesh, P('shard'))``); routing key = the projected
+    chunk's ``core.group_keys``. One jit dispatch per epoch."""
+    from jax.sharding import PartitionSpec as P
+
+    (axis, shard_map_compat, shuffle_chunk_local, n,
+     width) = _shard_scan_parts(mesh, recv_width)
+    exprs = tuple(exprs)
+    gk = tuple(core.group_keys)
+    recv_cap = width * rows_per_chunk
+
+    def epoch(stacked, start, key, k: int):
+        kpp = -(-k // n)
+
+        def local(state, start, key):
+            state = _squeeze(state)
+            s = jax.lax.axis_index(axis)
+
+            def body(carry, i):
+                st, rovf = carry
+                gi = i * n + s
+                ch = chunk_fn(start + gi * rows_per_chunk,
+                              jax.random.fold_in(key, gi))
+                proj = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+                proj = StreamChunk(proj.ops, proj.vis & (gi < k),
+                                   proj.columns)
+                owned = shuffle_chunk_local(proj, n, gk)
+                if width < n:
+                    owned, ovf = compact_chunk(owned, recv_cap)
+                    rovf = rovf | ovf
+                return (core.apply_chunk(st, owned), rovf), None
+
+            (state, rovf), _ = jax.lax.scan(
+                body, (state, jnp.zeros((), jnp.bool_)),
+                jnp.arange(kpp, dtype=jnp.int64))
+            return _unsqueeze(state), rovf[None]
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P(axis)))
+        return mapped(stacked, start, key)
+
+    epoch.__qualname__ = "sharded_agg_epoch.<locals>.epoch"
+    return jax.jit(epoch, static_argnums=(3,))
+
+
+def sharded_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
+                       rows_per_chunk: int, mesh,
+                       recv_width: int = 2) -> Callable:
+    """Build ``epoch(stacked_state, start, key, k)`` for the q7 shape:
+    source + project + bucketed interval join + per-window max flush,
+    sharded over ``mesh``. Routing key = the projected window-start
+    column (``core.ts_col``), so every window's probe rows and build row
+    co-locate and the per-shard body is exactly the solo join epoch body
+    over that shard's windows.
+
+    Returns the solo tuple with a leading ``[n_shards]`` axis on every
+    element; ``packed`` grows to ``[n, 6]`` — [n_flush, lane_overflow,
+    ring_clobber, saw_delete, n_probe, route_ovf] per shard — so ONE
+    fetch covers every shard's flags, emission counts AND the routing
+    overflow that drives the grow-retry."""
+    from jax.sharding import PartitionSpec as P
+
+    (axis, shard_map_compat, shuffle_chunk_local, n,
+     width) = _shard_scan_parts(mesh, recv_width)
+    exprs = tuple(exprs)
+    route = (core.ts_col,)
+    recv_cap = width * rows_per_chunk
+
+    def epoch(stacked, start, key, k: int):
+        kpp = -(-k // n)
+
+        def local(state, start, key):
+            state = _squeeze(state)
+            s = jax.lax.axis_index(axis)
+
+            def body(carry, i):
+                st, rovf = carry
+                gi = i * n + s
+                ch = chunk_fn(start + gi * rows_per_chunk,
+                              jax.random.fold_in(key, gi))
+                proj = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+                proj = StreamChunk(proj.ops, proj.vis & (gi < k),
+                                   proj.columns)
+                owned = shuffle_chunk_local(proj, n, route)
+                if width < n:
+                    owned, ovf = compact_chunk(owned, recv_cap)
+                    rovf = rovf | ovf
+                st, out = core.apply_chunk(st, owned)
+                return (st, rovf), out
+
+            (state, rovf), probe_out = jax.lax.scan(
+                body, (state, jnp.zeros((), jnp.bool_)),
+                jnp.arange(kpp, dtype=jnp.int64))
+            old_emitted_max = state.emitted_max
+            del_mask, ins_mask, packed = core.flush_plan(state)
+            state = core.finish_flush(state)
+            packed = jnp.concatenate([
+                packed,
+                jnp.sum(probe_out.vis).astype(jnp.int64)[None],
+                rovf.astype(jnp.int64)[None],
+            ])
+            return (_unsqueeze(state), _unsqueeze(probe_out),
+                    del_mask[None], ins_mask[None], old_emitted_max[None],
+                    packed[None])
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis),) * 6)
+        return mapped(stacked, start, key)
+
+    epoch.__qualname__ = "sharded_join_epoch.<locals>.epoch"
+    return jax.jit(epoch, static_argnums=(3,))
+
+
+#: builder registry, mirroring ops/fused_epoch.EPOCH_BUILDERS — the path
+#: bench.py and the frontend wiring resolve a sharded surface by shape
+SHARDED_EPOCH_BUILDERS = {
+    "source_agg": sharded_agg_epoch,     # NEXmark q5 over the mesh
+    "source_join": sharded_join_epoch,   # NEXmark q7 over the mesh
+}

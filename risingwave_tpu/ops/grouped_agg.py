@@ -306,3 +306,45 @@ class AggCore:
             table=table, lanes=tuple(lanes), prev_lanes=tuple(prev),
             dirty=dirty, ckpt_dirty=ckpt_dirty,
             overflow=state.overflow | ovf)
+
+
+def load_rows_into_state(core: AggCore, state: AggState, rows) -> AggState:
+    """Recovery bulk-load: fold state-table rows (keys ++ raw lanes) into
+    ``state`` in 1024-row batches. Shared by the solo executor reload
+    (stream/hash_agg.py) and the sharded-fused re-shard loader
+    (parallel/fused.py) so the durable row layout decodes in exactly one
+    place. Callers fix up ``prev_lanes`` themselves (the recovered
+    snapshot is the downstream baseline)."""
+    import numpy as np
+
+    rows = list(rows)
+    nk = len(core.group_keys)
+    bs = 1024
+    for i in range(0, len(rows), bs):
+        batch = rows[i:i + bs]
+        n = len(batch)
+        valid = jnp.arange(bs) < n
+        key_cols = []
+        for c in range(nk):
+            vals = [r[c] for r in batch]
+            mask = np.array([v is not None for v in vals]
+                            + [False] * (bs - n))
+            data = np.array(
+                [v if v is not None else 0 for v in vals] + [0] * (bs - n),
+                dtype=core.key_types[c].np_dtype)
+            key_cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
+        table, slots, _, ovf = ht_lookup_or_insert(
+            state.table, key_cols, valid)
+        if bool(ovf):
+            raise RuntimeError(
+                f"agg table overflow during recovery load (capacity "
+                f"{core.capacity})")
+        lanes = list(state.lanes)
+        for j in range(len(lanes)):
+            vals = np.array(
+                [r[nk + j] for r in batch] + [0] * (bs - n),
+                dtype=np.dtype(core.lane_dtypes[j]))
+            lanes[j] = lanes[j].at[slots].set(jnp.asarray(vals),
+                                              mode="drop")
+        state = state.replace(table=table, lanes=tuple(lanes))
+    return state

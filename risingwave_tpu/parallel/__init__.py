@@ -1,10 +1,14 @@
 from .sharded_agg import (  # noqa: F401
     SHARD_AXIS, ShardedHashAgg, build_sharded_q5_step, make_mesh,
-    shuffle_chunk_local,
+    shard_map_compat, shuffle_chunk_local,
 )
 from .sharded_join import (  # noqa: F401
     ShardedHashJoin, build_sharded_q7_step,
 )
 from .executors import (  # noqa: F401
     ShardedHashAggExecutor, ShardedHashJoinExecutor,
+)
+from .fused import (  # noqa: F401
+    ShardedFusedAgg, ShardedFusedJoin, load_shard_states,
+    reshard_join_payloads,
 )

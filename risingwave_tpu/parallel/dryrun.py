@@ -37,4 +37,69 @@ def run_dryrun(n_devices: int) -> None:
     else:
         build_sharded_q7_step(n_devices)
 
+    build_sharded_fused_epochs(n_devices)
+
     print(f"dryrun_multichip({n_devices}): all sharded steps OK")
+
+
+def build_sharded_fused_epochs(n_devices: int) -> None:
+    """One real mesh-sharded FUSED epoch of each shape (the PR-7 fast
+    path — ops/fused_sharded.py): q5 agg and q7 interval-join epochs run
+    as ONE dispatch across the mesh, cross-checked against the solo
+    fused epoch over the same (start, key, k)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..common.types import INT64, TIMESTAMP, Field, Schema
+    from ..connector import NexmarkConfig
+    from ..connector.nexmark import DeviceBidGenerator
+    from ..expr import Literal, call, col
+    from ..expr.agg import count_star
+    from ..ops.fused_epoch import fused_source_agg_epoch
+    from ..ops.grouped_agg import AggCore
+    from ..ops.interval_join import IntervalJoinCore
+    from .fused import ShardedFusedAgg, ShardedFusedJoin
+    from .sharded_agg import make_mesh
+
+    cap, k = 64, n_devices
+    mesh = make_mesh(n_devices)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+    exprs = [call("tumble_start", col(5, TIMESTAMP),
+                  Literal(1_000_000, INT64)),
+             col(0, INT64), col(2, INT64)]
+    key = jax.random.PRNGKey(5)
+
+    core = AggCore([INT64, INT64], [0, 1], [count_star()], 1 << 10, 64)
+    sf = ShardedFusedAgg(mesh, core, gen.chunk_fn(), exprs, cap)
+    sf.run_epoch(0, key, k)
+    sf.flush()
+    solo = fused_source_agg_epoch(gen.chunk_fn(), exprs, core, cap,
+                                  donate=False)
+    st = solo(core.init_state(), jnp.int64(0), key, k)
+    got = {kk: v[0] for kk, v in sf.merged_group_values().items()}
+    occ = np.asarray(st.table.occupied) & (np.asarray(st.lanes[0]) > 0)
+    kd = [np.asarray(x) for x in st.table.key_data]
+    km = [np.asarray(x) for x in st.table.key_mask]
+    cnt = np.asarray(st.lanes[0])
+    want = {tuple(kd[c][s].item() if km[c][s] else None
+                  for c in range(len(kd))): cnt[s].item()
+            for s in np.nonzero(occ)[0]}
+    assert got == want, (
+        f"sharded fused agg mismatch: {len(got)} vs {len(want)} groups")
+    print(f"dryrun_multichip({n_devices}): q5 sharded FUSED epoch OK, "
+          f"{len(got)} groups, 1 dispatch")
+
+    probe_schema = Schema((Field("window_start", TIMESTAMP),
+                           Field("auction", INT64), Field("price", INT64)))
+    join_exprs = [call("tumble_start", col(5, TIMESTAMP),
+                       Literal(5_000, INT64)),
+                  col(0, INT64), col(2, INT64)]
+    jcore = IntervalJoinCore(probe_schema, ts_col=0, val_col=2,
+                             window_us=5_000, n_buckets=256, lane_width=64)
+    sj = ShardedFusedJoin(mesh, jcore, gen.chunk_fn(), join_exprs, cap)
+    sj.run_epoch(0, key, k)
+    probe, churn = sj.flush(out_capacity=128)
+    jax.block_until_ready(sj.stacked.cur_max)
+    print(f"dryrun_multichip({n_devices}): q7 sharded FUSED epoch OK, "
+          f"{len(probe)} probe + {len(churn)} churn windows, 1 dispatch")

@@ -1,0 +1,434 @@
+"""Host drivers for the mesh-sharded fused epochs (ops/fused_sharded.py).
+
+``ShardedFusedAgg`` / ``ShardedFusedJoin`` own the sharded stacked state
+(leading ``[n_shards]`` axis, ``NamedSharding(mesh, P('shard'))``) and the
+per-epoch control loop:
+
+* ``run_epoch(start, key, k)`` — ONE jit dispatch for the whole mesh.
+* ``flush()`` — ONE packed stats fetch covering every shard (the agg
+  reuses ops/fused_multi.py's vmapped barrier steps: the shard axis is
+  served by exactly the machinery the co-scheduler built for its job
+  axis), then per-window output gathers via a traced shard index, so one
+  compiled gather serves every shard.
+* routing-overflow grow-retry: the compacted all-to-all receive width
+  (``recv_width`` chunks) can overflow under hot-key skew; the epoch's
+  sticky per-shard ``route_ovf`` flag surfaces in the SAME packed fetch,
+  and the driver doubles the width and re-runs the epoch from the
+  untouched previous state — the functional grow-retry of
+  parallel/sharded_join.py, applied to the fused path (which is why the
+  sharded epochs never donate their buffers).
+
+Durability composes with the ordinary split-state tables: per-shard
+states are solo-shaped (``shard_states()``), so the agg checkpoints
+through ONE HashAggExecutor persistence engine (its own state-table
+delta flush), and recovery re-shards committed rows onto ANY mesh size
+by replaying the vnode mapping (``load_shard_states`` — the same
+``vnode_to_shard`` in-dispatch routing uses). The join exports/imports
+per-shard ``IntervalJoinCore`` payloads; ``reshard_join_payloads``
+re-buckets them for a differently-sized mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common.chunk import Column, flatten_shards, gather_units_window
+from ..common.hashing import shard_rows, vnode_of, vnode_to_shard
+from ..ops.fused_multi import (
+    gather_job_flush_chunk, index_state, multi_agg_finish, stack_states,
+    unstack_states,
+)
+from ..ops.fused_sharded import sharded_agg_epoch, sharded_join_epoch
+from ..ops.grouped_agg import load_rows_into_state
+from .sharded_agg import SHARD_AXIS
+
+_NEG = np.iinfo(np.int64).min
+
+
+def _sharded_agg_probe(core) -> Callable:
+    """``probe(stacked, route_ovf[n]) -> (packed [n, 3], rank [n, cap])``
+    — the whole mesh's barrier probe in one dispatch / one fetch; slot 2
+    carries the epoch's routing-overflow flag so retry detection costs no
+    extra sync."""
+
+    def probe_one(st, rovf):
+        rank = core.flush_rank(st)
+        packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32),
+                            rovf.astype(jnp.int32)])
+        return packed, rank
+
+    vm = jax.vmap(probe_one)
+
+    def probe(stacked, rovf):
+        return vm(stacked, rovf)
+
+    return jax.jit(probe)
+
+
+class _ShardedFusedBase:
+    """Shared mesh/state plumbing + the grow-retry bookkeeping."""
+
+    def __init__(self, mesh, core, chunk_fn, exprs, rows_per_chunk: int,
+                 recv_width: int = 2, states: Optional[Sequence] = None):
+        self.mesh = mesh
+        self.n = mesh.devices.size
+        self.core = core
+        self.chunk_fn = chunk_fn
+        self.exprs = tuple(exprs)
+        self.rows_per_chunk = int(rows_per_chunk)
+        self.recv_width = min(int(recv_width), self.n)
+        self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        if states is None:
+            states = [core.init_state() for _ in range(self.n)]
+        if len(states) != self.n:
+            raise ValueError(
+                f"{len(states)} shard states for a {self.n}-device mesh")
+        self.stacked = self._put(stack_states(list(states)))
+        self._epochs: dict[int, Callable] = {}   # recv_width -> jitted
+        self._pending = None    # (prev_stacked, start, key, k) to retry
+        self.epochs_run = 0
+        self.route_grows = 0    # grow-retry events (observability)
+
+    def _put(self, stacked):
+        return jax.device_put(
+            stacked,
+            jax.tree_util.tree_map(lambda _: self._sharding, stacked))
+
+    def _build_epoch(self, width: int) -> Callable:
+        raise NotImplementedError
+
+    def _epoch_fn(self) -> Callable:
+        fn = self._epochs.get(self.recv_width)
+        if fn is None:
+            fn = self._build_epoch(self.recv_width)
+            self._epochs[self.recv_width] = fn
+        return fn
+
+    def _grow_and_retry(self):
+        """Routing overflow: the last epoch dropped rows on some shard.
+        Double the receive width (capped at full n·C, where overflow is
+        impossible) and replay the epoch from the untouched pre-epoch
+        state — deterministic (start, key, k) makes the retry exact."""
+        prev, start, key, k = self._pending
+        self.recv_width = min(max(self.recv_width * 2, 2), self.n)
+        self.route_grows += 1
+        return self._epoch_fn()(prev, start, key, k)
+
+    # -- per-shard state views (solo-shaped; checkpoint/test surface) ---------
+
+    def shard_states(self) -> list:
+        return unstack_states(self.stacked, self.n)
+
+    def set_states(self, states: Sequence) -> None:
+        self.stacked = self._put(stack_states(list(states)))
+
+
+class ShardedFusedAgg(_ShardedFusedBase):
+    """The q5 shape (source → project → AggCore) fused over a mesh."""
+
+    def __init__(self, mesh, core, chunk_fn, exprs, rows_per_chunk: int,
+                 recv_width: int = 2, states: Optional[Sequence] = None):
+        super().__init__(mesh, core, chunk_fn, exprs, rows_per_chunk,
+                         recv_width, states)
+        self._rovf = jnp.zeros(self.n, jnp.bool_)
+        self._probe = _sharded_agg_probe(core)
+        self._finish = multi_agg_finish(core)
+        self._gather = gather_job_flush_chunk(core)
+
+    def _build_epoch(self, width: int) -> Callable:
+        return sharded_agg_epoch(self.chunk_fn, self.exprs, self.core,
+                                 self.rows_per_chunk, self.mesh, width)
+
+    def _settle(self) -> None:
+        """Validate a still-pending epoch (routing overflow → grow-retry)
+        before piling another one on top of it. The usual driver cadence
+        — run_epoch, flush, run_epoch, … — settles inside flush() for
+        free; this extra fetch is paid only by epoch-chaining callers."""
+        while self._pending is not None:
+            if bool(np.any(np.asarray(jax.device_get(self._rovf)))):
+                self.stacked, self._rovf = self._grow_and_retry()
+            else:
+                self._pending = None
+
+    def run_epoch(self, start: int, key, k: int) -> None:
+        """ONE dispatch: k chunks generated, routed and aggregated across
+        the whole mesh. Validation (routing overflow) settles at the next
+        ``flush()`` — same tick, zero extra host syncs."""
+        self._settle()
+        args = (jnp.int64(start), key, int(k))
+        self._pending = (self.stacked, *args)
+        self.stacked, self._rovf = self._epoch_fn()(self.stacked, *args)
+        self.epochs_run += 1
+
+    def flush(self) -> list:
+        """Barrier flush: one packed [n, 3] fetch for every shard's dirty
+        count / overflow / route flag, per-window churn gathers (traced
+        shard index — one compiled gather for the mesh), one vmapped
+        finish. Returns the flush StreamChunks in shard-major order."""
+        while True:
+            packed, ranks = self._probe(self.stacked, self._rovf)
+            packed_h = np.asarray(jax.device_get(packed))
+            if self._pending is not None and packed_h[:, 2].any():
+                self.stacked, self._rovf = self._grow_and_retry()
+                continue
+            break
+        self._pending = None
+        self._rovf = jnp.zeros(self.n, jnp.bool_)
+        chunks = []
+        for s in range(self.n):
+            n_dirty, overflow = int(packed_h[s, 0]), int(packed_h[s, 1])
+            if overflow:
+                raise RuntimeError(
+                    f"sharded fused agg: shard {s} group table overflow "
+                    f"(per-shard capacity {self.core.capacity}); increase "
+                    "agg_table_capacity")
+            lo = 0
+            while lo < n_dirty:
+                chunks.append(self._gather(self.stacked, ranks,
+                                           jnp.int64(s), jnp.int64(lo)))
+                lo += self.core.groups_per_chunk
+        self.stacked = self._finish(self.stacked)
+        return chunks
+
+    def checkpoint(self, engine, epoch: int) -> None:
+        """Write every shard's checkpoint delta through ONE
+        HashAggExecutor persistence engine (its own state-table flush —
+        hash partitioning keeps per-shard keys disjoint, so the deltas
+        union cleanly in the shared table), then restack once."""
+        states = []
+        for s in range(self.n):
+            engine.state = index_state(self.stacked, s)
+            engine._checkpoint_to_state_table(epoch)
+            states.append(engine.state)
+        self.set_states(states)
+
+    def merged_group_values(self) -> dict:
+        """All shards' live groups → {key_tuple: (lanes...)}. Test/debug
+        surface (production egress is the flush chunks)."""
+        host = jax.device_get(self.stacked)
+        out: dict = {}
+        for s in range(self.n):
+            st = jax.tree_util.tree_map(lambda x: x[s], host)
+            occ = np.asarray(st.table.occupied)
+            live = np.asarray(st.lanes[0]) > 0
+            kd = [np.asarray(x) for x in st.table.key_data]
+            km = [np.asarray(x) for x in st.table.key_mask]
+            lanes = [np.asarray(x) for x in st.lanes]
+            for slot in np.nonzero(occ & live)[0]:
+                key = tuple(kd[c][slot].item() if km[c][slot] else None
+                            for c in range(len(kd)))
+                out[key] = tuple(l[slot].item() for l in lanes)
+        return out
+
+
+class ShardedFusedJoin(_ShardedFusedBase):
+    """The q7 shape (source → project → bucketed interval join + max
+    flush) fused over a mesh. ``core``: the PER-SHARD IntervalJoinCore —
+    windows spread uniformly under the vnode hash, so its ring only
+    needs ~1/n of the solo bucket count."""
+
+    def __init__(self, mesh, core, chunk_fn, exprs, rows_per_chunk: int,
+                 recv_width: int = 2, states: Optional[Sequence] = None):
+        super().__init__(mesh, core, chunk_fn, exprs, rows_per_chunk,
+                         recv_width, states)
+        self._out = None        # last epoch's full output tuple
+
+        def gather_flush(stacked, dels, inss, olds, s, lo,
+                         out_capacity: int):
+            st = index_state(stacked, s)
+            return core.gather_flush(st, dels[s], inss[s], olds[s], lo,
+                                     out_capacity)
+
+        def gather_probe(probe_out, s, lo, out_capacity: int):
+            pj = jax.tree_util.tree_map(lambda x: x[s], probe_out)
+            return gather_units_window(flatten_shards(pj), lo,
+                                       out_capacity)
+
+        self._gather_flush = jax.jit(gather_flush,
+                                     static_argnames=("out_capacity",))
+        self._gather_probe = jax.jit(gather_probe,
+                                     static_argnames=("out_capacity",))
+
+    def _build_epoch(self, width: int) -> Callable:
+        return sharded_join_epoch(self.chunk_fn, self.exprs, self.core,
+                                  self.rows_per_chunk, self.mesh, width)
+
+    def _settle(self) -> None:
+        """Validate a still-pending epoch before running the next one
+        (see ShardedFusedAgg._settle; the run/flush cadence never pays
+        this fetch)."""
+        while self._pending is not None:
+            packed_h = np.asarray(jax.device_get(self._out[5]))
+            if packed_h[:, 5].any():
+                self._out = self._grow_and_retry()
+                self.stacked = self._out[0]
+            else:
+                self._pending = None
+
+    def run_epoch(self, start: int, key, k: int) -> None:
+        """ONE dispatch: ingest + probe emission + the barrier flush plan
+        for every shard (the join epoch body flushes in-dispatch)."""
+        self._settle()
+        args = (jnp.int64(start), key, int(k))
+        self._pending = (self.stacked, *args)
+        self._out = self._epoch_fn()(self.stacked, *args)
+        self.stacked = self._out[0]
+        self.epochs_run += 1
+
+    def flush(self, out_capacity: int):
+        """Drain the epoch's two emission surfaces. ONE [n, 6] packed
+        fetch covers every shard's flags, counts and the route-overflow
+        retry signal. Returns ``(probe_chunks, churn_chunks)``."""
+        if self._out is None:
+            return [], []
+        while True:
+            packed_h = np.asarray(jax.device_get(self._out[5]))
+            if self._pending is not None and packed_h[:, 5].any():
+                self._out = self._grow_and_retry()
+                self.stacked = self._out[0]
+                continue
+            break
+        self._pending = None
+        _, probe_out, del_m, ins_m, old_emitted, _ = self._out
+        probe_chunks, churn_chunks = [], []
+        for s in range(self.n):
+            n_flush, ovf, clobber, sawdel, n_probe, _ = (
+                int(x) for x in packed_h[s])
+            if ovf or clobber or sawdel:
+                raise RuntimeError(
+                    f"sharded fused join: shard {s} flags ovf={ovf} "
+                    f"clobber={clobber} sawdel={sawdel}")
+            lo = 0
+            while lo < n_probe:
+                probe_chunks.append(self._gather_probe(
+                    probe_out, jnp.int64(s), jnp.int64(lo),
+                    out_capacity=out_capacity))
+                lo += out_capacity // 2
+            lo = 0
+            while lo < n_flush:
+                churn_chunks.append(self._gather_flush(
+                    self.stacked, del_m, ins_m, old_emitted,
+                    jnp.int64(s), jnp.int64(lo),
+                    out_capacity=out_capacity))
+                lo += out_capacity
+        self._out = None
+        return probe_chunks, churn_chunks
+
+    # -- checkpoint / recovery -------------------------------------------------
+
+    def export_host(self) -> list:
+        """Per-shard checkpoint payloads (IntervalJoinCore.export_host)."""
+        return [self.core.export_host(index_state(self.stacked, s))
+                for s in range(self.n)]
+
+    def import_host(self, payloads: Sequence) -> None:
+        self.set_states([self.core.import_host(p) for p in payloads])
+
+
+# ---------------------------------------------------------------------------
+# re-sharding: replay the vnode mapping over durable state so a job
+# recovers onto a DIFFERENTLY-sized mesh
+# ---------------------------------------------------------------------------
+
+
+def load_agg_rows(core, rows: Sequence) -> object:
+    """Fold state-table rows (keys ++ lanes) into a fresh AggState via
+    the SAME bulk loader the executor recovery uses
+    (ops/grouped_agg.load_rows_into_state). ``prev_lanes`` ends equal to
+    ``lanes``: the recovered snapshot is the baseline downstream already
+    saw."""
+    state = load_rows_into_state(core, core.init_state(), rows)
+    return state.replace(prev_lanes=state.lanes)
+
+
+def load_shard_states(core, rows: Sequence, n_shards: int) -> list:
+    """Partition committed agg rows onto ``n_shards`` by REPLAYING the
+    vnode mapping (common/hashing.shard_rows — the same ``vnode_of →
+    vnode_to_shard`` the in-dispatch all_to_all routes with), then load
+    each shard's slice. This is the re-shard path: the durable table is
+    shard-count-agnostic, so an 8-shard checkpoint reopens cleanly on a
+    4-shard (or solo) mesh."""
+    per_shard = shard_rows(core.key_types, rows, n_shards)
+    return [load_agg_rows(core, rs) for rs in per_shard]
+
+
+def _empty_join_payload(core) -> dict:
+    nb, W = core.n_buckets, core.W
+    return {
+        "win_id": np.full(nb, -1, np.int64),
+        "fill": np.zeros(nb, np.int32),
+        "touched": np.zeros(nb, bool),
+        "cur_max": np.full(nb, _NEG, np.int64),
+        "cur_cnt": np.zeros(nb, np.int64),
+        "emitted_max": np.full(nb, _NEG, np.int64),
+        "emitted_live": np.zeros(nb, bool),
+        "lane_overflow": np.zeros((), bool),
+        "ring_clobber": np.zeros((), bool),
+        "saw_delete": np.zeros((), bool),
+        "row_data": [np.zeros((nb, W), f.type.np_dtype)
+                     for f in core.probe_schema],
+        "row_mask": [np.zeros((nb, W), bool) for _ in core.probe_schema],
+    }
+
+
+_JOIN_BUCKET_FIELDS = ("win_id", "fill", "touched", "cur_max", "cur_cnt",
+                       "emitted_max", "emitted_live")
+_JOIN_FLAG_FIELDS = ("lane_overflow", "ring_clobber", "saw_delete")
+
+
+def reshard_join_payloads(old_core, payloads: Sequence, new_core,
+                          new_n: int) -> list:
+    """Re-bucket per-shard interval-join checkpoint payloads onto a
+    ``new_n``-shard mesh: every resident window re-routes by replaying
+    the vnode mapping over its window-start value — the exact hash the
+    in-dispatch all_to_all applies to that window's rows — and lands at
+    ``win_id % new_nb`` in its new owner's ring. Ring geometry may shrink
+    with the mesh (windows spread ~uniformly); a destination collision
+    (two live windows sharing a slot) raises instead of clobbering."""
+    if old_core.W != new_core.W or \
+            len(old_core.probe_schema) != len(new_core.probe_schema):
+        raise ValueError("re-shard requires identical lane geometry "
+                         "(lane_width / probe schema)")
+    if old_core.window_us != new_core.window_us or \
+            old_core.ts_col != new_core.ts_col or \
+            old_core.probe_schema[old_core.ts_col].type.np_dtype != \
+            new_core.probe_schema[new_core.ts_col].type.np_dtype:
+        # win_id values are copied verbatim: a different window (or ts
+        # layout) would relabel every resident window AND route it
+        # differently than the live all_to_all — refuse, don't split-brain
+        raise ValueError("re-shard requires identical window config "
+                         "(window_us / ts_col)")
+    nb_new = new_core.n_buckets
+    ts_dtype = old_core.probe_schema[old_core.ts_col].type.np_dtype
+    outs = [_empty_join_payload(new_core) for _ in range(new_n)]
+    for p in payloads:
+        for f in _JOIN_FLAG_FIELDS:
+            flag = bool(np.asarray(p[f]))
+            for o in outs:      # sticky flags stay visible on every shard
+                o[f] = o[f] | flag
+        win = np.asarray(p["win_id"])
+        idx = np.nonzero(win >= 0)[0]
+        if not len(idx):
+            continue
+        ws = (win[idx] * old_core.window_us).astype(np.dtype(ts_dtype))
+        col = Column(jnp.asarray(ws), jnp.ones(len(idx), jnp.bool_))
+        shard = np.asarray(vnode_to_shard(vnode_of([col]), new_n))
+        slot = win[idx] % nb_new
+        for j, b in enumerate(idx):
+            s, t = int(shard[j]), int(slot[j])
+            o = outs[s]
+            if o["win_id"][t] != -1:
+                raise RuntimeError(
+                    f"re-shard bucket collision on shard {s} slot {t}; "
+                    "increase the new core's n_buckets")
+            for f in _JOIN_BUCKET_FIELDS:
+                o[f][t] = p[f][b]
+            for c in range(len(o["row_data"])):
+                o["row_data"][c][t] = p["row_data"][c][b]
+                o["row_mask"][c][t] = p["row_mask"][c][b]
+    return outs

@@ -36,9 +36,29 @@ from ..ops.grouped_agg import AggCore, AggState
 SHARD_AXIS = "shard"
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across JAX versions: the top-level API (with
+    ``check_vma``) when present, else ``jax.experimental.shard_map``
+    (whose equivalent knob is ``check_rep``). Replication checking is
+    off either way — the hash shuffles communicate via explicit
+    ``all_to_all``/``psum``, which the checker cannot always follow."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(n_devices: int) -> Mesh:
-    devs = np.array(jax.devices()[:n_devices])
-    return Mesh(devs, (SHARD_AXIS,))
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        from ..common.config import MeshUnavailableError
+        raise MeshUnavailableError(
+            f"mesh needs {n_devices} devices, process has {len(devs)} "
+            f"(on CPU force a virtual mesh with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return Mesh(np.array(devs[:n_devices]), (SHARD_AXIS,))
 
 
 def shuffle_chunk_local(chunk: StreamChunk, n_shards: int,
@@ -121,11 +141,10 @@ class ShardedHashAgg:
             return new_state, rows_in
 
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 local_step, mesh=mesh,
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                 out_specs=(P(SHARD_AXIS), P()),
-                check_vma=False,
             )
         )
 

@@ -37,7 +37,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common.chunk import StreamChunk, chunk_to_rows
 from ..common.types import Schema
 from ..ops.join_state import JoinCore, JoinType, import_state
-from .sharded_agg import SHARD_AXIS, make_mesh, shuffle_chunk_local
+from .sharded_agg import (
+    SHARD_AXIS, make_mesh, shard_map_compat, shuffle_chunk_local,
+)
 
 
 class ShardedHashJoin:
@@ -97,11 +99,10 @@ class ShardedHashJoin:
                 return state, big
 
             return jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     local_step, mesh=mesh,
                     in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                     out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                    check_vma=False,
                 )
             )
 

@@ -36,8 +36,7 @@ import numpy as np
 from ..common.chunk import DEFAULT_CHUNK_CAPACITY, Column, StreamChunk
 from ..common.types import INT64, Field, Schema
 from ..expr.agg import AggCall
-from ..ops.grouped_agg import AggCore, AggState
-from ..ops.hash_table import ht_lookup_or_insert
+from ..ops.grouped_agg import AggCore, AggState, load_rows_into_state
 from ..storage.state_table import StateTable
 from .executor import Executor, SingleInputExecutor
 from .message import Barrier
@@ -364,24 +363,9 @@ class HashAggExecutor(SingleInputExecutor):
         """Keep rows whose group key hashes to this actor's shard — the
         same device hash the dispatcher routes live rows with, so reload
         placement always matches routing, for ANY shard count."""
-        from ..common.hashing import vnode_of, vnode_to_shard
+        from ..common.hashing import shard_rows
         idx, n_shards = self.load_shard
-        nk = len(self.core.group_keys)
-        out = []
-        bs = 1024
-        for i in range(0, len(rows), bs):
-            batch = rows[i:i + bs]
-            cols = []
-            for c in range(nk):
-                vals = [r[c] for r in batch]
-                data = np.array(
-                    [v if v is not None else 0 for v in vals],
-                    dtype=self.core.key_types[c].np_dtype)
-                mask = np.array([v is not None for v in vals])
-                cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
-            shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
-            out.extend(r for r, s in zip(batch, shard) if int(s) == idx)
-        return out
+        return shard_rows(self.core.key_types, rows, n_shards)[idx]
 
     def _load_from_state_table(self) -> None:
         """Recovery: reload committed groups into the device table."""
@@ -408,34 +392,7 @@ class HashAggExecutor(SingleInputExecutor):
             rows = hot
         if not rows:
             return
-        nk = len(self.core.group_keys)
-        bs = 1024
-        for i in range(0, len(rows), bs):
-            batch = rows[i : i + bs]
-            n = len(batch)
-            valid = jnp.arange(bs) < n
-            key_cols = []
-            for c in range(nk):
-                vals = [r[c] for r in batch]
-                mask = np.array([v is not None for v in vals] + [False] * (bs - n))
-                data = np.array(
-                    [v if v is not None else 0 for v in vals] + [0] * (bs - n),
-                    dtype=self.core.key_types[c].np_dtype,
-                )
-                key_cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
-            table, slots, _, ovf = ht_lookup_or_insert(
-                self.state.table, key_cols, valid
-            )
-            if bool(ovf):
-                raise RuntimeError("agg table overflow during recovery load")
-            lanes = list(self.state.lanes)
-            for j in range(len(lanes)):
-                vals = np.array(
-                    [r[nk + j] for r in batch] + [0] * (bs - n),
-                    dtype=np.dtype(self.core.lane_dtypes[j]),
-                )
-                lanes[j] = lanes[j].at[slots].set(jnp.asarray(vals), mode="drop")
-            self.state = self.state.replace(table=table, lanes=tuple(lanes))
+        self.state = load_rows_into_state(self.core, self.state, rows)
         # prev must match what was already emitted before the failure: the
         # recovered snapshot is the new baseline
         self.state = self.state.replace(prev_lanes=self.state.lanes)

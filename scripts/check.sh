@@ -44,11 +44,12 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_pallas_compile.py \
     "$@"
 
-echo "== fused-epoch / interval-join / co-schedule subset =="
+echo "== fused-epoch / interval-join / co-schedule / sharded subset =="
 python -m pytest -q -p no:cacheprovider \
     tests/test_fused_epoch.py \
     tests/test_fused_q8_q3.py \
     tests/test_coschedule.py \
+    tests/test_fused_sharded.py \
     tests/test_interval_join.py \
     tests/test_batched_ingest.py \
     tests/test_cli_fragments.py \

@@ -32,6 +32,26 @@ port = 5433
         assert cfg.streaming.chunk_capacity == 256
         assert cfg.streaming.barrier_interval_ms == 1000   # untouched default
 
+    def test_fallback_parser_subset(self, tmp_path):
+        """The tomllib-less fallback parser (py3.10) handles the config
+        subset: sections, ints/floats/bools, quoted strings — including
+        '#' INSIDE a quoted value — and trailing comments."""
+        from risingwave_tpu.common.config import _parse_toml_subset
+        data = _parse_toml_subset("""
+# header comment
+[storage]
+data_dir = "/tmp/run#3"          # trailing comment
+compactors = 2
+
+[streaming]
+coschedule = true
+slow_epoch_threshold_ms = 1.5
+""")
+        assert data["storage"]["data_dir"] == "/tmp/run#3"
+        assert data["storage"]["compactors"] == 2
+        assert data["streaming"]["coschedule"] is True
+        assert data["streaming"]["slow_epoch_threshold_ms"] == 1.5
+
     def test_unknown_keys_rejected(self, tmp_path):
         p = tmp_path / "rw.toml"
         p.write_text("[streaming]\nbogus_key = 1\n")

@@ -135,3 +135,47 @@ def test_multi_job_epoch_lowers_for_tpu():
     keys = jnp.stack([jax.random.PRNGKey(j) for j in range(8)])
     text = _lower_tpu_jitted(multi, stacked, starts, keys, 4)
     assert "stablehlo" in text and ("while" in text or "scan" in text)
+
+
+@pytest.mark.parametrize("shape", ["agg", "join"])
+def test_sharded_fused_epoch_lowers_for_tpu(shape):
+    """The mesh-sharded fused epochs (ops/fused_sharded.py) — shard_map
+    around the solo epoch body with the in-dispatch all_to_all shuffle —
+    lower for platform "tpu" chip-free over the virtual CPU mesh, so a
+    sharded surface that stopped compiling for the chip fails CI while
+    the tunnel is down."""
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.ops.fused_sharded import SHARDED_EPOCH_BUILDERS
+    from risingwave_tpu.ops.grouped_agg import AggCore
+    from risingwave_tpu.ops.interval_join import IntervalJoinCore
+    from risingwave_tpu.ops.fused_multi import stack_states
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    n = 4
+    assert len(jax.devices()) >= n
+    mesh = make_mesh(n)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=256))
+    exprs = [call("tumble_start", col(5, TIMESTAMP),
+                  Literal(5_000, INT64)), col(0, INT64), col(2, INT64)]
+    if shape == "agg":
+        core = AggCore([INT64, INT64], [0, 1], [count_star()],
+                       1 << 10, 128)
+        builder = SHARDED_EPOCH_BUILDERS["source_agg"]
+    else:
+        core = IntervalJoinCore(
+            Schema((Field("ws", TIMESTAMP), Field("auction", INT64),
+                    Field("price", INT64))),
+            ts_col=0, val_col=2, window_us=5_000, n_buckets=256,
+            lane_width=64)
+        builder = SHARDED_EPOCH_BUILDERS["source_join"]
+    fused = builder(gen.chunk_fn(), exprs, core, 256, mesh)
+    stacked = stack_states([core.init_state() for _ in range(n)])
+    text = _lower_tpu_jitted(fused, stacked, jnp.int64(0),
+                             jax.random.PRNGKey(0), 4)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+    assert "all-to-all" in text or "all_to_all" in text
