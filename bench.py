@@ -136,6 +136,16 @@ SHARDED_N_CHUNKS = 128
 SHARDED_WARMUP_CHUNKS = 32
 SHARDED_Q7_N_CHUNKS = 64
 SHARDED_VIRTUAL_DEVICES = 8    # CPU stand-in virtual mesh size
+# serving phase (frontend/serving.py — ROADMAP item 3): concurrent
+# point-lookups + small group-by reads over a LIVE q5 MV while the
+# stream keeps ticking. Cached+two-phase (the serving plane) vs the
+# uncached single-phase baseline ([batch] serving_cache_size = 0,
+# serving_tasks = 1 — every query replans/relowers under the API lock,
+# the pre-serving-plane behavior). QPS + p50/p99 per run.
+SERVING_SECONDS = 3.0          # measured wall clock per variant
+SERVING_THREADS = 4            # concurrent reader threads
+SERVING_TICK_S = 0.1           # live-stream tick cadence during reads
+SERVING_WARM_TICKS = 3
 
 
 def _emit(obj: dict) -> None:
@@ -775,6 +785,148 @@ def measure_barrier_latency(in_flight: int = 1) -> dict:
     return snap
 
 
+_SERVING_BID_DDL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT,
+price BIGINT, channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+extra VARCHAR) WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+_SERVING_Q5 = """CREATE MATERIALIZED VIEW q5 AS
+    SELECT AuctionBids.auction, AuctionBids.num FROM (
+        SELECT bid.auction, count(*) AS num, window_start AS starttime
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY window_start, bid.auction
+    ) AS AuctionBids
+    JOIN (
+        SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+        FROM (
+            SELECT count(*) AS num, window_start AS starttime_c
+            FROM HOP(bid, date_time, INTERVAL '2' SECOND,
+                     INTERVAL '10' SECOND)
+            GROUP BY bid.auction, window_start
+        ) AS CountBids
+        GROUP BY CountBids.starttime_c
+    ) AS MaxBids
+    ON AuctionBids.starttime = MaxBids.starttime_c
+       AND AuctionBids.num = MaxBids.maxn"""
+
+
+def _serving_run(cached: bool, seconds: float, n_threads: int) -> dict:
+    """One serving variant end to end: live q5 MV, a tick thread keeping
+    the stream moving, ``n_threads`` readers issuing point-lookups and
+    small group-by reads through ``Session.query``. ``cached=False``
+    zeroes the plan cache and the two-phase split — every query replans,
+    relowers, and runs single-phase under the API lock (the
+    pre-serving-plane read path)."""
+    from risingwave_tpu.common.config import load_config
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend.parser import parse_sql
+
+    overrides = {"streaming.chunk_capacity": 512}
+    if not cached:
+        overrides.update({"batch.serving_cache_size": 0,
+                          "batch.serving_tasks": 1})
+    s = Session(rw_config=load_config(None, **overrides))
+    s.run_sql(_SERVING_BID_DDL)
+    s.run_sql(_SERVING_Q5)
+    for _ in range(SERVING_WARM_TICKS):
+        s.tick()
+    s.flush()
+    rows = s.mv_rows("q5")
+    key = rows[0][0] if rows else 1000
+    point = parse_sql(f"SELECT num FROM q5 WHERE auction = {key}")[0].select
+    group = parse_sql("SELECT auction % 8, count(*), sum(num) "
+                      "FROM q5 GROUP BY auction % 8")[0].select
+    s.query(point)                      # warm: compiles land here
+    s.query(group)
+
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            s.tick()
+            stop.wait(SERVING_TICK_S)
+
+    lat: dict = {0: [], 1: []}
+    counts = [0] * n_threads
+    errors: list = []
+    t_tick = threading.Thread(target=ticker, daemon=True)
+
+    def reader(idx: int, deadline: float):
+        sels = (point, group)
+        mine = ([], [])
+        i = 0
+        try:
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                s.query(sels[i % 2])
+                mine[i % 2].append(time.perf_counter() - t0)
+                i += 1
+        except BaseException as e:  # noqa: BLE001 - fails the phase
+            errors.append(f"reader {idx}: {type(e).__name__}: {e}")
+        counts[idx] = i
+        lat[0].extend(mine[0])
+        lat[1].extend(mine[1])
+
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    t_tick.start()
+    threads = [threading.Thread(target=reader, args=(i, deadline))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    t_tick.join()
+    wall = time.perf_counter() - t0
+    m = s.metrics()["serving"]
+    s.close()
+    if errors:
+        # a dead reader would silently skew QPS/p99 — attribute it like
+        # every other phase failure instead
+        raise RuntimeError("; ".join(errors))
+    allq = sorted(lat[0] + lat[1])
+
+    def pct(xs, q):
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1e3, 3) \
+            if xs else None
+
+    return {
+        "qps": round(sum(counts) / wall, 1),
+        "point_qps": round(len(lat[0]) / wall, 1),
+        "group_qps": round(len(lat[1]) / wall, 1),
+        "p50_ms": pct(allq, 0.5),
+        "p99_ms": pct(allq, 0.99),
+        "cache_hits": m["cache_hits"],
+        "cache_misses": m["cache_misses"],
+        "reexecutions": m["reexecutions"],
+        "tasks_fired_local": m["tasks_fired_local"],
+    }
+
+
+def run_serving_phase(seconds: float, n_threads: int) -> None:
+    """Child entry for --serving-phase: cached+two-phase vs uncached
+    single-phase, one JSON line."""
+    base = _serving_run(False, seconds, n_threads)
+    served = _serving_run(True, seconds, n_threads)
+    out = {
+        "metric": "serving_qps", "unit": "queries/s",
+        "value": served["qps"],
+        "serving_qps": served["qps"],
+        "serving_point_qps": served["point_qps"],
+        "serving_group_qps": served["group_qps"],
+        "serving_p50_ms": served["p50_ms"],
+        "serving_p99_ms": served["p99_ms"],
+        "serving_baseline_qps": base["qps"],
+        "serving_baseline_p99_ms": base["p99_ms"],
+        "serving_speedup": (round(served["qps"] / base["qps"], 2)
+                            if base["qps"] else None),
+        "serving_threads": n_threads,
+        "serving_cache_hits": served["cache_hits"],
+        "serving_reexecutions": served["reexecutions"],
+    }
+    _emit(out)
+
+
 def run_phase(n_chunks: int, q7_chunks: int, q8_chunks: int,
               q3_chunks: int) -> None:
     """Child entry: measure everything on this process's backend, print one
@@ -923,6 +1075,24 @@ _SHARDED_RESULT_FIELDS = (
     "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
 )
 
+_SERVING_RESULT_FIELDS = (
+    "serving_qps", "serving_point_qps", "serving_group_qps",
+    "serving_p50_ms", "serving_p99_ms",
+    "serving_baseline_qps", "serving_baseline_p99_ms", "serving_speedup",
+)
+
+
+def measure_serving_cpu() -> dict:
+    """The serving phase on the CPU stand-in (a Session-level
+    measurement: plan cache + two-phase reads vs the uncached
+    single-phase baseline, concurrent with live ticks). Runs in a fresh
+    subprocess like every phase."""
+    env = {"JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": None, "TPU_LIBRARY_PATH": None}
+    return _spawn_phase("serving_cpu", env,
+                        ["--serving-phase", str(SERVING_SECONDS),
+                         str(SERVING_THREADS)])
+
 
 def measure_sharded_cpu() -> dict:
     """The mesh-sharded fused phase on the CPU stand-in: a virtual
@@ -1024,6 +1194,13 @@ _SHARED_FIELDS = (
     # record stays schema-stable
     "sharded_fused_shards", "sharded_fused_by_shards",
     "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
+    # serving plane (frontend/serving.py): cached+two-phase QPS with
+    # p50/p99 vs the uncached single-phase baseline, present on every
+    # backend (a Session-level CPU measurement) so the fallback record
+    # stays schema-stable
+    "serving_qps", "serving_point_qps", "serving_group_qps",
+    "serving_p50_ms", "serving_p99_ms",
+    "serving_baseline_qps", "serving_baseline_p99_ms", "serving_speedup",
 )
 
 
@@ -1052,6 +1229,16 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - attributed below
         sys.stderr.write(f"bench: sharded cpu phase failed: {e}\n")
         cpu["sharded_fused_error"] = str(e)
+    # serving phase (Session-level, CPU): merged into the CPU record the
+    # same way; non-fatal — a serving regression must not cost the round
+    # its headline numbers
+    try:
+        serving = measure_serving_cpu()
+        for f in _SERVING_RESULT_FIELDS:
+            cpu[f] = serving.get(f)
+    except Exception as e:  # noqa: BLE001 - attributed below
+        sys.stderr.write(f"bench: serving phase failed: {e}\n")
+        cpu["serving_error"] = str(e)
     cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     tpu, tpu_err = measure_tpu()
     if tpu is not None:
@@ -1071,6 +1258,10 @@ def main() -> int:
             # keep the record schema-stable with the stand-in's numbers
             for f in _SHARDED_RESULT_FIELDS:
                 tpu.setdefault(f, cpu.get(f))
+        # serving is a Session-level CPU measurement; the TPU record
+        # carries the stand-in's numbers for schema stability
+        for f in _SERVING_RESULT_FIELDS:
+            tpu.setdefault(f, cpu.get(f))
     if tpu is None:
         # tunnel/chip unavailable: fall back to the CPU streaming
         # measurement as the round's headline — a real, nonzero number
@@ -1233,6 +1424,33 @@ def run_smoke() -> int:
         assert n == 1, f"sharded epoch took {n} dispatches"
         sf.flush()
         checks.append(f"sharded[{n_dev}]=1 dispatch/epoch")
+    # serving plane: a repeated identical SELECT must create ZERO new
+    # jit wrappers (plan+compilation cache, frontend/serving.py) — and a
+    # write in between re-executes the SAME cached executors, still
+    # zero. Warm OUTSIDE the counter: count_dispatches counts calls of
+    # functions jitted inside it, so any replan/relower on the repeats
+    # would surface as nonzero counts.
+    from risingwave_tpu.frontend import Session
+    s = Session()
+    s.run_sql("CREATE TABLE st (a BIGINT, b BIGINT)")
+    s.run_sql("INSERT INTO st VALUES (1, 10), (2, 20), (1, 30)")
+    s.flush()
+    sql = "SELECT a, count(*), sum(b) FROM st GROUP BY a"
+    s.run_sql(sql)                      # warm: plan + lower + jit
+    with count_dispatches() as c:
+        assert s.run_sql(sql) == s.run_sql(sql)
+        assert c.total == 0, \
+            f"cached SELECT re-jitted: {dict(c.counts)}"
+        s.run_sql("INSERT INTO st VALUES (3, 5)")
+        s.flush()
+        rows = s.run_sql(sql)
+        assert c.total == 0, \
+            f"version-bump re-execution re-jitted: {dict(c.counts)}"
+    assert sorted(rows) == [(1, 2, 40), (2, 1, 20), (3, 1, 5)], rows
+    m = s.metrics()["serving"]
+    assert m["cache_hits"] >= 2 and m["reexecutions"] >= 1, m
+    s.close()
+    checks.append("serving cache: 0 new jits on repeat + re-exec")
     _emit({"metric": "bench_smoke", "value": round(
         time.perf_counter() - t0, 2), "unit": "s",
         "backend": jax.default_backend(), "checks": checks})
@@ -1241,7 +1459,8 @@ def run_smoke() -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] in ("--phase", "--probe",
-                                             "--sharded-phase"):
+                                             "--sharded-phase",
+                                             "--serving-phase"):
         watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
@@ -1258,6 +1477,23 @@ if __name__ == "__main__":
             except Exception as e:
                 _emit(_fail_line(f"probe failed: {type(e).__name__}: {e}"))
                 raise SystemExit(2)
+            raise SystemExit(0)
+        if sys.argv[1] == "--serving-phase":
+            watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                run_serving_phase(
+                    float(sys.argv[2]) if len(sys.argv) > 2
+                    else SERVING_SECONDS,
+                    int(sys.argv[3]) if len(sys.argv) > 3
+                    else SERVING_THREADS)
+            except Exception as e:
+                _emit(_fail_line(
+                    f"serving phase failed: {type(e).__name__}: {e}"))
+                raise SystemExit(2)
+            finally:
+                watchdog.cancel()
             raise SystemExit(0)
         if sys.argv[1] == "--sharded-phase":
             watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)

@@ -1,5 +1,6 @@
 from .executors import (  # noqa: F401
-    BatchFilter, BatchHashAgg, BatchLimit, BatchProject, BatchSort,
-    RowSeqScan, run_batch,
+    BatchFilter, BatchHashAgg, BatchLimit, BatchMergeAgg, BatchPartialAgg,
+    BatchProject, BatchSort, RowSeqScan, partial_agg_fields,
+    partial_supported, run_batch,
 )
-from .task import BatchTaskManager  # noqa: F401
+from .task import BatchTaskManager, vnode_partitions  # noqa: F401

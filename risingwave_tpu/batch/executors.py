@@ -38,7 +38,9 @@ from ..common.types import Field, Schema
 from ..expr.agg import AggCall
 from ..expr.expr import Expr, uses_host_callback
 from ..ops.grouped_agg import AggCore
-from ..ops.hash_table import ht_lookup, ht_lookup_or_insert, ht_new
+from ..ops.hash_table import (
+    ht_lookup, ht_lookup_or_insert, ht_new, scatter_reduce,
+)
 from ..ops.topn import OrderSpec
 from ..storage.state_table import StateTable
 
@@ -46,6 +48,19 @@ from ..storage.state_table import StateTable
 class BatchFallback(Exception):
     """Raised at run time when a plan shape needs the streaming fold
     (e.g. duplicate build keys in a batch hash join)."""
+
+
+def _bucket_capacity(n: int) -> int:
+    """Round a row count up to a power-of-two chunk capacity (min 16):
+    tail chunks otherwise carry their exact row count as the device
+    shape, and every distinct snapshot size forces a fresh XLA compile
+    of every downstream jitted step — fatal for the serving plane, where
+    cached plans re-execute against a growing table. Bucketing bounds
+    the shape set to O(log n); padded rows ride invisible."""
+    cap = 16
+    while cap < n:
+        cap *= 2
+    return cap
 
 
 class BatchExecutor:
@@ -101,7 +116,8 @@ class RowSeqScan(BatchExecutor):
             yield self._chunk(buf)
 
     def _chunk(self, rows: List[tuple]) -> StreamChunk:
-        chunk = physical_chunk(self.schema, rows, max(len(rows), 1))
+        chunk = physical_chunk(self.schema, rows,
+                               _bucket_capacity(len(rows)))
         if self.vnodes is None:
             return chunk
         # device vnode mask over the pk columns — the same hash the
@@ -126,7 +142,8 @@ class BatchRows(BatchExecutor):
         rows = self.provider()
         for i in range(0, len(rows), self.batch_size):
             part = rows[i:i + self.batch_size]
-            yield physical_chunk(self.schema, part, max(len(part), 1))
+            yield physical_chunk(self.schema, part,
+                                 _bucket_capacity(len(part)))
 
 
 class BatchFilter(_SingleInput):
@@ -229,6 +246,212 @@ class BatchHashAgg(_SingleInput):
         if bool(state.overflow):
             raise BatchFallback(
                 f"batch agg table overflow (capacity {self.capacity})")
+        live = np.asarray(state.table.occupied & (state.lanes[0] > 0))
+        idx = np.nonzero(live)[0]
+        if not len(idx):
+            return
+        outs = self.core.outputs(state.lanes)
+        key_data = [np.asarray(kd)[idx] for kd in state.table.key_data]
+        key_mask = [np.asarray(km)[idx] for km in state.table.key_mask]
+        out_data = [np.asarray(d)[idx] for d, _ in outs]
+        out_mask = [np.asarray(m)[idx] for _, m in outs]
+        n = len(idx)
+        cols = tuple(
+            Column(jnp.asarray(d), jnp.asarray(m))
+            for d, m in zip(key_data + out_data, key_mask + out_mask))
+        yield StreamChunk(jnp.zeros(n, jnp.int8),
+                          jnp.ones(n, jnp.bool_), cols)
+
+
+def partial_agg_fields(input_schema: Schema, group_keys: Sequence[int],
+                       agg_calls: Sequence[AggCall]) -> tuple:
+    """Transport schema of a PARTIAL grouped agg: group-key fields, the
+    row-count lane, then one field per agg state lane (the AggCore lane
+    layout flattened into columns). Lane transport types: int64/float64
+    lanes ride INT64/FLOAT64; string MIN/MAX lanes ride the arg's VARCHAR
+    type so the row codec re-interns dictionary ids across processes.
+    MIN/MAX lanes are NULL when the group saw no value (the on-device
+    sentinel never crosses the wire)."""
+    from ..common.types import FLOAT64, INT64
+    fields = [input_schema[i] for i in group_keys]
+    fields.append(Field("_rows", INT64))
+    for i, c in enumerate(agg_calls):
+        for j, dt in enumerate(c.state_dtypes()):
+            if c.is_string_minmax:
+                t = c.arg_type
+            elif np.dtype(dt) == np.dtype(np.int64):
+                t = INT64
+            else:
+                t = FLOAT64
+            fields.append(Field(f"_p{i}_{j}", t))
+    return tuple(fields)
+
+
+def partial_supported(group_keys: Sequence[int],
+                      agg_calls: Sequence[AggCall]) -> bool:
+    """True when a grouped agg can split into partial + merge phases:
+    every call's state is fixed lanes merging by add/min/max (count, sum,
+    min, max, avg-as-sum+count, approx_count_distinct registers)."""
+    return bool(group_keys) and all(
+        not c.lanes_unsupported for c in agg_calls)
+
+
+class BatchPartialAgg(_SingleInput):
+    """Phase 1 of the two-phase distributed aggregation: the same
+    AggCore scatter-reduce fold BatchHashAgg runs, but emitting the raw
+    per-group STATE LANES instead of projected outputs — one row per
+    live group in ``partial_agg_fields`` transport layout. Runs where
+    the vnode slice lives (a local vnode-partitioned task thread or a
+    worker's ``batch_task`` frame); ``BatchMergeAgg`` in the session
+    folds any number of partial row sets into the exact single-phase
+    state (reference: the partial/final agg split of
+    src/frontend/src/scheduler/distributed/query.rs:69-115)."""
+
+    def __init__(self, input: BatchExecutor, group_keys: Sequence[int],
+                 agg_calls: Sequence[AggCall],
+                 table_capacity: int = 1 << 16):
+        super().__init__(input)
+        self.group_keys = tuple(group_keys)
+        self.agg_calls = tuple(agg_calls)
+        if not partial_supported(self.group_keys, self.agg_calls):
+            raise BatchFallback("agg shape has no partial/merge split")
+        self.schema = Schema(partial_agg_fields(
+            input.schema, self.group_keys, self.agg_calls))
+        self.capacity = table_capacity
+        key_types = tuple(input.schema[i].type for i in self.group_keys)
+        self.core = AggCore(key_types, self.group_keys, self.agg_calls,
+                            table_capacity, out_capacity=1024)
+        self._apply = jax.jit(self.core.apply_chunk)
+        self._needs_ranks = any(c.is_string_minmax for c in self.agg_calls)
+
+    def _ranks(self):
+        if not self._needs_ranks:
+            return None
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks()
+
+    def execute_chunks(self):
+        state = self.core.init_state()
+        for chunk in self.input.execute_chunks():
+            state = self._apply(state, chunk, self._ranks())
+        if bool(state.overflow):
+            raise BatchFallback(
+                f"partial agg table overflow (capacity {self.capacity})")
+        live = np.asarray(state.table.occupied & (state.lanes[0] > 0))
+        idx = np.nonzero(live)[0]
+        if not len(idx):
+            return
+        n = len(idx)
+        cols = []
+        for kd, km in zip(state.table.key_data, state.table.key_mask):
+            cols.append(Column(jnp.asarray(np.asarray(kd)[idx]),
+                               jnp.asarray(np.asarray(km)[idx])))
+        ones = np.ones(n, np.bool_)
+        cols.append(Column(jnp.asarray(np.asarray(state.lanes[0])[idx]),
+                           jnp.asarray(ones)))
+        for call, ofs in zip(self.agg_calls, self.core.call_lane_ofs):
+            for j in range(call.num_lanes):
+                lane = np.asarray(state.lanes[ofs + j])[idx]
+                if call.kind in ("min", "max"):
+                    sent = call._minmax_sentinel()
+                    if call._integral_arg() or call.is_string_minmax:
+                        valid = lane != sent
+                    else:
+                        valid = np.isfinite(lane)
+                    data = np.where(valid, lane, 0)
+                    if call.is_string_minmax:
+                        data = data.astype(call.arg_type.np_dtype)
+                    cols.append(Column(jnp.asarray(data),
+                                       jnp.asarray(valid)))
+                else:
+                    cols.append(Column(jnp.asarray(lane),
+                                       jnp.asarray(ones)))
+        yield StreamChunk(jnp.zeros(n, jnp.int8), jnp.ones(n, jnp.bool_),
+                          tuple(cols))
+
+
+class BatchMergeAgg(_SingleInput):
+    """Phase 2: fold partial-state rows (``partial_agg_fields`` layout,
+    any number of upstream tasks concatenated) back into one AggCore
+    state with each lane's own reduce op — add for counts/sums/avg,
+    min/max in packed rank|id space for string MIN/MAX, register-max for
+    HLL — then project outputs EXACTLY like the single-phase
+    BatchHashAgg. Lane merging is associative and the vnode slices are
+    disjoint, so the merged state is bit-identical to the single-phase
+    fold for every exactly-represented lane (all-integer lanes always;
+    float sums up to f64 addition order)."""
+
+    def __init__(self, input: BatchExecutor, key_types: Sequence,
+                 agg_calls: Sequence[AggCall],
+                 table_capacity: int = 1 << 16):
+        super().__init__(input)
+        self.key_types = tuple(key_types)
+        self.agg_calls = tuple(agg_calls)
+        nk = len(self.key_types)
+        self.nk = nk
+        self.core = AggCore(self.key_types, tuple(range(nk)),
+                            self.agg_calls, table_capacity,
+                            out_capacity=1024)
+        fields = tuple(
+            Field(input.schema[i].name, self.key_types[i])
+            for i in range(nk)) + tuple(
+            Field(f"agg{i}", a.output_type)
+            for i, a in enumerate(self.agg_calls))
+        self.schema = Schema(fields)
+        self.capacity = table_capacity
+        self._needs_ranks = any(c.is_string_minmax for c in self.agg_calls)
+
+        def _merge(state, chunk, str_ranks=None):
+            key_cols = [chunk.columns[i] for i in range(nk)]
+            table, slots, _is_new, ovf = ht_lookup_or_insert(
+                state.table, key_cols, chunk.vis)
+            lanes = list(state.lanes)
+            c0 = chunk.columns[nk]
+            lanes[0] = scatter_reduce(
+                lanes[0], slots,
+                jnp.where(chunk.vis, c0.data, 0), "add")
+            pos = nk + 1
+            for call, ofs in zip(self.agg_calls, self.core.call_lane_ofs):
+                for j, op in enumerate(call.reduce_ops()):
+                    col = chunk.columns[pos]
+                    pos += 1
+                    have = chunk.vis & col.mask
+                    lane = lanes[ofs + j]
+                    if op == "add":
+                        contrib = jnp.where(have, col.data, 0)
+                        lanes[ofs + j] = scatter_reduce(
+                            lane, slots, contrib, "add")
+                        continue
+                    if call.kind in ("min", "max"):
+                        ident = call._minmax_sentinel()
+                    else:            # HLL registers: max over rho >= 0
+                        ident = 0
+                    v = jnp.where(have, col.data.astype(lane.dtype), ident)
+                    if call.is_string_minmax:
+                        cur = call.pack_lane(lane, str_ranks)
+                        vv = call.pack_lane(v, str_ranks)
+                        lanes[ofs + j] = call.unpack_lane(
+                            scatter_reduce(cur, slots, vv, op))
+                    else:
+                        lanes[ofs + j] = scatter_reduce(lane, slots, v, op)
+            return state.replace(table=table, lanes=tuple(lanes),
+                                 overflow=state.overflow | ovf)
+
+        self._merge = jax.jit(_merge)
+
+    def _ranks(self):
+        if not self._needs_ranks:
+            return None
+        from ..common.types import GLOBAL_STRING_DICT
+        return GLOBAL_STRING_DICT.device_ranks()
+
+    def execute_chunks(self):
+        state = self.core.init_state()
+        for chunk in self.input.execute_chunks():
+            state = self._merge(state, chunk, self._ranks())
+        if bool(state.overflow):
+            raise BatchFallback(
+                f"merge agg table overflow (capacity {self.capacity})")
         live = np.asarray(state.table.occupied & (state.lanes[0] > 0))
         idx = np.nonzero(live)[0]
         if not len(idx):

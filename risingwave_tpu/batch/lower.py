@@ -12,6 +12,7 @@ time and the session re-runs through the streaming fold."""
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 from ..expr.expr import FunctionCall, InputRef, Literal
@@ -19,7 +20,8 @@ from ..frontend import planner as P
 from ..storage.state_table import StateTable
 from .executors import (
     BatchExecutor, BatchFilter, BatchHashAgg, BatchHashJoin, BatchLimit,
-    BatchProject, BatchSort, RowSeqScan,
+    BatchMergeAgg, BatchPartialAgg, BatchProject, BatchSort, RowSeqScan,
+    partial_agg_fields, partial_supported,
 )
 
 
@@ -95,37 +97,59 @@ def _index_scan(plan: P.PFilter, catalog, store) -> Optional[BatchExecutor]:
 
 
 def lower_plan(plan: P.PlanNode, store,
-               catalog=None) -> Optional[BatchExecutor]:
+               catalog=None, vnodes=None) -> Optional[BatchExecutor]:
+    """``vnodes``: restrict every base scan to this vnode slice — the
+    per-task restriction of the two-phase serving plane (a worker's
+    ``batch_task`` frame or a local partitioned task carries its slice
+    here; reference: per-task vnode bitmaps in the distributed batch
+    scheduler)."""
     if isinstance(plan, (P.PTableScan, P.PMvScan)):
         d = plan.table if isinstance(plan, P.PTableScan) else plan.mv
         return RowSeqScan(StateTable(store, d.table_id, d.schema,
-                                     list(d.pk)))
+                                     list(d.pk)), vnodes=vnodes)
     if isinstance(plan, P.PRemoteFragment):
         from .executors import BatchRows
         return BatchRows(plan.schema, plan.fetch)
     if isinstance(plan, P.PProject):
-        inp = lower_plan(plan.input, store, catalog)
+        inp = lower_plan(plan.input, store, catalog, vnodes)
         if inp is None:
             return None
         return BatchProject(inp, list(plan.exprs), names=plan.schema.names)
     if isinstance(plan, P.PFilter):
-        if catalog is not None:
+        if catalog is not None and vnodes is None:
             ix = _index_scan(plan, catalog, store)
             if ix is not None:
                 return ix
-        inp = lower_plan(plan.input, store, catalog)
+        inp = lower_plan(plan.input, store, catalog, vnodes)
         if inp is None:
             return None
         return BatchFilter(inp, plan.predicate)
     if isinstance(plan, P.PAgg):
+        if vnodes is not None and plan.phase != "partial":
+            # a single-phase agg over one slice computes per-slice
+            # groups; unioning slices would duplicate them — only
+            # PARTIAL aggs (whose outputs are merge-folded) may run
+            # under a vnode restriction
+            return None
         if plan.eowc or any(c.distinct for c in plan.agg_calls):
             return None
-        inp = lower_plan(plan.input, store, catalog)
+        inp = lower_plan(plan.input, store, catalog, vnodes)
         if inp is None:
             return None
+        if plan.phase == "partial":
+            if not partial_supported(plan.group_keys, plan.agg_calls):
+                return None
+            return BatchPartialAgg(inp, list(plan.group_keys),
+                                   list(plan.agg_calls))
         return BatchHashAgg(inp, list(plan.group_keys),
                             list(plan.agg_calls))
     if isinstance(plan, P.PJoin):
+        if vnodes is not None:
+            # a vnode slice partitions by the BASE table's key — joins and
+            # limits over a slice would drop cross-slice matches; only
+            # slice-safe chains (scan/filter/project/partial-agg) may run
+            # per slice
+            return None
         if plan.kind not in ("inner", "left", "right", "full",
                              "left_semi", "left_anti"):
             return None
@@ -149,6 +173,8 @@ def lower_plan(plan: P.PlanNode, store,
                              prefer_build=prefer,
                              null_aware=getattr(plan, "null_aware", False))
     if isinstance(plan, P.PTopN):
+        if vnodes is not None:
+            return None               # a sliced top-n is not the top-n
         if plan.with_ties or plan.group_by:
             return None
         inp = lower_plan(plan.input, store, catalog)
@@ -157,3 +183,85 @@ def lower_plan(plan: P.PlanNode, store,
         return BatchLimit(BatchSort(inp, list(plan.order)),
                           limit=plan.limit, offset=plan.offset)
     return None
+
+
+# -- two-phase split (the distributed serving plane's planner half) ----------
+
+@dataclasses.dataclass
+class TwoPhaseSplit:
+    """A grouped-agg plan split into shippable halves.
+
+    ``partial_plan``: the PAgg(phase="partial") subtree over the original
+    input chain — lowering it (optionally with a ``vnodes`` slice) yields
+    a task emitting partial-state rows in ``partial_schema`` layout.
+    ``merge_input_schema``/``key_types``/``agg_calls`` parameterize the
+    session-side BatchMergeAgg; ``tail`` is the row-wise chain that sat
+    ABOVE the agg (projections / HAVING filters), re-applied over the
+    merged output in original order."""
+
+    partial_plan: P.PAgg
+    partial_schema: object
+    key_types: tuple
+    agg_calls: tuple
+    base: P.PlanNode                  # the scan leaf under the agg input
+    tail: tuple                       # (PProject | PFilter) nodes, top→down
+
+    def merge_executor(self, partial_rows_provider,
+                       batch_size: int = 4096) -> BatchExecutor:
+        """Session-side tail of the split: BatchRows over the collected
+        partial rows → BatchMergeAgg → the original row-wise tail."""
+        from .executors import BatchRows
+        ex: BatchExecutor = BatchMergeAgg(
+            BatchRows(self.partial_schema, partial_rows_provider,
+                      batch_size=batch_size),
+            self.key_types, self.agg_calls)
+        for node in reversed(self.tail):
+            if isinstance(node, P.PProject):
+                ex = BatchProject(ex, list(node.exprs),
+                                  names=node.schema.names)
+            else:
+                ex = BatchFilter(ex, node.predicate)
+        return ex
+
+
+def _slice_safe(node: P.PlanNode) -> bool:
+    """True when ``node`` is a chain of row-wise operators over exactly
+    one base scan — running it per disjoint vnode slice and unioning the
+    outputs equals running it once."""
+    while isinstance(node, (P.PProject, P.PFilter)):
+        node = node.input
+    return isinstance(node, (P.PTableScan, P.PMvScan))
+
+
+def split_two_phase(plan: P.PlanNode) -> Optional[TwoPhaseSplit]:
+    """Split ``plan`` into per-vnode-slice partial agg tasks + a final
+    session-side merge, when it has the shape
+    ``[Project|Filter]* → HashAgg → [Project|Filter]* → Scan`` with
+    lane-mergeable agg calls. Returns None for every other shape (the
+    caller keeps the single-phase path)."""
+    from ..common.types import Schema
+    tail = []
+    node = plan
+    while isinstance(node, (P.PProject, P.PFilter)):
+        tail.append(node)
+        node = node.input
+    if not isinstance(node, P.PAgg) or node.phase != "single":
+        return None
+    if node.eowc or not partial_supported(node.group_keys, node.agg_calls):
+        return None
+    if not _slice_safe(node.input):
+        return None
+    fields = partial_agg_fields(node.input.schema, node.group_keys,
+                                node.agg_calls)
+    pschema = Schema(fields)
+    nk = len(node.group_keys)
+    partial = dataclasses.replace(
+        node, phase="partial", schema=pschema, pk=tuple(range(nk)))
+    base = node.input
+    while isinstance(base, (P.PProject, P.PFilter)):
+        base = base.input
+    key_types = tuple(node.input.schema[i].type for i in node.group_keys)
+    return TwoPhaseSplit(partial_plan=partial, partial_schema=pschema,
+                         key_types=key_types,
+                         agg_calls=tuple(node.agg_calls),
+                         base=base, tail=tuple(tail))

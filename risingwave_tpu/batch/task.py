@@ -57,8 +57,30 @@ class BatchTaskManager:
                 for part in vnode_partitions(n_tasks)]
 
     def collect(self, task_id: int, timeout: Optional[float] = None):
-        fut = self._tasks.pop(task_id)
-        return fut.result(timeout=timeout)
+        """Wait for one task's result. The entry stays registered until
+        the task OUTCOME is actually retrieved: popping before the wait
+        (the old behavior) leaked the future on timeout — a slow task
+        became permanently uncollectable even though it finished moments
+        later. A task's own exception counts as retrieval (the entry is
+        dropped); only a collect timeout keeps it collectable."""
+        fut = self._tasks[task_id]
+        try:
+            result = fut.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            raise                      # not done yet: entry stays
+        except BaseException:
+            self._tasks.pop(task_id, None)   # outcome delivered: failed
+            raise
+        self._tasks.pop(task_id, None)
+        return result
+
+    def discard(self, task_id: int) -> None:
+        """Abandon a fired task: cancel if still queued, drop the entry
+        either way (callers that stop collecting after a sibling failed
+        use this so the remaining futures don't leak)."""
+        fut = self._tasks.pop(task_id, None)
+        if fut is not None:
+            fut.cancel()
 
     def collect_all(self, task_ids: List[int]) -> List[tuple]:
         rows: List[tuple] = []
@@ -66,5 +88,16 @@ class BatchTaskManager:
             rows.extend(self.collect(t))
         return rows
 
-    def shutdown(self) -> None:
-        self._pool.shutdown(wait=False)
+    def pending(self) -> int:
+        """Tasks fired but not yet collected (observability/tests)."""
+        return len(self._tasks)
+
+    def shutdown(self, wait: bool = False) -> None:
+        """Stop the pool (``Session.close`` calls this): queued-but-idle
+        tasks are cancelled; running ones finish but their results are
+        dropped with the task map."""
+        self._tasks.clear()
+        try:
+            self._pool.shutdown(wait=wait, cancel_futures=True)
+        except TypeError:              # cancel_futures needs py3.9+
+            self._pool.shutdown(wait=wait)

@@ -83,6 +83,29 @@ class StorageConfig:
 
 
 @dataclasses.dataclass
+class BatchConfig:
+    """Serving-plane knobs for batch reads (frontend/serving.py;
+    reference capability: the batch section + frontend query caches of
+    src/common/src/config.rs — distributed query execution and the
+    per-frontend plan caches)."""
+
+    # version-pinned plan+compilation cache: entries keyed on the
+    # statement's canonical form; an entry survives data-version bumps
+    # (it re-executes against the new snapshot WITHOUT replanning or new
+    # jit compilations) and is evicted LRU past this bound. 0 disables.
+    serving_cache_size: int = 64
+    # two-phase distributed aggregation: number of per-vnode-slice
+    # partial tasks a local grouped agg splits into (clamped to the
+    # vnode count; 0/1 keeps single-phase execution)
+    serving_tasks: int = 4
+    # thread pool executing local partial tasks (BatchTaskManager)
+    serving_threads: int = 4
+    # optimistic concurrent reads: attempts to observe a quiescent data
+    # version before falling back to the API-locked path
+    serving_read_retries: int = 32
+
+
+@dataclasses.dataclass
 class FaultConfig:
     """Fault-tolerance knobs for every external boundary (common/retry.py,
     storage/object_store.py, connector/broker.py, stream/sink.py,
@@ -161,6 +184,7 @@ class RwConfig:
     streaming: StreamingConfig = dataclasses.field(
         default_factory=StreamingConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    batch: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
 

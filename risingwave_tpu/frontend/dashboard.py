@@ -45,6 +45,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>cluster</h2><pre id="cluster">loading…</pre>
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>exchange edges</h2><pre id="exchange">loading…</pre>
+<h2>serving plane</h2><pre id="serving">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
 <h2>storage tier</h2><pre id="storage">loading…</pre>
@@ -62,6 +63,8 @@ async function loadStorage() {
     JSON.stringify(m.storage || {}, null, 2);
   document.getElementById("exchange").textContent =
     JSON.stringify(m.exchange || [], null, 2);
+  document.getElementById("serving").textContent =
+    JSON.stringify(m.serving || {}, null, 2);
   document.getElementById("metrics").textContent =
     JSON.stringify(m, null, 2);
 }

@@ -160,6 +160,15 @@ class PAgg(PlanNode):
     agg_calls: tuple                 # AggCall...
     append_only_input: bool = False
     eowc: bool = False
+    #: batch two-phase aggregation (batch/lower.py split_two_phase):
+    #: "single" = ordinary one-shot agg; "partial" = emit raw per-group
+    #: state lanes instead of projected outputs — the distributed serving
+    #: plane ships partial-phase subtrees to the workers owning the vnode
+    #: slices and merges the lanes in the session (reference: the
+    #: two-phase agg split in src/frontend/src/scheduler/distributed/
+    #: query.rs:69-115). ``schema`` of a partial node is the lane
+    #: transport schema, not the user-facing agg schema.
+    phase: str = "single"
 
     @property
     def children(self):
@@ -168,9 +177,10 @@ class PAgg(PlanNode):
     def _describe(self):
         calls = [f"{c.kind}({c.arg if c.arg >= 0 else '*'})"
                  for c in self.agg_calls]
+        ph = "" if self.phase == "single" else f", phase={self.phase}"
         return (f"{'SimpleAgg' if not self.group_keys else 'HashAgg'} "
                 f"{{keys={list(self.group_keys)}, aggs={calls}, "
-                f"pk={list(self.pk)}}}")
+                f"pk={list(self.pk)}{ph}}}")
 
 
 @dataclasses.dataclass

@@ -112,6 +112,21 @@ def render_metrics(session) -> str:
                     lines.append(
                         f'rw_exchange_stat{{{labels},'
                         f'stat="{stat}"}} {value}')
+    serving = m.get("serving") or {}
+    if serving:
+        lines += ["# HELP rw_serving_stat Serving-plane counters "
+                  "(plan-cache hits/misses, two-phase tasks fired, "
+                  "partial states merged, read latency percentiles).",
+                  "# TYPE rw_serving_stat gauge"]
+        for name, value in serving.items():
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            lines.append(
+                f'rw_serving_stat{{stat="{_sanitize(name)}"}} {value}')
+        for wid, n in (serving.get("task_workers") or {}).items():
+            lines.append(
+                f'rw_serving_task_total{{worker="{_sanitize(wid)}"}} {n}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

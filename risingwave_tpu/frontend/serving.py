@@ -1,0 +1,506 @@
+"""High-QPS serving plane: version-pinned plan cache + two-phase reads.
+
+The read-side counterpart of the fused write-side ladder (PRs 4–7): the
+north star's "millions of users" are overwhelmingly *readers*, and before
+this module every batch SELECT re-planned, re-lowered, re-jitted, and ran
+a single-phase scan+agg under the session API lock. Three composing legs
+(ROADMAP item 3; reference: the per-frontend query caches and the
+distributed batch scheduler, src/frontend/src/scheduler/distributed/
+query.rs:69-115):
+
+* **Two-phase distributed aggregation** — a grouped-agg plan splits into
+  per-vnode-slice PARTIAL tasks (``batch/lower.py split_two_phase``)
+  fired through the local ``BatchTaskManager`` thread pool, or through
+  the ``batch_task`` worker frame when the scanned MV's table lives on
+  worker processes (one task per root actor: the partial agg runs WHERE
+  the vnode slice lives and only per-group state lanes cross the wire).
+  A session-side ``BatchMergeAgg`` folds the lanes — bit-exact vs the
+  single-phase path.
+
+* **Version-pinned plan cache** — entries key on the statement's
+  canonical form and carry the lowered executor chain, the presentation
+  closure, and the result rows at a pinned data version. A repeated
+  SELECT with an unchanged version returns the cached rows; a version
+  bump re-executes the SAME executors against the new snapshot — zero
+  re-plan, zero re-lower, zero new jit wrappers (the
+  ``common/dispatch_count.py`` invariant). DDL clears the cache; an LRU
+  bound from ``rw_config [batch] serving_cache_size`` caps it. On the
+  Hummock tier each re-execution holds a version pin so concurrent
+  compaction cannot vacuum the SSTs mid-scan.
+
+* **Concurrent serving path** — cache hits never touch the session API
+  lock, so readers neither serialize behind each other nor block barrier
+  ticks. Re-executions of local plans run OPTIMISTICALLY: the session
+  maintains a seqlock-style data version (odd while a mutation is in
+  flight, bumped on every tick/commit); a read that observes the same
+  even version on both sides of its scan is consistent, anything else
+  retries and finally falls back behind the API lock. Plans that touch
+  worker RPCs re-execute under the lock (the session socket protocol is
+  single-driver).
+
+docs/serving.md covers the contract; Session.metrics()["serving"],
+Prometheus ``rw_serving_stat`` and the dashboard panel expose the
+counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+from ..batch.task import BatchTaskManager, vnode_partitions
+from ..common.config import BatchConfig
+
+
+class _Retired(Exception):
+    """Internal: the entry died (catalog bump raced the lookup)."""
+
+
+class ServingStats:
+    """Thread-safe counters + a latency ring for p50/p99."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.reexecutions = 0          # version-bump re-runs (no replan)
+        self.catalog_invalidations = 0
+        self.two_phase_queries = 0
+        self.tasks_fired_local = 0
+        self.tasks_fired_remote = 0
+        self.partials_merged = 0       # partial state rows folded
+        self.fallbacks = 0             # BatchFallback → single-phase
+        self.locked_reads = 0          # reads that needed the API lock
+        self.task_workers: collections.Counter = collections.Counter()
+        self._lat = collections.deque(maxlen=window)
+
+    def bump(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def saw_workers(self, worker_ids) -> None:
+        with self._lock:
+            self.task_workers.update(worker_ids)
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(seconds)
+
+    def _percentile(self, sorted_lat: List[float], q: float) -> float:
+        if not sorted_lat:
+            return 0.0
+        i = min(len(sorted_lat) - 1, int(q * len(sorted_lat)))
+        return sorted_lat[i]
+
+    def snapshot(self, cache_size: int = 0) -> dict:
+        with self._lock:
+            lat = sorted(self._lat)
+            return {
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "reexecutions": self.reexecutions,
+                "catalog_invalidations": self.catalog_invalidations,
+                "two_phase_queries": self.two_phase_queries,
+                "tasks_fired_local": self.tasks_fired_local,
+                "tasks_fired_remote": self.tasks_fired_remote,
+                "partials_merged": self.partials_merged,
+                "fallbacks": self.fallbacks,
+                "locked_reads": self.locked_reads,
+                "cache_size": cache_size,
+                "queries": self.cache_hits + self.cache_misses,
+                "task_workers": dict(self.task_workers),
+                "p50_ms": round(self._percentile(lat, 0.5) * 1e3, 3),
+                "p99_ms": round(self._percentile(lat, 0.99) * 1e3, 3),
+            }
+
+
+class _CacheEntry:
+    """One cached SELECT: plan artifacts + pinned-version result."""
+
+    __slots__ = ("key", "sel", "plan", "schema", "out_types", "runner",
+                 "needs_lock", "two_phase", "data_version",
+                 "pinned_version", "rows", "lock", "dead")
+
+    def __init__(self, key, sel, plan, schema, out_types, runner,
+                 needs_lock, two_phase):
+        self.key = key
+        self.sel = sel
+        self.plan = plan
+        self.schema = schema            # last_select_schema form
+        self.out_types = out_types      # plan.schema types (to_python)
+        self.runner = runner            # () -> physical row tuples
+        self.needs_lock = needs_lock    # touches worker RPCs
+        self.two_phase = two_phase
+        self.data_version = -1
+        self.pinned_version = None      # hummock vid at last execution
+        self.rows = []
+        self.lock = threading.Lock()    # one re-executor at a time
+        self.dead = False
+
+
+class ServingPlane:
+    """Per-session serving state: plan cache, task pool, counters.
+
+    Holds no back-reference to the Session — every entry point takes the
+    session as an argument, so the plane can be torn down independently
+    and never keeps a closed session alive."""
+
+    def __init__(self, cfg: Optional[BatchConfig] = None):
+        self.cfg = cfg or BatchConfig()
+        self.stats = ServingStats()
+        self.tasks = BatchTaskManager(
+            max_workers=max(1, self.cfg.serving_threads))
+        self._cache: "collections.OrderedDict[str, _CacheEntry]" = \
+            collections.OrderedDict()
+        self._cache_lock = threading.Lock()
+        self._closed = False
+
+    # -- cache plumbing -------------------------------------------------------
+
+    def _cache_get(self, key: str) -> Optional[_CacheEntry]:
+        with self._cache_lock:
+            ent = self._cache.get(key)
+            if ent is not None:
+                self._cache.move_to_end(key)
+            return ent
+
+    def _cache_put(self, ent: _CacheEntry) -> None:
+        if self.cfg.serving_cache_size <= 0:
+            return
+        with self._cache_lock:
+            self._cache[ent.key] = ent
+            self._cache.move_to_end(ent.key)
+            while len(self._cache) > self.cfg.serving_cache_size:
+                _, evicted = self._cache.popitem(last=False)
+                evicted.dead = True
+
+    def _cache_drop(self, key: str) -> None:
+        with self._cache_lock:
+            ent = self._cache.pop(key, None)
+            if ent is not None:
+                ent.dead = True
+
+    def cache_len(self) -> int:
+        with self._cache_lock:
+            return len(self._cache)
+
+    def invalidate_catalog(self) -> None:
+        """DDL happened: every cached plan may reference dropped/changed
+        relations — clear the cache (the reference invalidates frontend
+        caches on catalog notification)."""
+        with self._cache_lock:
+            for ent in self._cache.values():
+                ent.dead = True
+            self._cache.clear()
+        self.stats.bump(catalog_invalidations=1)
+
+    def shutdown(self) -> None:
+        self._closed = True
+        self.invalidate_catalog()
+        self.tasks.shutdown()
+
+    def metrics(self) -> dict:
+        return self.stats.snapshot(cache_size=self.cache_len())
+
+    # -- the Session.query entry ----------------------------------------------
+
+    def query(self, session, sel) -> list:
+        """Serve one SELECT. Fast path (cache hit, unchanged version):
+        lock-free. Version bump: re-execute the cached executors.
+        Miss: plan + lower under the API lock, cache if servable, else
+        run the session's stream-fold path."""
+        from ..batch.executors import BatchFallback
+        t0 = time.perf_counter()
+        key = repr(sel)
+        ent = self._cache_get(key)
+        if ent is not None:
+            try:
+                rows = self._serve_cached(session, ent)
+                self.stats.record_latency(time.perf_counter() - t0)
+                return rows
+            except _Retired:
+                pass
+            except BatchFallback:
+                # the data grew into a shape the cached executors cannot
+                # serve (duplicate join build keys, partial-agg table
+                # overflow): drop the entry and take the full path below
+                # — it rebuilds or lands on the stream-fold, exactly
+                # like the pre-cache behavior
+                self._cache_drop(key)
+                self.stats.bump(fallbacks=1)
+            except Exception:
+                # a failing cached plan must not wedge the statement:
+                # drop the entry and surface the error
+                self._cache_drop(key)
+                raise
+        with session._api_lock:
+            session._drain_inflight()
+            plan = session._plan(sel)
+            session.last_select_schema = [
+                (f.name, f.type) for f in plan.schema
+                if not f.name.startswith("_")]
+            ent = self._build_entry(session, key, sel, plan)
+            if ent is not None:
+                try:
+                    rows = self._execute_locked(session, ent)
+                except BatchFallback:
+                    self.stats.bump(fallbacks=1)
+                    ent = None
+            if ent is not None:
+                self.stats.bump(cache_misses=1)
+                self._cache_put(ent)
+                self.stats.record_latency(time.perf_counter() - t0)
+                return rows
+            return session._query_stream_fold(sel, plan)
+
+    # -- execution ------------------------------------------------------------
+
+    def _finish(self, session, ent: _CacheEntry, phys: list) -> list:
+        out = [
+            tuple(None if v is None else ent.out_types[i].to_python(v)
+                  for i, v in enumerate(r))
+            for r in phys
+        ]
+        return session._present(out, ent.sel, ent.plan)
+
+    def _pin(self, session):
+        pin = getattr(session.store, "pin", None)
+        return pin() if pin is not None else None
+
+    def _execute_locked(self, session, ent: _CacheEntry) -> list:
+        """Run an entry's executors while HOLDING the session API lock
+        (first execution, RPC-touching plans, contended fallback). The
+        lock serializes against every mutator, so the observed data
+        version is stable across the run."""
+        snap = self._pin(session)
+        try:
+            rows = self._finish(session, ent, ent.runner())
+        finally:
+            if snap is not None:
+                ent.pinned_version = snap.version.vid
+                snap.unpin()
+        ent.rows = rows
+        ent.data_version = session._data_version
+        return list(rows)
+
+    def _serve_cached(self, session, ent: _CacheEntry) -> list:
+        if ent.dead:
+            raise _Retired()
+        v = session._data_version
+        if v == ent.data_version and not (v & 1):
+            self.stats.bump(cache_hits=1)
+            session.last_select_schema = ent.schema
+            return list(ent.rows)
+        with ent.lock:
+            if ent.dead:
+                raise _Retired()
+            v = session._data_version
+            if v == ent.data_version and not (v & 1):
+                self.stats.bump(cache_hits=1)
+                session.last_select_schema = ent.schema
+                return list(ent.rows)
+            rows = self._reexecute(session, ent)
+            self.stats.bump(reexecutions=1)
+            session.last_select_schema = ent.schema
+            return rows
+
+    def _reexecute(self, session, ent: _CacheEntry) -> list:
+        """The data version moved: run the SAME executors again (zero
+        replan / relower / new jit wrappers). Local plans run
+        optimistically under the seqlock protocol; RPC-touching plans
+        and contended reads serialize briefly behind the API lock —
+        never the other way around, so ticks are never blocked by a
+        reader."""
+        if not ent.needs_lock:
+            for _ in range(max(1, self.cfg.serving_read_retries)):
+                v0 = session._data_version
+                if (v0 & 1) or session._inflight:
+                    time.sleep(0.0002)
+                    continue
+                # hold a version pin for the scan (Hummock tier): a
+                # concurrent compactor must not vacuum the SSTs under us
+                snap = self._pin(session)
+                try:
+                    rows = self._finish(session, ent, ent.runner())
+                except Exception:
+                    if session._data_version != v0:
+                        continue          # torn read: mutation raced us
+                    raise
+                finally:
+                    if snap is not None:
+                        snap.unpin()
+                if session._data_version == v0:
+                    if snap is not None:
+                        ent.pinned_version = snap.version.vid
+                    ent.rows = rows
+                    ent.data_version = v0
+                    return list(rows)
+        self.stats.bump(locked_reads=1)
+        with session._api_lock:
+            session._drain_inflight()
+            return self._execute_locked(session, ent)
+
+    # -- entry construction ---------------------------------------------------
+
+    def _build_entry(self, session, key, sel, plan) -> Optional[_CacheEntry]:
+        """Lower ``plan`` into a reusable runner. Preference order:
+        two-phase distributed agg (local slices or worker-side partial
+        tasks) → single-phase batch executors (with remote-fragment
+        pushdown) → None (stream-fold, uncached)."""
+        from ..batch.executors import BatchFallback
+        from ..batch.lower import lower_plan, split_two_phase
+        from .build import collect_leaves
+        from .planner import PMvScan
+
+        schema = [(f.name, f.type) for f in plan.schema
+                  if not f.name.startswith("_")]
+        out_types = [f.type for f in plan.schema]
+
+        def entry(runner, needs_lock, two_phase):
+            return _CacheEntry(key, sel, plan, schema, out_types, runner,
+                               needs_lock, two_phase)
+
+        split = None
+        if self.cfg.serving_tasks > 1:
+            split = split_two_phase(plan)
+        if split is not None:
+            base = split.base
+            hosts = (session._mv_hosts(base.mv.name)
+                     if isinstance(base, PMvScan) else [])
+            if hosts:
+                runner = self._remote_two_phase_runner(
+                    session, split, base.mv, hosts)
+                if runner is not None:
+                    self.stats.bump(two_phase_queries=1)
+                    return entry(runner, needs_lock=True, two_phase=True)
+            else:
+                runner = self._local_two_phase_runner(session, split)
+                if runner is not None:
+                    self.stats.bump(two_phase_queries=1)
+                    return entry(runner, needs_lock=False, two_phase=True)
+
+        # single-phase: the pre-existing batch fast path, now cached
+        if session._remote_specs or session._spanning_specs:
+            plan_pushed = session._push_remote_fragments(plan)
+        else:
+            plan_pushed = plan
+        remote_mvs = {
+            leaf.mv.name for leaf in collect_leaves(plan_pushed)
+            if isinstance(leaf, PMvScan)
+            and session._mv_worker(leaf.mv.name) is not None
+        }
+        try:
+            lowered = None if remote_mvs else lower_plan(
+                plan_pushed, session.store, catalog=session.catalog)
+        except BatchFallback:
+            lowered = None
+        if lowered is None:
+            return None
+        from ..batch.executors import run_batch
+        from .planner import PRemoteFragment
+        has_remote = any(isinstance(leaf, PRemoteFragment)
+                         for leaf in collect_leaves(plan_pushed))
+        return entry(lambda: run_batch(lowered),
+                     needs_lock=has_remote, two_phase=False)
+
+    def _local_two_phase_runner(self, session, split):
+        """Partial tasks over vnode slices of the SESSION store, fired
+        through the task-manager thread pool; merge in this thread. The
+        executor chain (and its jit wrappers) is built exactly once."""
+        from ..batch.lower import lower_plan
+        n = max(1, self.cfg.serving_tasks)
+        slices = vnode_partitions(n)
+        partials = []
+        for sl in slices:
+            ex = lower_plan(split.partial_plan, session.store, vnodes=sl)
+            if ex is None:
+                return None
+            partials.append(ex)
+        holder: dict = {"rows": []}
+        merge = split.merge_executor(lambda: holder["rows"])
+        from ..batch.executors import run_batch
+
+        def runner():
+            tids = [self.tasks.fire_task(lambda _vn, _ex=ex: _ex)
+                    for ex in partials]
+            self.stats.bump(tasks_fired_local=len(tids))
+            rows: list = []
+            try:
+                for t in tids:
+                    rows.extend(self.tasks.collect(t))
+            except BaseException:
+                # a failed slice aborts the query: abandon the siblings
+                # so their futures don't leak in the task map
+                for t in tids:
+                    self.tasks.discard(t)
+                raise
+            self.stats.bump(partials_merged=len(rows))
+            holder["rows"] = rows
+            return run_batch(merge)
+
+        return runner
+
+    def _remote_two_phase_runner(self, session, split, mv, hosts):
+        """Partial tasks WHERE THE VNODES LIVE: one ``batch_task`` frame
+        per worker hosting a slice of the MV's table (a sharded-root
+        spanning job has ≥2 such workers, each owning a contiguous vnode
+        range; a whole-job placement has one, sub-sliced by vnode for
+        scan parallelism). Only partial state rows cross the wire; the
+        merge runs in the session."""
+        import asyncio
+        import base64
+
+        from ..common.row import decode_value_row
+        from .plan_json import defs_to_json, plan_to_json
+
+        plan_json = plan_to_json(split.partial_plan)
+        defs_json = defs_to_json([mv])
+        types = [f.type for f in split.partial_schema]
+        reqs = []
+        if len(hosts) == 1:
+            worker, _rng = hosts[0]
+            for sl in vnode_partitions(max(1, self.cfg.serving_tasks)):
+                reqs.append((worker, sl))
+        else:
+            # each root actor's store IS its vnode slice — locality is
+            # the partition; no extra restriction needed
+            reqs = [(worker, None) for worker, _rng in hosts]
+        holder: dict = {"rows": []}
+        merge = split.merge_executor(lambda: holder["rows"])
+        from ..batch.executors import BatchFallback, run_batch
+        name = mv.name
+
+        def runner():
+            async def _fire():
+                frames = []
+                for worker, vnodes in reqs:
+                    frame = {"type": "batch_task", "job": name,
+                             "plan": plan_json, "defs": defs_json}
+                    if vnodes is not None:
+                        frame["vnodes"] = list(vnodes)
+                    # data-plane request: unbounded like _remote_scan
+                    frames.append(worker.request(frame, timeout=0))
+                return await asyncio.gather(*frames)
+
+            resps = session._await(_fire())
+            rows: list = []
+            workers_seen = []
+            for (worker, _vn), resp in zip(reqs, resps):
+                if not resp.get("ok"):
+                    raise BatchFallback(
+                        f"remote partial task on worker "
+                        f"{worker.worker_id}: {resp.get('error')}")
+                workers_seen.append(resp.get("worker", worker.worker_id))
+                for b in resp["rows"]:
+                    rows.append(decode_value_row(base64.b64decode(b),
+                                                 types))
+            self.stats.bump(tasks_fired_remote=len(reqs),
+                            partials_merged=len(rows))
+            self.stats.saw_workers(workers_seen)
+            holder["rows"] = rows
+            return run_batch(merge)
+
+        return runner

@@ -430,6 +430,20 @@ class Session:
         # processes over the SAME object-store root; the session plays
         # the meta role, handing out version-manager tasks off the
         # barrier path (_kick_compaction)
+        # serving plane (frontend/serving.py): version-pinned plan cache
+        # + two-phase distributed batch aggregation + the lock-free
+        # concurrent read path. The data-version seqlock: EVEN = stores
+        # quiescent, ODD = a mutation (tick / commit / recovery) is in
+        # flight; every mutator brackets itself with _enter_mutation /
+        # _exit_mutation and optimistic readers accept a result only
+        # when the same even version spans their whole scan.
+        self._data_version = 0
+        self._mutation_depth = 0
+        from ..common.config import BatchConfig
+        self.batch_config = (rw_config.batch if rw_config is not None
+                             else BatchConfig())
+        from .serving import ServingPlane
+        self._serving = ServingPlane(self.batch_config)
         self.compactors: list = []
         self._compaction_pump: Optional[threading.Thread] = None
         if compactors and data_dir is not None \
@@ -577,6 +591,11 @@ class Session:
             # shift later statements' ids (recovery replays only logged —
             # successful — DDL, so id assignment must be replay-deterministic)
             saved_id = self.catalog._next_table_id
+            # DDL is a data mutation for the seqlock too: a CREATE/DROP
+            # rearranges store tables mid-statement, and a lock-free
+            # optimistic reader racing it must see the version move and
+            # retry instead of accepting a torn scan
+            self._enter_mutation()
             try:
                 if isinstance(stmt, A.CreateSource):
                     return self._create_source(stmt)
@@ -590,8 +609,20 @@ class Session:
             except BaseException:
                 self.catalog._next_table_id = saved_id
                 raise
+            finally:
+                # cached serving plans may reference the (attempted)
+                # relations — clear on every catalog transition, BEFORE
+                # the version goes even again so no reader can re-cache
+                # against the old catalog
+                self._serving.invalidate_catalog()
+                self._exit_mutation()
         if isinstance(stmt, A.DropStatement):
-            return self._drop(stmt)
+            self._enter_mutation()
+            try:
+                return self._drop(stmt)
+            finally:
+                self._serving.invalidate_catalog()
+                self._exit_mutation()
         if isinstance(stmt, A.Insert):
             return self._insert(stmt)
         if isinstance(stmt, A.Delete):
@@ -2441,6 +2472,24 @@ class Session:
 
     # --------------------------------------------------------------- epochs --
 
+    # -- data-version seqlock (frontend/serving.py reads it) ------------------
+    # State-store mutation sections (tick / barrier completion / recovery):
+    # the data version goes ODD on entry of the outermost section and EVEN
+    # again on exit. Optimistic serving readers accept a scan only when
+    # the same even version spans it; mutators always hold the API lock,
+    # so the depth counter needs no extra lock. Plain enter/exit methods —
+    # these sit on the hot path of every tick.
+
+    def _enter_mutation(self) -> None:
+        self._mutation_depth += 1
+        if self._mutation_depth == 1:
+            self._data_version += 1              # odd: in progress
+
+    def _exit_mutation(self) -> None:
+        self._mutation_depth -= 1
+        if self._mutation_depth == 0:
+            self._data_version += 1              # even: quiescent
+
     @_locked
     def tick(self, generate: bool = True, checkpoint: Optional[bool] = None,
              mutation: Optional[Mutation] = None) -> int:
@@ -2451,6 +2500,14 @@ class Session:
         in_flight_barrier_nums config.rs:380-381). With the default of 1
         this is the classic synchronous cycle. Returns the last COMPLETED
         epoch."""
+        self._enter_mutation()
+        try:
+            return self._tick_impl(generate, checkpoint, mutation)
+        finally:
+            self._exit_mutation()
+
+    def _tick_impl(self, generate: bool, checkpoint: Optional[bool],
+                   mutation: Optional[Mutation]) -> int:
         epoch = self._injected + 1
         if checkpoint is None:
             checkpoint = epoch % self.checkpoint_frequency == 0
@@ -2557,6 +2614,13 @@ class Session:
         return self.epoch
 
     def _complete_oldest(self) -> None:
+        self._enter_mutation()
+        try:
+            self._complete_oldest_impl()
+        finally:
+            self._exit_mutation()
+
+    def _complete_oldest_impl(self) -> None:
         from ..common.tracing import CAT_EPOCH, GLOBAL_TRACE, Span, trace_span
         e, ckpt = self._inflight.pop(0)
         with trace_span("barrier.collect", CAT_EPOCH, epoch=e,
@@ -2840,21 +2904,34 @@ class Session:
             from .plan_json import defs_to_json, plan_to_json
             plan_json = plan_to_json(node)
             defs_json = defs_to_json([base.mv])
-            worker = self._mv_worker(name)
+            workers = [w for w, _rng in self._mv_hosts(name)]
             types = [f.type for f in node.schema]
 
             def fetch():
                 import base64 as _b64
 
                 from ..common.row import decode_value_row
-                # data-plane request: a big batch stage may legitimately
+
+                # data-plane requests: a big batch stage may legitimately
                 # outlive the control-frame deadline — unbounded here;
-                # wedge detection stays the barrier deadline's job
-                resp = self._await(worker.request(
-                    {"type": "batch_task", "job": name,
-                     "plan": plan_json, "defs": defs_json}, timeout=0))
-                return [decode_value_row(_b64.b64decode(b), types)
-                        for b in resp["rows"]]
+                # wedge detection stays the barrier deadline's job. A
+                # sharded-root MV's stage runs on EVERY slice-holding
+                # worker; chains are slice-safe, so the union is exact.
+                async def _all():
+                    return await asyncio.gather(*(
+                        w.request({"type": "batch_task", "job": name,
+                                   "plan": plan_json,
+                                   "defs": defs_json}, timeout=0)
+                        for w in workers))
+
+                rows = []
+                for resp in self._await(_all()):
+                    if not resp.get("ok", True):
+                        raise RuntimeError(
+                            f"batch stage on {name!r}: {resp.get('error')}")
+                    rows.extend(decode_value_row(_b64.b64decode(b), types)
+                                for b in resp["rows"])
+                return rows
 
             return PRemoteFragment(schema=node.schema, pk=node.pk,
                                    job=name, fetch=fetch)
@@ -2875,49 +2952,27 @@ class Session:
 
         return rewrite(plan)
 
-    @_locked
     def query(self, sel: A.Select) -> list:
-        """Batch SELECT: run the stream plan over snapshot sources."""
-        self._drain_inflight()   # read-your-writes snapshot
-        plan = self._plan(sel)
-        self.last_select_schema = [
-            (f.name, f.type) for f in plan.schema
-            if not f.name.startswith("_")]
+        """Batch SELECT through the serving plane (frontend/serving.py):
+        version-pinned plan cache (a repeated SELECT skips replan /
+        relower / re-jit entirely), two-phase distributed aggregation
+        for grouped-agg shapes, and a concurrent read path — cache hits
+        and local re-executions never take the session API lock, so
+        readers do not serialize behind each other or block barrier
+        ticks. Batch-unservable shapes (windows, EOWC, DISTINCT aggs,
+        fallback joins) run the stream-fold path below, exactly as
+        before. NOTE: do not call ``lower_plan`` here directly — the
+        serving cache is the only lowering entry (scripts/check.sh
+        lints this)."""
+        return self._serving.query(self, sel)
 
-        # batch engine fast path (batch/): pure scan/filter/project/agg/
-        # top-n plans run as one-shot vectorized executors; stream-only
-        # shapes (joins, windows, EOWC, DISTINCT aggs) fall through to the
-        # stream-fold below
-        from ..batch.executors import BatchFallback, run_batch
-        from ..batch.lower import lower_plan
+    def _query_stream_fold(self, sel: A.Select, plan) -> list:
+        """Stream-only SELECT shapes: run the SAME operator pipeline over
+        snapshot sources and fold the delta stream into rows (the
+        streaming/batch unification path). Called by the serving plane
+        WITH the API lock held."""
         if self._remote_specs or self._spanning_specs:
             plan = self._push_remote_fragments(plan)
-        remote_mvs = {l.mv.name for l in collect_leaves(plan)
-                      if isinstance(l, PMvScan)
-                      and self._mv_worker(l.mv.name) is not None}
-        try:
-            # a remote MV's rows live in the worker's store, not ours —
-            # the local-scan fast path would silently read empty tables
-            # (fragment pushdown above converts the common shapes)
-            lowered = None if remote_mvs else lower_plan(
-                plan, self.store, catalog=self.catalog)
-        except BatchFallback:
-            lowered = None
-        if lowered is not None:
-            try:
-                phys = run_batch(lowered)
-            except BatchFallback:
-                # run-time shape the one-shot executors cannot serve
-                # (e.g. duplicate join build keys) — stream-fold below
-                phys = None
-            if phys is not None:
-                out = [
-                    tuple(None if v is None
-                          else plan.schema[i].type.to_python(v)
-                          for i, v in enumerate(r))
-                    for r in phys
-                ]
-                return self._present(out, sel, plan)
 
         def factory(leaf) -> Executor:
             from .planner import PRemoteFragment
@@ -3021,38 +3076,61 @@ class Session:
         return rows
 
     def _mv_worker(self, name: str):
-        """The worker process holding an MV's materialized table: the
-        hosting worker for whole-job placement, the ROOT-fragment worker
-        for a spanning job, None for session-local MVs."""
+        """The PRIMARY worker process holding an MV's materialized table
+        (first root actor for a spanning job); None for session-local
+        MVs. Scan-shaped consumers must use ``_mv_hosts`` — a sharded
+        root distributes the table over SEVERAL workers."""
+        hosts = self._mv_hosts(name)
+        return hosts[0][0] if hosts else None
+
+    def _mv_hosts(self, name: str) -> list:
+        """Every worker holding a slice of an MV's materialized table,
+        as ``(worker, (vnode_start, vnode_end) | None)`` pairs: the one
+        hosting worker for whole-job placement (owning the full ring),
+        one entry per ROOT-FRAGMENT ACTOR for a spanning job — with a
+        sharded root (meta/fragment.py ``shardable``) the MV table is
+        vnode-distributed across ≥2 workers, each owning the contiguous
+        range its actor was placed with. Empty for session-local MVs."""
         spec = self._remote_specs.get(name)
         if spec is not None:
-            return spec["worker"]
+            return [(spec["worker"], None)]
         span = self._spanning_specs.get(name)
         if span is not None:
-            return span["root_worker"]
-        return None
+            placement = span["placement"]
+            graph = span["graph"]
+            by_id = {w.worker_id: w for w in span["workers"]}
+            return [(by_id[a.worker], (a.vnode_start, a.vnode_end))
+                    for a in placement.actors[graph.root_id]]
+        return []
 
     def _remote_scan(self, name: str, schema: Schema,
                      physical: bool = False) -> list:
-        """Fetch a worker-hosted MV's rows over the scan RPC."""
+        """Fetch a worker-hosted MV's rows over the scan RPC — the UNION
+        over every worker holding a slice of its table (one worker for
+        whole-job placement; every root actor of a sharded-root spanning
+        job, whose slices are disjoint by vnode range)."""
         import base64
 
         from ..common.row import decode_value_row
-        # data-plane request: scanning a huge MV may exceed the control
-        # deadline without the worker being wedged — unbounded
-        resp = self._await(
-            self._mv_worker(name).request({"type": "scan", "name": name},
-                                          timeout=0))
+
+        async def _scan_all() -> list:
+            # data-plane requests: scanning a huge MV may exceed the
+            # control deadline without the worker being wedged — unbounded
+            return await asyncio.gather(*(
+                w.request({"type": "scan", "name": name}, timeout=0)
+                for w, _rng in self._mv_hosts(name)))
+
         types = [f.type for f in schema]
         out = []
-        for b in resp["rows"]:
-            phys = decode_value_row(base64.b64decode(b), types)
-            if physical:
-                out.append(phys)
-            else:
-                out.append(tuple(
-                    None if v is None else schema[i].type.to_python(v)
-                    for i, v in enumerate(phys)))
+        for resp in self._await(_scan_all()):
+            for b in resp["rows"]:
+                phys = decode_value_row(base64.b64decode(b), types)
+                if physical:
+                    out.append(phys)
+                else:
+                    out.append(tuple(
+                        None if v is None else schema[i].type.to_python(v)
+                        for i, v in enumerate(phys)))
         return out
 
     @_locked
@@ -3097,6 +3175,9 @@ class Session:
                 for name, (_, _, _, sf) in
                 self._shardfused_engines.items()
             },
+            # serving plane (frontend/serving.py): plan-cache hit/miss,
+            # two-phase task counts, partials merged, read latency p50/p99
+            "serving": self._serving.metrics(),
             # per-site retry counters from every boundary (object store,
             # broker, sink delivery) — common/retry.py global registry
             "retry": _retry_snapshot(),
@@ -3232,6 +3313,7 @@ class Session:
         session loop. A closed session cannot be reused."""
         if self.loop.is_closed():
             return
+        self._serving.shutdown()      # stop the batch-task pool first
         self._drain_inflight()
         for job in list(self.jobs.values()):
             sink = getattr(job.pipeline, "sink", None)

@@ -240,8 +240,17 @@ def shardable(frag: SpanFragment) -> bool:
     exchange hash-split: a single grouped-agg core (cut directly below by
     its group keys) under any chain of row-wise operators. Each actor
     then owns a disjoint group-key shard, exactly the in-process
-    multi-actor agg layout (frontend/fragments.py)."""
-    if len(frag.upstream) != 1 or frag.is_root:
+    multi-actor agg layout (frontend/fragments.py).
+
+    ROOT fragments with a grouped-agg core shard too: each root actor
+    materializes ITS vnode slice of the MV table into its own worker's
+    store — the table becomes vnode-distributed across workers (the
+    reference's distributed StorageTable), scans union the slices
+    (``Session._remote_scan``), and the serving plane's two-phase
+    partial agg tasks run where the vnodes live (frontend/serving.py).
+    The agg's pk IS its group keys, so the materialize pk routing and
+    the input exchange routing agree by construction."""
+    if len(frag.upstream) != 1:
         return False
     node = frag.plan
     while isinstance(node, _ROW_WISE):

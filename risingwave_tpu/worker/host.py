@@ -503,14 +503,19 @@ class WorkerHost:
             return {"ok": False, "error": f"job {name!r} has no store"}
         self._register_defs(req["defs"])
         plan = plan_from_json(req["plan"], self.catalog)
-        ex = lower_plan(plan, store)
+        # optional per-task vnode slice (the serving plane's two-phase
+        # partial tasks restrict their scans to the slice they own;
+        # slice-unsafe shapes refuse by lowering to None)
+        vnodes = req.get("vnodes")
+        ex = lower_plan(plan, store, vnodes=vnodes)
         if ex is None:
             return {"ok": False,
                     "error": "stage plan is not batch-lowerable"}
         types = [f.type for f in plan.schema]
         rows = [base64.b64encode(encode_value_row(r, types)).decode()
                 for r in run_batch(ex)]
-        return {"ok": True, "rows": rows}
+        return {"ok": True, "rows": rows, "worker": self.worker_id,
+                "n_rows": len(rows)}
 
     # -- monitor ---------------------------------------------------------------
 

@@ -56,10 +56,18 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_bench_hardening.py -m 'not slow' \
     "$@"
 
+echo "== serving-plane tests (two-phase agg + plan cache + reads) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_serving.py \
+    tests/test_batch.py \
+    "$@"
+
 echo "== bench smoke (single tiny phase, 1-dispatch invariants) =="
 # seconds, not minutes: fused q5/q8/q3 epochs + a 4-job co-scheduled
 # group run end to end on the CPU backend with the
-# one-dispatch-per-epoch invariant asserted (bench.py --smoke)
+# one-dispatch-per-epoch invariant asserted (bench.py --smoke) — plus
+# the serving-cache invariant: a repeated identical SELECT creates 0
+# new jit wrappers, and a version-bump re-execution creates 0 too
 python bench.py --smoke
 
 echo "== distribution tests (cross-worker fragment graphs) =="
@@ -83,6 +91,19 @@ if [ -n "$bad" ]; then
     exit 1
 fi
 echo "exchange-boundary lint: OK"
+
+echo "== serving-cache lint =="
+# Every batch SELECT must lower through the serving plane
+# (frontend/serving.py) so the plan cache sees it. A direct
+# lower_plan(...) call inside frontend/session.py bypasses the cache
+# layer (and its 0-recompile + two-phase guarantees) — reject it.
+bad=$(grep -n "lower_plan(" risingwave_tpu/frontend/session.py || true)
+if [ -n "$bad" ]; then
+    echo "direct lower_plan call in Session bypasses the serving cache:"
+    echo "$bad"
+    exit 1
+fi
+echo "serving-cache lint: OK"
 
 echo "== boundary-IO lint =="
 # Every durable-tier consumer must open its store via
