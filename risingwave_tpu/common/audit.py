@@ -1,0 +1,197 @@
+"""ConsistencyAuditor: machine-checked invariants after a chaos run.
+
+FoundationDB's lesson is that seeded fault injection is only half of
+deterministic simulation testing — the other half is INVARIANT CHECKING
+strong enough that a run cannot "pass" by accident. After any chaos run
+(network fault plane, crash-point sweep, sim kills) the auditor
+cross-checks the cluster against the exactly-once contract:
+
+* **exactly-once sink delivery** — every sink's delivered output equals
+  the control run's, as a multiset of (op, row): an injected duplicate
+  frame that slipped past seq-dedup, or a replayed epoch double-
+  delivered after recovery, shows up as a dupe; a dropped frame that
+  recovery failed to replay shows up as loss;
+* **MV parity** — every MV bit-equal to the control session's;
+* **per-edge barrier-epoch monotonicity** — no exchange edge ever
+  delivered a barrier at or below its previous epoch
+  (``EdgeStats.epoch_regressions == 0`` across every worker), the
+  ordering invariant the Chandy-Lamport cut rests on;
+* **storage pin/refcount leak-freedom** — on the Hummock tier, no
+  version pins outlive their readers and every SST the object store
+  holds is referenced by the current version, a pinned version, or an
+  in-flight compaction output (a leak means chaos wedged a lease open
+  or orphaned uncommitted uploads forever).
+
+Usage::
+
+    report = ConsistencyAuditor(session).audit(control=control)
+    report.assert_ok()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class AuditViolation(AssertionError):
+    pass
+
+
+@dataclasses.dataclass
+class AuditReport:
+    checks: Dict[str, dict]
+
+    @property
+    def ok(self) -> bool:
+        return all(c.get("ok", False) for c in self.checks.values())
+
+    def failed(self) -> List[str]:
+        return [n for n, c in self.checks.items() if not c.get("ok")]
+
+    def assert_ok(self) -> None:
+        if not self.ok:
+            detail = {n: self.checks[n] for n in self.failed()}
+            raise AuditViolation(
+                "consistency audit failed: "
+                + json.dumps(detail, default=str, indent=2))
+
+
+def fold_changelog(rows: List[tuple]):
+    """Fold a delivered changelog into its net row multiset: inserts
+    add, deletes remove. Exactly-once delivery into an upsert-style
+    consumer is a contract on THIS folded state — epoch boundaries
+    legitimately differ between a chaos run and its control (a recovery
+    re-batches re-applied DML), changing U-/U+ granularity without
+    changing the net effect; a duplicated or lost delivery, though,
+    unbalances the fold and is caught. Negative counts (a delete whose
+    insert was never delivered) are a violation on their own."""
+    from collections import Counter
+    net: Counter = Counter()
+    for op, row in rows:
+        if op in ("insert", "update_insert"):
+            net[row] += 1
+        else:
+            net[row] -= 1
+    return net
+
+
+def sink_delivered_rows(session, name: str) -> Optional[List[tuple]]:
+    """The rows a sink job actually DELIVERED, as (op, row-values)
+    tuples, read back from the sink backend. FileSink (jsonl) is read
+    from disk so the check covers the real external surface; sinks
+    without a readable backend return None (skipped)."""
+    sink = session.sink_of(name)
+    if sink is None:
+        return None
+    path = getattr(sink, "path", None)
+    if path is None or getattr(sink, "fmt", "jsonl") != "jsonl":
+        return None
+    if not os.path.exists(path):
+        return []
+    out: List[tuple] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            op = obj.pop("__op", "insert")
+            out.append((op, tuple(sorted(obj.items()))))
+    return out
+
+
+class ConsistencyAuditor:
+    def __init__(self, session):
+        self.session = session
+
+    # -- individual checks ----------------------------------------------------
+
+    def check_mv_parity(self, control, mv_names=None) -> dict:
+        names = mv_names or sorted(
+            set(self.session.catalog.mvs) & set(control.catalog.mvs))
+        diverged = {}
+        for name in names:
+            got = sorted(self.session.mv_rows(name))
+            want = sorted(control.mv_rows(name))
+            if got != want:
+                diverged[name] = {"chaos": got[:5], "control": want[:5],
+                                  "n_chaos": len(got),
+                                  "n_control": len(want)}
+        return {"ok": not diverged, "mvs": len(names),
+                "diverged": diverged}
+
+    def check_sink_exactly_once(self, control, sink_names=None) -> dict:
+        names = sink_names or sorted(
+            set(self.session.catalog.sinks) & set(control.catalog.sinks))
+        bad, checked = {}, 0
+        for name in names:
+            got = sink_delivered_rows(self.session, name)
+            want = sink_delivered_rows(control, name)
+            if got is None or want is None:
+                continue            # backend not readable: skip honestly
+            checked += 1
+            cg, cw = fold_changelog(got), fold_changelog(want)
+            negative = {r: n for r, n in cg.items() if n < 0}
+            if cg != cw or negative:
+                bad[name] = {
+                    "delivered": len(got), "expected": len(want),
+                    "duplicated": sum((cg - cw).values()),
+                    "lost": sum((cw - cg).values()),
+                    "negative_rows": len(negative),
+                }
+        return {"ok": not bad, "sinks_checked": checked, "violations": bad}
+
+    def check_barrier_monotonic(self) -> dict:
+        """No exchange edge may ever deliver a barrier at or below its
+        previous epoch (EdgeStats.saw_barrier counts regressions)."""
+        m = self.session.metrics()
+        bad = [e for e in m.get("exchange", ())
+               if e.get("epoch_regressions", 0) > 0]
+        return {"ok": not bad,
+                "edges": len(m.get("exchange", ()) or ()),
+                "regressions": bad}
+
+    def check_storage_pins(self) -> dict:
+        """Hummock tier: version-pin leases all released and no orphaned
+        SSTs (listed but unreachable from version/pins/in-flight tasks).
+        Non-hummock tiers pass trivially."""
+        store = self.session.store
+        mgr = getattr(store, "manager", None)
+        if mgr is None:
+            return {"ok": True, "tier": "non-hummock"}
+        self.session.wait_compaction()
+        pins = mgr.pinned_versions()
+        # torn uploads / cancelled tasks legitimately leave orphans —
+        # bounded garbage the vacuum must be able to EAT. The leak
+        # invariant is that after one GC pass, every object the store
+        # still lists is accounted for (version, pin, in-flight task,
+        # registered upload): anything else means refcounting lost track
+        mgr.vacuum()
+        from ..storage.hummock import SST_PREFIX
+        listed = set(store.object_store.list(SST_PREFIX))
+        refs = set(mgr.referenced_ssts())
+        protected = mgr._protected_prefixes()
+        unaccounted = sorted(
+            n for n in listed - refs
+            if not any(n.startswith(p) for p in protected))
+        return {"ok": not pins and not unaccounted,
+                "tier": "hummock", "pins": len(pins),
+                "unaccounted_ssts": unaccounted[:10]}
+
+    # -- the full audit -------------------------------------------------------
+
+    def audit(self, control=None, mv_names=None,
+              sink_names=None) -> AuditReport:
+        checks: Dict[str, dict] = {}
+        if control is not None:
+            self.session.flush()
+            control.flush()
+            checks["mv_parity"] = self.check_mv_parity(control, mv_names)
+            checks["sink_exactly_once"] = self.check_sink_exactly_once(
+                control, sink_names)
+        checks["barrier_monotonic"] = self.check_barrier_monotonic()
+        checks["storage_pins"] = self.check_storage_pins()
+        return AuditReport(checks)

@@ -137,6 +137,14 @@ class FaultConfig:
     # worker trips these instead of hanging the session forever
     worker_request_timeout_s: float = 120.0
     worker_epoch_timeout_s: float = 300.0
+    # idle-link keepalive on worker↔worker exchange sockets
+    # (rpc/exchange.py): a half-open peer socket — peer died without a
+    # FIN, or a severed link — is probed with exg_ping and declared
+    # broken after ``exchange_keepalive_timeout_s`` without a pong, so
+    # the pool evicts it BEFORE the next epoch's send burns a permit on
+    # a doomed frame. 0 disables probing.
+    exchange_keepalive_s: float = 10.0
+    exchange_keepalive_timeout_s: float = 5.0
     # seeded object-store fault injection (tests / sim chaos only)
     inject_object_store_transient_rate: float = 0.0
     inject_object_store_torn_write_rate: float = 0.0

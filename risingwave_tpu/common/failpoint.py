@@ -12,13 +12,51 @@ mid-checkpoint.
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _ARMED: Dict[str, Any] = {}
+
+#: Every failpoint site in the codebase, declared up front so the
+#: crash-point sweep (sim.py) can iterate ALL of them — including sites
+#: a particular workload has not executed yet. ``fail_point`` also
+#: self-registers at first execution, so a site added without updating
+#: this list still shows up after it first runs (and the boundary test
+#: asserting declared ⊇ executed keeps the list honest).
+KNOWN_SITES: "set[str]" = {
+    # segment checkpoint log (storage/checkpoint.py) — incl. both 2PC
+    # phases of the spanning-job cluster checkpoint
+    "checkpoint.manifest.write",
+    "checkpoint.manifest.rename",
+    "checkpoint.segment.write",
+    "checkpoint.segment.write.partial",
+    "checkpoint.prepare",
+    "checkpoint.commit",
+    "checkpoint.settle",
+    # hummock tier (storage/hummock.py, meta/hummock.py)
+    "hummock.sst.write",
+    "hummock.sst.write.partial",
+    "hummock.version.publish",
+    "compactor.task.start",
+    "compactor.output.write",
+    "compactor.merge.step",
+    # sink delivery (stream/sink.py)
+    "sink.deliver",
+    # meta store durable txn append (meta/store.py)
+    "meta.store.txn",
+}
+
+
+def register_site(*names: str) -> None:
+    KNOWN_SITES.update(names)
+
+
+def registered_sites() -> List[str]:
+    return sorted(KNOWN_SITES)
 
 
 def fail_point(name: str) -> None:
     """Call at an IO site; raises/executes whatever the test armed."""
+    KNOWN_SITES.add(name)
     action = _ARMED.get(name)
     if action is None:
         return
@@ -41,6 +79,47 @@ def disarm(name: Optional[str] = None) -> None:
         _ARMED.clear()
     else:
         _ARMED.pop(name, None)
+
+
+def arm_from_env(worker_id: Optional[int] = None) -> int:
+    """Subprocess bring-up (worker/compactor): arm sites from the
+    ``RWTPU_FAILPOINTS`` env JSON — ``{"site": {"action": "exit",
+    "once_marker": "/path", "worker": 1}}``. Action "exit" is a REAL
+    process death (``os._exit``) at the site, the crash-point sweep's
+    way of killing a worker at an exact instruction; the marker file
+    makes it fire once across respawns (the respawned worker inherits
+    the env, sees the marker, and lives), and "worker" scopes the kill
+    to ONE deterministic victim (a broadcast frame like phase-2 commit
+    reaches every worker — without the scope the death count races).
+    Returns the number of sites armed."""
+    import json
+    import os
+    spec = os.environ.get("RWTPU_FAILPOINTS")
+    if not spec:
+        return 0
+    n = 0
+    for site, cfg in json.loads(spec).items():
+        if cfg.get("worker") is not None and worker_id is not None \
+                and int(cfg["worker"]) != int(worker_id):
+            continue
+        action = cfg.get("action", "exit")
+        if action == "exit":
+            marker = cfg.get("once_marker")
+
+            def _die(marker=marker, site=site):
+                if marker:
+                    if os.path.exists(marker):
+                        return
+                    with open(marker, "w") as f:
+                        f.write(site)
+                os._exit(31)
+
+            arm(site, _die)
+            n += 1
+        elif action == "raise":
+            arm(site, OSError(site), once=bool(cfg.get("once")))
+            n += 1
+    return n
 
 
 @contextlib.contextmanager

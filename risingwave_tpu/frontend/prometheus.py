@@ -127,6 +127,30 @@ def render_metrics(session) -> str:
         for wid, n in (serving.get("task_workers") or {}).items():
             lines.append(
                 f'rw_serving_task_total{{worker="{_sanitize(wid)}"}} {n}')
+    chaos = m.get("chaos") or {}
+    if chaos:
+        lines += ["# HELP rw_chaos_injection_total Network fault plane "
+                  "injections by kind (rpc/faults.py), session process "
+                  "plus every worker's plane.",
+                  "# TYPE rw_chaos_injection_total counter"]
+        merged: dict = dict(chaos.get("injections") or {})
+        for _wid, wc in (chaos.get("workers") or {}).items():
+            for kind, n in (wc.get("injections") or {}).items():
+                merged[kind] = merged.get(kind, 0) + n
+        for kind, n in sorted(merged.items()):
+            lines.append(
+                f'rw_chaos_injection_total{{kind="{_sanitize(kind)}"}} '
+                f'{n}')
+        lines += ["# HELP rw_chaos_stat Fault-plane hardening counters "
+                  "(fencing generation, stale acks dropped, duplicate "
+                  "replies/acks deduped).",
+                  "# TYPE rw_chaos_stat gauge"]
+        for stat in ("generation", "stale_acks_dropped",
+                     "dup_replies_dropped", "dup_acks_dropped"):
+            value = chaos.get(stat)
+            if isinstance(value, (int, float)):
+                lines.append(
+                    f'rw_chaos_stat{{stat="{stat}"}} {value}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

@@ -58,13 +58,27 @@ class RemoteWorker:
         self.epoch_timeout = self.EPOCH_TIMEOUT_S
         self.dead = False
         self.proc: Optional[subprocess.Popen] = None
+        #: fault-plane link name of the session→worker direction
+        self.link = f"s->w{worker_id}"
+        #: session-generation fencing token (ISSUE 9): stamped on every
+        #: frame this handle sends; the Session bumps it on every scoped
+        #: recovery so a stale pre-recovery worker's barrier acks are
+        #: dropped here and its commits are refused worker-side
+        self.generation = 1
+        self.stale_acks_dropped = 0
+        self.dup_replies_dropped = 0
+        self.dup_acks_dropped = 0
         self._rid = itertools.count(1)
         self._chan = itertools.count(worker_id * 100_000 + 1)
         self._pending: dict[int, asyncio.Future] = {}
+        self._done_rids: "set[int]" = set()
         self._epoch_events: dict[int, asyncio.Event] = {}
         self._epoch_errors: dict[int, str] = {}
         self._init_fut: Optional[asyncio.Future] = None
         self._sems: dict[int, asyncio.Semaphore] = {}
+        self._data_seqs: dict[int, int] = {}
+        from ..rpc.exchange import AckWatermark
+        self._acks: dict[int, AckWatermark] = {}
         self._forwarders: dict[str, list[asyncio.Task]] = {}
         self._wlock: Optional[asyncio.Lock] = None
         self._reader_task: Optional[asyncio.Task] = None
@@ -149,6 +163,8 @@ class RemoteWorker:
         self._epoch_events.clear()
         self._epoch_errors.clear()
         self._sems.clear()
+        self._data_seqs.clear()
+        self._acks.clear()
         # sibling jobs' forwarders feed a process that no longer exists;
         # cancel (not just forget) so they cannot leak across recoveries
         for tasks in self._forwarders.values():
@@ -184,14 +200,41 @@ class RemoteWorker:
                 return
             t = frame.get("type")
             if t == "reply":
-                fut = self._pending.pop(frame.get("rid"), None)
+                rid = frame.get("rid")
+                fut = self._pending.pop(rid, None)
                 if fut is not None and not fut.done():
                     fut.set_result(frame)
+                    if rid is not None:
+                        self._done_rids.add(rid)
+                        if len(self._done_rids) > 4096:
+                            self._done_rids = set(
+                                sorted(self._done_rids)[-2048:])
+                elif rid in self._done_rids:
+                    # at-least-once reply delivery (duplicated frame on a
+                    # faulty link) stays exactly-once at the caller: the
+                    # first copy resolved the future, later copies drop
+                    self.dup_replies_dropped += 1
             elif t == "ack":
-                sem = self._sems.get(frame["chan"])
+                chan = frame["chan"]
+                wm = self._acks.get(chan)
+                if wm is not None and not wm.accept(frame.get("seq")):
+                    # duplicated data ack: releasing a permit for it
+                    # would inflate the channel's credit (reordered
+                    # acks are accepted exactly once by the watermark)
+                    self.dup_acks_dropped += 1
+                    continue
+                sem = self._sems.get(chan)
                 if sem is not None:
                     sem.release()
             elif t == "barrier_complete":
+                gen = frame.get("gen")
+                if gen is not None and int(gen) != self.generation:
+                    # fencing: a barrier ack carrying a stale generation
+                    # (pre-recovery incarnation, or a chaos-delayed
+                    # frame) must not count toward the CURRENT graph's
+                    # epoch collection
+                    self.stale_acks_dropped += 1
+                    continue
                 # per-JOB failure map: one poisoned or peer-starved job
                 # must not read as a whole-worker failure (legacy
                 # ok/error frames fold into the wildcard entry)
@@ -221,17 +264,24 @@ class RemoteWorker:
         for sem in self._sems.values():
             sem.release()          # unblock forwarders; send() will raise
 
-    async def send(self, obj: dict) -> None:
+    async def send(self, obj: dict, meta: bool = False) -> None:
         if self.dead or self._writer is None:
             raise WorkerDied("worker is down")
+        if "gen" not in obj:
+            # fencing token on every session→worker frame: the worker
+            # records it at job creation and refuses barrier/commit
+            # frames older than a job's deployment generation
+            obj = {**obj, "gen": self.generation}
         try:
-            await write_frame(self._writer, obj, self._wlock)
+            await write_frame(self._writer, obj, self._wlock,
+                              link=self.link, meta=meta)
         except (ConnectionError, BrokenPipeError, OSError):
             self._mark_dead()
             raise WorkerDied("worker connection lost") from None
 
     async def request(self, obj: dict,
-                      timeout: Optional[float] = None) -> dict:
+                      timeout: Optional[float] = None,
+                      meta: bool = False) -> dict:
         """Request/reply with a DEFAULT deadline (``request_timeout``; a
         worker wedged before replying is declared dead instead of hanging
         the caller forever). Pass ``timeout=0`` to wait unbounded."""
@@ -241,7 +291,7 @@ class RemoteWorker:
         self._pending[rid] = fut
         t = self.request_timeout if timeout is None else timeout
         try:
-            await self.send(obj)
+            await self.send(obj, meta=meta)
             if t and t > 0:
                 try:
                     resp = await asyncio.wait_for(fut, t)
@@ -269,8 +319,11 @@ class RemoteWorker:
     # -- data channels ---------------------------------------------------------
 
     def alloc_chan(self) -> int:
+        from ..rpc.exchange import AckWatermark
         chan = next(self._chan)
         self._sems[chan] = asyncio.Semaphore(self.permits)
+        self._data_seqs[chan] = 0
+        self._acks[chan] = AckWatermark()
         return chan
 
     async def send_data(self, chan: int, msg: Message, schema) -> None:
@@ -281,7 +334,9 @@ class RemoteWorker:
                 await sem.acquire()
             if self.dead:
                 raise WorkerDied("worker is down")
-        await self.send({"type": "data", "chan": chan,
+        seq = self._data_seqs.get(chan, 0)
+        self._data_seqs[chan] = seq + 1
+        await self.send({"type": "data", "chan": chan, "seq": seq,
                          "msg": message_to_wire(msg, schema)})
 
     def start_forwarder(self, job: str, q: QueueSource, chan: int,
@@ -418,7 +473,8 @@ class RemoteWorker:
         req: dict = {"type": "stats"}
         if span_ack is not None:
             req["span_ack"] = span_ack
-        return await asyncio.wait_for(self.request(req), timeout)
+        return await asyncio.wait_for(self.request(req, meta=True),
+                                      timeout)
 
     async def shutdown(self) -> None:
         try:

@@ -325,6 +325,16 @@ class Session:
             data_dir=_os.path.join(data_dir, "meta")
             if data_dir is not None else None)
         self.catalog_writer = MetaBackedCatalog(self.catalog, self.meta)
+        # session-generation fencing token (ISSUE 9): monotone across
+        # session restarts (persisted in the meta store) and bumped on
+        # every scoped recovery. Stamped on every session→worker frame;
+        # a stale pre-recovery worker can neither ack barriers (the
+        # session drops acks from older generations) nor commit
+        # checkpoints (the worker refuses commit frames older than a
+        # job's deployment generation).
+        self._generation = int(
+            self.meta.store.get("session_generation") or "0") + 1
+        self.meta.store.put("session_generation", str(self._generation))
         self._jobs_to_recover: list[str] = []
         self._dead_jobs: set[str] = set()
         self.meta.on_job_failure(self._jobs_to_recover.append)
@@ -418,6 +428,7 @@ class Session:
                 # session forever
                 w.request_timeout = self.fault.worker_request_timeout_s
                 w.epoch_timeout = self.fault.worker_epoch_timeout_s
+                w.generation = self._generation
                 w.spawn()
                 self._await(w.connect())
                 self.workers.append(w)
@@ -1418,6 +1429,7 @@ class Session:
         source-fed), re-wire exchange edges (reference: recovery.rs:110
         rebuilding actors on a replacement worker)."""
         self._drain_inflight()
+        self._bump_generation()
         spec = self._remote_specs[name]
         worker = spec["worker"]
         job = self.jobs.pop(name, None)
@@ -1703,6 +1715,10 @@ class Session:
         keep running untouched)."""
         from .remote import SpanningJob, WorkerDied
         self._drain_inflight()
+        # fence the dead incarnation FIRST: frames the rebuilt graph
+        # sends carry the new generation, and anything still in flight
+        # from the old one (delayed acks, stale commits) is refused
+        self._bump_generation()
         spec = self._spanning_specs[name]
         job = self.jobs.pop(name, None)
         if job is not None:
@@ -2610,7 +2626,19 @@ class Session:
                 for n in pending:
                     if n in covered or n in recovered:
                         continue
-                    recovered.update(self._recover_job(n))
+                    from .remote import WorkerDied
+                    try:
+                        recovered.update(self._recover_job(n))
+                    except WorkerDied:
+                        # the fabric is STILL faulty (an ongoing
+                        # partition ate the rebuilt graph's init cut, or
+                        # the respawned worker died again): a recovery
+                        # attempt must not crash the session — requeue
+                        # and retry on a later tick, when the fault
+                        # window may have passed
+                        if n in self.jobs:
+                            self._dead_jobs.add(n)
+                        self._jobs_to_recover.append(n)
         return self.epoch
 
     def _complete_oldest(self) -> None:
@@ -3189,7 +3217,24 @@ class Session:
                 if hasattr(job.pipeline, "sink_health")
             },
         }
+        # network fault plane (rpc/faults.py): the session process's
+        # installed schedule + injection counters, the fencing/dedup
+        # counters injection forced, and every worker's plane snapshot
+        from ..rpc.faults import chaos_snapshot
+        out["chaos"] = {
+            **chaos_snapshot(),
+            "generation": self._generation,
+            "stale_acks_dropped": sum(
+                getattr(w, "stale_acks_dropped", 0) for w in self.workers),
+            "dup_replies_dropped": sum(
+                getattr(w, "dup_replies_dropped", 0) for w in self.workers),
+            "dup_acks_dropped": sum(
+                getattr(w, "dup_acks_dropped", 0) for w in self.workers),
+        }
         worker_stats = self._federate_worker_stats()
+        out["chaos"]["workers"] = {
+            wid: st["chaos"] for wid, st in sorted(worker_stats.items())
+            if st.get("chaos")}
         exchange: list = []
         for wid, st in sorted(worker_stats.items()):
             # live local jobs win over cached worker snapshots of the
@@ -3369,6 +3414,17 @@ class Session:
         self.loop.run_until_complete(_drain_finalizers())
         self.loop.run_until_complete(self.loop.shutdown_asyncgens())
         self.loop.close()
+
+    def _bump_generation(self) -> None:
+        """Advance the session-generation fencing token (persisted in
+        the meta store, propagated to every worker handle). Called at
+        the top of every scoped recovery, after in-flight epochs
+        drained: from here on, frames from the pre-recovery incarnation
+        are stale and are refused on both sides of the wire."""
+        self._generation += 1
+        self.meta.store.put("session_generation", str(self._generation))
+        for w in self.workers:
+            w.generation = self._generation
 
     def _alloc_shard(self) -> int:
         self._next_shard += 1

@@ -118,6 +118,14 @@ class FileMetaStore(MetaStore):
     def _persist(self, ops) -> None:
         if not ops:
             return
+        # injectable meta-store IO: the failpoint registry's exact-site
+        # faults and the network fault plane's "meta" link both land
+        # here, BEFORE the append — a failed txn leaves memory and disk
+        # agreeing (the all-or-nothing contract the caller relies on)
+        from ..common.failpoint import fail_point
+        from ..rpc.faults import meta_io
+        fail_point("meta.store.txn")
+        meta_io("txn", ops[0][1] if ops else "")
         self._f.write(json.dumps(list(ops)) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())

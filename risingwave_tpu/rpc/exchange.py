@@ -8,8 +8,10 @@ process's ONE listening socket serves both the session's control
 connection and any number of PEER connections; a peer connection opens
 with an ``exg_hello`` frame and then carries only exchange frames:
 
-    producer → consumer   {"type": "exg_data", "chan": C, "msg": <wire>}
-    consumer → producer   {"type": "exg_ack",  "chan": C}
+    producer → consumer   {"type": "exg_data", "chan": C, "seq": N,
+                           "msg": <wire>}
+    consumer → producer   {"type": "exg_ack",  "chan": C, "seq": K}
+    either → either       {"type": "exg_ping"/"exg_pong", "seq": J}
 
 Credit flow mirrors ``PermitChannel`` end-to-end across the process
 boundary: StreamChunk frames consume a permit on the PRODUCER before the
@@ -19,25 +21,99 @@ barriers and watermarks always pass so the control stream can never
 deadlock behind data — the invariant the two-phase checkpoint depends
 on. One client connection per (host, port) pair multiplexes every edge
 between the two processes, like the reference's pooled compute clients.
+
+Hardening (ISSUE 9, the network fault plane forced all three):
+
+* every frame on an edge carries a per-channel SEQUENCE NUMBER; the
+  consuming ``ExchangeInput`` dedups duplicates (delivered at-least-once
+  by a faulty network becomes exactly-once at the executor) and
+  re-orders delayed frames back into send order, and the producer dedups
+  duplicated acks so credit accounting cannot inflate;
+* an idle-link KEEPALIVE (exg_ping/exg_pong) detects a half-open peer
+  socket — a peer that died without a FIN, or a severed link — and marks
+  the client broken BEFORE the next epoch's send would burn a permit on
+  a doomed frame; ``PeerClientPool`` evicts broken clients on lookup;
+* every send routes through the fault plane's per-link transport
+  (rpc/faults.py), so a seeded ChaosSchedule can partition, delay, drop
+  or duplicate exchange traffic deterministically.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
-import struct
+import time
 from typing import Dict, Optional, Tuple
 
-from .wire import MAX_FRAME, read_frame
-
-_LEN = struct.Struct("<I")
+from .wire import read_frame
 
 
 class PeerLost(ConnectionError):
     """The remote end of an exchange edge is gone (process death, socket
-    reset). Distinguished from executor logic errors so barrier
-    collection can classify it as a KILL — the heartbeat-TTL scoped
-    recovery path — rather than a poisoned job."""
+    reset, keepalive timeout). Distinguished from executor logic errors
+    so barrier collection can classify it as a KILL — the heartbeat-TTL
+    scoped recovery path — rather than a poisoned job."""
+
+
+class AckWatermark:
+    """Exactly-once accounting for seq-carrying credit acks, shared by
+    the producer-side ack loops (ExchangePeerClient here, RemoteWorker
+    in frontend/remote.py). Each DISTINCT ack seq releases exactly one
+    permit: duplicates are refused (credit must not inflate) and
+    REORDERED acks — a chaos-delayed sibling overtaking — are accepted
+    exactly once via a small out-of-order set compacted into the
+    watermark (a plain ``seq < expected`` check misreads a late genuine
+    ack as a duplicate and leaks its permit forever)."""
+
+    __slots__ = ("next", "_seen")
+
+    def __init__(self) -> None:
+        self.next = 0
+        self._seen: set = set()
+
+    def accept(self, seq: Optional[int]) -> bool:
+        """True iff this ack is a first delivery (release a permit)."""
+        if seq is None:
+            return True                  # legacy peer: no seq discipline
+        if seq < self.next or seq in self._seen:
+            return False
+        self._seen.add(seq)
+        while self.next in self._seen:
+            self._seen.discard(self.next)
+            self.next += 1
+        return True
+
+
+class SeqReorderBuffer:
+    """Consumer-side dedup + re-sequencing for seq-carrying frames,
+    shared by the exchange input (stream/remote_exchange.py) and the
+    worker's session data channels (worker/host.py). ``feed`` returns
+    the frames now deliverable IN SEND ORDER (possibly none: held for a
+    gap; possibly several: a gap just closed); duplicates are dropped."""
+
+    __slots__ = ("next_seq", "_held", "dup_frames", "reordered")
+
+    def __init__(self) -> None:
+        self.next_seq = 0
+        self._held: Dict[int, object] = {}
+        self.dup_frames = 0
+        self.reordered = 0
+
+    def feed(self, seq: Optional[int], payload) -> list:
+        if seq is None:                  # legacy peer: pass through
+            return [payload]
+        if seq < self.next_seq or seq in self._held:
+            self.dup_frames += 1
+            return []
+        if seq > self.next_seq:
+            self.reordered += 1
+            self._held[seq] = payload
+            return []
+        out = [payload]
+        self.next_seq += 1
+        while self.next_seq in self._held:
+            out.append(self._held.pop(self.next_seq))
+            self.next_seq += 1
+        return out
 
 
 class EdgeStats:
@@ -46,7 +122,8 @@ class EdgeStats:
     Prometheus (``rw_exchange_stat``), and the dashboard."""
 
     __slots__ = ("edge", "direction", "peer_worker", "chunks", "bytes",
-                 "permits_waited", "barriers")
+                 "permits_waited", "barriers", "dup_frames", "reordered",
+                 "last_barrier_epoch", "epoch_regressions")
 
     def __init__(self, edge: str, direction: str, peer_worker: int):
         self.edge = edge              # "job:f<u>a<i>->f<d>a<j>"
@@ -56,36 +133,75 @@ class EdgeStats:
         self.bytes = 0
         self.permits_waited = 0
         self.barriers = 0
+        # duplicate frames dropped by seq-dedup / frames that arrived
+        # out of order and were re-sequenced (network fault plane)
+        self.dup_frames = 0
+        self.reordered = 0
+        # per-edge barrier-epoch monotonicity (the ConsistencyAuditor
+        # asserts epoch_regressions == 0 after every chaos run)
+        self.last_barrier_epoch = 0
+        self.epoch_regressions = 0
+
+    def saw_barrier(self, epoch: int) -> None:
+        self.barriers += 1
+        if epoch <= self.last_barrier_epoch:
+            self.epoch_regressions += 1
+        else:
+            self.last_barrier_epoch = epoch
 
     def snapshot(self, backlog: int = 0) -> dict:
         return {"edge": self.edge, "dir": self.direction,
                 "peer_worker": self.peer_worker, "chunks": self.chunks,
                 "bytes": self.bytes, "permits_waited": self.permits_waited,
-                "barriers": self.barriers, "backlog": backlog}
+                "barriers": self.barriers, "backlog": backlog,
+                "dup_frames": self.dup_frames, "reordered": self.reordered,
+                "last_barrier_epoch": self.last_barrier_epoch,
+                "epoch_regressions": self.epoch_regressions}
 
 
 class ExchangePeerClient:
     """Producer-side connection to ONE peer worker's exchange server.
-    Owns the socket, the per-channel permit semaphores, and the ack read
-    loop. All edges from this process to that peer share the connection
-    (per-channel credit keeps them independent)."""
+    Owns the socket, the per-channel permit semaphores, the keepalive
+    prober, and the ack read loop. All edges from this process to that
+    peer share the connection (per-channel credit keeps them
+    independent)."""
 
-    def __init__(self, host: str, port: int, from_worker: int):
+    def __init__(self, host: str, port: int, from_worker: int,
+                 peer_worker: Optional[int] = None,
+                 keepalive_s: float = 10.0,
+                 keepalive_timeout_s: float = 5.0):
         self.host = host
         self.port = port
         self.from_worker = from_worker
+        self.peer_worker = peer_worker
+        # fault-plane link name for every frame this client sends
+        self.link = (f"w{from_worker}->w{peer_worker}"
+                     if peer_worker is not None
+                     else f"w{from_worker}->{host}:{port}")
+        self.keepalive_s = keepalive_s
+        self.keepalive_timeout_s = keepalive_timeout_s
         self.broken = False
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock = asyncio.Lock()
         self._sems: Dict[int, asyncio.Semaphore] = {}
+        self._seqs: Dict[int, int] = {}       # chan -> next data seq
+        self._acks: Dict[int, AckWatermark] = {}
+        self.dup_acks = 0
         self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._pong = asyncio.Event()
+        self._last_rx = time.monotonic()
         self._connect_lock = asyncio.Lock()
 
     def register(self, chan: int, permits: int) -> None:
         self._sems[chan] = asyncio.Semaphore(permits)
+        self._seqs[chan] = 0
+        self._acks[chan] = AckWatermark()
 
     def unregister(self, chan: int) -> None:
         self._sems.pop(chan, None)
+        self._seqs.pop(chan, None)
+        self._acks.pop(chan, None)
 
     async def _ensure_connected(self) -> None:
         async with self._connect_lock:
@@ -102,15 +218,17 @@ class ExchangePeerClient:
             writer.write(self._pack({"type": "exg_hello",
                                      "worker": self.from_worker}))
             await writer.drain()
+            self._last_rx = time.monotonic()
             self._reader_task = asyncio.ensure_future(
                 self._ack_loop(reader))
+            if self.keepalive_s and self.keepalive_s > 0:
+                self._keepalive_task = asyncio.ensure_future(
+                    self._keepalive_loop())
 
     @staticmethod
     def _pack(obj: dict) -> bytes:
-        body = json.dumps(obj).encode()
-        if len(body) > MAX_FRAME:
-            raise ValueError(f"oversized exchange frame: {len(body)} bytes")
-        return _LEN.pack(len(body)) + body
+        from .wire import pack_frame
+        return pack_frame(obj)
 
     async def _ack_loop(self, reader) -> None:
         while True:
@@ -118,22 +236,97 @@ class ExchangePeerClient:
             if frame is None:
                 self._mark_broken()
                 return
-            if frame.get("type") == "exg_ack":
-                sem = self._sems.get(frame["chan"])
+            self._last_rx = time.monotonic()
+            t = frame.get("type")
+            if t == "exg_ack":
+                chan = frame["chan"]
+                wm = self._acks.get(chan)
+                if wm is not None and not wm.accept(frame.get("seq")):
+                    # duplicated ack (network fault): releasing a
+                    # permit for it would inflate the edge's credit
+                    self.dup_acks += 1
+                    continue
+                sem = self._sems.get(chan)
                 if sem is not None:
                     sem.release()
+            elif t == "exg_pong":
+                self._pong.set()
+
+    async def _keepalive_loop(self) -> None:
+        """Idle-link prober: a peer socket that died without a FIN (or a
+        severed link) otherwise looks healthy until the next send wedges
+        permit accounting. A ping answered by nothing within the timeout
+        marks this client broken — senders fail fast with PeerLost and
+        the pool evicts the client on next lookup."""
+        interval = self.keepalive_s
+        missed = 0
+        while not self.broken:
+            await asyncio.sleep(interval)
+            if self.broken or self._writer is None:
+                return
+            if time.monotonic() - self._last_rx < interval:
+                missed = 0
+                continue              # link demonstrably alive
+            self._pong.clear()
+            try:
+                await self._raw_send({"type": "exg_ping", "seq": 0},
+                                     meta=True)
+            except (PeerLost, ConnectionError, OSError):
+                self._mark_broken()
+                return
+            try:
+                await asyncio.wait_for(self._pong.wait(),
+                                       self.keepalive_timeout_s)
+                missed = 0
+            except asyncio.TimeoutError:
+                # TWO consecutive missed pongs before declaring the link
+                # dead: a peer whose event loop is pinned by a long
+                # compute-bound epoch legitimately answers late, and a
+                # single-miss policy false-kills healthy graphs under
+                # load (found by the netsplit harness)
+                missed += 1
+                if missed >= 2:
+                    self._mark_broken()
+                    return
 
     def _mark_broken(self) -> None:
         self.broken = True
         for sem in self._sems.values():
             sem.release()        # unblock senders; send() raises PeerLost
 
+    async def _raw_send(self, obj: dict, meta: bool = False) -> int:
+        """Pack + write one frame through the fault plane. Raises on a
+        dead socket; returns bytes written (0 when the plane ate it)."""
+        if self.broken or self._writer is None:
+            raise PeerLost(
+                f"exchange peer {self.host}:{self.port} is down")
+        buf = self._pack(obj)
+
+        async def emit(b: bytes) -> None:
+            async with self._wlock:
+                self._writer.write(b)
+                await self._writer.drain()
+
+        from .faults import FaultyTransport, plane
+        try:
+            if plane().installed:
+                sent = await FaultyTransport(self.link).send(
+                    obj, buf, emit, meta=meta)
+                return len(buf) if sent else 0
+            await emit(buf)
+        except (ConnectionError, OSError) as e:
+            self._mark_broken()
+            raise PeerLost(
+                f"exchange peer {self.host}:{self.port}: {e}") from None
+        return len(buf)
+
     async def send(self, chan: int, wire_msg: dict, is_data: bool,
                    stats: Optional[EdgeStats] = None) -> int:
         """Ship one message on an edge; returns bytes written. Data
         consumes a permit (blocking the SENDING actor when the consumer's
         credit is exhausted — end-to-end backpressure); control frames
-        always pass."""
+        always pass. Every frame carries the channel's next sequence
+        number so the consumer can dedup and re-order faulty delivery."""
         await self._ensure_connected()
         if is_data:
             sem = self._sems.get(chan)
@@ -144,26 +337,21 @@ class ExchangePeerClient:
         if self.broken or self._writer is None:
             raise PeerLost(
                 f"exchange peer {self.host}:{self.port} is down")
-        buf = self._pack({"type": "exg_data", "chan": chan,
-                          "msg": wire_msg})
-        try:
-            async with self._wlock:
-                self._writer.write(buf)
-                await self._writer.drain()
-        except (ConnectionError, OSError) as e:
-            self._mark_broken()
-            raise PeerLost(
-                f"exchange peer {self.host}:{self.port}: {e}") from None
-        return len(buf)
+        seq = self._seqs.get(chan, 0)
+        self._seqs[chan] = seq + 1
+        return await self._raw_send({"type": "exg_data", "chan": chan,
+                                     "seq": seq, "msg": wire_msg})
 
     async def aclose(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-            try:
-                await self._reader_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._reader_task = None
+        for t in (self._reader_task, self._keepalive_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        self._reader_task = None
+        self._keepalive_task = None
         if self._writer is not None:
             self._writer.close()
             try:
@@ -176,19 +364,39 @@ class ExchangePeerClient:
 class PeerClientPool:
     """One ``ExchangePeerClient`` per (host, port) target, shared by every
     edge this process produces toward that peer (reference: the pooled
-    compute clients of rpc_client/src/lib.rs). A broken client is
-    replaced on next lookup so recovery's re-created edges (same worker,
-    NEW port after respawn) never reuse a dead socket."""
+    compute clients of rpc_client/src/lib.rs). A broken client — socket
+    error, process death, or the keepalive prober declaring a half-open
+    link dead — is EVICTED and replaced on next lookup, so recovery's
+    re-created edges (same worker, NEW port after respawn) never reuse a
+    dead socket and never burn a permit on a doomed frame."""
 
-    def __init__(self, from_worker: int):
+    def __init__(self, from_worker: int, keepalive_s: float = 10.0,
+                 keepalive_timeout_s: float = 5.0):
         self.from_worker = from_worker
+        self.keepalive_s = keepalive_s
+        self.keepalive_timeout_s = keepalive_timeout_s
+        self.evictions = 0
         self._clients: Dict[Tuple[str, int], ExchangePeerClient] = {}
 
-    def get(self, host: str, port: int) -> ExchangePeerClient:
+    def get(self, host: str, port: int,
+            peer_worker: Optional[int] = None) -> ExchangePeerClient:
         key = (host, port)
         client = self._clients.get(key)
         if client is None or client.broken:
-            client = ExchangePeerClient(host, port, self.from_worker)
+            if client is not None:
+                # eviction must also TEAR DOWN the broken client: its
+                # reader task blocks in read_frame on a half-open socket
+                # that may never deliver EOF, and once replaced in the
+                # dict, pool.aclose() can no longer reach it
+                self.evictions += 1
+                try:
+                    asyncio.ensure_future(client.aclose())
+                except RuntimeError:     # no running loop (sync caller)
+                    pass
+            client = ExchangePeerClient(
+                host, port, self.from_worker, peer_worker=peer_worker,
+                keepalive_s=self.keepalive_s,
+                keepalive_timeout_s=self.keepalive_timeout_s)
             self._clients[key] = client
         return client
 

@@ -1,0 +1,476 @@
+"""Deterministic network fault plane under the RPC fabric.
+
+FoundationDB-style deterministic simulation for the cluster's internal
+links (reference posture: seeded, replayable fault schedules plus
+machine-checked invariants find the distributed bugs random chaos
+misses). Every frame the session↔worker control sockets, the
+worker↔worker exchange sockets, and the compactor control socket carry
+routes through a per-link ``FaultyTransport`` obtained from the
+process-global plane; an installed ``ChaosSchedule`` then decides — as a
+PURE function of (seed, link, per-link frame seq, frame type, per-link
+epoch) — whether to drop, duplicate, reorder, delay, or partition each
+frame. Replaying the same seed over the same workload reproduces the
+identical per-link injection trace, so a failing run is a repro, not an
+anecdote.
+
+Link naming (one string per directed edge):
+
+    s->w0     session control frames toward worker 0
+    w0->s     worker 0's replies / barrier acks / data acks
+    w0->w1    worker 0's exchange frames toward worker 1 (exg_data/ack)
+    s->c0     compactor control (sync frames), and c0->s its replies
+    meta      the meta store's durable txn appends (in-process IO)
+
+Rule matching supports ``fnmatch`` patterns and the shorthand
+``"w0<->w1"`` (both directions). ``ChaosSchedule`` is JSON-serializable;
+worker subprocesses inherit it through the ``RWTPU_CHAOS`` env var and
+persist their injection traces to ``<data_dir>/chaos_trace.jsonl`` so a
+killed worker's pre-death injections survive for replay comparison.
+
+Determinism contract: wall-clock-driven frames (keepalive pings/pongs,
+stats polls and their replies) pass through the plane WITHOUT consuming
+a link seq and WITHOUT entering the trace — they are still subject to
+partition/sever windows (that is how the keepalive detects a severed
+link) but can never perturb the decision stream of real frames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: frame types that are wall-clock-driven and therefore excluded from
+#: per-link seq accounting and the injection trace (see module docstring)
+META_FRAME_TYPES = frozenset({"exg_ping", "exg_pong", "stats"})
+
+FAULT_KINDS = ("partition", "sever", "drop", "delay", "duplicate",
+               "meta_fault")
+
+
+def _hash01(seed: int, link: str, seq: int, salt: int = 0) -> float:
+    """Deterministic uniform draw in [0, 1): stable across processes,
+    platforms, and PYTHONHASHSEED (uses sha256, not hash())."""
+    h = hashlib.sha256(
+        f"{seed}|{link}|{seq}|{salt}".encode()).digest()
+    return int.from_bytes(h[:8], "little") / float(1 << 64)
+
+
+@dataclasses.dataclass
+class ChaosRule:
+    """One per-link fault rule.
+
+    kind      partition | sever | drop | delay | duplicate | meta_fault
+    link      fnmatch pattern over link names; "a<->b" matches both
+              directions
+    types     optional frame-type filter; exchange frames also expose
+              their inner message as "exg_data:chunk" / "exg_data:barrier"
+    frames    optional [lo, hi) window over the link's frame seq
+    epochs    optional [lo, hi) window over the link's last-seen epoch
+              (updated from barrier/commit frames ON that link — a
+              per-link quantity, so the window is deterministic)
+    prob      per-frame probability (seeded hash draw; 1.0 = always)
+    count     max times this rule may fire (None = unlimited)
+    delay_frames  (kind=delay) hold the frame until N later frames have
+              been sent on the link — deterministic reordering
+    delay_ms  (kind=delay) wall-clock delay before the write
+    """
+
+    kind: str
+    link: str = "*"
+    types: Optional[List[str]] = None
+    frames: Optional[List[int]] = None
+    epochs: Optional[List[int]] = None
+    prob: float = 1.0
+    count: Optional[int] = None
+    delay_frames: int = 0
+    delay_ms: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+    def matches_link(self, link: str) -> bool:
+        import fnmatch
+        pat = self.link
+        if "<->" in pat:
+            a, b = pat.split("<->", 1)
+            return (fnmatch.fnmatch(link, f"{a}->{b}")
+                    or fnmatch.fnmatch(link, f"{b}->{a}"))
+        return fnmatch.fnmatch(link, pat)
+
+    def matches(self, link: str, seq: int, ftype: str, subtype: str,
+                epoch: int, seed: int, rule_idx: int) -> bool:
+        if not self.matches_link(link):
+            return False
+        if self.types is not None and ftype not in self.types \
+                and subtype not in self.types:
+            return False
+        if self.frames is not None and not (
+                self.frames[0] <= seq < self.frames[1]):
+            return False
+        if self.epochs is not None and not (
+                self.epochs[0] <= epoch < self.epochs[1]):
+            return False
+        if self.prob < 1.0 and _hash01(seed, link, seq,
+                                       salt=rule_idx) >= self.prob:
+            return False
+        return True
+
+
+class ChaosSchedule:
+    """A seeded, JSON-serializable set of per-link fault rules. The
+    schedule itself is immutable; mutable per-link state (seq counters,
+    hold queues, fire counts) lives in the installing plane so the same
+    schedule object can be round-tripped and re-installed for replay."""
+
+    def __init__(self, seed: int, rules: List[ChaosRule],
+                 name: str = ""):
+        self.seed = int(seed)
+        self.rules = list(rules)
+        self.name = name
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed, "name": self.name,
+            "rules": [dataclasses.asdict(r) for r in self.rules],
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ChaosSchedule":
+        d = json.loads(s)
+        return cls(d["seed"], [ChaosRule(**r) for r in d["rules"]],
+                   name=d.get("name", ""))
+
+
+class _LinkState:
+    __slots__ = ("seq", "epoch", "held", "frames_seen")
+
+    def __init__(self) -> None:
+        self.seq = 0
+        self.epoch = 0
+        # (release_at_seq, payload_bytes) queue of reorder-delayed frames
+        self.held: List[Tuple[int, bytes]] = []
+        self.frames_seen = 0
+
+
+class ChaosPlane:
+    """Process-global registry: the installed schedule + per-link state
+    + counters + the injection trace. ``metrics()["chaos"]`` surfaces
+    ``snapshot()``; worker processes ship theirs in stats frames."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.schedule: Optional[ChaosSchedule] = None
+        self._links: Dict[str, _LinkState] = {}
+        self._fired: Dict[int, int] = {}       # rule idx -> fire count
+        self.injections: Dict[str, int] = {}   # kind -> count
+        self.trace: List[dict] = []
+        self.trace_path: Optional[str] = None
+        self._trace_f = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def install(self, schedule: Optional[ChaosSchedule],
+                trace_path: Optional[str] = None) -> None:
+        with self._lock:
+            if self._trace_f is not None:
+                try:
+                    self._trace_f.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._trace_f = None
+            self.schedule = schedule
+            self._links.clear()
+            self._fired.clear()
+            self.injections = {}
+            self.trace = []
+            self.trace_path = trace_path
+            if schedule is not None and trace_path is not None:
+                os.makedirs(os.path.dirname(os.path.abspath(trace_path)),
+                            exist_ok=True)
+                self._trace_f = open(trace_path, "a", encoding="utf-8")
+                # incarnation marker: the file appends across process
+                # respawns whose per-stream seqs restart at 0 — readers
+                # count these to keep same-(seq,rule) events from
+                # different incarnations distinct
+                self._trace_f.write(json.dumps(
+                    {"marker": "install", "seed": schedule.seed,
+                     "name": schedule.name}) + "\n")
+                self._trace_f.flush()
+
+    def clear(self) -> None:
+        self.install(None)
+
+    @property
+    def installed(self) -> bool:
+        return self.schedule is not None
+
+    # -- decision core --------------------------------------------------------
+
+    def _state(self, link: str) -> _LinkState:
+        st = self._links.get(link)
+        if st is None:
+            st = self._links[link] = _LinkState()
+        return st
+
+    def _record(self, link: str, seq: int, kind: str, rule_idx: int,
+                ftype: str, epoch: int) -> None:
+        self.injections[kind] = self.injections.get(kind, 0) + 1
+        ev = {"link": link, "seq": seq, "kind": kind, "rule": rule_idx,
+              "type": ftype, "epoch": epoch}
+        self.trace.append(ev)
+        if self._trace_f is not None:
+            self._trace_f.write(json.dumps(ev, sort_keys=True) + "\n")
+            self._trace_f.flush()
+
+    def decide(self, link: str, ftype: str, subtype: str,
+               epoch_hint: Optional[int],
+               meta: bool) -> Tuple[List[Tuple[str, ChaosRule, int]], int]:
+        """One frame's fate. ``link`` here is a STREAM key — the base
+        directed edge plus an optional ``#c<chan>``/``#a<chan>`` suffix
+        (several exchange edges multiplex one socket, and their
+        interleaving is timing-dependent; per-channel streams are the
+        deterministic unit, since one actor produces each in order).
+        Rules match against the BASE link; seq/epoch/hold state is per
+        stream. Returns (actions, seq). ``meta`` frames (keepalive/
+        stats) consume no seq and leave no trace, but still honor
+        partition/sever windows."""
+        base = link.split("#", 1)[0]
+        with self._lock:
+            sched = self.schedule
+            st = self._state(link)
+            if epoch_hint is not None:
+                st.epoch = max(st.epoch, int(epoch_hint))
+            if sched is None:
+                if not meta:
+                    st.seq += 1
+                return [], st.seq - 1
+            seq = st.seq
+            if not meta:
+                st.seq += 1
+                st.frames_seen += 1
+            actions: List[Tuple[str, ChaosRule, int]] = []
+            for idx, rule in enumerate(sched.rules):
+                if meta and rule.kind not in ("partition", "sever"):
+                    continue
+                if rule.count is not None \
+                        and self._fired.get(idx, 0) >= rule.count:
+                    continue
+                if not rule.matches(base, seq, ftype, subtype, st.epoch,
+                                    sched.seed, idx):
+                    continue
+                self._fired[idx] = self._fired.get(idx, 0) + 1
+                actions.append((rule.kind, rule, idx))
+                if not meta:
+                    self._record(link, seq, rule.kind, idx, ftype,
+                                 st.epoch)
+            return actions, seq
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "installed": self.schedule is not None,
+                "seed": self.schedule.seed if self.schedule else None,
+                "name": self.schedule.name if self.schedule else "",
+                "injections": dict(self.injections),
+                "links": {l: {"frames": st.frames_seen, "seq": st.seq,
+                              "epoch": st.epoch, "held": len(st.held)}
+                          for l, st in sorted(self._links.items())},
+                "trace_len": len(self.trace),
+            }
+
+    def trace_by_link(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            out: Dict[str, List[dict]] = {}
+            for ev in self.trace:
+                out.setdefault(ev["link"], []).append(ev)
+            return out
+
+
+_PLANE = ChaosPlane()
+
+#: env var carrying a schedule JSON into worker/compactor subprocesses
+CHAOS_ENV = "RWTPU_CHAOS"
+
+
+def plane() -> ChaosPlane:
+    return _PLANE
+
+
+def install(schedule: Optional[ChaosSchedule],
+            trace_path: Optional[str] = None) -> ChaosPlane:
+    _PLANE.install(schedule, trace_path=trace_path)
+    return _PLANE
+
+
+def install_from_env(trace_path: Optional[str] = None) -> bool:
+    """Worker-process bring-up: adopt the spawning session's schedule
+    (RWTPU_CHAOS env JSON). Returns True when a schedule was installed."""
+    s = os.environ.get(CHAOS_ENV)
+    if not s:
+        return False
+    _PLANE.install(ChaosSchedule.from_json(s), trace_path=trace_path)
+    return True
+
+
+def chaos_snapshot() -> dict:
+    return _PLANE.snapshot()
+
+
+def _frame_kind(obj: dict) -> Tuple[str, str, Optional[int]]:
+    """(ftype, subtype, epoch_hint) of one frame. Exchange data frames
+    expose their inner message type; barrier-ish frames expose their
+    epoch so per-link epoch windows advance deterministically."""
+    ftype = str(obj.get("type", "?"))
+    subtype = ftype
+    epoch = None
+    if ftype == "exg_data":
+        msg = obj.get("msg") or {}
+        subtype = f"exg_data:{msg.get('t', '?')}"
+        if msg.get("t") == "barrier":
+            epoch = msg.get("epoch")
+    elif ftype in ("barrier", "commit"):
+        epoch = obj.get("epoch")
+    return ftype, subtype, epoch
+
+
+class FaultyTransport:
+    """Per-link frame gate. Send sites build their frame and hand it
+    here with an ``emit`` callback performing the actual socket write;
+    recv sites pass inbound frames through ``recv`` (which only counts —
+    all faults are injected sender-side, where determinism lives)."""
+
+    def __init__(self, link: str, pl: Optional[ChaosPlane] = None):
+        self.link = link
+        self.plane = pl or _PLANE
+
+    # -- helpers --------------------------------------------------------------
+
+    def _stream_key(self, obj: dict, ftype: str) -> str:
+        """Per-channel stream key: one exchange socket multiplexes many
+        edges (and their acks), whose interleaving is wall-clock-
+        dependent — per-channel streams are produced by ONE actor in
+        order, so seq-keyed decisions replay deterministically."""
+        chan = obj.get("chan")
+        if chan is None:
+            return self.link
+        tag = "a" if ftype in ("exg_ack", "ack") else "c"
+        return f"{self.link}#{tag}{chan}"
+
+    def _plan(self, obj: dict, meta: bool):
+        ftype, subtype, epoch = _frame_kind(obj)
+        if not meta and ftype in META_FRAME_TYPES:
+            meta = True
+        key = self._stream_key(obj, ftype)
+        actions, seq = self.plane.decide(key, ftype, subtype,
+                                         epoch, meta)
+        dropped = any(k in ("partition", "sever", "drop")
+                      for k, _, _ in actions)
+        dup = any(k == "duplicate" for k, _, _ in actions)
+        delay_ms = max((r.delay_ms for k, r, _ in actions
+                        if k == "delay"), default=0.0)
+        delay_frames = max((r.delay_frames for k, r, _ in actions
+                            if k == "delay"), default=0)
+        is_barrier = subtype.endswith("barrier")
+        if is_barrier:
+            # barriers are never reorder-held: they are the epoch cut,
+            # and the cut flushing the hold queue (below) is what keeps
+            # a frame held near stream end from being lost forever
+            delay_frames = 0
+        return key, seq, dropped, dup, delay_ms, delay_frames, is_barrier
+
+    def _release_due(self, key: str, seq: int,
+                     all_held: bool = False) -> List[bytes]:
+        # a frame held at seq S with delay n releases once n LATER
+        # frames have been sent, i.e. before emitting seq > S + n — or
+        # unconditionally before a BARRIER (all_held), so reordering
+        # stays within an epoch and nothing is held past stream end
+        with self.plane._lock:
+            st = self.plane._state(key)
+            if all_held:
+                due = [b for (_at, b) in st.held]
+                st.held = []
+            else:
+                due = [b for (at, b) in st.held if at < seq]
+                st.held = [(at, b) for (at, b) in st.held if at >= seq]
+        return due
+
+    def _hold(self, key: str, seq: int, n: int, buf: bytes) -> None:
+        with self.plane._lock:
+            self.plane._state(key).held.append((seq + n, buf))
+
+    # -- async send -----------------------------------------------------------
+
+    async def send(self, obj: dict, buf: bytes, emit,
+                   meta: bool = False) -> bool:
+        """Route one outbound frame. ``emit`` is an async callable
+        taking the packed bytes. Returns False when the frame was
+        dropped/held (callers treat it as written — that is the point:
+        the network ate it)."""
+        if not self.plane.installed:
+            await emit(buf)
+            return True
+        (key, seq, dropped, dup, delay_ms, delay_frames,
+         is_barrier) = self._plan(obj, meta)
+        if dropped:
+            # an active partition/sever/drop window eats EVERYTHING on
+            # the stream — including frames a delay rule was holding
+            # (releasing them mid-window would leak traffic through the
+            # documented total-starvation contract; they stay held and
+            # flush with the first frame after the window)
+            return False
+        for late in self._release_due(key, seq, all_held=is_barrier):
+            await emit(late)
+        if delay_ms > 0:
+            import asyncio
+            await asyncio.sleep(delay_ms / 1000.0)
+        if delay_frames > 0:
+            self._hold(key, seq, delay_frames, buf)
+            return False
+        await emit(buf)
+        if dup:
+            await emit(buf)
+        return True
+
+    # -- sync send (compactor control conversation) ---------------------------
+
+    def send_sync(self, obj: dict, buf: bytes, emit,
+                  meta: bool = False) -> bool:
+        if not self.plane.installed:
+            emit(buf)
+            return True
+        (key, seq, dropped, dup, delay_ms, delay_frames,
+         is_barrier) = self._plan(obj, meta)
+        if dropped:
+            return False       # window eats held frames too (see send)
+        for late in self._release_due(key, seq, all_held=is_barrier):
+            emit(late)
+        if delay_ms > 0:
+            time.sleep(delay_ms / 1000.0)
+        if delay_frames > 0:
+            self._hold(key, seq, delay_frames, buf)
+            return False
+        emit(buf)
+        if dup:
+            emit(buf)
+        return True
+
+
+def meta_io(op: str, key: str) -> None:
+    """Meta-store durable-IO injection point (link "meta"): a
+    ``meta_fault`` rule matching the "meta" link raises OSError here,
+    exercising the meta tier's torn-txn handling from the same seeded
+    registry as the wire faults."""
+    if not _PLANE.installed:
+        return
+    actions, _seq = _PLANE.decide("meta", op, f"{op}:{key}", None, False)
+    for kind, _rule, _idx in actions:
+        if kind == "meta_fault":
+            raise OSError(f"chaos: meta store {op} {key!r} failed")

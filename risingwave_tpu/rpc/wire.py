@@ -48,6 +48,13 @@ _LEN = struct.Struct("<I")
 MAX_FRAME = 256 << 20
 
 
+def pack_frame(obj: dict) -> bytes:
+    body = json.dumps(obj).encode()
+    if len(body) > MAX_FRAME:
+        raise ValueError(f"oversized frame: {len(body)} bytes")
+    return _LEN.pack(len(body)) + body
+
+
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     """Read one frame; None on clean EOF (peer closed)."""
     try:
@@ -65,17 +72,32 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
 
 
 async def write_frame(writer: asyncio.StreamWriter, obj: dict,
-                      lock: Optional[asyncio.Lock] = None) -> None:
+                      lock: Optional[asyncio.Lock] = None,
+                      link: Optional[str] = None,
+                      meta: bool = False) -> None:
     """Write one frame; ``lock`` serializes concurrent writer tasks
-    (barrier collectors, permit acks) on a shared socket."""
-    body = json.dumps(obj).encode()
-    if lock is not None:
-        async with lock:
-            writer.write(_LEN.pack(len(body)) + body)
+    (barrier collectors, permit acks) on a shared socket. ``link`` names
+    the directed edge for the network fault plane (rpc/faults.py): every
+    named send routes through the plane's per-link FaultyTransport, so a
+    seeded ChaosSchedule can drop/delay/duplicate/partition this frame
+    deterministically. Unnamed sends bypass injection (local tooling)."""
+    buf = pack_frame(obj)
+
+    async def emit(b: bytes) -> None:
+        if lock is not None:
+            async with lock:
+                writer.write(b)
+                await writer.drain()
+        else:
+            writer.write(b)
             await writer.drain()
-    else:
-        writer.write(_LEN.pack(len(body)) + body)
-        await writer.drain()
+
+    if link is not None:
+        from .faults import FaultyTransport, plane
+        if plane().installed:
+            await FaultyTransport(link).send(obj, buf, emit, meta=meta)
+            return
+    await emit(buf)
 
 
 def read_frame_sync(sock) -> Optional[dict]:
@@ -103,9 +125,14 @@ def read_frame_sync(sock) -> Optional[dict]:
     return json.loads(body)
 
 
-def write_frame_sync(sock, obj: dict) -> None:
-    body = json.dumps(obj).encode()
-    sock.sendall(_LEN.pack(len(body)) + body)
+def write_frame_sync(sock, obj: dict, link: Optional[str] = None) -> None:
+    buf = pack_frame(obj)
+    if link is not None:
+        from .faults import FaultyTransport, plane
+        if plane().installed:
+            FaultyTransport(link).send_sync(obj, buf, sock.sendall)
+            return
+    sock.sendall(buf)
 
 
 # -- message codecs -----------------------------------------------------------

@@ -13,15 +13,41 @@ Client semantics are honest: DML acknowledged only at FLUSH; statements
 not yet flushed when a kill strikes are re-applied by the harness (client
 retry), exactly how an at-least-once client driver behaves against the
 reference. The end-state cross-check compares every MV against a control
-session that never crashed.
+session that never crashed — and, since ISSUE 9, every readable SINK's
+delivered output (the surface the ConsistencyAuditor checks), so chaos
+entries catch sink dupes/loss, not just MV divergence.
+
+Two DETERMINISTIC modes ride on the network fault plane (rpc/faults.py):
+
+* **named netsplit scenarios** (``run_netsplit``) — seeded
+  ``ChaosSchedule``s over a live cluster: partition one exchange edge of
+  a spanning 2-worker q5 graph for a window of epochs mid-stream, delay
+  acks past the permit budget, duplicate+reorder exchange frames,
+  duplicate a batch_task reply. Each run ends in a ConsistencyAuditor
+  pass against a no-chaos control and returns its per-link injection
+  trace; replaying the same seed reproduces the identical trace.
+* **crash-point sweep** (``crash_point_sweep``) — iterate every
+  registered failpoint site (common/failpoint.py KNOWN_SITES, including
+  both 2PC checkpoint phases), kill the cluster the moment the site
+  fires, recover, and audit — FoundationDB-style "die at every
+  interesting instruction" coverage.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import random
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .frontend.session import Session
+from .rpc.faults import CHAOS_ENV, ChaosRule, ChaosSchedule, install, plane
+
+
+class CrashPoint(BaseException):
+    """Raised by an armed failpoint to simulate process death AT that
+    site: BaseException so no intermediate ``except Exception`` recovery
+    layer can absorb it — the only handler is the sweep's kill path."""
 
 
 class SimCluster:
@@ -29,6 +55,7 @@ class SimCluster:
                  checkpoint_frequency: int = 2, workers: int = 0,
                  transient_fault_rate: float = 0.0,
                  broker=None, broker_restart_rate: float = 0.0,
+                 chaos: Optional[ChaosSchedule] = None,
                  **session_kw):
         """``workers`` > 0 runs MV jobs on worker PROCESSES and arms
         per-component kills: the chaos step randomly SIGKILLs one worker
@@ -64,6 +91,17 @@ class SimCluster:
         self.broker = broker
         self.broker_restart_rate = broker_restart_rate
         self.broker_restarts = 0
+        # network fault plane: install the schedule in THIS process and
+        # export it so worker subprocesses (including recovery respawns)
+        # adopt it at bring-up; injection traces persist under data_dir
+        # so a killed process's trace survives for replay comparison
+        self.chaos = chaos
+        self._chaos_env_set = False
+        if chaos is not None:
+            os.environ[CHAOS_ENV] = chaos.to_json()
+            self._chaos_env_set = True
+            install(chaos, trace_path=os.path.join(
+                data_dir, "chaos_trace_session.jsonl"))
         self.session = Session(data_dir=data_dir, **self.session_kw)
         self.kills = 0
         self.worker_kills = 0
@@ -188,7 +226,12 @@ class SimCluster:
 
     def verify_against(self, control: Session,
                        mv_names: Optional[List[str]] = None) -> None:
-        """Final-state cross-check after both sides flushed."""
+        """Final-state cross-check after both sides flushed: every MV
+        bit-equal AND every readable sink's DELIVERED output equal as a
+        multiset (the surface the ConsistencyAuditor checks — a chaos
+        run that re-delivered or lost sink rows fails here even when
+        the MVs converged)."""
+        from .common.audit import fold_changelog, sink_delivered_rows
         self.flush()
         control.flush()
         names = mv_names or sorted(self.session.catalog.mvs)
@@ -198,3 +241,547 @@ class SimCluster:
             assert got == want, (
                 f"MV {name!r} diverged after {self.kills} kills:\n"
                 f"  chaos:   {got[:10]}\n  control: {want[:10]}")
+        for name in sorted(set(self.session.catalog.sinks)
+                           & set(control.catalog.sinks)):
+            got_s = sink_delivered_rows(self.session, name)
+            want_s = sink_delivered_rows(control, name)
+            if got_s is None or want_s is None:
+                continue               # backend not readable: skip
+            assert fold_changelog(got_s) == fold_changelog(want_s), (
+                f"sink {name!r} delivery diverged after {self.kills} "
+                f"kills: {len(got_s)} rows delivered vs {len(want_s)} "
+                "expected (dupes or loss in the folded changelog)")
+
+    def close(self) -> None:
+        """Tear down the cluster and clear the exported chaos schedule
+        (so later sessions in this process spawn clean workers)."""
+        if self._chaos_env_set:
+            os.environ.pop(CHAOS_ENV, None)
+            self._chaos_env_set = False
+            install(None)
+        try:
+            self.session.close()
+        except Exception:   # noqa: BLE001 - best-effort teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Named netsplit scenarios (deterministic network-fault runs)
+# ---------------------------------------------------------------------------
+
+_BID_DDL = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, "
+            "price BIGINT, channel VARCHAR, url VARCHAR, "
+            "date_time TIMESTAMP, extra VARCHAR) "
+            "WITH (connector = 'nexmark', nexmark_table = 'bid')")
+
+_Q5 = """CREATE MATERIALIZED VIEW q5 AS
+    SELECT AuctionBids.auction, AuctionBids.num FROM (
+        SELECT bid.auction, count(*) AS num, window_start AS starttime
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY window_start, bid.auction
+    ) AS AuctionBids
+    JOIN (
+        SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+        FROM (
+            SELECT count(*) AS num, window_start AS starttime_c
+            FROM HOP(bid, date_time, INTERVAL '2' SECOND,
+                     INTERVAL '10' SECOND)
+            GROUP BY bid.auction, window_start
+        ) AS CountBids
+        GROUP BY CountBids.starttime_c
+    ) AS MaxBids
+    ON AuctionBids.starttime = MaxBids.starttime_c
+       AND AuctionBids.num = MaxBids.maxn"""
+
+_AGG = ("CREATE MATERIALIZED VIEW q AS SELECT auction, count(*) AS n, "
+        "max(price) AS mx FROM bid GROUP BY auction")
+
+#: named scenarios: mv SQL, which schedule to arm, and whether the
+#: injection is expected to force a scoped recovery (partition) or be
+#: absorbed transparently by the hardening (dedup/reorder/keepalive)
+NETSPLIT_SCENARIOS: Dict[str, dict] = {
+    # partition ONE exchange edge of the spanning 2-worker q5 graph for
+    # 3 epochs mid-stream: barrier collection on the starved consumer
+    # trips the epoch deadline, scoped recovery rebuilds the graph from
+    # per-worker durable state, sources replay, and the MV converges
+    # bit-exact with a no-chaos control (the ISSUE 9 acceptance run)
+    "q5_exchange_partition": {
+        "sql": _Q5, "mv": "q5", "expect_recovery": True,
+        "rules": lambda e0: [ChaosRule(
+            kind="partition", link="w0->w1", types=["exg_data"],
+            epochs=[e0, e0 + 3])],
+    },
+    # duplicate + reorder exchange frames on the w0<->w1 edges: the
+    # per-channel seq layer dedups and re-sequences, so the run needs NO
+    # recovery and stays bit-exact (exactly-once from at-least-once)
+    "exchange_dup_reorder": {
+        "sql": _AGG, "mv": "q", "expect_recovery": False,
+        "rules": lambda e0: [
+            ChaosRule(kind="duplicate", link="w0<->w1",
+                      types=["exg_data"], prob=0.3),
+            ChaosRule(kind="delay", link="w0<->w1",
+                      types=["exg_data:chunk"], prob=0.25,
+                      delay_frames=2),
+        ],
+    },
+    # delay consumption acks on the exchange edges: producers stall on
+    # permits (permits_waited grows) but nothing is lost — backpressure
+    # is the correct, convergent behavior
+    "ack_delay": {
+        "sql": _AGG, "mv": "q", "expect_recovery": False,
+        "rules": lambda e0: [ChaosRule(
+            kind="delay", link="w0<->w1", types=["exg_ack"],
+            delay_ms=30.0)],
+    },
+    # duplicate every worker→session reply frame: request/reply rid
+    # dedup keeps batch_task / scan results exactly-once at the caller.
+    # The query runs the serving plane's TWO-PHASE path over the
+    # sharded-root spanning MV, so real batch_task replies (one per
+    # slice-holding worker) cross the faulty link and get duplicated.
+    "dup_batch_reply": {
+        "sql": _AGG, "mv": "q", "expect_recovery": False,
+        "query": "SELECT auction, count(*) AS c FROM q GROUP BY auction",
+        "rules": lambda e0: [ChaosRule(
+            kind="duplicate", link="w*->s", types=["reply"])],
+    },
+}
+
+
+def netsplit_schedule(name: str, seed: int,
+                      base_ticks: int = 2) -> ChaosSchedule:
+    """Build the seeded schedule for one named scenario. The fault
+    window is expressed in ABSOLUTE epochs: the setup below (DDL, then
+    ``base_ticks`` lockstep ticks, then FLUSH) lands the cluster at
+    epoch ``base_ticks + 2``, so the window opens on the next epoch —
+    mid-stream, after a committed checkpoint cut."""
+    spec = NETSPLIT_SCENARIOS[name]
+    e0 = base_ticks + 3
+    return ChaosSchedule(seed, spec["rules"](e0), name=name)
+
+
+def _collect_trace(data_dir: str) -> Dict[str, list]:
+    """Collect every persisted injection trace under ``data_dir``
+    (chaos_trace.jsonl per worker incarnation, chaos_trace_session.jsonl
+    for the session process), grouped per stream. Each plane install
+    wrote an incarnation marker; events carry their incarnation index so
+    two incarnations of the same stream (per-stream seqs restart at 0
+    after a respawn) never collapse into one event. Per-stream
+    per-incarnation event lists are the deterministic replay unit."""
+    events: List[tuple] = []
+    for root, _dirs, files in os.walk(data_dir):
+        for f in sorted(files):
+            if not (f.startswith("chaos_trace") and f.endswith(".jsonl")):
+                continue
+            inc = -1
+            with open(os.path.join(root, f), encoding="utf-8") as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    ev = json.loads(line)
+                    if ev.get("marker") == "install":
+                        inc += 1
+                        continue
+                    events.append((ev["link"], max(inc, 0), ev["seq"],
+                                   ev["kind"], ev["type"], ev["rule"]))
+    if not events:
+        # no persisted files (plane installed without a trace_path):
+        # fall back to the in-memory trace, one incarnation
+        events = [(ev["link"], 0, ev["seq"], ev["kind"], ev["type"],
+                   ev["rule"]) for ev in plane().trace]
+    by_link: Dict[str, set] = {}
+    for link, inc, seq, kind, ftype, rule in events:
+        by_link.setdefault(link, set()).add((inc, seq, kind, ftype,
+                                             rule))
+    return {k: sorted(v) for k, v in by_link.items()}
+
+
+def run_netsplit(name: str, seed: int = 7, data_dir: Optional[str] = None,
+                 base_ticks: int = 2, post_ticks: int = 2,
+                 chunk_capacity: int = 64) -> dict:
+    """Run one named netsplit scenario end to end and machine-check the
+    result: build a 2-worker cluster with the seeded schedule installed,
+    run the scenario's MV as a spanning graph, let the injection strike
+    (riding out a scoped recovery when the scenario forces one), then
+    audit against a no-chaos single-process control. Returns a report
+    with the per-link injection trace — re-running the same (name, seed)
+    reproduces it identically."""
+    import tempfile
+
+    from .common.audit import ConsistencyAuditor
+    from .common.config import FaultConfig
+    from .frontend.build import BuildConfig
+
+    spec = NETSPLIT_SCENARIOS[name]
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_netsplit_")
+    schedule = netsplit_schedule(name, seed, base_ticks)
+    # short deadlines: a partitioned edge must trip the epoch deadline
+    # in seconds, not the production 300s. NOT too short though: the
+    # first data epoch of a fresh worker process pays XLA compilation,
+    # and a deadline under that cost reads as a dead worker and spins
+    # recovery forever (found by this very harness) — the shared
+    # compilation cache below keeps RESPAWNED workers fast
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(data_dir, "jax_cache"))
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    # keepalive probing stays OFF here: detection rides the epoch
+    # deadline (the probe's own regression test sets up a controlled
+    # idle link instead — under q5's compute-bound epochs an aggressive
+    # prober would race the busy event loop)
+    fc = FaultConfig(worker_epoch_timeout_s=15.0,
+                     worker_request_timeout_s=60.0,
+                     exchange_keepalive_s=0.0)
+    sim = SimCluster(data_dir, seed=seed, kill_rate=0.0, workers=2,
+                     chaos=schedule, source_chunk_capacity=chunk_capacity,
+                     checkpoint_frequency=2, fault_config=fc,
+                     config=BuildConfig(fragment_parallelism=2))
+    control = Session(seed=42, source_chunk_capacity=chunk_capacity,
+                      checkpoint_frequency=2)
+    mv = spec["mv"]
+    try:
+        for sess in (sim.session, control):
+            sess.run_sql(_BID_DDL)
+            sess.run_sql(spec["sql"])
+        assert mv in sim.session._spanning_specs, \
+            f"{mv} did not deploy as a 2-worker spanning graph"
+        for _ in range(base_ticks):
+            sim.tick()
+            control.tick()
+        sim.flush()                    # committed cut before the window
+        control.flush()
+        recovered = False
+        if spec["expect_recovery"]:
+            # the window opens on the next epoch: tick the chaos side
+            # alone until the starved graph died AND scoped recovery
+            # rebuilt it (dead-window ticks feed the job nothing, and
+            # the wedged epoch's uncommitted generate replays from the
+            # committed offsets — so the control is NOT ticked here)
+            for _ in range(40):
+                sim.tick()
+                s = sim.session
+                job = s.jobs.get(mv)
+                healthy = (job is not None and job._failure is None
+                           and mv not in s._dead_jobs
+                           and not any(w.dead for w in s.workers))
+                if recovered and healthy:
+                    break
+                if not healthy:
+                    recovered = True   # strike observed; await rebuild
+            else:
+                raise AssertionError(
+                    f"netsplit {name!r} never recovered")
+            assert recovered, f"netsplit {name!r} never struck"
+        for _ in range(post_ticks):
+            sim.tick()
+            control.tick()
+        # read MVs through the chaos side BEFORE auditing so a remote
+        # scan path exercises the (possibly still chaotic) reply links
+        _ = sim.mv_rows(mv)
+        query_ok = None
+        if spec.get("query"):
+            # batch query through the chaos side's serving plane (two-
+            # phase batch_task frames over the faulty links) must equal
+            # the control's answer EXACTLY ONCE — a duplicated reply
+            # that slipped rid-dedup would double rows here
+            got_q = sorted(sim.session.run_sql(spec["query"]))
+            want_q = sorted(control.run_sql(spec["query"]))
+            assert got_q == want_q, (
+                f"query diverged under chaos: {got_q[:5]} vs "
+                f"{want_q[:5]}")
+            query_ok = True
+        sim.verify_against(control, [mv])
+        report = ConsistencyAuditor(sim.session).audit(control=control)
+        report.assert_ok()
+        metrics = sim.session.metrics()
+        out = {
+            "scenario": name, "seed": seed,
+            "schedule": schedule.to_json(),
+            "recovered": recovered,
+            "rows": len(sim.mv_rows(mv)),
+            "query_ok": query_ok,
+            "chaos": metrics["chaos"],
+            "audit": {k: v.get("ok") for k, v in report.checks.items()},
+        }
+    finally:
+        sim.close()
+        control.close()
+    out["trace"] = _collect_trace(data_dir)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Crash-point sweep (die at every registered failpoint, audit after each)
+# ---------------------------------------------------------------------------
+
+def _sweep_workload_stmts(sink_path: str) -> List[tuple]:
+    """(sql, kind) steps: DDL first, then interleaved DML/FLUSH with a
+    mid-stream CREATE (so meta-store txns fire mid-workload too)."""
+    steps: List[tuple] = [
+        ("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)", "ddl:t"),
+        ("CREATE MATERIALIZED VIEW m AS SELECT sum(v) AS n FROM t",
+         "ddl:m"),
+        (f"CREATE SINK snk FROM m WITH (connector = 'file', "
+         f"path = '{sink_path}')", "ddl:snk"),
+    ]
+    for i in range(1, 9):
+        steps.append((f"INSERT INTO t VALUES ({i}, {10 * i})", "dml"))
+        if i % 2 == 0:
+            steps.append(("FLUSH", "flush"))
+        if i == 4:
+            steps.append(
+                ("CREATE MATERIALIZED VIEW m2 AS "
+                 "SELECT count(*) AS c FROM t", "ddl:m2"))
+    steps.append(("FLUSH", "flush"))
+    return steps
+
+
+def _exists(session: Session, kind: str) -> bool:
+    name = kind.split(":", 1)[1]
+    cat = session.catalog
+    return (name in cat.tables or name in cat.mvs or name in cat.sinks)
+
+
+def crash_point_sweep(base_dir: str, sites: Optional[List[str]] = None,
+                      seed: int = 0,
+                      audit: bool = True) -> Dict[str, dict]:
+    """FoundationDB-style sweep: for EVERY registered failpoint site run
+    the same durable workload, crash the cluster the moment the site
+    fires (``CrashPoint`` is a BaseException no recovery layer can
+    absorb), recover, finish the workload, and let the
+    ``ConsistencyAuditor`` assert exactly-once sinks / MV parity /
+    monotone barriers / pin leak-freedom against an unharmed control.
+    Sites the workload never executes are reported ``not_hit`` honestly.
+    Worker-resident sites (the 2PC prepare/commit phases of a SPANNING
+    graph) are exercised by ``crash_point_sweep_spanning``."""
+    from .common.audit import ConsistencyAuditor
+    from .common.failpoint import arm, disarm, registered_sites
+
+    sites = sites if sites is not None else registered_sites()
+    results: Dict[str, dict] = {}
+    for i, site in enumerate(sites):
+        tier = ("hummock" if site.startswith(("hummock.", "compactor."))
+                else "segment")
+        d = os.path.join(base_dir, f"site_{i:02d}")
+        sink_chaos = os.path.join(d, "sink_chaos.jsonl")
+        sink_ctl = os.path.join(d, "sink_ctl.jsonl")
+        steps = _sweep_workload_stmts(sink_chaos)
+        control = Session(data_dir=os.path.join(d, "ctl"), seed=seed,
+                          checkpoint_frequency=2, state_store=tier)
+        sim = SimCluster(os.path.join(d, "chaos"), seed=seed,
+                         kill_rate=0.0, checkpoint_frequency=2,
+                         state_store=tier)
+        hit = [False]
+
+        def _trip(_site=site, _hit=hit):
+            _hit[0] = True
+            raise CrashPoint(_site)
+
+        try:
+            # control first, UNARMED: the failpoint registry is
+            # process-global, so arming before the control ran would
+            # crash the control too
+            for sql, _kind in steps:
+                control.run_sql(sql.replace(sink_chaos, sink_ctl))
+            control.flush()
+            for sql, kind in steps:
+                if kind == "ddl:snk":
+                    # arm AFTER setup DDL: the sweep's subject is the
+                    # running cluster, not bootstrap
+                    arm(site, _trip, once=True)
+                try:
+                    sim.run_sql(sql)
+                except BaseException:
+                    # CrashPoint propagates directly from IO-path sites;
+                    # a site inside a stream actor surfaces as the job's
+                    # failure (RuntimeError) instead — either way, if
+                    # the armed site JUST fired this IS the simulated
+                    # crash. Errors before the site fired, or after its
+                    # one crash was already taken, are real bugs.
+                    if not hit[0] or _ARMED_SWEEP_KILLED.get(site):
+                        raise
+                    _ARMED_SWEEP_KILLED[site] = True
+                    sim.kill()         # die AT the site; recover; retry
+                    if kind.startswith("ddl") \
+                            and not _exists(sim.session, kind):
+                        sim.run_sql(sql)   # client retries a lost DDL
+                if hit[0] and not _ARMED_SWEEP_KILLED.get(site):
+                    # the site fired on a BACKGROUND thread (inline
+                    # compaction): the thread died, the main path did
+                    # not — still crash the cluster at this moment
+                    _ARMED_SWEEP_KILLED[site] = True
+                    sim.kill()
+            try:
+                sim.flush()
+            except BaseException:       # armed-once site fired at the
+                if not hit[0] or _ARMED_SWEEP_KILLED.get(site):
+                    raise               # closing flush: die there too,
+                _ARMED_SWEEP_KILLED[site] = True
+                sim.kill()              # recover, and flush clean
+                sim.flush()
+            status: dict = {"hit": hit[0], "kills": sim.kills}
+            sim.verify_against(control)
+            if audit:
+                report = ConsistencyAuditor(sim.session).audit(
+                    control=control)
+                report.assert_ok()
+                status["audit"] = "ok"
+            results[site] = status
+        finally:
+            disarm(site)
+            _ARMED_SWEEP_KILLED.pop(site, None)
+            sim.close()
+            control.close()
+    return results
+
+
+_ARMED_SWEEP_KILLED: Dict[str, bool] = {}
+
+
+def crash_point_sweep_spanning(base_dir: str, seed: int = 3,
+                               sites: Optional[List[str]] = None
+                               ) -> Dict[str, dict]:
+    """The 2PC checkpoint phases fire inside WORKER processes of a
+    spanning graph. For each phase site, arm a REAL process exit at the
+    site via the RWTPU_FAILPOINTS env (the worker dies with ``os._exit``
+    the first time it reaches the site — a marker file keeps the
+    respawned worker from dying forever), then prove the heartbeat-TTL
+    scoped recovery converges and the auditor passes against a no-chaos
+    control."""
+    from .common.audit import ConsistencyAuditor
+    from .common.config import FaultConfig
+    from .frontend.build import BuildConfig
+
+    # checkpoint.prepare = phase 1 (durable staging before the ack);
+    # checkpoint.settle = phase 2 (the commit frame promoting the
+    # staged epoch) — settle, not append, is the prepared-epoch path
+    sites = sites or ["checkpoint.prepare", "checkpoint.settle"]
+    results: Dict[str, dict] = {}
+    for i, site in enumerate(sites):
+        d = os.path.join(base_dir, f"span_{i:02d}")
+        os.makedirs(d, exist_ok=True)
+        marker = os.path.join(d, "died_once.marker")
+        # ONE deterministic victim (worker 1): phase-2 commit frames
+        # broadcast to every participant, and an unscoped exit would
+        # race over how many workers die
+        os.environ["RWTPU_FAILPOINTS"] = json.dumps(
+            {site: {"action": "exit", "once_marker": marker,
+                    "worker": 1}})
+        # shared compile cache + generous deadline: a respawned worker's
+        # first epoch pays XLA compilation (see run_netsplit)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                              os.path.join(base_dir, "jax_cache"))
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+        fc = FaultConfig(worker_epoch_timeout_s=15.0,
+                         worker_request_timeout_s=60.0,
+                         exchange_keepalive_s=0.0)
+        # checkpoint ONLY at the explicit flush below, so the armed 2PC
+        # site fires at a known point in the lockstep schedule (an
+        # early-tick checkpoint would kill the worker mid-warmup and
+        # desynchronize the control's generate accounting)
+        sim = SimCluster(os.path.join(d, "chaos"), seed=seed,
+                         kill_rate=0.0, workers=2,
+                         source_chunk_capacity=64,
+                         checkpoint_frequency=1000, fault_config=fc,
+                         config=BuildConfig(fragment_parallelism=2))
+        control = Session(seed=42, source_chunk_capacity=64,
+                          checkpoint_frequency=1000)
+        try:
+            for sess in (sim.session, control):
+                sess.run_sql(_BID_DDL)
+                sess.run_sql(_AGG)
+            assert "q" in sim.session._spanning_specs
+            for _ in range(2):
+                sim.tick()
+            # the flush's checkpoint reaches the armed site in worker 1:
+            # it EXITS there; the TTL + scoped recovery rebuild the
+            # graph from the DECIDED cut. The two phases differ — that
+            # is the contract under test:
+            #   prepare-death: the victim never acked, so the epoch was
+            #     never decided; every participant's prepared state is
+            #     DISCARDED and the pre-flush ticks replay from zero
+            #     (nothing earlier committed in this schedule);
+            #   commit-death: every participant prepared + acked, so
+            #     the epoch was decided; the victim's prepared state
+            #     ROLLS FORWARD at recovery and the pre-flush ticks
+            #     survive the crash.
+            sim.flush()
+            died = os.path.exists(marker)
+            for _ in range(40):
+                job = sim.session.jobs.get("q")
+                if not any(w.dead for w in sim.session.workers) \
+                        and job is not None and job._failure is None \
+                        and "q" not in sim.session._dead_jobs \
+                        and died:
+                    break
+                sim.tick()
+                died = died or os.path.exists(marker)
+            assert died, f"no worker reached site {site!r}"
+            for _ in range(2):
+                sim.tick()
+            # effective generate ticks the chaos side's MV reflects:
+            # post-recovery ticks, plus the rolled-forward pre-flush
+            # ticks iff the decided epoch survived the crash
+            pre_survived = 2 if site == "checkpoint.settle" else 0
+            for _ in range(pre_survived + 2):
+                control.tick()
+            sim.verify_against(control, ["q"])
+            report = ConsistencyAuditor(sim.session).audit(
+                control=control)
+            report.assert_ok()
+            results[site] = {"hit": True, "audit": "ok",
+                             "worker_kills": 1,
+                             "rolled_forward": bool(pre_survived)}
+        finally:
+            os.environ.pop("RWTPU_FAILPOINTS", None)
+            sim.close()
+            control.close()
+    return results
+
+
+def main(argv=None) -> int:
+    """CLI for replaying seeds: ``python -m risingwave_tpu.sim
+    --netsplit q5_exchange_partition --seed 7 [--replay]`` or
+    ``--sweep [--sites a,b]`` (docs/robustness.md)."""
+    import argparse
+    import tempfile
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--netsplit", choices=sorted(NETSPLIT_SCENARIOS))
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--replay", action="store_true",
+                    help="run the scenario twice and assert the "
+                         "injection traces are identical")
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--spanning-sweep", action="store_true")
+    ap.add_argument("--sites", default=None,
+                    help="comma-separated failpoint subset for --sweep")
+    args = ap.parse_args(argv)
+    if args.netsplit:
+        r1 = run_netsplit(args.netsplit, seed=args.seed,
+                          data_dir=tempfile.mkdtemp(prefix="rwtpu_ns1_"))
+        print(json.dumps({k: r1[k] for k in
+                          ("scenario", "seed", "recovered", "audit")},
+                         indent=2))
+        if args.replay:
+            r2 = run_netsplit(args.netsplit, seed=args.seed,
+                              data_dir=tempfile.mkdtemp(
+                                  prefix="rwtpu_ns2_"))
+            assert r1["trace"] == r2["trace"], (
+                "seeded replay diverged:\n"
+                f"run1: {r1['trace']}\nrun2: {r2['trace']}")
+            print(f"replay OK: {sum(len(v) for v in r1['trace'].values())}"
+                  " injections reproduced identically")
+    if args.sweep:
+        sites = args.sites.split(",") if args.sites else None
+        res = crash_point_sweep(tempfile.mkdtemp(prefix="rwtpu_sweep_"),
+                                sites=sites, seed=args.seed)
+        print(json.dumps(res, indent=2))
+    if args.spanning_sweep:
+        res = crash_point_sweep_spanning(
+            tempfile.mkdtemp(prefix="rwtpu_span_"))
+        print(json.dumps(res, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -188,6 +188,8 @@ class CheckpointLog:
 
     def append_epoch(self, epoch: int,
                      deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
+        from ..common.failpoint import fail_point
+        fail_point("checkpoint.commit")
         if deltas:
             name = f"epoch_{epoch:012d}.seg"
             self._write_segment(name, deltas)
@@ -220,6 +222,8 @@ class CheckpointLog:
     def prepare_epoch(self, epoch: int,
                       deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
         """Phase 1: durably stage an epoch's deltas without committing."""
+        from ..common.failpoint import fail_point
+        fail_point("checkpoint.prepare")
         name = None
         if deltas:
             name = f"epoch_{epoch:012d}.prepared.seg"
@@ -251,6 +255,8 @@ class CheckpointLog:
         with pipelined checkpoints a LATER epoch may already be durably
         prepared when this epoch's commit frame arrives, and it must
         survive for its own commit."""
+        from ..common.failpoint import fail_point
+        fail_point("checkpoint.settle")
         victims: list[str] = []
         with self._mlock:
             manifest = self._read_manifest()

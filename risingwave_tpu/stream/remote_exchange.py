@@ -38,22 +38,44 @@ class ExchangeInput:
     so ``MergeExecutor`` treats it exactly like a local ``PermitChannel``
     end. Frames decode lazily and the permit ack goes back over the peer
     socket only when the consumer TAKES a chunk — end-to-end
-    consumption-based credit (reference: permit.rs)."""
+    consumption-based credit (reference: permit.rs).
+
+    Faulty-delivery hardening (ISSUE 9): frames carry per-channel
+    sequence numbers. A duplicate (seq already delivered) is dropped
+    WITHOUT an ack — the producer consumed one permit for it, so acking
+    twice would inflate the edge's credit. An out-of-order frame (a
+    chaos-delayed sibling overtook it) is held and released in seq
+    order, so barrier position in the stream — the exactly-once cut —
+    survives reordering networks."""
 
     def __init__(self, chan: int, schema: Schema, capacity: int,
-                 stats: EdgeStats, job: str):
+                 stats: EdgeStats, job: str, link: str = ""):
+        from ..rpc.exchange import SeqReorderBuffer
         self.chan = chan
         self.schema = schema
         self.capacity = capacity
         self.stats = stats
         self.job = job
+        self.link = link              # fault-plane name of the ACK edge
         self._q = MsgQueue()
+        self._seqbuf = SeqReorderBuffer()
+        self._ack_seq = 0             # per-chan ack sequence (producer
+        #                               dedups duplicated acks by it)
 
-    def feed_wire(self, wire_msg: dict, writer, wlock) -> None:
+    def feed_wire(self, wire_msg: dict, writer, wlock,
+                  seq: Optional[int] = None) -> None:
         """Called by the peer-connection read loop for every exg_data
         frame on this channel (the writer is the SAME connection, used to
-        send consumption acks back)."""
-        self._q.put_nowait(("wire", wire_msg, writer, wlock))
+        send consumption acks back). Dedup + re-order by ``seq`` HERE,
+        before the frame enters the delivery queue, so ``recv`` only ever
+        sees each frame once, in send order (a dropped duplicate is NOT
+        acked — the producer consumed one permit for it)."""
+        delivered = self._seqbuf.feed(seq, ("wire", wire_msg, writer,
+                                            wlock))
+        self.stats.dup_frames = self._seqbuf.dup_frames
+        self.stats.reordered = self._seqbuf.reordered
+        for item in delivered:
+            self._q.put_nowait(item)
 
     def put_local(self, msg: Optional[Message]) -> None:
         """Locally injected message (stop barriers at drop; None closes)."""
@@ -78,13 +100,18 @@ class ExchangeInput:
         msg = message_from_wire(payload, self.schema, self.capacity)
         if isinstance(msg, StreamChunk):
             self.stats.chunks += 1
+            ack = {"type": "exg_ack", "chan": self.chan,
+                   "seq": self._ack_seq}
+            self._ack_seq += 1
             try:
-                await write_frame(writer, {"type": "exg_ack",
-                                           "chan": self.chan}, wlock)
+                await write_frame(writer, ack, wlock,
+                                  link=self.link or None)
             except (ConnectionError, OSError):
                 pass      # producer gone; its permits die with it
         elif isinstance(msg, Barrier):
-            self.stats.barriers += 1
+            # per-edge barrier-epoch monotonicity: the auditor asserts
+            # regressions == 0 after every chaos run
+            self.stats.saw_barrier(msg.epoch.curr)
         return msg
 
 
@@ -109,7 +136,7 @@ class ExchangeOutput:
         if is_data:
             self.stats.chunks += 1
         elif isinstance(msg, Barrier):
-            self.stats.barriers += 1
+            self.stats.saw_barrier(msg.epoch.curr)
 
 
 class FragmentJob:
@@ -277,8 +304,11 @@ def _build_fragments_into(host, req: dict, store, job: FragmentJob,
                         chans.append(ch)
                     else:
                         stats = EdgeStats(c["edge"], "in", c["from_worker"])
-                        inp = ExchangeInput(c["chan"], leaf.schema,
-                                            host.chunk_capacity, stats, name)
+                        inp = ExchangeInput(
+                            c["chan"], leaf.schema, host.chunk_capacity,
+                            stats, name,
+                            link=(f"w{host.worker_id}"
+                                  f"->w{c['from_worker']}"))
                         host.exchange_inputs[c["chan"]] = inp
                         job.exchange_inputs.append(inp)
                         chans.append(inp)
@@ -310,7 +340,8 @@ def _build_fragments_into(host, req: dict, store, job: FragmentJob,
                     job.local_chan_ids.append(t["chan"])
                     outs.append(ch)
                 else:
-                    client = host.peer_pool.get(t["host"], t["port"])
+                    client = host.peer_pool.get(t["host"], t["port"],
+                                                peer_worker=t["worker"])
                     client.register(t["chan"], permits)
                     stats = EdgeStats(t["edge"], "out", t["worker"])
                     o = ExchangeOutput(client, t["chan"], plan.schema, stats)

@@ -244,7 +244,11 @@ class CompactorClient:
         obj = {**obj, "rid": self._rid}
         try:
             self.sock.settimeout(timeout)
-            write_frame_sync(self.sock, obj)
+            # compactor control frames ride the fault plane too
+            # (rpc/faults.py link "s->c<k>"): a chaos schedule can drop
+            # or delay the meta→compactor conversation deterministically
+            write_frame_sync(self.sock, obj,
+                             link=f"s->c{self.worker_id}")
             while True:
                 resp = read_frame_sync(self.sock)
                 if resp is None:

@@ -68,17 +68,38 @@ class _ChannelSource(Executor):
     """Executor view of a wire data channel: frames decode lazily and the
     permit ack is sent only when the consumer TAKES a chunk — end-to-end
     consumption-based flow control (reference: permit.rs — data consumes
-    credits, control always passes)."""
+    credits, control always passes). Session data frames carry per-chan
+    sequence numbers (frontend/remote.py send_data); duplicates are
+    dropped un-acked and delayed frames re-enter in send order — the
+    session→worker half of the exchange-edge dedup discipline."""
 
     identity = "RemoteExchangeSource"
 
     def __init__(self, host: "WorkerHost", chan: int, schema: Schema,
                  capacity: int):
+        from ..rpc.exchange import SeqReorderBuffer
         self.host = host
         self.chan = chan
         self.schema = schema
         self.capacity = capacity
         self.queue: asyncio.Queue = asyncio.Queue()
+        self._seqbuf = SeqReorderBuffer()
+        self._ack_seq = 0
+
+    @property
+    def dup_frames(self) -> int:
+        return self._seqbuf.dup_frames
+
+    @property
+    def reordered(self) -> int:
+        return self._seqbuf.reordered
+
+    def feed(self, wire_msg, seq: Optional[int] = None) -> None:
+        """Session data frame arrival: dedup + re-order by seq before
+        the frame reaches the executor queue (a dropped duplicate is
+        NOT acked — the session consumed one permit for it)."""
+        for item in self._seqbuf.feed(seq, wire_msg):
+            self.queue.put_nowait(item)
 
     async def execute(self) -> AsyncIterator[Message]:
         while True:
@@ -90,7 +111,10 @@ class _ChannelSource(Executor):
             else:
                 msg = message_from_wire(d, self.schema, self.capacity)
                 if isinstance(msg, StreamChunk):
-                    await self.host.send({"type": "ack", "chan": self.chan})
+                    ack_seq = self._ack_seq
+                    self._ack_seq += 1
+                    await self.host.send({"type": "ack", "chan": self.chan,
+                                          "seq": ack_seq})
             yield msg
             if isinstance(msg, Barrier) and msg.is_stop():
                 return
@@ -137,6 +161,13 @@ class WorkerHost:
         self.exchange_inputs: dict[int, object] = {}
         self.span_chans: dict[int, object] = {}
         self.peer_pool = PeerClientPool(worker_id)
+        # session-generation fencing (ISSUE 9): each job records the
+        # generation its deployment frame carried; a barrier or commit
+        # frame from an OLDER generation — a stale pre-recovery session
+        # view, or a chaos-delayed frame arriving after scoped recovery
+        # rebuilt the graph — is refused instead of acked/committed
+        self.job_gens: dict[str, int] = {}
+        self.fenced_frames = 0
         self.chunks_per_tick = 1
         self.chunk_capacity = 1024
         self.seed = 42
@@ -153,9 +184,10 @@ class WorkerHost:
         self._span_outbox: list = []
         self._span_seq = 0
 
-    async def send(self, obj: dict) -> None:
+    async def send(self, obj: dict, meta: bool = False) -> None:
         if self._writer is not None:
-            await write_frame(self._writer, obj, self._wlock)
+            await write_frame(self._writer, obj, self._wlock,
+                              link=f"w{self.worker_id}->s", meta=meta)
 
     # -- job construction ------------------------------------------------------
 
@@ -207,6 +239,16 @@ class WorkerHost:
     def _alloc_shard(self) -> int:
         self._next_shard += 1
         return self._next_shard - 1
+
+    def _set_fault(self, fault: dict) -> None:
+        """Adopt the session's fault-tolerance knobs (shipped on every
+        create frame) — including the exchange keepalive cadence the
+        peer pool hands to new clients."""
+        from ..common.config import FaultConfig
+        self.fault = FaultConfig(**fault)
+        self.peer_pool.keepalive_s = self.fault.exchange_keepalive_s
+        self.peer_pool.keepalive_timeout_s = \
+            self.fault.exchange_keepalive_timeout_s
 
     def _job_dir(self, name: str) -> str:
         import os
@@ -274,8 +316,7 @@ class WorkerHost:
                 f"cannot build remote leaf {type(leaf).__name__}")
 
         if req.get("fault"):
-            from ..common.config import FaultConfig
-            self.fault = FaultConfig(**req["fault"])
+            self._set_fault(req["fault"])
         cfg = BuildConfig(**req.get("config", {}))
         ctx = BuildContext(store, next_table_id, factory, cfg,
                            durable=True)
@@ -293,6 +334,7 @@ class WorkerHost:
                                  plan.schema, list(plan.pk)))
         job = StreamJob(name, mat, queues, actors=ctx.actors)
         self.jobs[name] = job
+        self.job_gens[name] = int(req.get("gen", 0))
         job.start()                          # current (running) loop
         return {"ok": True, "state_table_ids": ctx.state_table_ids,
                 "ids_end": next(ids)}
@@ -322,8 +364,7 @@ class WorkerHost:
         self.chunk_capacity = req.get("chunk_capacity", 1024)
         self.seed = req.get("seed", 42)
         if req.get("fault"):
-            from ..common.config import FaultConfig
-            self.fault = FaultConfig(**req["fault"])
+            self._set_fault(req["fault"])
         feeds0 = len(self.feeds)
         try:
             # (build_fragments rolls its own endpoint registrations back)
@@ -336,6 +377,7 @@ class WorkerHost:
                 self.stores.pop(name, None)
             raise
         self.jobs[name] = job
+        self.job_gens[name] = int(req.get("gen", 0))
         job.start()
         return {"ok": True,
                 "state_table_ids": job.state_table_ids}
@@ -372,6 +414,7 @@ class WorkerHost:
             await job.stop()
         self.feeds = [f for f in self.feeds if f.job != name]
         self.stores.pop(name, None)
+        self.job_gens.pop(name, None)
         if req.get("drop_state", True):
             import shutil
             shutil.rmtree(self._job_dir(name), ignore_errors=True)
@@ -392,6 +435,17 @@ class WorkerHost:
         only = req.get("only")
         scope = set(only) if only is not None else set(self.jobs)
         scope -= set(req.get("exclude") or ())
+        gen = req.get("gen")
+        if gen is not None:
+            # fencing: a barrier from an older session generation must
+            # not reach jobs a newer generation already rebuilt — acking
+            # it would let a stale graph stage state under the cluster's
+            # current epoch cut
+            stale = {n for n in scope
+                     if self.job_gens.get(n, 0) > int(gen)}
+            if stale:
+                self.fenced_frames += len(stale)
+                scope -= stale
         mut = None
         if req.get("mutation"):
             mut = Mutation(MutationKind(req["mutation"]),
@@ -466,9 +520,11 @@ class WorkerHost:
                 store = self.stores.get(name)
                 if store is not None:
                     store.prepare(epoch)
-        await self.send({"type": "barrier_complete", "epoch": epoch,
-                         "failed": failed,
-                         "init": bool(req.get("init", False))})
+        done = {"type": "barrier_complete", "epoch": epoch,
+                "failed": failed, "init": bool(req.get("init", False))}
+        if gen is not None:
+            done["gen"] = int(gen)   # session drops acks from stale gens
+        await self.send(done)
 
     def handle_job_epochs(self, req: dict) -> dict:
         """Recovery negotiation: what this worker durably holds for one
@@ -550,6 +606,7 @@ class WorkerHost:
             if len(self._span_outbox) > cap:
                 del self._span_outbox[:-cap]
             self._span_seq += 1
+        from ..rpc.faults import chaos_snapshot
         from ..stream.remote_exchange import exchange_stats
         return {
             "ok": True, "worker_id": self.worker_id,
@@ -560,6 +617,13 @@ class WorkerHost:
             # forwarded, backlog) for every cross-worker edge endpoint
             # this process hosts — federated into metrics()["exchange"]
             "exchange": exchange_stats(self),
+            # fault-plane state: this process's chaos injections plus
+            # the fencing / dedup counters the plane's injection forced
+            "chaos": {**chaos_snapshot(),
+                      "fenced_frames": self.fenced_frames,
+                      "pool_evictions": self.peer_pool.evictions,
+                      "dup_data_frames": sum(
+                          ch.dup_frames for ch in self.channels.values())},
             "spans": list(self._span_outbox), "span_seq": self._span_seq,
         }
 
@@ -578,18 +642,21 @@ class WorkerHost:
 
     # -- serve -----------------------------------------------------------------
 
-    async def _reply(self, frame: dict, handler) -> None:
+    async def _reply(self, frame: dict, handler,
+                     meta: bool = False) -> None:
         """Per-request error isolation: a failing handler (bad plan,
         unknown connector, missing file) answers THIS request with the
         error — it must never tear down the worker and its other jobs
         (the local path surfaces the same failures as per-statement
-        SqlErrors)."""
+        SqlErrors). ``meta`` marks wall-clock-driven replies (stats
+        polls) so the fault plane keeps them out of the deterministic
+        frame-seq stream."""
         try:
             resp = await handler(frame)
         except Exception as e:  # noqa: BLE001 - shipped to the session
             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
         resp.update({"type": "reply", "rid": frame["rid"]})
-        await self.send(resp)
+        await self.send(resp, meta=meta)
 
     async def handle_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> str:
@@ -619,17 +686,33 @@ class WorkerHost:
         a silently starved merge would wedge barrier collection."""
         wlock = asyncio.Lock()
         fed: set[int] = set()
+        peer = hello.get("worker")
+        link = (f"w{self.worker_id}->w{peer}" if peer is not None
+                else f"w{self.worker_id}->peer")
         try:
             while True:
                 frame = await read_frame(reader)
                 if frame is None:
                     break
-                if frame.get("type") == "exg_data":
+                t = frame.get("type")
+                if t == "exg_data":
                     chan = frame["chan"]
                     inp = self.exchange_inputs.get(chan)
                     if inp is not None:
                         fed.add(chan)
-                        inp.feed_wire(frame["msg"], writer, wlock)
+                        inp.feed_wire(frame["msg"], writer, wlock,
+                                      seq=frame.get("seq"))
+                elif t == "exg_ping":
+                    # keepalive probe: answer on the same socket so a
+                    # half-open link (answer eaten, or this process
+                    # wedged) times out on the prober's side
+                    try:
+                        await write_frame(
+                            writer, {"type": "exg_pong",
+                                     "seq": frame.get("seq", 0)},
+                            wlock, link=link, meta=True)
+                    except (ConnectionError, OSError):
+                        break
         finally:
             for chan in fed:
                 inp = self.exchange_inputs.get(chan)
@@ -650,7 +733,7 @@ class WorkerHost:
                 if t == "data":
                     ch = self.channels.get(frame["chan"])
                     if ch is not None:
-                        ch.queue.put_nowait(frame["msg"])
+                        ch.feed(frame["msg"], frame.get("seq"))
                 elif t == "barrier":
                     tasks.append(
                         asyncio.ensure_future(self.handle_barrier(frame)))
@@ -659,11 +742,20 @@ class WorkerHost:
                     # staged state for the epoch becomes durable —
                     # except jobs the session excludes (a spanning job
                     # with a dead peer must not have its SURVIVING
-                    # fragments' torn epochs committed under it)
+                    # fragments' torn epochs committed under it) and
+                    # jobs whose deployment generation FENCES this frame
+                    # (a stale pre-recovery commit must not promote a
+                    # rebuilt job's staged epochs)
                     skip = set(frame.get("skip_jobs") or ())
+                    cgen = frame.get("gen")
                     for jname, store in self.stores.items():
-                        if jname not in skip:
-                            store.commit(int(frame["epoch"]))
+                        if jname in skip:
+                            continue
+                        if cgen is not None \
+                                and self.job_gens.get(jname, 0) > int(cgen):
+                            self.fenced_frames += 1
+                            continue
+                        store.commit(int(frame["epoch"]))
                 elif t == "create_job":
                     await self._reply(frame, self.handle_create_job)
                 elif t == "create_fragments":
@@ -681,7 +773,7 @@ class WorkerHost:
                 elif t == "stats":
                     async def _stats(f):
                         return self.handle_stats(f)
-                    await self._reply(frame, _stats)
+                    await self._reply(frame, _stats, meta=True)
                 elif t == "batch_task":
                     async def _bt(f):
                         return self.handle_batch_task(f)
@@ -725,6 +817,17 @@ def _channel_roots(job: StreamJob):
 
 
 async def amain(data_dir: str, worker_id: int, port: int) -> None:
+    import os
+    from ..common.failpoint import arm_from_env
+    from ..rpc.faults import install_from_env
+    # adopt the spawning session's chaos schedule (RWTPU_CHAOS env);
+    # injections append to a per-worker trace file so a killed worker's
+    # pre-death trace survives for seeded-replay comparison. The
+    # crash-point sweep arms process-exit failpoints the same way
+    # (RWTPU_FAILPOINTS) — a worker dies AT the armed 2PC site.
+    install_from_env(trace_path=os.path.join(data_dir,
+                                             "chaos_trace.jsonl"))
+    arm_from_env(worker_id=worker_id)
     host = WorkerHost(data_dir, worker_id)
     done = asyncio.Event()
 

@@ -76,6 +76,21 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_multiprocess.py \
     "$@"
 
+echo "== network fault plane (chaos subset) =="
+# Unit surface (schedules, seq dedup/reorder, keepalive eviction,
+# auditor), then one FAST seeded netsplit scenario run twice to assert
+# the identical-injection-trace replay property, then a bounded
+# crash-point sweep (die at four failpoint sites, audit after each).
+# The full acceptance surface — q5 partition, every registered site,
+# the spanning 2PC sweep — is tests/test_chaos.py (slow-marked).
+python -m pytest -q -p no:cacheprovider \
+    tests/test_net_faults.py \
+    "$@"
+python -m risingwave_tpu.sim --netsplit exchange_dup_reorder \
+    --seed 7 --replay
+python -m risingwave_tpu.sim --sweep \
+    --sites checkpoint.segment.write,checkpoint.commit,sink.deliver,meta.store.txn
+
 echo "== exchange-boundary lint =="
 # Every exchange edge must go through the dispatch fabric
 # (stream/dispatch.py open_channel / the frontend fragment builders) or
@@ -91,6 +106,23 @@ if [ -n "$bad" ]; then
     exit 1
 fi
 echo "exchange-boundary lint: OK"
+
+echo "== wire-boundary lint =="
+# Every internal RPC frame must flow through rpc/wire.py (where the
+# network fault plane's per-link FaultyTransport hooks live). Raw
+# sock.sendall/sock.recv anywhere else means some module grew its own
+# wire path that chaos schedules cannot reach — reject it. The broker
+# (connector/broker.py) is exempt: it is an EXTERNAL boundary with its
+# own line protocol, hardened by the PR-3 reconnect layer instead.
+bad=$(grep -rn "sock\.sendall(\|sock\.recv(" risingwave_tpu --include='*.py' \
+      | grep -v "risingwave_tpu/rpc/wire.py" \
+      | grep -v "risingwave_tpu/connector/broker.py" || true)
+if [ -n "$bad" ]; then
+    echo "raw socket IO outside the rpc/wire.py fault-plane boundary:"
+    echo "$bad"
+    exit 1
+fi
+echo "wire-boundary lint: OK"
 
 echo "== serving-cache lint =="
 # Every batch SELECT must lower through the serving plane
