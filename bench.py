@@ -927,6 +927,64 @@ def run_serving_phase(seconds: float, n_threads: int) -> None:
     _emit(out)
 
 
+def run_rescale_phase(ticks: int = 6, cap: int = 256) -> None:
+    """Child entry for --rescale-phase: one LIVE 2→4 vnode migration of
+    a spanning grouped-agg job on a 4-worker cluster (docs/scaling.md),
+    recording rows/s before / during / after plus the migration pause
+    (drain→init wall time) and the moved vnode count. One JSON line."""
+    import tempfile
+
+    from risingwave_tpu.frontend.build import BuildConfig
+    from risingwave_tpu.frontend.session import Session
+
+    d = tempfile.mkdtemp(prefix="rwtpu_bench_rescale_")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(d, "jax_cache"))
+    s = Session(workers=4, seed=42, data_dir=d, source_chunk_capacity=cap,
+                config=BuildConfig(fragment_parallelism=2))
+    try:
+        s.run_sql(
+            "CREATE SOURCE bid (auction BIGINT, bidder BIGINT, "
+            "price BIGINT, channel VARCHAR, url VARCHAR, "
+            "date_time TIMESTAMP, extra VARCHAR) "
+            "WITH (connector = 'nexmark', nexmark_table = 'bid')")
+        s.run_sql("CREATE MATERIALIZED VIEW q AS SELECT auction, "
+                  "count(*) AS n, max(price) AS mx FROM bid "
+                  "GROUP BY auction")
+        assert "q" in s._spanning_specs, "q did not span workers"
+
+        def run_ticks(n: int) -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                s.tick()
+            return (n * s.chunks_per_tick * cap) / (
+                time.perf_counter() - t0)
+
+        run_ticks(2)                       # warm the compiled graphs
+        before = run_ticks(ticks)
+        t0 = time.perf_counter()
+        out = s.rescale("q", 4)
+        mid = run_ticks(ticks)
+        during_wall = time.perf_counter() - t0
+        # "during" folds the migration pause into the window's rate —
+        # the number a serving operator actually experiences
+        during = (ticks * s.chunks_per_tick * cap) / during_wall
+        after = run_ticks(ticks)
+        _emit({
+            "metric": "rescale_pause_ms", "unit": "ms",
+            "value": out["pause_ms"],
+            "rescale_pause_ms": out["pause_ms"],
+            "rescale_moved_vnodes": out["moved_vnodes"],
+            "rescale_rows_per_sec_before": round(before, 1),
+            "rescale_rows_per_sec_during": round(during, 1),
+            "rescale_rows_per_sec_after": round(after, 1),
+            "rescale_parallelism": out["parallelism"],
+            "rescale_mid_window_rows_per_sec": round(mid, 1),
+        })
+    finally:
+        s.close()
+
+
 def run_phase(n_chunks: int, q7_chunks: int, q8_chunks: int,
               q3_chunks: int) -> None:
     """Child entry: measure everything on this process's backend, print one
@@ -1081,6 +1139,22 @@ _SERVING_RESULT_FIELDS = (
     "serving_baseline_qps", "serving_baseline_p99_ms", "serving_speedup",
 )
 
+_RESCALE_RESULT_FIELDS = (
+    "rescale_pause_ms", "rescale_moved_vnodes",
+    "rescale_rows_per_sec_before", "rescale_rows_per_sec_during",
+    "rescale_rows_per_sec_after",
+)
+
+
+def measure_rescale_cpu() -> dict:
+    """The elastic-scaling phase on the CPU stand-in: a live 2→4 vnode
+    migration of a spanning job mid-stream, measuring the migration
+    pause and rows/s before/during/after (a Session-level measurement;
+    fresh subprocess like every phase)."""
+    env = {"JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": None, "TPU_LIBRARY_PATH": None}
+    return _spawn_phase("rescale_cpu", env, ["--rescale-phase"])
+
 
 def measure_serving_cpu() -> dict:
     """The serving phase on the CPU stand-in (a Session-level
@@ -1201,6 +1275,13 @@ _SHARED_FIELDS = (
     "serving_qps", "serving_point_qps", "serving_group_qps",
     "serving_p50_ms", "serving_p99_ms",
     "serving_baseline_qps", "serving_baseline_p99_ms", "serving_speedup",
+    # elastic scaling plane (meta/rescale.py): live-migration pause +
+    # throughput around a 2→4 rescale, present on every backend (a
+    # Session-level CPU measurement) so the TPU-outage fallback record
+    # stays schema-stable
+    "rescale_pause_ms", "rescale_moved_vnodes",
+    "rescale_rows_per_sec_before", "rescale_rows_per_sec_during",
+    "rescale_rows_per_sec_after",
 )
 
 
@@ -1239,6 +1320,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - attributed below
         sys.stderr.write(f"bench: serving phase failed: {e}\n")
         cpu["serving_error"] = str(e)
+    # elastic-scaling phase (Session-level, CPU): live 2→4 migration
+    # pause + rows/s around it; non-fatal like the serving phase
+    try:
+        rescale = measure_rescale_cpu()
+        for f in _RESCALE_RESULT_FIELDS:
+            cpu[f] = rescale.get(f)
+    except Exception as e:  # noqa: BLE001 - attributed below
+        sys.stderr.write(f"bench: rescale phase failed: {e}\n")
+        cpu["rescale_error"] = str(e)
     cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     tpu, tpu_err = measure_tpu()
     if tpu is not None:
@@ -1460,7 +1550,8 @@ def run_smoke() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] in ("--phase", "--probe",
                                              "--sharded-phase",
-                                             "--serving-phase"):
+                                             "--serving-phase",
+                                             "--rescale-phase"):
         watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
@@ -1491,6 +1582,20 @@ if __name__ == "__main__":
             except Exception as e:
                 _emit(_fail_line(
                     f"serving phase failed: {type(e).__name__}: {e}"))
+                raise SystemExit(2)
+            finally:
+                watchdog.cancel()
+            raise SystemExit(0)
+        if sys.argv[1] == "--rescale-phase":
+            watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                run_rescale_phase(
+                    int(sys.argv[2]) if len(sys.argv) > 2 else 6)
+            except Exception as e:
+                _emit(_fail_line(
+                    f"rescale phase failed: {type(e).__name__}: {e}"))
                 raise SystemExit(2)
             finally:
                 watchdog.cancel()

@@ -122,9 +122,16 @@ def main(argv=None) -> int:
                                       "hummock", "vacuum", "cluster"])
     ctl.add_argument("sub", nargs="?", default=None,
                      help="subcommand for `ctl cluster` "
-                     "(currently: fragments — dump the persisted "
-                     "fragment→worker placement and per-edge permit "
-                     "state of spanning jobs)")
+                     "(fragments — dump the persisted fragment→worker "
+                     "placement and per-edge permit state of spanning "
+                     "jobs; rescale — live-migrate one spanning job to "
+                     "a new parallelism; autoscaler — dump the scaling "
+                     "plane's policy state and executed migrations)")
+    ctl.add_argument("job", nargs="?", default=None,
+                     help="job name for `ctl cluster rescale`")
+    ctl.add_argument("--parallelism", type=int, default=None,
+                     help="target fragment parallelism for "
+                     "`ctl cluster rescale` (docs/scaling.md)")
     ctl.add_argument("--data-dir", required=True)
     ctl.add_argument("--backup-dir",
                      help="backup location for backup/restore/backup-info")
@@ -189,9 +196,15 @@ def _ctl(args) -> int:
         print(_json.dumps(desc, indent=2))
         return 0
     if args.what == "cluster":
-        if args.sub != "fragments":
-            raise SystemExit("usage: ctl cluster fragments --data-dir DIR")
-        return _ctl_cluster_fragments(args, _json)
+        if args.sub == "fragments":
+            return _ctl_cluster_fragments(args, _json)
+        if args.sub == "rescale":
+            return _ctl_cluster_rescale(args, _json)
+        if args.sub == "autoscaler":
+            return _ctl_cluster_autoscaler(args, _json)
+        raise SystemExit(
+            "usage: ctl cluster fragments|rescale|autoscaler "
+            "--data-dir DIR [JOB --parallelism N]")
     if args.what in ("hummock", "vacuum"):
         # storage-only inspection: no session (and no job recovery) —
         # read the version manifest straight off the object store
@@ -246,9 +259,6 @@ def _ctl_cluster_fragments(args, _json) -> int:
     meta = MetaService(data_dir=os.path.join(args.data_dir, "meta"))
     placements = meta.all_placements()
     meta.store.close()
-    n_workers = args.workers
-    for p in placements.values():
-        n_workers = max(n_workers, max(p.workers()) + 1)
     for job, p in sorted(placements.items()):
         print(f"-- {job} (root worker {p.root_worker})")
         for fid in sorted(p.actors):
@@ -262,7 +272,7 @@ def _ctl_cluster_fragments(args, _json) -> int:
     # live per-edge permit state: recover the cluster and scrape the
     # workers' exchange counters (skipped if bring-up fails — the
     # persisted placement above is still authoritative for WHERE)
-    args.workers = n_workers
+    args.workers = _infer_workers(args)
     try:
         session = _build_session(args)
     except Exception as e:  # noqa: BLE001 - offline dump already printed
@@ -279,6 +289,57 @@ def _ctl_cluster_fragments(args, _json) -> int:
                   f" bytes={e.get('bytes')}"
                   f" permits_waited={e.get('permits_waited')}"
                   f" backlog={e.get('backlog')}")
+    finally:
+        session.close()
+    return 0
+
+
+def _infer_workers(args) -> int:
+    """Workers needed to bring the persisted cluster up: the explicit
+    --workers, raised to cover every worker any persisted placement
+    names (a spanning job must find its per-worker stores)."""
+    import os
+    from .meta.service import MetaService
+    n_workers = args.workers
+    path = os.path.join(args.data_dir, "meta", "meta.jsonl")
+    if os.path.exists(path):
+        meta = MetaService(data_dir=os.path.join(args.data_dir, "meta"))
+        for p in meta.all_placements().values():
+            n_workers = max(n_workers, max(p.workers()) + 1)
+        meta.store.close()
+    return n_workers
+
+
+def _ctl_cluster_rescale(args, _json) -> int:
+    """`ctl cluster rescale JOB --parallelism N`: recover the cluster
+    from the durable dir, run the LIVE vnode migration (only the vnode
+    ranges whose owner changes move, as handoff refs — docs/scaling.md),
+    persist the new placement, and report what moved. Offline-safe in
+    the sense that it owns the cluster for the duration; a deployment
+    with its own live session must issue Session.rescale there instead."""
+    if not args.job or not args.parallelism:
+        raise SystemExit(
+            "usage: ctl cluster rescale JOB --parallelism N --data-dir DIR")
+    args.workers = max(_infer_workers(args), args.parallelism)
+    session = _build_session(args)
+    try:
+        out = session.rescale(args.job, args.parallelism)
+        session.flush()
+        print(_json.dumps(out, indent=2, default=str))
+    finally:
+        session.close()
+    return 0
+
+
+def _ctl_cluster_autoscaler(args, _json) -> int:
+    """`ctl cluster autoscaler`: dump the scaling plane's state —
+    policy streaks/cooldowns per job, decisions taken, executed
+    migrations and their moved vnode ranges (metrics()["autoscaler"])."""
+    args.workers = _infer_workers(args)
+    session = _build_session(args)
+    try:
+        print(_json.dumps(session.metrics().get("autoscaler", {}),
+                          indent=2, default=str))
     finally:
         session.close()
     return 0

@@ -180,6 +180,40 @@ class FaultConfig:
 
 
 @dataclasses.dataclass
+class AutoscalerConfig:
+    """Backlog-driven autoscaler policy (meta/autoscaler.py): watches
+    the per-edge exchange counters (permits_waited, backlog —
+    rpc/exchange.py EdgeStats) and the slow-epoch detector
+    (common/tracing.py) and grows/shrinks a spanning job's fragment
+    parallelism by issuing live rescale plans (meta/rescale.py,
+    docs/scaling.md). Hysteresis + cooldown keep it from flapping under
+    oscillating load; all thresholds are per observation (one barrier
+    tick)."""
+
+    enabled: bool = False
+    # scale-OUT triggers: any one sustained for ``hysteresis``
+    # consecutive observations fires target = parallelism * 2
+    high_backlog: int = 64            # queued chunks across the job's edges
+    high_permits_waited: int = 16     # new permit waits since last observe
+    high_slow_epochs: int = 1         # slow-epoch detections since last
+    # scale-IN: ALL load signals at/below these for ``scale_in_after``
+    # consecutive observations fires target = parallelism // 2
+    low_backlog: int = 0
+    low_permits_waited: int = 0
+    # consecutive high observations required before scaling out
+    hysteresis: int = 3
+    # observations after ANY decision during which no new decision may
+    # fire (and streaks reset) — the anti-flap guard
+    cooldown: int = 16
+    # consecutive all-quiet observations required before scaling in
+    # (deliberately >> hysteresis: scale-in re-migrates state, so it
+    # must be much lazier than scale-out)
+    scale_in_after: int = 32
+    min_parallelism: int = 1
+    max_parallelism: int = 8
+
+
+@dataclasses.dataclass
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 4566
@@ -194,6 +228,8 @@ class RwConfig:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     batch: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+    autoscaler: AutoscalerConfig = dataclasses.field(
+        default_factory=AutoscalerConfig)
 
 
 def _parse_toml_subset(text: str) -> dict:

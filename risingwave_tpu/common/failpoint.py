@@ -41,6 +41,11 @@ KNOWN_SITES: "set[str]" = {
     "compactor.merge.step",
     # sink delivery (stream/sink.py)
     "sink.deliver",
+    # elastic scaling plane (frontend/session.py _rescale_spanning):
+    # after the handoff export / after the placement commit — the
+    # rollback/roll-forward watershed of a live vnode migration
+    "rescale.migrate",
+    "rescale.commit",
     # meta store durable txn append (meta/store.py)
     "meta.store.txn",
 }

@@ -73,20 +73,20 @@ def vnode_to_shard(vnode: jax.Array, num_shards: int) -> jax.Array:
     return jnp.minimum(vnode // per, num_shards - 1).astype(jnp.int32)
 
 
-def shard_rows(key_types: Sequence, rows: Sequence, n_shards: int) -> list:
-    """Host-side partition of key-prefixed rows by the SAME vnode mapping
-    the device paths route with (``vnode_of → vnode_to_shard``): returns
-    ``n_shards`` row lists. Shared by every reload/re-shard surface
-    (stream/hash_agg.py shard filtering, parallel/fused.py recovery) so
-    durable-row placement can never diverge from live routing."""
+def vnodes_of_rows(key_types: Sequence, key_rows: Sequence) -> list:
+    """Host-side per-row vnode of key-value tuples, computed with the
+    SAME device hash every dispatch path routes with (``vnode_of``), so
+    migration filters, reload filters, and live routing can never
+    disagree. ``key_rows`` holds just the distribution-key values, in
+    key order."""
     import numpy as np
 
-    rows = list(rows)
-    out: list[list] = [[] for _ in range(n_shards)]
+    key_rows = list(key_rows)
+    out: list = []
     nk = len(key_types)
     bs = 1024
-    for i in range(0, len(rows), bs):
-        batch = rows[i:i + bs]
+    for i in range(0, len(key_rows), bs):
+        batch = key_rows[i:i + bs]
         cols = []
         for c in range(nk):
             vals = [r[c] for r in batch]
@@ -94,7 +94,37 @@ def shard_rows(key_types: Sequence, rows: Sequence, n_shards: int) -> list:
                             dtype=key_types[c].np_dtype)
             mask = np.array([v is not None for v in vals])
             cols.append(Column(jnp.asarray(data), jnp.asarray(mask)))
-        shard = np.asarray(vnode_to_shard(vnode_of(cols), n_shards))
-        for r, s in zip(batch, shard):
-            out[int(s)].append(r)
+        out.extend(int(v) for v in np.asarray(vnode_of(cols)))
+    return out
+
+
+def filter_rows_vnodes(key_types: Sequence, rows: Sequence,
+                       vnode_start: int, vnode_end: int,
+                       key_indices: Sequence[int] = None) -> list:
+    """Keep rows whose distribution key hashes into ``[vnode_start,
+    vnode_end)`` — the live-migration row filter (meta/rescale.py moves,
+    HashAggExecutor ``load_vnodes`` reload, worker root-scan slices).
+    ``key_indices`` names the key columns inside each row (default: the
+    first ``len(key_types)`` columns)."""
+    rows = list(rows)
+    if vnode_start <= 0 and vnode_end >= VNODE_COUNT:
+        return rows
+    idx = (list(range(len(key_types))) if key_indices is None
+           else list(key_indices))
+    vns = vnodes_of_rows(key_types, [[r[i] for i in idx] for r in rows])
+    return [r for r, vn in zip(rows, vns)
+            if vnode_start <= vn < vnode_end]
+
+
+def shard_rows(key_types: Sequence, rows: Sequence, n_shards: int) -> list:
+    """Host-side partition of key-prefixed rows by the SAME vnode mapping
+    the device paths route with (``vnode_of → vnode_to_shard``): returns
+    ``n_shards`` row lists. Shared by every reload/re-shard surface
+    (stream/hash_agg.py shard filtering, parallel/fused.py recovery) so
+    durable-row placement can never diverge from live routing."""
+    rows = list(rows)
+    out: list[list] = [[] for _ in range(n_shards)]
+    per = VNODE_COUNT // n_shards  # == vnode_to_shard's contiguous map
+    for r, vn in zip(rows, vnodes_of_rows(key_types, rows)):
+        out[min(vn // per, n_shards - 1)].append(r)
     return out

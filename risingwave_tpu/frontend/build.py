@@ -104,12 +104,18 @@ class BuildContext:
         source_factory: Callable[[P.PlanNode], Executor],
         config: Optional[BuildConfig] = None,
         durable: bool = True,
+        vnode_range: Optional[tuple] = None,
     ):
         self.store = store
         self.next_table_id = next_table_id
         self.source_factory = source_factory
         self.config = config or BuildConfig()
         self.durable = durable
+        # (vnode_start, vnode_end) owned by a SPANNING fragment actor:
+        # stateful executors reload only rows in this range, so a store
+        # holding ranges that migrated away (meta/rescale.py) never
+        # resurrects them into device state
+        self.vnode_range = vnode_range
         self.state_table_ids: list[int] = []
         # actor coroutine factories for multi-fragment builds; the
         # StreamJob spawns one task per entry alongside the root pipeline
@@ -182,7 +188,8 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
                 list(range(nk + 5)))     # keys + agg_idx/is_null/vi/vf/vs
             return MaterializedAggExecutor(
                 inp, list(plan.group_keys), list(plan.agg_calls),
-                state_table=st, out_capacity=cfg.chunk_capacity)
+                state_table=st, out_capacity=cfg.chunk_capacity,
+                load_vnodes=ctx.vnode_range)
         if (plan.group_keys and cfg.fragment_parallelism > 1
                 and cfg.mesh is None and ctx.durable):
             # multi-fragment build over the dispatch fabric; batch builds
@@ -206,6 +213,7 @@ def _build_plan(plan: P.PlanNode, ctx: BuildContext) -> Executor:
                 inp, list(plan.group_keys), list(plan.agg_calls),
                 state_table=st, table_capacity=cfg.agg_table_capacity,
                 out_capacity=cfg.chunk_capacity,
+                load_vnodes=ctx.vnode_range,
                 hbm_group_budget=cfg.agg_hbm_budget)
         from ..stream.simple_agg import simple_agg_state_schema
         st = ctx.state_table(simple_agg_state_schema(plan.agg_calls), [0])

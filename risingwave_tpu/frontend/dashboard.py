@@ -46,6 +46,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>exchange edges</h2><pre id="exchange">loading…</pre>
 <h2>serving plane</h2><pre id="serving">loading…</pre>
+<h2>scaling</h2><pre id="scaling">loading…</pre>
 <h2>chaos / fault plane</h2><pre id="chaos">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
@@ -66,6 +67,8 @@ async function loadStorage() {
     JSON.stringify(m.exchange || [], null, 2);
   document.getElementById("serving").textContent =
     JSON.stringify(m.serving || {}, null, 2);
+  document.getElementById("scaling").textContent =
+    JSON.stringify(m.autoscaler || {}, null, 2);
   document.getElementById("chaos").textContent =
     JSON.stringify(m.chaos || {}, null, 2);
   document.getElementById("metrics").textContent =

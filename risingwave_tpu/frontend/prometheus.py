@@ -151,6 +151,35 @@ def render_metrics(session) -> str:
             if isinstance(value, (int, float)):
                 lines.append(
                     f'rw_chaos_stat{{stat="{stat}"}} {value}')
+    scaler = m.get("autoscaler") or {}
+    if scaler:
+        # monotonic total, not the capped decision-history ring length
+        n_decisions = scaler.get("decisions_total",
+                                 len(scaler.get("decisions") or ()))
+        lines += ["# HELP rw_autoscaler_stat Elastic scaling plane "
+                  "counters (meta/autoscaler.py decisions, executed live "
+                  "migrations, moved vnodes).",
+                  "# TYPE rw_autoscaler_stat counter",
+                  f'rw_autoscaler_stat{{stat="decisions"}} '
+                  f'{n_decisions}',
+                  f'rw_autoscaler_stat{{stat="migrations"}} '
+                  f'{scaler.get("migrations", 0)}',
+                  f'rw_autoscaler_stat{{stat="moved_vnodes"}} '
+                  f'{scaler.get("moved_vnodes", 0)}',
+                  "# HELP rw_autoscaler_enabled Autoscaler policy "
+                  "armed (config [autoscaler] enabled).",
+                  "# TYPE rw_autoscaler_enabled gauge",
+                  f'rw_autoscaler_enabled '
+                  f'{1 if scaler.get("enabled") else 0}']
+        lines += ["# HELP rw_autoscaler_parallelism Observed fragment "
+                  "parallelism per spanning job.",
+                  "# TYPE rw_autoscaler_parallelism gauge"]
+        for job, st in sorted((scaler.get("jobs") or {}).items()):
+            sig = st.get("signals") or {}
+            if "parallelism" in sig:
+                lines.append(
+                    f'rw_autoscaler_parallelism{{job="{_sanitize(job)}"}} '
+                    f'{sig["parallelism"]}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

@@ -465,9 +465,14 @@ class ServingPlane:
             for sl in vnode_partitions(max(1, self.cfg.serving_tasks)):
                 reqs.append((worker, sl))
         else:
-            # each root actor's store IS its vnode slice — locality is
-            # the partition; no extra restriction needed
-            reqs = [(worker, None) for worker, _rng in hosts]
+            # each root actor serves ITS placed vnode range, explicitly:
+            # after a live migration (meta/rescale.py) a store may hold
+            # handed-off leftovers OUTSIDE the actor's owned range — an
+            # unrestricted scan would double-count them against the
+            # range's current owner (docs/scaling.md)
+            reqs = [(worker,
+                     None if rng is None else list(range(rng[0], rng[1])))
+                    for worker, rng in hosts]
         holder: dict = {"rows": []}
         merge = split.merge_executor(lambda: holder["rows"])
         from ..batch.executors import BatchFallback, run_batch

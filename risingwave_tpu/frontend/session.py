@@ -201,7 +201,8 @@ class Session:
                  state_store: Optional[str] = None,
                  compactors: int = 0,
                  rw_config=None,
-                 fault_config=None):
+                 fault_config=None,
+                 autoscaler_config=None):
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
         # style). Reference: load_config + SystemParams (config.rs:128).
@@ -338,6 +339,21 @@ class Session:
         self._jobs_to_recover: list[str] = []
         self._dead_jobs: set[str] = set()
         self.meta.on_job_failure(self._jobs_to_recover.append)
+        # elastic scaling plane (meta/rescale.py + meta/autoscaler.py):
+        # the autoscaler observes per-edge exchange pressure each tick
+        # and issues LIVE rescale plans; stats feed metrics()/Prometheus
+        from ..common.config import AutoscalerConfig
+        from ..meta.autoscaler import Autoscaler
+        self.autoscaler_config = (
+            autoscaler_config
+            or (rw_config.autoscaler if rw_config is not None
+                else AutoscalerConfig()))
+        self.autoscaler = Autoscaler(self.autoscaler_config)
+        self._rescale_stats: dict = {"migrations": 0, "moved_vnodes": 0,
+                                     "last": None, "history": []}
+        self._autoscaler_pw: dict[str, int] = {}
+        self._autoscaler_slow_seen = 0
+        self._in_rescale = False
         self.config = config or BuildConfig()
         self.checkpoint_frequency = checkpoint_frequency
         # barrier cadence for interval-driven drivers (CLI ticker); mutable
@@ -1579,7 +1595,8 @@ class Session:
         mv.table_id_range = (id_start, id_end)  # type: ignore[attr-defined]
         mv.span_workers = placement.workers()  # type: ignore[attr-defined]
         self.catalog_writer.add_mv(mv)
-        self.meta.save_placement(placement)
+        from ..meta.rescale import commit_placement
+        commit_placement(self.meta, placement)
         self.jobs[stmt.name] = SpanningJob(stmt.name, involved)
         self._spanning_specs[stmt.name] = spec
         self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
@@ -1595,11 +1612,15 @@ class Session:
         return []
 
     def _span_requests(self, name: str, spec: dict, fresh: bool,
-                       recover_at: Optional[int] = None) -> dict[int, dict]:
+                       recover_at: Optional[int] = None,
+                       import_refs: Optional[dict] = None) -> dict[int, dict]:
         """Per-worker ``create_fragments`` requests for one spanning job.
         Re-run at recovery with FRESH channel ids and the workers'
         CURRENT ports (a respawned worker listens on a new ephemeral
-        port), so edge specs always name live peers."""
+        port), so edge specs always name live peers. ``import_refs``
+        ((fragment, actor) → handoff segment paths) rides a LIVE RESCALE
+        deployment: the receiving worker imports those refs' rows before
+        building (meta/rescale.py, docs/scaling.md)."""
         from .plan_json import plan_to_json
         graph, placement = spec["graph"], spec["placement"]
         by_id = {w.worker_id: w for w in self.workers}
@@ -1655,14 +1676,23 @@ class Session:
                             "edge": edge(fid, ap.actor, d_fid, dp.actor),
                         } for dp in downs],
                     }
-                frag_specs[ap.worker].append({
+                fspec = {
                     "fid": fid, "actor": ap.actor,
                     "plan": plan_json,
                     "id_start": spec["id_start"] + fid * _SPAN_ID_STRIDE,
                     "shard_base": fid * 16,
                     "is_root": frag.is_root,
+                    # owned vnode range: stateful executors reload (and
+                    # the root MV serves scans for) ONLY this range, so
+                    # placement == routing survives live migrations
+                    "vnodes": [ap.vnode_start, ap.vnode_end],
                     "inputs": inputs, "output": out,
-                })
+                }
+                if import_refs:
+                    refs = import_refs.get((fid, ap.actor))
+                    if refs:
+                        fspec["import_refs"] = list(refs)
+                frag_specs[ap.worker].append(fspec)
         reqs = {}
         for w in spec["workers"]:
             reqs[w.worker_id] = {
@@ -1754,6 +1784,251 @@ class Session:
         self.meta.notifications.notify(
             "recovery", {"jobs": [name], "epoch": self.epoch})
         return [name]
+
+    # ------------------------------------- elastic scaling (live rescale) --
+
+    @_locked
+    def rescale(self, name: str, parallelism: int) -> dict:
+        """Change one MV job's fragment parallelism (docs/scaling.md).
+
+        * **spanning jobs** — LIVE vnode migration: pause the graph at an
+          aligned checkpoint barrier, hand off only the vnode ranges
+          whose owner changes as state refs (handoff segments on shared
+          storage), fence the old incarnation by generation, redeploy
+          with rewired exchange edges — no full-session restart, worker
+          processes stay up (reference: scale.rs:657).
+        * **session-local jobs** — no vnode-mapped placement exists;
+          delegates to ``reschedule`` (quiesce + rebuild from durable
+          state under the new ``fragment_parallelism``).
+        * **whole-job remote placements** — refused loudly
+          (``RescaleUnsupported``): a round-robined whole job has no
+          fragments to migrate (VERDICT #78 made this failure explicit
+          instead of silent).
+        """
+        from ..meta.rescale import RescaleUnsupported
+        if name in self._spanning_specs:
+            return self._rescale_spanning(name, parallelism)
+        if name in self._remote_specs:
+            raise RescaleUnsupported(
+                f"MV {name!r} is placed WHOLE-JOB on worker "
+                f"{self._remote_specs[name]['worker'].worker_id}: "
+                "round-robined whole-job placements carry no vnode-mapped "
+                "fragments, so there is nothing to migrate. DROP and "
+                "re-CREATE it under a span-capable shape (sourced plan, "
+                ">= 2 workers, fragment_parallelism >= 2) to make it "
+                "rescalable — see docs/scaling.md")
+        if name not in self.catalog.mvs:
+            raise SqlError(f"materialized view {name!r} not found "
+                           "(only MV jobs rescale)")
+        cfg = dataclasses.replace(self.config,
+                                  fragment_parallelism=max(1, parallelism))
+        self.reschedule(name, config=cfg)
+        return {"job": name, "mode": "local-rebuild",
+                "parallelism": max(1, parallelism), "moved_vnodes": 0}
+
+    def _rescale_spanning(self, name: str, parallelism: int) -> dict:
+        """Diff-based live vnode migration of one spanning job.
+
+        Protocol (every step under the API lock, the session being the
+        barrier conductor — "paused" means no barrier can be injected
+        while this runs):
+
+        1. **aligned barrier**: drain in-flight epochs + checkpoint
+           flush — every fragment's state durably committed at one cut
+           ``E`` on its own worker;
+        2. **plan**: ``meta.rescale.plan_rescale`` computes the new
+           placement (ranges == the ``vnode_to_shard`` routing) and the
+           minimal ``VnodeMove`` set;
+        3. **fence**: bump the session generation — the pre-rescale
+           incarnation can neither ack barriers nor commit;
+        4. **hand off**: each moving range's committed rows are exported
+           by the (still-live) source actors as handoff segments on
+           shared storage; only REFS travel to the destinations;
+        5. **pause actors**: stop + drop the job's actors on every old
+           worker (``drop_state=False`` — processes stay up, durable
+           state stays put);
+        6. **redeploy**: ``create_fragments`` under the new placement
+           with fresh exchange channels; destinations import their refs
+           before building, every actor reloads only its owned range;
+        7. **commit**: persist the placement (``commit_placement``) —
+           the rollback/roll-forward watershed — then init barriers.
+
+        A failure before step 7 ROLLS BACK (redeploy the old placement
+        from the untouched durable cut); after it, failures ROLL FORWARD
+        through the ordinary scoped recovery under the new placement.
+        """
+        import time as _time
+
+        from ..meta.rescale import RescaleUnsupported, plan_rescale
+        if self._in_rescale:
+            raise RuntimeError("a rescale is already in flight")
+        spec = self._spanning_specs[name]
+        graph, old_placement = spec["graph"], spec["placement"]
+        for w in self.workers:
+            self.meta.cluster.set_compute_state(
+                w.worker_id, "DOWN" if w.dead else "RUNNING")
+        worker_ids = [n.worker_id
+                      for n in self.meta.cluster.live_compute_nodes()]
+        plan = plan_rescale(name, graph, old_placement, worker_ids,
+                            parallelism)
+        new_par = max(len(a) for a in plan.new.actors.values())
+        if not plan.moves and plan.new.to_json() == old_placement.to_json():
+            return {"job": name, "mode": "noop", "parallelism": new_par,
+                    "moved_vnodes": 0, "pause_ms": 0.0}
+        by_id = {w.worker_id: w for w in self.workers}
+        missing = [wid for wid in plan.new.workers() if wid not in by_id]
+        if missing:
+            raise RescaleUnsupported(
+                f"rescale of {name!r} needs workers {missing} which this "
+                "session does not run")
+        # 1. aligned barrier: quiesce + checkpoint-commit the cut
+        t0 = _time.perf_counter()
+        self._in_rescale = True
+        try:
+            return self._rescale_spanning_locked(name, spec, plan,
+                                                 old_placement, by_id,
+                                                 new_par, t0)
+        finally:
+            self._in_rescale = False
+
+    def _rescale_spanning_locked(self, name: str, spec: dict, plan,
+                                 old_placement, by_id: dict,
+                                 new_par: int, t0: float) -> dict:
+        import os as _os
+        import time as _time
+
+        from ..common.failpoint import fail_point
+        from ..meta.rescale import commit_placement
+        from .remote import SpanningJob, WorkerDied
+        self._drain_inflight()
+        self.flush()
+        decided = self._span_decided_epoch(name, spec["workers"])
+        # 3. fence the pre-rescale incarnation
+        self._bump_generation()
+        old_workers = list(spec["workers"])
+        try:
+            # 4. export the moving ranges as state refs on shared storage
+            handoff_dir = _os.path.join(self._workers_base, "handoff",
+                                        name, f"g{self._generation}")
+            import_refs: dict[tuple, list] = {}
+            for (src_wid, fid), moves in sorted(
+                    plan.moves_by_source().items()):
+                resp = self._await(by_id[src_wid].request({
+                    "type": "rescale_export", "name": name,
+                    "fragment": fid,
+                    "ranges": [[m.vnode_start, m.vnode_end]
+                               for m in moves],
+                    "dir": handoff_dir}))
+                for ref, m in zip(resp["refs"], moves):
+                    import_refs.setdefault(
+                        (fid, m.to_actor), []).append(ref["path"])
+            fail_point("rescale.migrate")
+            # 5. pause: tear the actors down in place (no process restart)
+            job = self.jobs.pop(name, None)
+            if job is not None:
+                self._await(job.stop())
+                self._unsubscribe_job(job)
+                self.meta.deregister_job(name)
+                self._dead_jobs.discard(name)
+            for w in old_workers:
+                self._await(w.request(
+                    {"type": "drop_job", "name": name,
+                     "epoch": self._injected + 1, "drop_state": False}))
+            # 6. redeploy under the new placement, refs riding along
+            spec["placement"] = plan.new
+            spec["workers"] = [by_id[wid] for wid in plan.new.workers()]
+            spec["root_worker"] = by_id[plan.new.root_worker]
+            reqs = self._span_requests(name, spec, fresh=False,
+                                       recover_at=decided,
+                                       import_refs=import_refs)
+            for w in spec["workers"]:
+                self._await(w.request(reqs[w.worker_id]))
+        except (WorkerDied, RuntimeError, OSError) as e:
+            self._rollback_rescale(name, spec, old_placement, old_workers,
+                                   by_id)
+            raise RuntimeError(
+                f"rescale of {name!r} failed mid-migration; the job was "
+                f"rolled back to its previous placement") from e
+        # 7. COMMIT: the new placement becomes authoritative — failures
+        # from here roll FORWARD via scoped recovery under it
+        commit_placement(self.meta, plan.new)
+        # cached serving runners are bound to the PRE-rescale host set
+        # (remote two-phase tasks name workers + vnode slices): drop
+        # them — re-planning against the new placement is the only
+        # correct re-execution (frontend/serving.py)
+        self._serving.invalidate_catalog()
+        mv = self.catalog.mvs.get(name)
+        if mv is not None:
+            mv.span_workers = plan.new.workers()  # type: ignore[attr-defined]
+        self.jobs[name] = SpanningJob(name, spec["workers"])
+        self._pending_mutation = Mutation(MutationKind.UPDATE, name)
+        fail_point("rescale.commit")
+
+        async def _init_all() -> None:
+            await asyncio.gather(*(w.init_barrier(name, self.epoch)
+                                   for w in spec["workers"]))
+
+        try:
+            self._await(_init_all())
+        except (WorkerDied, RuntimeError):
+            # committed: the new placement is truth — roll forward
+            self._recover_spanning_job(name)
+        pause_ms = round((_time.perf_counter() - t0) * 1e3, 3)
+        out = {
+            "job": name, "mode": "live-migration",
+            "parallelism": new_par, "epoch": decided,
+            "moved_vnodes": plan.moved_vnodes,
+            "moved_ranges": [
+                {"fragment": m.fragment_id, "vnodes":
+                 [m.vnode_start, m.vnode_end],
+                 "from_worker": m.from_worker, "to_worker": m.to_worker}
+                for m in plan.moves],
+            "workers": plan.new.workers(),
+            "pause_ms": pause_ms,
+        }
+        self._rescale_stats["migrations"] += 1
+        self._rescale_stats["moved_vnodes"] += plan.moved_vnodes
+        self._rescale_stats["last"] = out
+        self._rescale_stats["history"].append(
+            {k: out[k] for k in ("job", "parallelism", "moved_vnodes",
+                                 "pause_ms", "epoch")})
+        del self._rescale_stats["history"][:-16]
+        self.meta.notifications.notify(
+            "rescale", {"job": name, "parallelism": new_par,
+                        "moved_vnodes": plan.moved_vnodes})
+        return out
+
+    def _rollback_rescale(self, name: str, spec: dict, old_placement,
+                          old_workers: list, by_id: dict) -> None:
+        """Migration failed before the placement commit: the OLD
+        placement is still authoritative. Drop whatever the attempt
+        half-deployed on ANY worker (a new worker's orphan fragments
+        would otherwise wedge its barrier collection forever), restore
+        the spec, and redeploy the old layout from the untouched durable
+        cut via the scoped-recovery machinery. Imported handoff rows a
+        destination already committed are benign leftovers: every reload
+        and scan filters to the actor's OWNED vnode range."""
+        from .remote import WorkerDied
+        spec["placement"] = old_placement
+        spec["workers"] = old_workers
+        spec["root_worker"] = by_id[old_placement.root_worker]
+        for w in self.workers:
+            if w.dead:
+                continue
+            try:
+                self._await(w.request(
+                    {"type": "drop_job", "name": name,
+                     "epoch": self._injected + 1, "drop_state": False}))
+            except (WorkerDied, RuntimeError):
+                pass
+        self._serving.invalidate_catalog()
+        try:
+            self._recover_spanning_job(name)
+        except Exception as e2:
+            raise RuntimeError(
+                f"rescale of {name!r} failed AND the rollback redeploy "
+                "failed; durable state is intact — restart the session "
+                "to restore the job") from e2
 
     def _create_sink(self, stmt: A.CreateSink) -> list:
         """CREATE SINK: a stream job whose terminal is a SinkExecutor over
@@ -1863,8 +2138,11 @@ class Session:
             raise SqlError(f"materialized view {name!r} not found "
                            "(only MV jobs reschedule)")
         if self._mv_worker(name) is not None:
-            raise SqlError("reschedule of a worker-hosted MV is not "
-                           "supported yet; drop and re-create it")
+            raise SqlError(
+                "reschedule of a worker-hosted MV is not supported; "
+                "spanning jobs rescale LIVE via Session.rescale / "
+                "`ctl cluster rescale` (docs/scaling.md), whole-job "
+                "placements must be dropped and re-created")
         self.flush()                       # all state durable + quiesced
         old_job = self.jobs[name]
         self._await(old_job.stop())
@@ -2639,7 +2917,79 @@ class Session:
                         if n in self.jobs:
                             self._dead_jobs.add(n)
                         self._jobs_to_recover.append(n)
+            if (self.autoscaler_config.enabled and self.workers
+                    and not self._in_rescale
+                    and not self._dead_jobs and not self._jobs_to_recover):
+                # backlog-driven autoscaling, AFTER failure handling: a
+                # cluster mid-recovery must heal, not rescale — and a
+                # rescale's own quiesce flush (a nested tick) must not
+                # re-enter the policy mid-migration
+                self._autoscaler_step()
         return self.epoch
+
+    def _autoscaler_step(self) -> None:
+        """One autoscaler observation per spanning job: fold this job's
+        per-edge exchange counters (backlog, permits_waited growth) and
+        the slow-epoch detector into the policy core
+        (meta/autoscaler.py); execute any decision as a live rescale.
+        A failed migration rolls back, notes the error, and holds the
+        cooldown — the autoscaler can never crash a tick."""
+        if not self._spanning_specs:
+            return          # nothing rescalable: skip the stats fan-out
+        stats = self._federate_worker_stats(force=True, timeout=0.5)
+        slow_delta = self._slow_epoch_total - self._autoscaler_slow_seen
+        self._autoscaler_slow_seen = self._slow_epoch_total
+        if len(self._spanning_specs) > 1:
+            # the slow-epoch detector times the WHOLE barrier tick, so
+            # with several spanning jobs it cannot name a culprit — one
+            # heavy job would scale out every idle sibling. Per-edge
+            # backlog/permit counters stay per-job; only they decide.
+            slow_delta = 0
+        live_workers = sum(1 for w in self.workers if not w.dead)
+        for name in list(self._spanning_specs):
+            placement = self._spanning_specs[name]["placement"]
+            par = max(len(a) for a in placement.actors.values())
+            backlog = pw = 0
+            for _wid, st in sorted(stats.items()):
+                for e in st.get("exchange", ()) or ():
+                    if str(e.get("edge", "")).startswith(f"{name}:"):
+                        backlog += int(e.get("backlog", 0) or 0)
+                        pw += int(e.get("permits_waited", 0) or 0)
+            pw_delta = max(0, pw - self._autoscaler_pw.get(name, 0))
+            self._autoscaler_pw[name] = pw
+            target = self.autoscaler.observe(
+                name, par, backlog=backlog, permits_waited=pw_delta,
+                slow_epochs=slow_delta, live_workers=live_workers)
+            if target is None or target == par:
+                continue
+            try:
+                self.rescale(name, target)
+            except Exception as e:  # noqa: BLE001 - rolled back + held
+                self.autoscaler.note_failed(name, repr(e))
+
+    @_locked
+    def set_source_rate(self, chunks_per_tick: int) -> None:
+        """Adjust the per-tick source generation rate LIVE, session-side
+        and on every worker (``set_rate`` frames) — the traffic-spike
+        lever the sim's autoscaler scenario drives (sim.py
+        run_traffic_spike)."""
+        self.chunks_per_tick = max(0, int(chunks_per_tick))
+        if not self.workers:
+            return
+        from .remote import WorkerDied
+
+        async def _all() -> None:
+            for w in self.workers:
+                if w.dead:
+                    continue
+                try:
+                    await w.request({"type": "set_rate",
+                                     "chunks_per_tick":
+                                     self.chunks_per_tick})
+                except WorkerDied:
+                    pass          # recovery re-ships chunks_per_tick
+
+        self._await(_all())
 
     def _complete_oldest(self) -> None:
         self._enter_mutation()
@@ -2932,7 +3282,7 @@ class Session:
             from .plan_json import defs_to_json, plan_to_json
             plan_json = plan_to_json(node)
             defs_json = defs_to_json([base.mv])
-            workers = [w for w, _rng in self._mv_hosts(name)]
+            hosts = self._mv_hosts(name)
             types = [f.type for f in node.schema]
 
             def fetch():
@@ -2944,13 +3294,20 @@ class Session:
                 # outlive the control-frame deadline — unbounded here;
                 # wedge detection stays the barrier deadline's job. A
                 # sharded-root MV's stage runs on EVERY slice-holding
-                # worker; chains are slice-safe, so the union is exact.
+                # worker, each restricted to ITS placed vnode range — a
+                # live migration (meta/rescale.py) can leave handed-off
+                # rows behind in a store, and an unrestricted scan would
+                # union them twice against the range's current owner.
                 async def _all():
+                    def req(rng):
+                        frame = {"type": "batch_task", "job": name,
+                                 "plan": plan_json, "defs": defs_json}
+                        if rng is not None:
+                            frame["vnodes"] = list(range(rng[0], rng[1]))
+                        return frame
                     return await asyncio.gather(*(
-                        w.request({"type": "batch_task", "job": name,
-                                   "plan": plan_json,
-                                   "defs": defs_json}, timeout=0)
-                        for w in workers))
+                        w.request(req(rng), timeout=0)
+                        for w, rng in hosts))
 
                 rows = []
                 for resp in self._await(_all()):
@@ -3235,6 +3592,20 @@ class Session:
         out["chaos"]["workers"] = {
             wid: st["chaos"] for wid, st in sorted(worker_stats.items())
             if st.get("chaos")}
+        # elastic scaling plane (meta/rescale.py + meta/autoscaler.py):
+        # policy state + executed migrations + per-worker handoff rows
+        out["autoscaler"] = {
+            "enabled": self.autoscaler_config.enabled,
+            **self.autoscaler.status(),
+            "migrations": self._rescale_stats["migrations"],
+            "moved_vnodes": self._rescale_stats["moved_vnodes"],
+            "last_rescale": self._rescale_stats["last"],
+            "rescale_history": list(self._rescale_stats["history"]),
+            "handoff_rows": {
+                wid: st["rescale"]
+                for wid, st in sorted(worker_stats.items())
+                if st.get("rescale")},
+        }
         exchange: list = []
         for wid, st in sorted(worker_stats.items()):
             # live local jobs win over cached worker snapshots of the

@@ -1,0 +1,145 @@
+"""Backlog-driven autoscaler: the decision core of the elastic scaling
+plane.
+
+Counterpart of what the reference leaves to operators + external
+controllers (its scale controller executes *requested* reschedules,
+scale.rs:657; cloud deployments close the loop outside the kernel). Here
+the loop closes inside the meta tier: the Session feeds one observation
+per barrier tick — the job's per-edge exchange pressure (``backlog``
+queued chunks, ``permits_waited`` growth: rpc/exchange.py EdgeStats,
+federated via worker stats) and slow-epoch detections (common/tracing.py)
+— and this class answers with a target parallelism when the policy says
+to act. The Session then executes the decision as a LIVE vnode migration
+(frontend/session.py ``rescale`` over meta/rescale.py plans).
+
+The class is deliberately pure-state (no Session reference, no clock):
+hysteresis, cooldown, and scale-in laziness are unit-testable against
+synthetic signal streams (tests/test_rescale_live.py), and the same
+instance serves the deterministic sim's traffic-spike scenario
+(sim.py run_traffic_spike).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..common.config import AutoscalerConfig
+
+
+@dataclasses.dataclass
+class _JobState:
+    high_streak: int = 0
+    low_streak: int = 0
+    cooldown: int = 0
+    observations: int = 0
+    last_signals: Optional[dict] = None
+    last_error: Optional[str] = None
+
+
+class Autoscaler:
+    """Hysteresis + cooldown policy over per-job load signals.
+
+    ``observe`` returns the target parallelism when a decision fires,
+    else None. Anti-flap contract (pinned by tests): no decision while a
+    cooldown runs (streaks do not even accumulate), scale-out needs
+    ``hysteresis`` CONSECUTIVE high observations, scale-in needs
+    ``scale_in_after`` consecutive all-quiet ones — so load oscillating
+    faster than the hysteresis window produces no decisions at all, and
+    a spike followed by quiet produces exactly one scale-out."""
+
+    def __init__(self, cfg: Optional[AutoscalerConfig] = None):
+        self.cfg = cfg or AutoscalerConfig()
+        self.jobs: Dict[str, _JobState] = {}
+        self.decisions: List[dict] = []
+        self.decisions_total = 0  # monotonic (decisions is a capped ring)
+
+    def _state(self, job: str) -> _JobState:
+        return self.jobs.setdefault(job, _JobState())
+
+    def observe(self, job: str, parallelism: int, backlog: int = 0,
+                permits_waited: int = 0, slow_epochs: int = 0,
+                live_workers: Optional[int] = None) -> Optional[int]:
+        cfg = self.cfg
+        # a spanning rescale needs `target` DISTINCT live workers
+        # (meta/rescale.py plan_rescale refuses otherwise): cap the
+        # reachable parallelism so the policy never decides a migration
+        # the cluster cannot execute — an uncapped decision would burn a
+        # cooldown on a guaranteed RescaleUnsupported every window
+        max_par = (cfg.max_parallelism if live_workers is None
+                   else min(cfg.max_parallelism, live_workers))
+        st = self._state(job)
+        st.observations += 1
+        st.last_signals = {"backlog": int(backlog),
+                           "permits_waited": int(permits_waited),
+                           "slow_epochs": int(slow_epochs),
+                           "parallelism": int(parallelism)}
+        if st.cooldown > 0:
+            # anti-flap: inside the cooldown window signals are recorded
+            # but never accumulate toward a decision
+            st.cooldown -= 1
+            st.high_streak = st.low_streak = 0
+            return None
+        high = (backlog >= cfg.high_backlog
+                or permits_waited >= cfg.high_permits_waited
+                or slow_epochs >= cfg.high_slow_epochs)
+        low = (backlog <= cfg.low_backlog
+               and permits_waited <= cfg.low_permits_waited
+               and slow_epochs == 0)
+        if high:
+            st.high_streak += 1
+            st.low_streak = 0
+        elif low:
+            st.low_streak += 1
+            st.high_streak = 0
+        else:
+            st.high_streak = st.low_streak = 0
+        target: Optional[int] = None
+        reason = None
+        if (st.high_streak >= cfg.hysteresis
+                and parallelism < max_par):
+            target = min(max_par, max(parallelism * 2,
+                                      parallelism + 1))
+            reason = "scale-out"
+        elif (st.low_streak >= cfg.scale_in_after
+                and parallelism > cfg.min_parallelism):
+            target = max(cfg.min_parallelism, parallelism // 2)
+            reason = "scale-in"
+        if target is None or target == parallelism:
+            return None
+        st.cooldown = cfg.cooldown
+        st.high_streak = st.low_streak = 0
+        self.decisions_total += 1
+        self.decisions.append({
+            "job": job, "reason": reason, "from": int(parallelism),
+            "to": int(target), "at_observation": st.observations,
+            "signals": dict(st.last_signals)})
+        del self.decisions[:-64]
+        return target
+
+    def note_failed(self, job: str, error: str) -> None:
+        """A decided rescale failed to execute (rolled back): remember
+        the error and hold the full cooldown before retrying, so a
+        persistently failing migration cannot busy-loop."""
+        st = self._state(job)
+        st.last_error = error
+        st.cooldown = max(st.cooldown, self.cfg.cooldown)
+
+    def status(self) -> dict:
+        """Policy-state dump for metrics()/`ctl cluster autoscaler`."""
+        return {
+            "decisions": list(self.decisions),
+            "decisions_total": self.decisions_total,
+            "last_trigger": self.decisions[-1] if self.decisions else None,
+            "jobs": {
+                job: {
+                    "high_streak": st.high_streak,
+                    "low_streak": st.low_streak,
+                    "cooldown": st.cooldown,
+                    "observations": st.observations,
+                    "signals": st.last_signals,
+                    "last_error": st.last_error,
+                }
+                for job, st in sorted(self.jobs.items())
+            },
+        }

@@ -1,0 +1,221 @@
+"""Elastic scaling plane: placement diffing for live vnode migration.
+
+Counterpart of the reference's scale controller
+(reference: src/meta/src/stream/scale.rs:657 — a Reschedule command
+computes, per fragment, which vnode-bitmap ranges change owner and
+rebuilds only the affected actors, shipping state as shared-storage
+references instead of replaying sources). This module is the PURE math
+half of that controller: given a deployed ``FragmentPlacement`` and a
+target parallelism it produces a new placement whose actor ranges still
+equal the ``vnode_to_shard`` contiguous mapping (the routing function —
+placement and routing can never diverge) while moving the MINIMAL set of
+vnode ranges, plus the explicit ``VnodeMove`` list the migration protocol
+executes (frontend/session.py ``rescale``; worker state-ref handoff in
+worker/host.py).
+
+This module is also the single write path for placement mutations:
+``commit_placement`` is the only caller of ``MetaService.save_placement``
+outside the service itself (scripts/check.sh lints this), so every
+``placement/<job>`` meta-store write is attributable to either job
+creation or an executed rescale plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .fragment import ActorPlacement, FragmentPlacement, SpanGraph, shardable
+
+
+class RescaleUnsupported(ValueError):
+    """A rescale request the scaling plane cannot execute (documented in
+    docs/scaling.md): whole-job remote placements have no vnode-mapped
+    fragments to migrate, and a spanning rescale needs at least
+    ``parallelism`` live workers."""
+
+
+@dataclasses.dataclass(frozen=True)
+class VnodeMove:
+    """One contiguous vnode range of one fragment changing owner."""
+
+    fragment_id: int
+    vnode_start: int
+    vnode_end: int
+    from_worker: int
+    from_actor: int
+    to_worker: int
+    to_actor: int
+
+    @property
+    def width(self) -> int:
+        return self.vnode_end - self.vnode_start
+
+
+@dataclasses.dataclass
+class RescalePlan:
+    job: str
+    old: FragmentPlacement
+    new: FragmentPlacement
+    moves: List[VnodeMove]
+
+    @property
+    def moved_vnodes(self) -> int:
+        return sum(m.width for m in self.moves)
+
+    def moves_by_source(self) -> Dict[Tuple[int, int], List[VnodeMove]]:
+        """Moves grouped by (from_worker, fragment) — one export request
+        per group (the source worker writes one handoff segment per
+        moving range)."""
+        out: Dict[Tuple[int, int], List[VnodeMove]] = {}
+        for m in self.moves:
+            out.setdefault((m.from_worker, m.fragment_id), []).append(m)
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "job": self.job,
+            "moves": [dataclasses.asdict(m) for m in self.moves],
+            "moved_vnodes": self.moved_vnodes,
+            "workers_before": self.old.workers(),
+            "workers_after": self.new.workers(),
+        }
+
+
+def actor_ranges(vnode_count: int, n: int) -> List[Tuple[int, int]]:
+    """The contiguous per-actor vnode ranges for ``n`` actors — EXACTLY
+    the ``vnode_to_shard`` mapping (common/hashing.py): actor ``a`` owns
+    ``[a*per, (a+1)*per)`` with the last actor absorbing the remainder,
+    so the persisted placement IS the routing function."""
+    if n < 1:
+        raise ValueError("parallelism must be >= 1")
+    per = vnode_count // n
+    if per == 0:
+        raise ValueError(f"parallelism {n} exceeds vnode count {vnode_count}")
+    return [(a * per, vnode_count if a == n - 1 else (a + 1) * per)
+            for a in range(n)]
+
+
+def _overlap(a: Tuple[int, int], b: Tuple[int, int]) -> int:
+    return max(0, min(a[1], b[1]) - max(a[0], b[0]))
+
+
+def plan_rescale(job: str, graph: SpanGraph, old: FragmentPlacement,
+                 worker_ids: List[int], parallelism: int,
+                 vnode_count: Optional[int] = None) -> RescalePlan:
+    """Compute the minimal-movement placement for a new parallelism.
+
+    Shardable fragments (meta/fragment.py ``shardable``) change actor
+    count; each NEW contiguous range is assigned to the distinct worker
+    owning the LARGEST slice of it under the old placement (both
+    partitions are contiguous and ordered, so this greedy assignment
+    moves only the ranges whose owner must change — for 2→4 over one
+    fragment exactly half the ring moves, for 4→2 likewise). Singleton
+    and source fragments keep their placement verbatim: nothing of
+    theirs moves. Raises ``RescaleUnsupported`` when fewer than
+    ``parallelism`` distinct live workers exist."""
+    if vnode_count is None:
+        from ..common.hashing import VNODE_COUNT
+        vnode_count = VNODE_COUNT
+    if parallelism < 1:
+        raise RescaleUnsupported(f"parallelism must be >= 1, got "
+                                 f"{parallelism}")
+    if not worker_ids:
+        raise RescaleUnsupported("no live workers")
+    actors: Dict[int, List[ActorPlacement]] = {}
+    # global vnode balance across fragments for overlap-free assignments
+    vnodes_of: Dict[int, int] = {w: 0 for w in worker_ids}
+    for fid in sorted(graph.fragments):
+        frag = graph.fragments[fid]
+        old_acts = old.actors[fid]
+        if not shardable(frag):
+            kept = [dataclasses.replace(a) for a in old_acts]
+            for a in kept:
+                if a.worker in vnodes_of:
+                    vnodes_of[a.worker] += a.vnode_end - a.vnode_start
+            actors[fid] = kept
+            continue
+        n = parallelism
+        if n > len(worker_ids):
+            raise RescaleUnsupported(
+                f"fragment {fid} needs {n} distinct workers, "
+                f"only {len(worker_ids)} are live")
+        ranges = actor_ranges(vnode_count, n)
+        assign: List[Optional[int]] = [None] * n
+        taken: set = set()
+        # pass 1: keep ranges with their largest old owner, biggest
+        # overlaps first — burning a worker on a zero-overlap range
+        # while it still owns another range would move vnodes for free
+        pairs = []
+        for a, rng in enumerate(ranges):
+            for oa in old_acts:
+                if oa.worker not in vnodes_of:
+                    continue
+                ov = _overlap(rng, (oa.vnode_start, oa.vnode_end))
+                if ov > 0:
+                    pairs.append((-ov, a, oa.worker))
+        for neg_ov, a, w in sorted(pairs):
+            if assign[a] is None and w not in taken:
+                assign[a] = w
+                taken.add(w)
+        # pass 2: genuinely new ranges go to the least-loaded free worker
+        acts: List[ActorPlacement] = []
+        for a, (start, end) in enumerate(ranges):
+            w = assign[a]
+            if w is None:
+                free = [x for x in worker_ids if x not in taken]
+                if not free:
+                    raise RescaleUnsupported(
+                        f"fragment {fid} needs {n} distinct workers")
+                w = min(free, key=lambda x: (vnodes_of[x], x))
+                taken.add(w)
+            vnodes_of[w] += end - start
+            acts.append(ActorPlacement(fid, a, w, start, end))
+        actors[fid] = acts
+    new = FragmentPlacement(job, actors,
+                            root_worker=actors[graph.root_id][0].worker)
+    return RescalePlan(job, old, new, diff_placements(old, new))
+
+
+def diff_placements(old: FragmentPlacement,
+                    new: FragmentPlacement) -> List[VnodeMove]:
+    """The vnode ranges whose OWNER changes between two placements of
+    the same fragment graph — the only state the migration protocol
+    touches (everything else stays in place on its worker). Ranges are
+    split at every old/new actor boundary so each move names exactly one
+    (source actor, destination actor) pair."""
+    moves: List[VnodeMove] = []
+    for fid in sorted(new.actors):
+        old_acts = old.actors.get(fid, [])
+        cuts = sorted({a.vnode_start for a in old_acts}
+                      | {a.vnode_end for a in old_acts}
+                      | {a.vnode_start for a in new.actors[fid]}
+                      | {a.vnode_end for a in new.actors[fid]})
+        for s, e in zip(cuts, cuts[1:]):
+            src = next((a for a in old_acts
+                        if a.vnode_start <= s and e <= a.vnode_end), None)
+            dst = next((a for a in new.actors[fid]
+                        if a.vnode_start <= s and e <= a.vnode_end), None)
+            if src is None or dst is None or src.worker == dst.worker:
+                continue
+            prev = moves[-1] if moves else None
+            if (prev is not None and prev.fragment_id == fid
+                    and prev.vnode_end == s
+                    and prev.from_worker == src.worker
+                    and prev.from_actor == src.actor
+                    and prev.to_worker == dst.worker
+                    and prev.to_actor == dst.actor):
+                moves[-1] = dataclasses.replace(prev, vnode_end=e)
+            else:
+                moves.append(VnodeMove(fid, s, e, src.worker, src.actor,
+                                       dst.worker, dst.actor))
+    return moves
+
+
+def commit_placement(meta, placement: FragmentPlacement) -> None:
+    """Persist a placement mutation. The ONLY sanctioned write path for
+    ``placement/<job>`` outside MetaService itself (and the lint in
+    scripts/check.sh keeps it that way): job creation and executed
+    rescale plans both commit through here, so the durable mapping is
+    always one the scheduler or the scaling plane produced."""
+    meta.save_placement(placement)

@@ -510,6 +510,117 @@ def run_netsplit(name: str, seed: int = 7, data_dir: Optional[str] = None,
 
 
 # ---------------------------------------------------------------------------
+# Traffic-spike scenario (elastic scaling plane, docs/scaling.md)
+# ---------------------------------------------------------------------------
+
+def run_traffic_spike(seed: int = 7, data_dir: Optional[str] = None,
+                      workers: int = 4, warmup_ticks: int = 2,
+                      spike_rate: int = 8, settle_ticks: int = 6,
+                      chunk_capacity: int = 32) -> dict:
+    """The scaling plane's acceptance scenario: a spanning grouped-agg
+    job runs at parallelism 2 on a ``workers``-process cluster with the
+    autoscaler armed; a seeded traffic spike (source rate jumps to
+    ``spike_rate`` chunks/tick over a tiny exchange permit budget)
+    drives permits_waited up, the autoscaler scales the job out 2→4 via
+    LIVE vnode migration (only the changed ranges move — asserted from
+    the migration metrics), and when the load subsides the policy's
+    cooldown + scale-in laziness keep it from flapping. The end state is
+    cross-checked bit-exact against a no-spike-plumbing control and the
+    ConsistencyAuditor must come back green."""
+    import tempfile
+
+    from .common.audit import ConsistencyAuditor
+    from .common.config import AutoscalerConfig, FaultConfig
+    from .frontend.build import BuildConfig
+
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_spike_")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(data_dir, "jax_cache"))
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    acfg = AutoscalerConfig(
+        enabled=True, high_permits_waited=1, hysteresis=2, cooldown=8,
+        scale_in_after=64, max_parallelism=min(4, workers))
+    fc = FaultConfig(worker_epoch_timeout_s=60.0,
+                     worker_request_timeout_s=120.0,
+                     exchange_keepalive_s=0.0)
+    sim = SimCluster(data_dir, seed=seed, kill_rate=0.0, workers=workers,
+                     source_chunk_capacity=chunk_capacity,
+                     checkpoint_frequency=2, fault_config=fc,
+                     config=BuildConfig(fragment_parallelism=2,
+                                        exchange_permits=2),
+                     autoscaler_config=acfg)
+    control = Session(seed=42, source_chunk_capacity=chunk_capacity,
+                      checkpoint_frequency=2)
+    mv = "q"
+    try:
+        for sess in (sim.session, control):
+            sess.run_sql(_BID_DDL)
+            sess.run_sql(_AGG)
+        assert mv in sim.session._spanning_specs, \
+            f"{mv} did not deploy as a spanning graph"
+        spec = sim.session._spanning_specs[mv]
+        assert max(len(a) for a in
+                   spec["placement"].actors.values()) == 2
+
+        def par() -> int:
+            return max(len(a) for a in spec["placement"].actors.values())
+
+        for _ in range(warmup_ticks):
+            sim.tick()
+            control.tick()
+        # SPIKE: raise the source rate on chaos side AND control — the
+        # control consumes the same rows without the scaling plumbing
+        sim.session.set_source_rate(spike_rate)
+        control.chunks_per_tick = spike_rate
+        spike_ticks = 0
+        for _ in range(24):
+            sim.tick()
+            control.tick()
+            spike_ticks += 1
+            if par() == acfg.max_parallelism:
+                break
+        assert par() == acfg.max_parallelism, (
+            f"autoscaler never scaled out (parallelism {par()}, "
+            f"status {sim.session.autoscaler.status()})")
+        decisions_at_peak = len(sim.session.autoscaler.decisions)
+        last = sim.session._rescale_stats["last"]
+        moved = last["moved_vnodes"]
+        from .common.hashing import VNODE_COUNT
+        # only the CHANGED ranges moved: one sharded fragment halves its
+        # per-actor ranges, so exactly half the ring changes owner
+        assert moved == VNODE_COUNT // 2, (
+            f"expected {VNODE_COUNT // 2} moved vnodes, got {moved}: "
+            f"{last['moved_ranges']}")
+        # SUBSIDE: load returns to 1 chunk/tick; cooldown + scale-in
+        # laziness must keep the topology steady (no flapping)
+        sim.session.set_source_rate(1)
+        control.chunks_per_tick = 1
+        for _ in range(settle_ticks):
+            sim.tick()
+            control.tick()
+        assert par() == acfg.max_parallelism, "autoscaler flapped"
+        assert len(sim.session.autoscaler.decisions) == \
+            decisions_at_peak, "autoscaler flapped after load subsided"
+        sim.verify_against(control, [mv])
+        report = ConsistencyAuditor(sim.session).audit(control=control)
+        report.assert_ok()
+        metrics = sim.session.metrics()
+        return {
+            "scenario": "traffic_spike", "seed": seed,
+            "parallelism": par(), "moved_vnodes": moved,
+            "pause_ms": last["pause_ms"],
+            "spike_ticks": spike_ticks,
+            "decisions": list(sim.session.autoscaler.decisions),
+            "rows": len(sim.mv_rows(mv)),
+            "audit": {k: v.get("ok") for k, v in report.checks.items()},
+        }
+    finally:
+        sim.close()
+        control.close()
+
+
+# ---------------------------------------------------------------------------
 # Crash-point sweep (die at every registered failpoint, audit after each)
 # ---------------------------------------------------------------------------
 
@@ -753,6 +864,11 @@ def main(argv=None) -> int:
                          "injection traces are identical")
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--spanning-sweep", action="store_true")
+    ap.add_argument("--traffic-spike", action="store_true",
+                    help="run the elastic-scaling acceptance scenario: "
+                         "seeded load spike → autoscaler live-rescales "
+                         "2→4 → no flap on subside → audit green "
+                         "(docs/scaling.md)")
     ap.add_argument("--sites", default=None,
                     help="comma-separated failpoint subset for --sweep")
     args = ap.parse_args(argv)
@@ -780,6 +896,11 @@ def main(argv=None) -> int:
         res = crash_point_sweep_spanning(
             tempfile.mkdtemp(prefix="rwtpu_span_"))
         print(json.dumps(res, indent=2))
+    if args.traffic_spike:
+        res = run_traffic_spike(
+            seed=args.seed,
+            data_dir=tempfile.mkdtemp(prefix="rwtpu_spike_"))
+        print(json.dumps(res, indent=2, default=str))
     return 0
 
 

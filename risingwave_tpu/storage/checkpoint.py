@@ -155,6 +155,10 @@ class CheckpointLog:
         data = self.store.get(name)
         if data is None:
             raise FileNotFoundError(name)
+        return self._decode_segment(data)
+
+    @staticmethod
+    def _decode_segment(data: bytes) -> dict[int, dict[bytes, Optional[bytes]]]:
         pos = 0
         (n_tables,) = struct.unpack_from("<I", data, pos)
         pos += 4
@@ -409,6 +413,34 @@ class CheckpointLog:
                 self.store.delete(n)
 
 
+# -- vnode-migration handoff segments (elastic scaling plane) ----------------
+# A live rescale (meta/rescale.py, docs/scaling.md) moves only the vnode
+# ranges whose owner changes. The SOURCE worker writes each moving
+# range's committed rows as ONE handoff segment on shared storage (the
+# same wire format as checkpoint segments) and the migration protocol
+# hands the DESTINATION a *reference* — the path — instead of shipping
+# rows through the session or replaying sources (reference: scale.rs:657
+# moving Hummock SST references between parallel units).
+
+
+def write_handoff(path: str,
+                  deltas: dict[int, dict[bytes, Optional[bytes]]]) -> None:
+    """Durably write one handoff segment (fsync before rename so a ref
+    never names a torn object)."""
+    payload = CheckpointLog._encode_segment(deltas)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_handoff(path: str) -> dict[int, dict[bytes, Optional[bytes]]]:
+    with open(path, "rb") as f:
+        return CheckpointLog._decode_segment(f.read())
+
+
 class DurableStateStore(MemoryStateStore):
     """MemoryStateStore whose epoch commits are persisted through a
     CheckpointLog; a fresh instance over the same directory recovers the
@@ -474,6 +506,27 @@ class DurableStateStore(MemoryStateStore):
                             tables=len(deltas)):
                 self.log.append_epoch(epoch, deltas)
         super().commit(epoch)
+
+    def import_tables(self, deltas: dict[int, dict[bytes, bytes]],
+                      epoch: int) -> int:
+        """Apply a migration handoff straight into the COMMITTED tier
+        (memory + a durable segment): the rows were committed at
+        ``epoch`` by their previous owner, so they enter this store as
+        already-committed state, not as a pending epoch a later barrier
+        must settle. Returns the number of rows imported."""
+        deltas = {tid: dict(rows) for tid, rows in deltas.items() if rows}
+        if not deltas:
+            return 0
+        n = 0
+        for tid, rows in deltas.items():
+            tbl = self._committed.setdefault(tid, {})
+            self._keys_dirty.add(tid)
+            for k, v in rows.items():
+                tbl[k] = v
+            n += len(rows)
+        self.log.append_epoch(max(epoch, self.committed_epoch), deltas)
+        self.committed_epoch = max(self.committed_epoch, epoch)
+        return n
 
     def drop_table(self, table_id: int) -> None:
         super().drop_table(table_id)

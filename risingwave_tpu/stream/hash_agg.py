@@ -58,6 +58,7 @@ class HashAggExecutor(SingleInputExecutor):
         table_capacity: int = 1 << 16,
         out_capacity: int = DEFAULT_CHUNK_CAPACITY,
         load_shard: Optional[tuple] = None,
+        load_vnodes: Optional[tuple] = None,
         hbm_group_budget: Optional[int] = None,
     ):
         """``load_shard``: (shard_idx, n_shards) for fragmented builds —
@@ -65,6 +66,14 @@ class HashAggExecutor(SingleInputExecutor):
         recovery keeps only the rows whose group key hashes to its shard
         (vnode reassignment across a parallelism change, reference:
         stream/scale.rs:657 vnode-bitmap updates).
+
+        ``load_vnodes``: (vnode_start, vnode_end) for SPANNING fragment
+        actors (meta-placed vnode ranges): recovery keeps only rows in
+        the owned range. After a live vnode migration the actor's local
+        store may hold rows for ranges that moved away (and an imported
+        handoff may sit beside foreign leftovers) — this filter is what
+        makes reload placement equal live routing regardless of
+        migration history (meta/rescale.py, docs/scaling.md).
 
         ``hbm_group_budget``: cap on LIVE groups held in device memory.
         When a checkpoint finds more, the coldest (LRU by touch step) are
@@ -84,6 +93,7 @@ class HashAggExecutor(SingleInputExecutor):
                     f"{c.kind}{'(distinct)' if c.distinct else ''} needs "
                     "materialized-input state (stream/materialized_agg.py)")
         self.load_shard = load_shard
+        self.load_vnodes = load_vnodes
         if hbm_group_budget is not None:
             if state_table is None:
                 hbm_group_budget = None       # no cold tier to evict to
@@ -372,6 +382,12 @@ class HashAggExecutor(SingleInputExecutor):
         rows = list(self.state_table.scan_all())
         if rows and self.load_shard is not None:
             rows = self._filter_shard(rows)
+        if rows and self.load_vnodes is not None:
+            # spanning actor: keep only the meta-placed vnode range —
+            # post-migration stores may hold rows that moved away
+            from ..common.hashing import filter_rows_vnodes
+            s, e = self.load_vnodes
+            rows = filter_rows_vnodes(self.core.key_types, rows, s, e)
         if (self.hbm_group_budget is not None
                 and len(rows) > self.hbm_group_budget):
             # under eviction the durable tier legitimately holds more

@@ -92,9 +92,15 @@ class MaterializedAggExecutor(SingleInputExecutor):
     def __init__(self, input: Executor, group_keys: Sequence[int],
                  agg_calls: Sequence[AggCall],
                  state_table: Optional[StateTable] = None,
-                 out_capacity: int = DEFAULT_CHUNK_CAPACITY):
+                 out_capacity: int = DEFAULT_CHUNK_CAPACITY,
+                 load_vnodes: Optional[tuple] = None):
+        """``load_vnodes``: (vnode_start, vnode_end) owned by a SPANNING
+        fragment actor — recovery reloads only rows in the owned range,
+        so a store holding ranges a live migration moved away never
+        resurrects them (meta/rescale.py, docs/scaling.md)."""
         super().__init__(input)
         self.group_keys = tuple(group_keys)
+        self.load_vnodes = load_vnodes
         self.agg_calls = tuple(agg_calls)
         for c in self.agg_calls:
             if c.arg_type is not None and (c.arg_type.is_list
@@ -319,7 +325,13 @@ class MaterializedAggExecutor(SingleInputExecutor):
 
     def _load_from_state_table(self) -> None:
         nk = len(self.group_keys)
-        for row in self.state_table.scan_all():
+        rows = list(self.state_table.scan_all())
+        if rows and nk and self.load_vnodes is not None:
+            from ..common.hashing import filter_rows_vnodes
+            key_types = [self.in_schema[i].type for i in self.group_keys]
+            s, e = self.load_vnodes
+            rows = filter_rows_vnodes(key_types, rows, s, e)
+        for row in rows:
             key = tuple(row[:nk])
             agg_idx, is_null, val_i, val_f, val_s, cnt = row[nk:nk + 6]
             g = self._groups.get(key)

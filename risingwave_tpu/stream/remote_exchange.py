@@ -157,6 +157,12 @@ class FragmentJob:
         self.exchange_inputs: List[ExchangeInput] = []
         self.exchange_outputs: List[ExchangeOutput] = []
         self.local_chan_ids: List[int] = []
+        # per-fragment executor roots + the root actor's owned vnode
+        # range: the live-migration export walks fragment_execs for
+        # state tables, and scans of a vnode-distributed root MV filter
+        # to root_vnodes (meta/rescale.py, worker/host.py)
+        self.fragment_execs: Dict[int, object] = {}
+        self.root_vnodes: Optional[tuple] = None
         self._actors: list = []               # (fragment) coroutine factories
         self._tasks: List[asyncio.Task] = []
         self._events: Dict[int, asyncio.Event] = {}
@@ -316,7 +322,9 @@ def _build_fragments_into(host, req: dict, store, job: FragmentJob,
             raise ValueError(
                 f"cannot build span leaf {type(leaf).__name__}")
 
-        ctx = BuildContext(store, next_table_id, factory, cfg, durable=True)
+        vnodes = spec.get("vnodes")
+        ctx = BuildContext(store, next_table_id, factory, cfg, durable=True,
+                           vnode_range=(tuple(vnodes) if vnodes else None))
         pipeline = build_plan(plan, ctx)
         state_table_ids.extend(ctx.state_table_ids)
         if ctx.actors:
@@ -331,6 +339,9 @@ def _build_fragments_into(host, req: dict, store, job: FragmentJob,
                                      plan.schema, list(plan.pk)))
             job.pipeline = mat
             job.table = mat.table
+            if vnodes:
+                job.root_vnodes = tuple(vnodes)
+            job.fragment_execs[spec["fid"]] = mat
             job.add_actor(_fragment_actor(job, mat, None))
         else:
             outs = []
@@ -353,6 +364,7 @@ def _build_fragments_into(host, req: dict, store, job: FragmentJob,
                 disp = SimpleDispatcher(outs[0])
             else:
                 disp = BroadcastDispatcher(outs)
+            job.fragment_execs[spec["fid"]] = pipeline
             job.add_actor(_fragment_actor(job, pipeline, disp))
 
 

@@ -168,6 +168,10 @@ class WorkerHost:
         # rebuilt the graph — is refused instead of acked/committed
         self.job_gens: dict[str, int] = {}
         self.fenced_frames = 0
+        # elastic scaling plane counters (meta/rescale.py): rows exported
+        # to / imported from handoff segments by live vnode migrations
+        self.migrated_rows_out = 0
+        self.migrated_rows_in = 0
         self.chunks_per_tick = 1
         self.chunk_capacity = 1024
         self.seed = 42
@@ -359,6 +363,19 @@ class WorkerHost:
             store = DurableStateStore(self._job_dir(name),
                                       recover_at=req.get("recover_at"))
             self.stores[name] = store
+        # live-migration handoff: fragment specs may carry state REFS —
+        # handoff segments a previous owner exported to shared storage
+        # (storage/checkpoint.py write_handoff) for the vnode ranges this
+        # actor is gaining. Import them into the committed tier BEFORE
+        # the build below, so executors reload them like any other
+        # recovered state (their load_vnodes filter scopes the reload to
+        # the owned range either way).
+        for spec in req.get("fragments", ()):
+            for ref in spec.get("import_refs", ()) or ():
+                from ..storage.checkpoint import read_handoff
+                deltas = read_handoff(ref)
+                self.migrated_rows_in += store.import_tables(
+                    deltas, int(req.get("recover_at") or 0))
         self._register_defs(req["defs"])
         self.chunks_per_tick = req.get("chunks_per_tick", 1)
         self.chunk_capacity = req.get("chunk_capacity", 1024)
@@ -542,6 +559,109 @@ class WorkerHost:
         committed, prepared = log.recovery_info()
         return {"ok": True, "committed": committed, "prepared": prepared}
 
+    # -- elastic scaling plane (live vnode migration) --------------------------
+
+    @staticmethod
+    def _vnode_tables(ex) -> list:
+        """The vnode-partitioned state tables under one fragment's
+        executor subtree, as (StateTable, key_indices, key_types) —
+        what a live migration must hand off for a moving range. Covers
+        the shapes the scaling plane migrates (``shardable`` fragments:
+        grouped-agg cores under row-wise operators, plus the root
+        materialize); exchange leaves end the walk."""
+        from ..stream.hash_agg import HashAggExecutor
+        from ..stream.materialized_agg import MaterializedAggExecutor \
+            as _MatAgg
+        out = []
+        stack, seen = [ex], set()
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, MaterializeExecutor) \
+                    and node.table is not None:
+                t = node.table
+                out.append((t, tuple(t.pk_indices),
+                            tuple(t.schema[i].type for i in t.pk_indices)))
+            if isinstance(node, HashAggExecutor) \
+                    and node.state_table is not None:
+                nk = len(node.core.group_keys)
+                out.append((node.state_table, tuple(range(nk)),
+                            tuple(node.core.key_types)))
+            if isinstance(node, _MatAgg) \
+                    and node.state_table is not None and node.group_keys:
+                nk = len(node.group_keys)
+                out.append((node.state_table, tuple(range(nk)),
+                            tuple(node.in_schema[i].type
+                                  for i in node.group_keys)))
+            for attr in ("input", "inner", "left", "right"):
+                child = getattr(node, attr, None)
+                if isinstance(child, Executor):
+                    stack.append(child)
+            for child in getattr(node, "inputs", ()):
+                if isinstance(child, Executor):
+                    stack.append(child)
+        return out
+
+    def handle_rescale_export(self, req: dict) -> dict:
+        """Export the committed rows of one fragment's moving vnode
+        ranges as handoff segments on shared storage, returning their
+        REFS (paths). Runs on the quiesced pre-migration graph: the
+        session drained + checkpoint-flushed first, so the committed
+        tier is the complete state of the epoch being handed off
+        (reference: scale.rs:657 shipping state as SST refs)."""
+        import os
+
+        from ..common.hashing import vnodes_of_rows
+        from ..common.row import decode_value_row
+        from ..storage.checkpoint import write_handoff
+        name = req["name"]
+        job = self.jobs.get(name)
+        if job is None:
+            return {"ok": False, "error": f"job {name!r} not found"}
+        ex = getattr(job, "fragment_execs", {}).get(int(req["fragment"]))
+        if ex is None:
+            return {"ok": False,
+                    "error": f"fragment {req['fragment']} not hosted here"}
+        os.makedirs(req["dir"], exist_ok=True)
+        refs = []
+        tables = self._vnode_tables(ex)
+        for start, end in req["ranges"]:
+            deltas: dict[int, dict] = {}
+            moved = 0
+            for table, key_idx, key_types in tables:
+                kept: dict[bytes, bytes] = {}
+                pairs = list(table.store.iter_table(table.table_id))
+                rows = [decode_value_row(v, table.schema.types)
+                        for _k, v in pairs]
+                vns = vnodes_of_rows(
+                    key_types, [[r[i] for i in key_idx] for r in rows])
+                for (k, v), vn in zip(pairs, vns):
+                    if start <= vn < end:
+                        kept[k] = v
+                if kept:
+                    deltas[table.table_id] = kept
+                    moved += len(kept)
+            path = os.path.join(
+                req["dir"],
+                f"f{int(req['fragment'])}_{start}_{end}"
+                f"_w{self.worker_id}.seg")
+            write_handoff(path, deltas)
+            self.migrated_rows_out += moved
+            refs.append({"path": path, "vnode_start": start,
+                         "vnode_end": end, "rows": moved,
+                         "tables": {str(t): len(r)
+                                    for t, r in deltas.items()}})
+        return {"ok": True, "refs": refs, "worker": self.worker_id}
+
+    def handle_set_rate(self, req: dict) -> dict:
+        """Adjust this worker's per-tick source generation rate live —
+        the traffic-spike lever (sim.py run_traffic_spike drives it; the
+        autoscaler reacts to the resulting backlog)."""
+        self.chunks_per_tick = max(0, int(req["chunks_per_tick"]))
+        return {"ok": True, "chunks_per_tick": self.chunks_per_tick}
+
     # -- distributed batch stage ----------------------------------------------
 
     def handle_batch_task(self, req: dict) -> dict:
@@ -624,6 +744,10 @@ class WorkerHost:
                       "pool_evictions": self.peer_pool.evictions,
                       "dup_data_frames": sum(
                           ch.dup_frames for ch in self.channels.values())},
+            # elastic scaling plane: handoff rows this process exported /
+            # imported across live vnode migrations (meta/rescale.py)
+            "rescale": {"rows_out": self.migrated_rows_out,
+                        "rows_in": self.migrated_rows_in},
             "spans": list(self._span_outbox), "span_seq": self._span_seq,
         }
 
@@ -634,10 +758,26 @@ class WorkerHost:
         job = self.jobs.get(name)
         if job is None:
             return {"ok": False, "error": f"job {name!r} not found"}
+        if job.table is None:
+            return {"ok": False,
+                    "error": f"job {name!r} hosts no table on this worker"}
         schema = job.pipeline.schema
         types = [f.type for f in schema]
+        rows = list(job.table.scan_all())
+        rv = getattr(job, "root_vnodes", None)
+        if rv is not None:
+            # vnode-distributed root MV: serve only the owned range. A
+            # live migration leaves moved-away rows behind in this store
+            # (bounded leftovers, reloaded by nobody); without this
+            # filter the scan union across root actors would double-read
+            # them (meta/rescale.py, docs/scaling.md).
+            from ..common.hashing import filter_rows_vnodes
+            pk = list(job.table.pk_indices)
+            rows = filter_rows_vnodes(
+                [types[i] for i in pk], rows, rv[0], rv[1],
+                key_indices=pk)
         rows = [base64.b64encode(encode_value_row(r, types)).decode()
-                for r in job.table.scan_all()]
+                for r in rows]
         return {"ok": True, "rows": rows}
 
     # -- serve -----------------------------------------------------------------
@@ -764,6 +904,14 @@ class WorkerHost:
                     async def _je(f):
                         return self.handle_job_epochs(f)
                     await self._reply(frame, _je)
+                elif t == "rescale_export":
+                    async def _re(f):
+                        return self.handle_rescale_export(f)
+                    await self._reply(frame, _re)
+                elif t == "set_rate":
+                    async def _sr(f):
+                        return self.handle_set_rate(f)
+                    await self._reply(frame, _sr)
                 elif t == "drop_job":
                     await self._reply(frame, self.handle_drop_job)
                 elif t == "scan":

@@ -76,6 +76,11 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_multiprocess.py \
     "$@"
 
+echo "== scaling tests (live vnode migration + autoscaler) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_rescale_live.py -m 'not slow' \
+    "$@"
+
 echo "== network fault plane (chaos subset) =="
 # Unit surface (schedules, seq dedup/reorder, keepalive eviction,
 # auditor), then one FAST seeded netsplit scenario run twice to assert
@@ -123,6 +128,30 @@ if [ -n "$bad" ]; then
     exit 1
 fi
 echo "wire-boundary lint: OK"
+
+echo "== placement-mutation lint =="
+# Every fragment→worker placement mutation must go through the scaling
+# plane: the raw "placement/" meta-store key belongs to meta/service.py
+# alone, and save_placement may only be CALLED by meta/rescale.py's
+# commit_placement (the single write path job creation and live
+# rescales both use) — a direct write elsewhere would bypass the diff
+# math that keeps placement equal to routing.
+bad=$(grep -rn '"placement/' risingwave_tpu --include='*.py' \
+      | grep -v "risingwave_tpu/meta/service.py" || true)
+if [ -n "$bad" ]; then
+    echo "raw placement/ meta-store key outside meta/service.py:"
+    echo "$bad"
+    exit 1
+fi
+bad=$(grep -rn "save_placement(" risingwave_tpu --include='*.py' \
+      | grep -v "risingwave_tpu/meta/service.py" \
+      | grep -v "risingwave_tpu/meta/rescale.py" || true)
+if [ -n "$bad" ]; then
+    echo "placement mutation outside meta/rescale.py commit_placement:"
+    echo "$bad"
+    exit 1
+fi
+echo "placement-mutation lint: OK"
 
 echo "== serving-cache lint =="
 # Every batch SELECT must lower through the serving plane

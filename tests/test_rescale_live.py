@@ -1,0 +1,611 @@
+"""Elastic scaling plane: live vnode migration + backlog-driven
+autoscaler (ISSUE 10, docs/scaling.md).
+
+What these tests pin:
+  * placement-diff math (meta/rescale.py): new actor ranges always equal
+    the ``vnode_to_shard`` routing function, a 2→4/4→2 rescale moves
+    EXACTLY half the ring (the minimal move set), worker balance stays
+    within one vnode, and a same-parallelism plan is a no-op;
+  * autoscaler policy (meta/autoscaler.py): hysteresis (no decision
+    before N consecutive highs), cooldown (no second decision inside the
+    window), no flapping under oscillating load, lazy scale-in, clamps;
+  * LIVE migration (frontend/session.py rescale): a spanning grouped-agg
+    job rescales 2→4 mid-stream with only the changed vnode ranges
+    handed off as state refs, bit-exact vs a no-rescale control, worker
+    processes untouched (same pids), migration metrics populated, and
+    the persisted placement redeploying on restart;
+  * kill -9 mid-migration rolls BACK to the old placement via generation
+    fencing (pre-commit) or FORWARD under the new one (post-commit),
+    converging bit-exact either way;
+  * whole-job remote placements refuse rescale loudly (VERDICT #78) and
+    session-local jobs delegate to the documented quiesce+rebuild path;
+  * the seeded sim traffic-spike scenario: the autoscaler triggers the
+    same 2→4 rescale autonomously from injected backlog and does not
+    flap when the load subsides (slow tier).
+"""
+
+import pytest
+
+from risingwave_tpu.common.config import AutoscalerConfig
+from risingwave_tpu.common.hashing import VNODE_COUNT, vnode_to_shard
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.frontend.build import BuildConfig
+from risingwave_tpu.meta.autoscaler import Autoscaler
+from risingwave_tpu.meta.fragment import FragmentScheduler, span_plan
+from risingwave_tpu.meta.rescale import (
+    RescaleUnsupported, actor_ranges, diff_placements, plan_rescale,
+)
+
+CAP = 64
+
+BID_DDL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,
+channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)
+WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+AGG = ("CREATE MATERIALIZED VIEW q AS SELECT auction, count(*) AS n, "
+       "max(price) AS mx FROM bid GROUP BY auction")
+
+Q5 = """CREATE MATERIALIZED VIEW q5 AS
+    SELECT AuctionBids.auction, AuctionBids.num FROM (
+        SELECT bid.auction, count(*) AS num, window_start AS starttime
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY window_start, bid.auction
+    ) AS AuctionBids
+    JOIN (
+        SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+        FROM (
+            SELECT count(*) AS num, window_start AS starttime_c
+            FROM HOP(bid, date_time, INTERVAL '2' SECOND,
+                     INTERVAL '10' SECOND)
+            GROUP BY bid.auction, window_start
+        ) AS CountBids
+        GROUP BY CountBids.starttime_c
+    ) AS MaxBids
+    ON AuctionBids.starttime = MaxBids.starttime_c
+       AND AuctionBids.num = MaxBids.maxn"""
+
+
+def _agg_graph():
+    """A span graph with one shardable fragment, built through the real
+    frontend pipeline (a session without workers is cheap)."""
+    from risingwave_tpu.frontend.parser import parse_one
+    s = Session(seed=42)
+    try:
+        s.run_sql(BID_DDL)
+        stmt = parse_one(AGG)
+        return span_plan(s._plan(stmt.query))
+    finally:
+        s.close()
+
+
+def _par(placement) -> int:
+    return max(len(a) for a in placement.actors.values())
+
+
+class TestPlacementPlan:
+    def test_ranges_equal_routing_function(self):
+        """Per-actor ranges ARE the vnode_to_shard mapping, for every
+        parallelism — placement and routing cannot diverge."""
+        for n in (1, 2, 3, 4, 5, 7, 8):
+            ranges = actor_ranges(VNODE_COUNT, n)
+            assert ranges[0][0] == 0 and ranges[-1][1] == VNODE_COUNT
+            for a, (s, e) in enumerate(ranges):
+                for v in (s, e - 1):
+                    assert int(vnode_to_shard(v, n)) == a
+
+    def test_balance_within_one_for_divisors(self):
+        for n in (1, 2, 4, 8, 16):
+            sizes = [e - s for s, e in actor_ranges(VNODE_COUNT, n)]
+            assert max(sizes) - min(sizes) <= 1
+
+    def test_2_to_4_moves_exactly_half_the_ring(self):
+        g = _agg_graph()
+        old = FragmentScheduler().place("q", g, [0, 1, 2, 3],
+                                        parallelism=2)
+        plan = plan_rescale("q", g, old, [0, 1, 2, 3], 4)
+        assert _par(plan.new) == 4
+        # minimal move set: the two ranges whose owner must change
+        assert plan.moved_vnodes == VNODE_COUNT // 2
+        # ranges that kept their owner are NOT in the move list
+        for m in plan.moves:
+            assert m.from_worker != m.to_worker
+        # every new actor lands on a distinct worker per fragment
+        for acts in plan.new.actors.values():
+            workers = [a.worker for a in acts]
+            assert len(set(workers)) == len(workers)
+
+    def test_4_to_2_moves_exactly_half_the_ring(self):
+        g = _agg_graph()
+        old = FragmentScheduler().place("q", g, [0, 1, 2, 3],
+                                        parallelism=4)
+        plan = plan_rescale("q", g, old, [0, 1, 2, 3], 2)
+        assert _par(plan.new) == 2
+        assert plan.moved_vnodes == VNODE_COUNT // 2
+
+    def test_same_parallelism_is_noop(self):
+        g = _agg_graph()
+        old = FragmentScheduler().place("q", g, [0, 1, 2, 3],
+                                        parallelism=2)
+        plan = plan_rescale("q", g, old, [0, 1, 2, 3], 2)
+        assert plan.moves == [] and plan.moved_vnodes == 0
+        assert plan.new.to_json() == old.to_json()
+
+    def test_singleton_fragments_never_move(self):
+        g = _agg_graph()
+        old = FragmentScheduler().place("q", g, [0, 1, 2, 3],
+                                        parallelism=2)
+        plan = plan_rescale("q", g, old, [0, 1, 2, 3], 4)
+        from risingwave_tpu.meta.fragment import shardable
+        for fid, frag in g.fragments.items():
+            if not shardable(frag):
+                assert plan.new.actors[fid] == old.actors[fid]
+                assert all(m.fragment_id != fid for m in plan.moves)
+
+    def test_diff_merges_adjacent_ranges(self):
+        g = _agg_graph()
+        a = FragmentScheduler().place("q", g, [0, 1], parallelism=2)
+        # same shape on swapped workers: the whole sharded ring moves as
+        # two merged ranges (one per (src, dst) actor pair)
+        import dataclasses
+        swapped = dataclasses.replace(a)
+        swapped.actors = {
+            fid: [dataclasses.replace(x, worker={0: 1, 1: 0}[x.worker])
+                  for x in acts]
+            for fid, acts in a.actors.items()}
+        moves = diff_placements(a, swapped)
+        sharded_moves = [m for m in moves
+                         if (m.vnode_end - m.vnode_start) < VNODE_COUNT]
+        assert sum(m.width for m in sharded_moves) == VNODE_COUNT
+
+    def test_rejects_bad_parallelism(self):
+        g = _agg_graph()
+        old = FragmentScheduler().place("q", g, [0, 1], parallelism=2)
+        with pytest.raises(RescaleUnsupported):
+            plan_rescale("q", g, old, [0, 1], 0)
+        with pytest.raises(RescaleUnsupported):
+            plan_rescale("q", g, old, [], 2)
+        # refused loudly, never silently clamped to the worker count
+        with pytest.raises(RescaleUnsupported, match="distinct workers"):
+            plan_rescale("q", g, old, [0, 1], 4)
+
+
+class TestAutoscalerPolicy:
+    CFG = AutoscalerConfig(enabled=True, high_backlog=10,
+                           high_permits_waited=5, hysteresis=3,
+                           cooldown=4, scale_in_after=6,
+                           min_parallelism=1, max_parallelism=8)
+
+    def test_hysteresis_requires_consecutive_highs(self):
+        a = Autoscaler(self.CFG)
+        assert a.observe("j", 2, backlog=100) is None
+        assert a.observe("j", 2, backlog=100) is None
+        assert a.observe("j", 2, backlog=0, permits_waited=1) is None
+        # the streak was broken: two more highs still aren't enough
+        assert a.observe("j", 2, backlog=100) is None
+        assert a.observe("j", 2, backlog=100) is None
+        assert a.observe("j", 2, backlog=100) == 4
+
+    def test_cooldown_blocks_second_decision(self):
+        a = Autoscaler(self.CFG)
+        for _ in range(2):
+            a.observe("j", 2, backlog=100)
+        assert a.observe("j", 2, backlog=100) == 4
+        # high signals continue, but the cooldown holds...
+        for _ in range(self.CFG.cooldown):
+            assert a.observe("j", 4, backlog=100) is None
+        # ...and once it expires a fresh streak is still required
+        assert a.observe("j", 4, backlog=100) is None
+        assert a.observe("j", 4, backlog=100) is None
+        assert a.observe("j", 4, backlog=100) == 8
+
+    def test_no_flapping_under_oscillating_load(self):
+        a = Autoscaler(self.CFG)
+        for i in range(40):
+            target = a.observe("j", 2,
+                               backlog=(100 if i % 2 == 0 else 0))
+            assert target is None       # oscillation never sustains
+        assert a.decisions == []
+
+    def test_scale_in_is_lazy_and_halves(self):
+        a = Autoscaler(self.CFG)
+        for i in range(self.CFG.scale_in_after - 1):
+            assert a.observe("j", 4) is None
+        assert a.observe("j", 4) == 2
+
+    def test_clamps_at_max_and_min(self):
+        a = Autoscaler(self.CFG)
+        for _ in range(3):
+            t = a.observe("j", 8, backlog=100)
+        assert t is None                # already at max: no decision
+        b = Autoscaler(self.CFG)
+        for i in range(self.CFG.scale_in_after):
+            t = b.observe("j", 1)
+        assert t is None                # already at min
+
+    def test_live_worker_cap_blocks_unreachable_scale_out(self):
+        # 2 live workers: a 2→4 decision could never execute
+        # (plan_rescale needs 4 distinct workers), so the policy must
+        # not fire it — no phantom decision churn every cooldown window
+        a = Autoscaler(self.CFG)
+        for _ in range(10):
+            assert a.observe("j", 2, backlog=100, live_workers=2) is None
+        assert a.decisions == [] and a.decisions_total == 0
+        # with 3 live workers the cap still allows 2→3
+        b = Autoscaler(self.CFG)
+        for _ in range(2):
+            b.observe("j", 2, backlog=100, live_workers=3)
+        assert b.observe("j", 2, backlog=100, live_workers=3) == 3
+
+    def test_decisions_total_is_monotonic_past_history_cap(self):
+        cfg = AutoscalerConfig(enabled=True, high_backlog=10,
+                               hysteresis=1, cooldown=0,
+                               max_parallelism=1 << 80)
+        a = Autoscaler(cfg)
+        n = 0
+        par = 2
+        while n < 70:                    # history ring caps at 64
+            t = a.observe("j", par, backlog=100)
+            if t is not None:
+                par, n = t, n + 1
+        assert a.decisions_total == 70 and len(a.decisions) == 64
+        assert a.status()["decisions_total"] == 70
+
+    def test_failed_rescale_holds_cooldown(self):
+        a = Autoscaler(self.CFG)
+        for _ in range(2):
+            a.observe("j", 2, backlog=100)
+        assert a.observe("j", 2, backlog=100) == 4
+        a.note_failed("j", "boom")
+        st = a.status()["jobs"]["j"]
+        assert st["cooldown"] >= self.CFG.cooldown
+        assert st["last_error"] == "boom"
+
+
+def cluster(workers=4, seed=42, data_dir=None, parallelism=2,
+            **kw) -> Session:
+    return Session(workers=workers, seed=seed, data_dir=data_dir,
+                   source_chunk_capacity=CAP,
+                   config=BuildConfig(fragment_parallelism=parallelism,
+                                      **kw.pop("cfg", {})),
+                   **kw)
+
+
+def control_session(seed=42) -> Session:
+    s = Session(seed=seed, source_chunk_capacity=CAP)
+    s.run_sql(BID_DDL)
+    s.run_sql(AGG)
+    return s
+
+
+class TestLiveRescale:
+    def test_scale_out_2_to_4_bit_exact(self, tmp_path):
+        """THE tentpole path: a spanning grouped-agg job rescales 2→4
+        mid-stream. Only the changed half of the ring moves (migration
+        metrics), worker processes stay up (same pids), output is
+        bit-exact vs a no-rescale control, and the persisted placement
+        carries the new parallelism."""
+        s = cluster(data_dir=str(tmp_path / "d"))
+        c = control_session()
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            assert "q" in s._spanning_specs
+            pids = [w.proc.pid for w in s.workers]
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+            out = s.rescale("q", 4)
+            assert out["mode"] == "live-migration"
+            assert out["parallelism"] == 4
+            # only the changed vnode ranges moved
+            assert out["moved_vnodes"] == VNODE_COUNT // 2
+            for r in out["moved_ranges"]:
+                assert r["from_worker"] != r["to_worker"]
+            # live migration: no worker process was restarted
+            assert [w.proc.pid for w in s.workers] == pids
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            got = sorted(s.mv_rows("q"))
+            assert got == sorted(c.mv_rows("q")) and got
+            m = s.metrics()["autoscaler"]
+            assert m["migrations"] == 1
+            assert m["moved_vnodes"] == VNODE_COUNT // 2
+            assert m["last_rescale"]["pause_ms"] > 0
+            # handoff accounting balances: rows out == rows in
+            h = m["handoff_rows"]
+            assert sum(v["rows_out"] for v in h.values()) == \
+                sum(v["rows_in"] for v in h.values()) > 0
+            # the placement mutation went through the meta store
+            persisted = s.meta.load_placement("q")
+            assert _par(persisted) == 4
+        finally:
+            s.close()
+            c.close()
+
+    def test_serving_reads_stay_exact_across_rescale(self, tmp_path):
+        """Batch SQL through the serving plane stays exactly-once across
+        live migrations: cached pre-rescale entries are invalidated at
+        the placement commit (their remote tasks name the OLD host set),
+        and every per-host task ships its placed vnode range — an
+        unrestricted scan would count handed-off leftover rows twice
+        against the range's current owner."""
+        s = cluster(data_dir=str(tmp_path / "d"))
+        c = control_session()
+        q1 = "SELECT count(*) AS groups FROM q"
+        q2 = "SELECT auction, count(*) AS cnt FROM q GROUP BY auction"
+        q3 = "SELECT auction, n FROM q WHERE n > 1"
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            for _ in range(2):
+                s.tick()
+                c.tick()
+            # prime the serving cache BEFORE the rescale
+            assert s.run_sql(q1) == c.run_sql(q1)
+            assert sorted(s.run_sql(q3)) == sorted(c.run_sql(q3))
+            for par in (4, 2):
+                s.rescale("q", par)
+                s.tick()
+                c.tick()
+                s.flush()
+                c.flush()
+                assert s.run_sql(q1) == c.run_sql(q1)
+                assert sorted(s.run_sql(q2)) == sorted(c.run_sql(q2))
+                assert sorted(s.run_sql(q3)) == sorted(c.run_sql(q3))
+        finally:
+            s.close()
+            c.close()
+
+    def test_rescale_remote_whole_job_refuses_loudly(self, tmp_path):
+        """VERDICT #78: a round-robined whole-job placement cannot
+        reschedule — that is now an explicit, documented refusal, not a
+        silent ignore."""
+        # one worker → span_plan refuses (fewer than two live workers)
+        # → the MV deploys whole-job on the worker
+        s = cluster(workers=1, data_dir=str(tmp_path / "d"))
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            assert "q" in s._remote_specs
+            with pytest.raises(RescaleUnsupported) as ei:
+                s.rescale("q", 2)
+            assert "docs/scaling.md" in str(ei.value)
+            # ...and the legacy reschedule path names the remediation
+            from risingwave_tpu.frontend.session import SqlError
+            with pytest.raises(SqlError) as ei2:
+                s.reschedule("q")
+            assert "rescale" in str(ei2.value)
+        finally:
+            s.close()
+
+    def test_local_job_delegates_to_rebuild(self):
+        """A session-local MV has no vnode-mapped placement: rescale
+        delegates to the quiesce+rebuild reschedule under the new
+        fragment parallelism (documented fallback, not live)."""
+        s = Session(seed=42, source_chunk_capacity=CAP)
+        c = control_session()
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            for _ in range(2):
+                s.tick()
+                c.tick()
+            out = s.rescale("q", 2)
+            assert out["mode"] == "local-rebuild"
+            for _ in range(2):
+                s.tick()
+                c.tick()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+        finally:
+            s.close()
+            c.close()
+
+    def test_kill9_mid_migration_rolls_back_fenced(self, tmp_path):
+        """kill -9 of a worker between the state-ref export and the
+        redeploy: the placement commit never happened, so the rescale
+        ROLLS BACK — the generation bump fences anything the dead
+        incarnation had in flight, the old placement redeploys from the
+        untouched durable cut, and a later rescale succeeds."""
+        from risingwave_tpu.common.config import FaultConfig
+        from risingwave_tpu.common.failpoint import arm, disarm
+        fc = FaultConfig(worker_epoch_timeout_s=60.0,
+                         worker_request_timeout_s=60.0)
+        s = cluster(data_dir=str(tmp_path / "d"), fault_config=fc)
+        c = control_session()
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            victim = s._spanning_specs["q"]["workers"][0]
+            gen0 = s._generation
+            arm("rescale.migrate", victim.kill9, once=True)
+            try:
+                with pytest.raises(RuntimeError) as ei:
+                    s.rescale("q", 4)
+            finally:
+                disarm("rescale.migrate")
+            assert "rolled back" in str(ei.value)
+            assert s._generation > gen0          # fenced
+            # old placement still authoritative, in memory AND durably
+            assert _par(s._spanning_specs["q"]["placement"]) == 2
+            assert _par(s.meta.load_placement("q")) == 2
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+            # the cluster healed: the same rescale now goes through
+            out = s.rescale("q", 4)
+            assert out["moved_vnodes"] == VNODE_COUNT // 2
+            s.tick()
+            c.tick()
+            s.flush()
+            c.flush()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+        finally:
+            s.close()
+            c.close()
+
+
+@pytest.mark.slow
+class TestLiveRescaleSlow:
+    def test_scale_in_4_to_2_and_restart_redeploys(self, tmp_path):
+        """4→2 scale-IN moves half the ring back, stays bit-exact, and
+        a restarted session redeploys the persisted post-rescale
+        placement (parallelism 2) — recovery and rescale persistence
+        compose."""
+        d = str(tmp_path / "d")
+        s = cluster(data_dir=d, parallelism=4, seed=7)
+        c = Session(seed=7, source_chunk_capacity=CAP)
+        c.run_sql(BID_DDL)
+        c.run_sql(AGG)
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            assert _par(s._spanning_specs["q"]["placement"]) == 4
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            out = s.rescale("q", 2)
+            assert out["mode"] == "live-migration"
+            assert out["moved_vnodes"] == VNODE_COUNT // 2
+            for _ in range(2):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+            s.close()
+            s = cluster(data_dir=d, parallelism=4, seed=7)
+            assert _par(s._spanning_specs["q"]["placement"]) == 2
+            for _ in range(2):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+        finally:
+            s.close()
+            c.close()
+
+    def test_kill9_after_commit_rolls_forward(self, tmp_path):
+        """kill -9 of a worker AFTER the placement commit: the new
+        placement is authoritative, so recovery rolls FORWARD — the job
+        converges at the new parallelism, bit-exact."""
+        from risingwave_tpu.common.config import FaultConfig
+        from risingwave_tpu.common.failpoint import arm, disarm
+        fc = FaultConfig(worker_epoch_timeout_s=60.0,
+                         worker_request_timeout_s=60.0)
+        s = cluster(data_dir=str(tmp_path / "d"), fault_config=fc)
+        c = control_session()
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            victim = s._spanning_specs["q"]["workers"][0]
+            arm("rescale.commit", victim.kill9, once=True)
+            try:
+                s.rescale("q", 4)   # rolls forward internally
+            finally:
+                disarm("rescale.commit")
+            assert _par(s._spanning_specs["q"]["placement"]) == 4
+            assert _par(s.meta.load_placement("q")) == 4
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            assert sorted(s.mv_rows("q")) == sorted(c.mv_rows("q"))
+        finally:
+            s.close()
+            c.close()
+
+    def test_q5_rescale_2_to_4_bit_exact(self, tmp_path):
+        """The ROADMAP acceptance shape: the spanning q5 graph (two
+        sharded hop-window aggs feeding a join) rescales 2→4 workers
+        mid-stream — only the sharded fragments' changed ranges move —
+        and stays bit-exact vs a no-rescale control."""
+        s = cluster(data_dir=str(tmp_path / "d"))
+        c = Session(seed=42, source_chunk_capacity=CAP)
+        c.run_sql(BID_DDL)
+        c.run_sql(Q5)
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(Q5)
+            assert "q5" in s._spanning_specs
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            out = s.rescale("q5", 4)
+            assert out["mode"] == "live-migration"
+            # every sharded agg fragment went to 4 actors and moved
+            # exactly half ITS ring — singletons moved nothing
+            sharded = [acts for acts in
+                       s._spanning_specs["q5"]["placement"].actors
+                       .values() if len(acts) == 4]
+            assert len(sharded) >= 2
+            assert out["moved_vnodes"] == \
+                len(sharded) * (VNODE_COUNT // 2)
+            for _ in range(3):
+                s.tick()
+                c.tick()
+            s.flush()
+            c.flush()
+            got = sorted(s.mv_rows("q5"))
+            assert got == sorted(c.mv_rows("q5")) and got
+        finally:
+            s.close()
+            c.close()
+
+    def test_autoscaler_scales_out_and_does_not_flap(self, tmp_path):
+        """End-to-end policy loop: a traffic spike over a tiny permit
+        budget drives permits_waited up; the autoscaler live-rescales
+        2→4 after its hysteresis, then holds steady when the load
+        subsides (cooldown + lazy scale-in = no flapping)."""
+        acfg = AutoscalerConfig(enabled=True, high_permits_waited=1,
+                                hysteresis=2, cooldown=6,
+                                scale_in_after=64, max_parallelism=4)
+        s = cluster(data_dir=str(tmp_path / "d"), seed=3,
+                    autoscaler_config=acfg,
+                    cfg={"exchange_permits": 2})
+        try:
+            s.run_sql(BID_DDL)
+            s.run_sql(AGG)
+            spec = s._spanning_specs["q"]
+            for _ in range(2):
+                s.tick()
+            s.set_source_rate(8)
+            for _ in range(12):
+                s.tick()
+                if _par(spec["placement"]) == 4:
+                    break
+            assert _par(spec["placement"]) == 4, \
+                s.autoscaler.status()
+            assert len(s.autoscaler.decisions) == 1
+            s.set_source_rate(1)
+            for _ in range(8):
+                s.tick()
+            assert _par(spec["placement"]) == 4
+            assert len(s.autoscaler.decisions) == 1   # no flap
+        finally:
+            s.close()
+
+    def test_sim_traffic_spike_scenario(self, tmp_path):
+        """The seeded sim scenario end to end: autonomous 2→4 under a
+        load spike, minimal move set, exactly-once audit green, no flap
+        on subside (python -m risingwave_tpu.sim --traffic-spike)."""
+        from risingwave_tpu.sim import run_traffic_spike
+        out = run_traffic_spike(seed=7, data_dir=str(tmp_path / "d"))
+        assert out["parallelism"] == 4
+        assert out["moved_vnodes"] == VNODE_COUNT // 2
+        assert all(out["audit"].values()), out["audit"]
+        assert len(out["decisions"]) == 1
