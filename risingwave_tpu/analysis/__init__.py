@@ -1,0 +1,43 @@
+"""rwlint — AST-grounded invariant checker for the dispatch, barrier,
+and boundary planes.
+
+Run it as ``python -m risingwave_tpu.analysis`` (CI alias:
+``scripts/rwlint``). The rules, their rationale, and the suppression
+pragma format are documented in docs/static-analysis.md; per-rule
+rationale is also available via ``--explain RULE``.
+
+Programmatic surface (used by tests/test_rwlint.py and scripts):
+
+    from risingwave_tpu.analysis import lint_package
+    findings, counts, package = lint_package()          # whole package
+    findings, counts, package = lint_package("/some/pkg_root")
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Optional, Tuple
+
+from .core import (Finding, Package, Rule, RULES, all_rules,
+                   load_package, register, run_rules)
+
+__all__ = [
+    "Finding", "Package", "Rule", "RULES", "all_rules", "register",
+    "load_package", "run_rules", "lint_package", "package_root",
+]
+
+
+def package_root() -> Path:
+    """The risingwave_tpu package directory this module ships inside."""
+    return Path(__file__).resolve().parents[1]
+
+
+def lint_package(root=None, rules: Optional[Iterable[Rule]] = None
+                 ) -> Tuple[list, dict, Package]:
+    """Lint ``root`` (default: the installed package) with ``rules``
+    (default: all registered). Returns (findings, per-rule counts,
+    the parsed Package)."""
+    package = load_package(Path(root) if root is not None
+                           else package_root())
+    findings, counts = run_rules(package, rules)
+    return findings, counts, package

@@ -1,0 +1,106 @@
+"""rwlint CLI: ``python -m risingwave_tpu.analysis [root] [flags]``.
+
+Exit status 0 = every rule clean, 1 = findings, 2 = usage error.
+
+``--ci`` prints the per-rule ``<rule> lint: OK`` lines scripts/check.sh
+has always emitted (kept byte-compatible for the five migrated grep
+lints so CI output stays diffable across the migration), ``--json``
+emits the machine-readable report, ``--list-rules`` / ``--explain``
+surface the registry and per-rule docs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import all_rules, lint_package, RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rwlint",
+        description="AST-grounded invariant checker for the dispatch, "
+                    "barrier, and boundary planes "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="package root to lint (default: the installed "
+                         "risingwave_tpu package)")
+    ap.add_argument("--ci", action="store_true",
+                    help="per-rule OK lines, diffable CI output")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME", help="run only this rule "
+                    "(repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="RULE", default=None,
+                    help="print a rule's long-form rationale and exit")
+    ap.add_argument("--coverage", action="store_true",
+                    help="dump the dispatch-discipline reachability "
+                         "closure per registry entry (JSON)")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:20s} {r.title}")
+        return 0
+    if args.explain is not None:
+        r = RULES.get(args.explain)
+        if r is None:
+            print(f"rwlint: unknown rule {args.explain!r} "
+                  f"(try --list-rules)", file=sys.stderr)
+            return 2
+        print(f"{r.name} — {r.title}\n")
+        print(r.doc.strip())
+        return 0
+    if args.rule:
+        unknown = [n for n in args.rule if n not in RULES]
+        if unknown:
+            print(f"rwlint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [RULES[n] for n in args.rule]
+
+    t0 = time.monotonic()
+    findings, counts, package = lint_package(args.root, rules)
+    elapsed = time.monotonic() - t0
+
+    if args.coverage:
+        from .rules_purity import DispatchDiscipline
+        print(json.dumps(
+            DispatchDiscipline().coverage(package), indent=2))
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": not findings,
+            "files": len(package.modules),
+            "elapsed_s": round(elapsed, 3),
+            "rules": counts,
+            "findings": [f.to_json() for f in findings],
+        }, indent=2))
+        return 1 if findings else 0
+
+    for f in findings:
+        print(f.render())
+    if args.ci:
+        for r in rules:
+            if counts.get(r.name, 0) == 0:
+                print(f"{r.ci_label or r.name} lint: OK")
+    if findings:
+        print(f"rwlint: {len(findings)} finding(s) across "
+              f"{len({f.path for f in findings})} file(s) "
+              f"[{len(package.modules)} files linted, {elapsed:.2f}s]")
+        return 1
+    if not args.ci:
+        print(f"rwlint: OK ({len(rules)} rules, "
+              f"{len(package.modules)} files, {elapsed:.2f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

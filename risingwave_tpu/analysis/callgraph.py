@@ -1,0 +1,205 @@
+"""Whole-package function index + conservative call graph.
+
+The dispatch-discipline rule needs *reachability*: "no host transfer in
+any function reachable from the ``EPOCH_BUILDERS`` registries" is a
+closure property, not a per-line pattern. This module builds the index
+once per lint run (``Package.shared``) and answers:
+
+* which function object does this Name/Attribute refer to?
+* what does function F reference (call OR pass as a value — a
+  ``lax.scan(body, ...)`` body is reached without ever being "called"
+  by name)?
+
+Resolution is deliberately OVER-approximate: for ``obj.method(...)``
+where ``obj``'s type is unknowable statically, we fall back to "every
+class method with that bare name in the package" (minus a denylist of
+jnp-array/builtin method names that would drag the whole package in).
+For a lint, over-approximation errs toward flagging — the pragma system
+absorbs the rare deliberate exception; silent non-coverage would rot
+the invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import Module, Package
+
+__all__ = ["Func", "FunctionIndex", "build_index"]
+
+#: attribute-call names that are overwhelmingly jnp-array / builtin
+#: container methods — method-name fallback on these would connect
+#: epoch bodies to unrelated package classes (e.g. a meta-store
+#: ``set``) and poison reachability.
+_FALLBACK_DENY = {
+    "set", "get", "add", "pop", "update", "items", "keys", "values",
+    "append", "extend", "remove", "clear", "copy", "astype", "reshape",
+    "sum", "min", "max", "mean", "any", "all", "take", "dot", "ravel",
+    "flatten", "squeeze", "transpose", "clip", "round", "cumsum",
+    "sort", "argsort", "nonzero", "tolist", "view", "item", "at",
+    "block_until_ready", "join", "split", "format", "strip", "read",
+    "write", "close", "encode", "decode", "startswith", "endswith",
+}
+
+#: unknown-receiver method fallback is restricted to the device-plane
+#: subtree: the core/state classes an epoch body dispatches into
+#: (AggCore, Q3Core, hash tables, Expr.eval ...) all live under ops/
+#: and expr/. Without the restriction, a generic verb like ``.flush()``
+#: inside an epoch body would edge into Session.flush and drag the
+#: whole frontend into the "traced" region.
+_FALLBACK_SCOPES = ("ops/", "expr/")
+
+#: externals we never index into (their attrs are not package funcs)
+_EXTERNAL_HEADS = ("jax.", "numpy.", "functools.", "math.", "os.",
+                   "sys.", "typing.", "collections.", "itertools.",
+                   "threading.", "time.", "asyncio.", "json.", "struct.",
+                   "socket.", "contextlib.", "dataclasses.")
+
+
+class Func:
+    """One function/method/nested-def in the package."""
+
+    __slots__ = ("qualname", "module", "node", "cls", "parent", "nested")
+
+    def __init__(self, qualname: str, module: Module, node: ast.AST,
+                 cls: Optional[str], parent: Optional["Func"]):
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.cls = cls            # bare class name if a method
+        self.parent = parent
+        self.nested: List["Func"] = []
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def __repr__(self) -> str:
+        return f"<Func {self.qualname}>"
+
+
+class FunctionIndex:
+    def __init__(self, package: Package):
+        self.package = package
+        self.by_qualname: Dict[str, Func] = {}
+        self.methods_by_name: Dict[str, List[Func]] = {}
+        self._edges: Dict[str, Set[str]] = {}
+        for mod in package.modules.values():
+            self._index_module(mod)
+
+    # -- construction -----------------------------------------------------
+
+    def _index_module(self, mod: Module) -> None:
+        def visit(node: ast.AST, prefix: str, cls: Optional[str],
+                  parent: Optional[Func]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}.{child.name}"
+                    fn = Func(qn, mod, child, cls, parent)
+                    self.by_qualname[qn] = fn
+                    if cls is not None and parent is None:
+                        self.methods_by_name.setdefault(
+                            child.name, []).append(fn)
+                    if parent is not None:
+                        parent.nested.append(fn)
+                    visit(child, qn, None, fn)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}.{child.name}",
+                          child.name, None)
+                elif not isinstance(child, (ast.Lambda,)):
+                    visit(child, prefix, cls, parent)
+
+        visit(mod.tree, mod.qualname, None, None)
+
+    # -- lookup -----------------------------------------------------------
+
+    def lookup(self, qualname: Optional[str]) -> Optional[Func]:
+        if qualname is None:
+            return None
+        return self.by_qualname.get(
+            self.package.canonical(qualname) or qualname)
+
+    def resolve_ref(self, func: Func, node: ast.AST) -> Set[Func]:
+        """Funcs a Name/Attribute reference inside ``func`` may denote."""
+        out: Set[Func] = set()
+        mod = func.module
+        if isinstance(node, ast.Name):
+            # nested function in the lexical scope chain
+            cur: Optional[Func] = func
+            while cur is not None:
+                for n in cur.nested:
+                    if n.name == node.id:
+                        return {n}
+                cur = cur.parent
+            hit = self.lookup(mod.imports.resolve_or_local(node))
+            if hit is not None:
+                out.add(hit)
+            return out
+        if isinstance(node, ast.Attribute):
+            # self.method() -> same-class method, precisely
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and func.cls is not None:
+                for cand in self.methods_by_name.get(node.attr, []):
+                    if cand.cls == func.cls and cand.module is mod:
+                        return {cand}
+            qn = mod.imports.resolve(node)
+            if qn is not None:
+                if qn.startswith(_EXTERNAL_HEADS):
+                    return out
+                hit = self.lookup(qn)
+                if hit is not None:
+                    out.add(hit)
+                    return out
+            # unknown receiver: bare-method-name fallback, device-plane
+            # classes only (see _FALLBACK_SCOPES)
+            if node.attr not in _FALLBACK_DENY:
+                out.update(
+                    cand for cand in self.methods_by_name.get(
+                        node.attr, [])
+                    if cand.module.rel.startswith(_FALLBACK_SCOPES))
+            return out
+        return out
+
+    # -- edges / reachability ---------------------------------------------
+
+    def references(self, func: Func) -> Set[Func]:
+        """Every Func that ``func``'s body references — called OR
+        passed as a value OR defined nested (over-approximation)."""
+        cached = self._edges.get(func.qualname)
+        if cached is not None:
+            return {self.by_qualname[q] for q in cached}
+        out: Set[Func] = set(func.nested)
+        for node in self._own_body_walk(func):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                out.update(self.resolve_ref(func, node))
+        self._edges[func.qualname] = {f.qualname for f in out}
+        return out
+
+    def _own_body_walk(self, func: Func):
+        """Walk func's body without descending into nested defs (they
+        are separate Funcs, linked via ``nested``)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(func.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def reachable(self, roots: Iterable[Func]) -> Set[Func]:
+        seen: Set[Func] = set()
+        stack = list(roots)
+        while stack:
+            f = stack.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            stack.extend(self.references(f) - seen)
+        return seen
+
+
+def build_index(package: Package) -> FunctionIndex:
+    return package.shared("function_index", FunctionIndex)

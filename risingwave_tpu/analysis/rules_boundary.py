@@ -1,0 +1,222 @@
+"""The five migrated boundary lints — AST-grounded replacements for the
+grep blocks that used to live in scripts/check.sh.
+
+Each rule here guards a subsystem *boundary*: a constructor or call
+that must only appear inside the one module that owns the invariant.
+The grep versions matched byte patterns, so they fired on docstrings
+and comments (false positives) and went blind the moment anyone wrote
+``from ..stream.dispatch import PermitChannel as PC`` (false
+negatives). These match resolved call expressions: an alias is caught,
+a mention in prose is not. tests/test_rwlint.py pins one
+grep-beats-nothing case of each kind per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Sequence, Set
+
+from .core import Finding, Package, Rule, register
+
+PKG = "risingwave_tpu"
+
+
+def _call_sites(package: Package, *, targets: Set[str],
+                exempt: Sequence[str] = ()):
+    """Yield (module, call) for calls whose callee resolves — through
+    import aliases and re-export chains — to one of ``targets``."""
+    for rel, mod in package.modules.items():
+        if rel in exempt:
+            continue
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            qn = package.canonical(
+                mod.imports.resolve_or_local(node.func))
+            if qn in targets:
+                yield mod, node
+
+
+@register
+class ExchangeBoundary(Rule):
+    name = "exchange-boundary"
+    title = "PermitChannel constructed only inside the dispatch fabric"
+    ci_label = "exchange-boundary"
+    doc = """Every exchange edge must go through the dispatch fabric
+(stream/dispatch.py open_channel / the frontend fragment builders). A
+raw ``PermitChannel(...)`` anywhere else means a module wired its own
+flow control outside the subsystem boundary — its frames would dodge
+backpressure accounting and the chaos plane. Guards the PR-2 exchange
+subsystem; replaces the check.sh grep that missed import aliases."""
+
+    TARGET = f"{PKG}.stream.dispatch.PermitChannel"
+    EXEMPT = ("stream/dispatch.py", "frontend/fragments.py")
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for mod, call in _call_sites(package, targets={self.TARGET},
+                                     exempt=self.EXEMPT):
+            yield Finding(self.name, mod.rel, call.lineno,
+                          call.col_offset,
+                          "raw PermitChannel construction outside the "
+                          "dispatch fabric (use stream/dispatch."
+                          "open_channel or the fragment builders)")
+
+
+@register
+class WireBoundary(Rule):
+    name = "wire-boundary"
+    title = "socket IO only inside rpc/wire.py (or the broker)"
+    ci_label = "wire-boundary"
+    doc = """Every internal RPC frame must flow through rpc/wire.py,
+where the network fault plane's per-link FaultyTransport hooks live.
+A ``.sendall(...)`` / socket ``.recv(...)`` call anywhere else is a
+wire path chaos schedules cannot reach. connector/broker.py is exempt:
+it is an EXTERNAL boundary with its own line protocol, hardened by the
+PR-3 reconnect layer instead. The old grep matched only receivers
+literally named ``sock`` — any other variable name slipped through."""
+
+    EXEMPT = ("rpc/wire.py", "connector/broker.py")
+    #: unambiguous socket methods — no other object family in this
+    #: codebase has them
+    ALWAYS = {"sendall", "recv_into", "sendmsg", "recvmsg"}
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for rel, mod in package.modules.items():
+            if rel in self.EXEMPT:
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                # socket.recv(bufsize) REQUIRES a size argument; the
+                # dispatch fabric's async channel .recv() takes none —
+                # an argument-free recv is a channel receive, not wire
+                # IO. This is the discrimination grep could not make.
+                sockety = attr in self.ALWAYS or (
+                    attr == "recv" and (node.args or node.keywords))
+                if not sockety:
+                    continue
+                qn = mod.imports.resolve(node.func)
+                if qn is not None and not qn.startswith(PKG):
+                    continue
+                yield Finding(self.name, mod.rel, node.lineno,
+                              node.col_offset,
+                              f"raw socket .{attr}() outside the "
+                              "rpc/wire.py fault-plane boundary")
+
+
+@register
+class PlacementMutation(Rule):
+    name = "placement-mutation"
+    title = "placement state mutated only via the scaling plane"
+    ci_label = "placement-mutation"
+    doc = """Fragment→worker placement must equal routing at all times;
+the diff math that guarantees it lives in meta/rescale.py
+commit_placement, and the raw ``"placement/"`` meta-store keyspace
+belongs to meta/service.py alone. A direct key write or a
+``save_placement(...)`` call elsewhere bypasses the live-migration
+fencing from PR 10. The grep version fired on every docstring that
+mentioned the keyspace; this rule skips docstrings (no Call / no
+non-doc string constant) and still sees f-string key construction."""
+
+    KEY_EXEMPT = ("meta/service.py",)
+    CALL_EXEMPT = ("meta/service.py", "meta/rescale.py")
+    TARGET = f"{PKG}.meta.service.MetaService.save_placement"
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for rel, mod in package.modules.items():
+            docs = None
+            if rel not in self.KEY_EXEMPT:
+                docs = mod.docstring_linenos()
+                for node in mod.walk():
+                    lit = self._placement_literal(node)
+                    if lit is None or node.lineno in docs:
+                        continue
+                    yield Finding(
+                        self.name, mod.rel, node.lineno, node.col_offset,
+                        'raw "placement/" meta-store key outside '
+                        "meta/service.py")
+            if rel not in self.CALL_EXEMPT:
+                for node in mod.walk():
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "save_placement":
+                        yield Finding(
+                            self.name, mod.rel, node.lineno,
+                            node.col_offset,
+                            "placement mutation outside meta/rescale.py "
+                            "commit_placement")
+
+    #: the keyspace prefix this rule polices; spelled once so the
+    #: detector's own source carries exactly one (annotated) literal
+    PREFIX = \
+        "placement/"  # rwlint: allow(placement-mutation): the rule itself must name the keyspace it matches
+
+    @classmethod
+    def _placement_literal(cls, node: ast.AST) -> Optional[str]:
+        # plain Constant covers both bare strings and the constant
+        # segments inside an f-string (ast.walk visits JoinedStr parts
+        # as Constant nodes), so f"placement/{job}" keys are seen too
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith(cls.PREFIX):
+            return node.value
+        return None
+
+
+@register
+class ServingCache(Rule):
+    name = "serving-cache"
+    title = "batch SELECTs lower only through the serving plane"
+    ci_label = "serving-cache"
+    doc = """Every batch SELECT must lower through frontend/serving.py
+so the version-pinned plan cache sees it; a direct ``lower_plan(...)``
+call inside frontend/session.py bypasses the cache layer and its
+0-recompile + two-phase guarantees (PR 8). Alias-aware: importing
+``lower_plan as _lp`` is still caught — the old grep was not."""
+
+    ONLY = ("frontend/session.py",)
+    TARGET = f"{PKG}.batch.lower.lower_plan"
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for rel in self.ONLY:
+            mod = package.module(rel)
+            if mod is None:
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.Call):
+                    continue
+                qn = package.canonical(
+                    mod.imports.resolve_or_local(node.func))
+                named = isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "lower_plan"
+                if qn == self.TARGET or named:
+                    yield Finding(
+                        self.name, mod.rel, node.lineno, node.col_offset,
+                        "direct lower_plan call in Session bypasses the "
+                        "serving cache")
+
+
+@register
+class BoundaryIO(Rule):
+    name = "boundary-io"
+    title = "object stores opened only behind the retry boundary"
+    ci_label = "boundary-IO"
+    doc = """Every durable-tier consumer must open its store via
+open_object_store/wrap_object_store (the retry boundary from PR 3). A
+raw ``LocalFsObjectStore(...)`` anywhere else performs unwrapped
+single-shot IO on the barrier path — one transient EIO becomes a
+failed checkpoint. Alias-aware like the rest of this family."""
+
+    TARGET = f"{PKG}.storage.object_store.LocalFsObjectStore"
+    EXEMPT = ("storage/object_store.py",)
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for mod, call in _call_sites(package, targets={self.TARGET},
+                                     exempt=self.EXEMPT):
+            yield Finding(self.name, mod.rel, call.lineno,
+                          call.col_offset,
+                          "raw object-store construction outside the "
+                          "retry boundary (use open_object_store / "
+                          "wrap_object_store)")

@@ -18,11 +18,14 @@ _ARMED: Dict[str, Any] = {}
 
 #: Every failpoint site in the codebase, declared up front so the
 #: crash-point sweep (sim.py) can iterate ALL of them — including sites
-#: a particular workload has not executed yet. ``fail_point`` also
-#: self-registers at first execution, so a site added without updating
-#: this list still shows up after it first runs (and the boundary test
-#: asserting declared ⊇ executed keeps the list honest).
-KNOWN_SITES: "set[str]" = {
+#: a particular workload has not executed yet. This literal is the
+#: source of truth the lint-time honesty rule reads STATICALLY
+#: (``rwlint``'s failpoint-honesty, docs/static-analysis.md): the set
+#: of ``fail_point("...")`` literals in the package must equal this
+#: set, both directions, so the declared⊇executed guarantee holds
+#: before any test runs. Keep it a pure literal — computed entries
+#: would be invisible to the static check.
+DECLARED_SITES: "frozenset[str]" = frozenset({
     # segment checkpoint log (storage/checkpoint.py) — incl. both 2PC
     # phases of the spanning-job cluster checkpoint
     "checkpoint.manifest.write",
@@ -48,11 +51,27 @@ KNOWN_SITES: "set[str]" = {
     "rescale.commit",
     # meta store durable txn append (meta/store.py)
     "meta.store.txn",
-}
+})
+
+#: The RUNTIME registry: seeded from the declaration, grown by
+#: ``fail_point`` self-registration at first execution (a site added
+#: without updating DECLARED_SITES still shows up here after it first
+#: runs — and fails the lint until declared).
+KNOWN_SITES: "set[str]" = set(DECLARED_SITES)
 
 
 def register_site(*names: str) -> None:
     KNOWN_SITES.update(names)
+
+
+def declared_sites() -> List[str]:
+    """Runtime mirror of the ``DECLARED_SITES`` literal, sorted for
+    stable iteration. The honesty lint reads the literal STATICALLY
+    from the AST and the crash-point sweep iterates
+    ``registered_sites()``; this helper is for tooling/tests that want
+    the declared set at runtime (the lint-wiring smoke cross-checks the
+    lint's static parse against it)."""
+    return sorted(DECLARED_SITES)
 
 
 def registered_sites() -> List[str]:
