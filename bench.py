@@ -1514,6 +1514,21 @@ def run_smoke() -> int:
         assert n == 1, f"sharded epoch took {n} dispatches"
         sf.flush()
         checks.append(f"sharded[{n_dev}]=1 dispatch/epoch")
+    # device profiling plane (common/profiling.py): ON by default, and
+    # every 1-dispatch assertion above ran THROUGH its wrappers — so the
+    # invariants passing IS the proof that profiling adds zero
+    # dispatches. Cross-check its live counters against the same
+    # qualnames the dispatch counter keyed.
+    from risingwave_tpu.common.profiling import GLOBAL_PROFILER
+    assert GLOBAL_PROFILER.enabled, "profiling plane is off by default"
+    prof = GLOBAL_PROFILER.counts()
+    for qn in ("build_group_epoch.<locals>.coscheduled_epoch",
+               "fused_source_session_epoch.<locals>.epoch",
+               "fused_source_q3_epoch.<locals>.epoch",
+               "sharded_agg_epoch.<locals>.epoch"):
+        assert prof.get(qn, 0) >= 1, \
+            f"profiler missed dispatches for {qn}: {prof}"
+    checks.append("profiling on: counters live, 0 added dispatches")
     # serving plane: a repeated identical SELECT must create ZERO new
     # jit wrappers (plan+compilation cache, frontend/serving.py) — and a
     # write in between re-executes the SAME cached executors, still

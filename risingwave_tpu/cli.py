@@ -119,20 +119,45 @@ def main(argv=None) -> int:
     ctl.add_argument("what", choices=["jobs", "parameters", "fragments",
                                       "metrics", "trace", "backup",
                                       "restore", "backup-info",
-                                      "hummock", "vacuum", "cluster"])
+                                      "hummock", "vacuum", "cluster",
+                                      "profile", "bench"])
     ctl.add_argument("sub", nargs="?", default=None,
                      help="subcommand for `ctl cluster` "
                      "(fragments — dump the persisted fragment→worker "
                      "placement and per-edge permit state of spanning "
                      "jobs; rescale — live-migrate one spanning job to "
                      "a new parallelism; autoscaler — dump the scaling "
-                     "plane's policy state and executed migrations)")
+                     "plane's policy state and executed migrations), "
+                     "`ctl profile` (roofline — AOT cost/memory "
+                     "analysis of the fused q5/q7 epochs against the "
+                     "chip roofline, chip-free) and `ctl bench` "
+                     "(trend — per-field trend with regression flags "
+                     "over the checked-in BENCH_r*.json records)")
     ctl.add_argument("job", nargs="?", default=None,
                      help="job name for `ctl cluster rescale`")
     ctl.add_argument("--parallelism", type=int, default=None,
                      help="target fragment parallelism for "
                      "`ctl cluster rescale` (docs/scaling.md)")
-    ctl.add_argument("--data-dir", required=True)
+    ctl.add_argument("--data-dir", default=None,
+                     help="durable data dir (required for every ctl "
+                     "command except `profile` and `bench`, which read "
+                     "no cluster state)")
+    ctl.add_argument("--json", action="store_true",
+                     help="profile/bench: emit the full JSON report "
+                     "instead of the table")
+    ctl.add_argument("--peak-flops", type=float, default=None,
+                     help="profile roofline: chip peak FLOP/s "
+                     "(default [observability] chip_peak_flops)")
+    ctl.add_argument("--peak-bandwidth", type=float, default=None,
+                     help="profile roofline: chip HBM bandwidth in "
+                     "bytes/s (default [observability] "
+                     "chip_peak_bandwidth)")
+    ctl.add_argument("--tolerance", type=float, default=0.2,
+                     help="bench trend: relative move off the best "
+                     "prior value that flags a regression")
+    ctl.add_argument("--bench-dir", default=".",
+                     help="bench trend: directory holding "
+                     "BENCH_r*.json / BENCH_partial.json")
     ctl.add_argument("--backup-dir",
                      help="backup location for backup/restore/backup-info")
     ctl.add_argument("--workers", type=int, default=0,
@@ -181,6 +206,18 @@ def _ctl(args) -> int:
     trace, profile; meta backup/restore:
     src/meta/src/backup_restore/backup_manager.rs)."""
     import json as _json
+    if args.what == "profile":
+        if args.sub != "roofline":
+            raise SystemExit("usage: ctl profile roofline "
+                             "[--peak-flops F --peak-bandwidth B --json]")
+        return _ctl_profile_roofline(args, _json)
+    if args.what == "bench":
+        if args.sub != "trend":
+            raise SystemExit("usage: ctl bench trend "
+                             "[--bench-dir DIR --tolerance T --json]")
+        return _ctl_bench_trend(args, _json)
+    if not args.data_dir:
+        raise SystemExit("--data-dir is required")
     if args.what in ("backup", "restore", "backup-info"):
         from .storage.backup import (
             create_backup, list_backup, restore_backup,
@@ -242,6 +279,99 @@ def _ctl(args) -> int:
         _ctl_dispatch(args, session, _json)
     finally:
         session.close()
+    return 0
+
+
+def _roofline_targets() -> dict:
+    """Representative fused q5/q7 epoch callables at bench-like shapes
+    (the same builds bench.py measures), for chip-free AOT analysis —
+    nothing is executed, so this works with no chip attached."""
+    import jax
+    import jax.numpy as jnp
+    from .common import INT64, TIMESTAMP
+    from .common.types import Field, Schema
+    from .connector import NexmarkConfig
+    from .connector.nexmark import DeviceBidGenerator
+    from .expr import Literal, call, col
+    from .expr.agg import count_star
+    from .ops.fused_epoch import EPOCH_BUILDERS
+    from .ops.grouped_agg import AggCore
+    from .ops.interval_join import IntervalJoinCore
+
+    cap, k, window_us = 1024, 8, 10_000_000
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+    start, key = jnp.int64(0), jax.random.PRNGKey(0)
+    # q5: source → project → grouped agg (the fused_source_agg_epoch
+    # surface bench.py's q5 phase measures)
+    q5_exprs = [call("tumble_start", col(5, TIMESTAMP),
+                     Literal(window_us, INT64)), col(0, INT64)]
+    q5_core = AggCore((INT64, INT64), (0, 1), [count_star()],
+                      table_capacity=1 << 16, out_capacity=cap)
+    q5 = EPOCH_BUILDERS["source_agg"](gen.chunk_fn(), q5_exprs, q5_core,
+                                      cap)
+    # q7: source → project → bucketed interval join + max flush
+    q7_exprs = [call("tumble_start", col(5, TIMESTAMP),
+                     Literal(window_us, INT64)),
+                col(0, INT64), col(2, INT64)]
+    probe_schema = Schema((Field("window_start", TIMESTAMP),
+                           Field("auction", INT64),
+                           Field("price", INT64)))
+    q7_core = IntervalJoinCore(probe_schema, ts_col=0, val_col=2,
+                               window_us=window_us, n_buckets=1 << 12,
+                               lane_width=16)
+    q7 = EPOCH_BUILDERS["source_join"](gen.chunk_fn(), q7_exprs, q7_core,
+                                       cap)
+    return {
+        "fused_source_agg_epoch.<locals>.epoch":
+            (q5, (q5_core.init_state(), start, key, k)),
+        "fused_source_join_epoch.<locals>.epoch":
+            (q7, (q7_core.init_state(), start, key, k)),
+    }
+
+
+def _ctl_profile_roofline(args, _json) -> int:
+    """`ctl profile roofline`: AOT-``lower().compile()`` the fused q5
+    and q7 epochs and print each kernel's flops / bytes accessed /
+    arithmetic intensity / %-of-peak against the chip roofline — the
+    measured-roofline artifact ROADMAP item 1 demands, available
+    chip-free (docs/performance.md)."""
+    from .common.config import ObservabilityConfig
+    from .common.profiling import (
+        aot_analysis, render_roofline_table, roofline_report,
+    )
+    obs = ObservabilityConfig()
+    peak_flops = args.peak_flops or obs.chip_peak_flops
+    peak_bw = args.peak_bandwidth or obs.chip_peak_bandwidth
+    analyses = {}
+    for name, (fn, fn_args) in _roofline_targets().items():
+        analyses[name] = aot_analysis(fn, *fn_args)
+    report = roofline_report(analyses, peak_flops, peak_bw)
+    if args.json:
+        print(_json.dumps(report, indent=2))
+    else:
+        print(render_roofline_table(report))
+    return 0
+
+
+def _ctl_bench_trend(args, _json) -> int:
+    """`ctl bench trend`: fold every checked-in BENCH_r*.json round and
+    BENCH_partial.json phase record into a per-field trend, flagging
+    fields whose latest value regressed past ``--tolerance`` off the
+    best prior value — ROADMAP item 5's "regressions in ANY plane show
+    up as a trend"."""
+    from .common.profiling import (
+        bench_trend, load_bench_history, render_trend_table,
+    )
+    history = load_bench_history(args.bench_dir)
+    if not history:
+        raise SystemExit(
+            f"no BENCH_r*.json / BENCH_partial.json under "
+            f"{args.bench_dir!r}")
+    trend = bench_trend(history, tolerance=args.tolerance)
+    if args.json:
+        print(_json.dumps(trend, indent=2))
+    else:
+        print(render_trend_table(trend))
     return 0
 
 

@@ -56,11 +56,10 @@ class StreamingConfig:
     # Refuses loudly (MeshUnavailableError) when the process has fewer
     # devices. 0/None = single-chip.
     mesh_shape: Optional[int] = None
-    # observability (common/tracing.py): span ring size per process, and
-    # the slow-epoch detector — an epoch whose inject→collect latency
-    # meets the threshold gets its span tree snapshotted for post-hoc
-    # inspection (0 disables; reference capability: barrier_latency
-    # histograms + await-tree dumps read together by hand)
+    # LEGACY aliases of [observability] trace_ring_capacity /
+    # slow_epoch_threshold_ms (kept so existing configs keep working;
+    # an explicitly-set [observability] value wins — see
+    # ObservabilityConfig below)
     trace_ring_capacity: int = 4096
     slow_epoch_threshold_ms: float = 0.0
 
@@ -214,6 +213,40 @@ class AutoscalerConfig:
 
 
 @dataclasses.dataclass
+class ObservabilityConfig:
+    """Device profiling plane + tracing knobs (common/profiling.py,
+    common/tracing.py, docs/observability.md). Reference capability:
+    the monitor-service profiling handlers + streaming metrics config
+    (src/compute/src/rpc/service/monitor_service.rs)."""
+
+    # per-dispatch telemetry (DispatchProfiler): wall seconds, recompile
+    # events, trace-ring spans for every profiled dispatch site. Pure
+    # host bookkeeping — adds zero dispatches (CI-guarded); off turns
+    # the wrappers into passthroughs.
+    profiling: bool = True
+    # dispatch spans shorter than this skip the trace ring (0 = record
+    # every dispatch; the ring is bounded either way)
+    dispatch_span_min_ms: float = 0.0
+    # span ring + slow-epoch detector — the canonical home of the knobs
+    # that used to live only on [streaming] (which still works as a
+    # legacy alias). Unset (None) inherits the alias; ANY value set
+    # here wins, including one equal to the alias default (effective
+    # defaults: 4096 spans, 0.0 = detector off)
+    trace_ring_capacity: Optional[int] = None
+    slow_epoch_threshold_ms: Optional[float] = None
+    # cluster-wide HBM ledger: resident state + analyzed peak temp
+    # bytes are charged against this capacity (default 16 GiB ≈ one
+    # v5e chip); a job reaching hbm_warn_fraction of it is flagged
+    hbm_capacity_bytes: int = 16 << 30
+    hbm_warn_fraction: float = 0.8
+    # roofline model peaks (ctl profile roofline): chip peak FLOP/s and
+    # HBM bandwidth in bytes/s (defaults ≈ TPU v4: 275 TFLOP/s bf16,
+    # 1.2 TB/s)
+    chip_peak_flops: float = 275e12
+    chip_peak_bandwidth: float = 1.2e12
+
+
+@dataclasses.dataclass
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 4566
@@ -230,6 +263,8 @@ class RwConfig:
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig)
 
 
 def _parse_toml_subset(text: str) -> dict:

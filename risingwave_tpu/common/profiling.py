@@ -1,0 +1,535 @@
+"""Device profiling plane: per-dispatch cost/memory telemetry.
+
+Counterpart of the reference's compute-node profiling surface
+(reference: src/compute/src/rpc/service/monitor_service.rs profiling
+handlers + src/common/src/estimate_size/ feeding eviction decisions).
+The TPU-native variant is XLA-shaped: the unit of work is a *dispatch*
+(one jitted epoch callable entering XLA), so the plane hangs off the
+same qualnames ``common/dispatch_count.py`` and the
+``EPOCH_BUILDERS``/``SHARDED_EPOCH_BUILDERS`` registries already key —
+
+* ``DispatchProfiler`` / ``GLOBAL_PROFILER``: every builder in
+  ops/fused_epoch.py, ops/fused_multi.py, ops/fused_sharded.py and the
+  barrier-step jits in parallel/fused.py returns its jitted callable
+  through ``profile_dispatch(jitted, qualname)``. The wrapper is pure
+  host Python — it adds ZERO dispatches (the same reason
+  count_dispatches' wrapper counts correctly) — and records per call:
+  wall seconds (cumulative device-occupancy proxy on the synchronous
+  CPU stand-in; enqueue latency on an async TPU backend), a
+  jit-cache-miss/recompile event when the underlying executable cache
+  grew during the call (compile seconds = that call's wall time), and
+  a ``cat="dispatch"`` span into the PR-1 Chrome trace ring tagged
+  with the current epoch — a slow epoch attributes to the dispatch
+  that caused it.
+* AOT cost/memory analysis: the first call through a wrapper snapshots
+  the argument *avals* (ShapeDtypeStructs — no device buffers are
+  retained), so ``analyze()`` can later ``.lower().compile()`` the
+  already-traced callable and read XLA's static ``cost_analysis()``
+  flops / bytes-accessed and ``memory_analysis()`` temp/arg/output
+  bytes — chip-free on the CPU stand-in, for-real on TPU.
+* ``hbm_ledger``: the cluster-wide memory ledger — per-job/per-executor
+  state bytes (common/memory.py walks, federated from workers through
+  the existing stats frame) summed with the analyzed peak temp bytes
+  against ``[observability] hbm_capacity_bytes``, reporting headroom
+  and flagging jobs approaching eviction-budget territory.
+* ``roofline_report``: arithmetic intensity (flops / bytes accessed)
+  of each analyzed kernel against configurable chip peak flops and
+  HBM bandwidth — the artifact ROADMAP item 1's "measured roofline
+  analysis" demands (``ctl profile roofline``).
+* ``load_bench_history`` / ``bench_trend``: fold the checked-in
+  BENCH_r*.json + BENCH_partial.json records into a per-field trend
+  with regression flags (``ctl bench trend``) — ROADMAP item 5's
+  "regressions in ANY plane show up as a trend".
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .tracing import CAT_DISPATCH, GLOBAL_TRACE, Span
+
+
+class DispatchRecord:
+    """Telemetry for one dispatch qualname (mutated lock-free on the
+    hot path — single attribute bumps under the GIL)."""
+
+    __slots__ = ("name", "calls", "total_s", "last_s", "max_s",
+                 "compiles", "compile_s")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.total_s = 0.0
+        self.last_s = 0.0
+        self.max_s = 0.0
+        self.compiles = 0
+        self.compile_s = 0.0
+
+    def to_dict(self) -> dict:
+        return {"calls": self.calls,
+                "total_s": round(self.total_s, 6),
+                "last_ms": round(self.last_s * 1e3, 4),
+                "max_ms": round(self.max_s * 1e3, 4),
+                "mean_ms": round(self.total_s / self.calls * 1e3, 4)
+                if self.calls else 0.0,
+                "compiles": self.compiles,
+                "compile_s": round(self.compile_s, 4)}
+
+
+def _aval(x: Any) -> Any:
+    """Arg → ShapeDtypeStruct for AOT lowering (device buffers must not
+    be retained by the profiler); non-array args (static ints, None)
+    pass through for static_argnums."""
+    if hasattr(x, "shape") and hasattr(x, "dtype") \
+            and not isinstance(x, (bool, int, float)):
+        import jax
+        sharding = getattr(x, "sharding", None)
+        try:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=sharding)
+        except Exception:  # noqa: BLE001 - e.g. committed=False shardings
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+class DispatchProfiler:
+    """Process-global dispatch telemetry registry.
+
+    Enabled by default: the hot path per dispatch is one enabled check,
+    two ``perf_counter`` reads, an executable-cache-size probe and a
+    handful of attribute bumps — microseconds against a dispatch that
+    crosses into XLA. ``[observability] profiling = false`` turns the
+    wrapper into a single-attribute-check passthrough."""
+
+    def __init__(self):
+        self.enabled = True
+        #: dispatch spans shorter than this skip the trace ring
+        #: ([observability] dispatch_span_min_ms)
+        self.span_min_ms = 0.0
+        #: current epoch tag for dispatch spans (set by Session.tick)
+        self.epoch: Optional[int] = None
+        self._records: dict[str, DispatchRecord] = {}
+        #: qualname -> (lowerable, arg avals, kwarg avals) for AOT
+        self._lowerable: dict[str, tuple] = {}
+        self._analyses: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # -- hot path --------------------------------------------------------------
+
+    def wrap(self, jitted: Callable, name: Optional[str] = None) -> Callable:
+        """Instrument one jitted callable. The wrapper forwards the AOT
+        surface (``.lower``/``.trace``) exactly like count_dispatches'
+        wrapper, so the two compose in either order and
+        tests/test_pallas_compile.py keeps lowering through it."""
+        name = name or getattr(jitted, "__qualname__",
+                               getattr(jitted, "__name__", repr(jitted)))
+        # the executable cache lives on the innermost real jit object
+        # (wrap may sit on top of a count_dispatches wrapper)
+        inner = jitted
+        while hasattr(inner, "__wrapped_jit__"):
+            inner = inner.__wrapped_jit__
+        cache_size = getattr(inner, "_cache_size", None)
+        profiler = self
+
+        def wrapper(*args, **kwargs):
+            if not profiler.enabled:
+                return jitted(*args, **kwargs)
+            rec = profiler._records.get(name)
+            if rec is None:
+                rec = profiler._record(name)
+            if name not in profiler._lowerable:
+                profiler._remember_aval(name, jitted, args, kwargs)
+            before = cache_size() if cache_size is not None else None
+            ts = time.time()
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            rec.calls += 1
+            rec.total_s += dt
+            rec.last_s = dt
+            if dt > rec.max_s:
+                rec.max_s = dt
+            if before is not None and cache_size() > before:
+                rec.compiles += 1
+                rec.compile_s += dt
+            elif before is None and rec.calls == 1:
+                rec.compiles += 1       # no cache probe: first call compiles
+                rec.compile_s += dt
+            if dt * 1e3 >= profiler.span_min_ms:
+                GLOBAL_TRACE.record(Span(
+                    name, CAT_DISPATCH, ts, dt, epoch=profiler.epoch,
+                    tid="dispatch"))
+            return out
+
+        wrapper.__qualname__ = name
+        wrapper.__name__ = name.rsplit(".", 1)[-1]
+        wrapper.lower = getattr(jitted, "lower", None)
+        wrapper.trace = getattr(jitted, "trace", None)
+        wrapper.__wrapped_jit__ = jitted
+        return wrapper
+
+    def _record(self, name: str) -> DispatchRecord:
+        with self._lock:
+            rec = self._records.get(name)
+            if rec is None:
+                rec = self._records[name] = DispatchRecord(name)
+            return rec
+
+    def _remember_aval(self, name, jitted, args, kwargs) -> None:
+        """Snapshot abstract arg shapes for later AOT analysis. No
+        device buffers are retained, and the callable itself is held
+        only weakly — a dropped engine's compiled executables must not
+        live forever in the profiler."""
+        try:
+            import weakref
+
+            import jax
+            ref = weakref.ref(jitted)
+            a = jax.tree_util.tree_map(_aval, args)
+            k = jax.tree_util.tree_map(_aval, kwargs)
+        except Exception:  # noqa: BLE001 - telemetry must never fail a job
+            return
+        with self._lock:
+            self._lowerable.setdefault(name, (ref, a, k))
+
+    # -- AOT cost / memory analysis --------------------------------------------
+
+    def analyze(self, name: Optional[str] = None,
+                force: bool = False) -> dict:
+        """AOT-``lower().compile()`` recorded callables and read XLA's
+        static cost/memory analysis. Expensive (a fresh compile per
+        qualname) — run on demand (``ctl profile roofline``,
+        ``Session.profile_report()``), never on the barrier path.
+        Results are cached per qualname."""
+        names = [name] if name is not None else list(self._lowerable)
+        out: dict = {}
+        for n in names:
+            if not force and n in self._analyses:
+                out[n] = self._analyses[n]
+                continue
+            entry = self._lowerable.get(n)
+            if entry is None:
+                continue
+            ref, args, kwargs = entry
+            jitted = ref()
+            if jitted is None:          # engine dropped since recording
+                out[n] = {"error": "callable no longer alive"}
+                continue
+            try:
+                out[n] = self._analyses[n] = aot_analysis(
+                    jitted, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - analysis is best-effort
+                out[n] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def analyses(self) -> dict:
+        """Completed analyses only (no recompiles triggered)."""
+        return dict(self._analyses)
+
+    def peak_temp_bytes(self) -> int:
+        """Largest analyzed per-dispatch temp allocation — the scratch
+        HBM one in-flight epoch needs on top of resident state."""
+        return max((a.get("memory", {}).get("temp_bytes", 0)
+                    for a in self._analyses.values()
+                    if isinstance(a, dict)), default=0)
+
+    # -- snapshots -------------------------------------------------------------
+
+    def counts(self) -> dict:
+        """{qualname: calls} — the live twin of count_dispatches."""
+        return {n: r.calls for n, r in self._records.items()}
+
+    def snapshot(self) -> dict:
+        """Full per-qualname telemetry + any completed analyses."""
+        out = {}
+        for n, r in sorted(self._records.items()):
+            d = r.to_dict()
+            a = self._analyses.get(n)
+            if a is not None and "error" not in a:
+                d["cost"] = a.get("cost")
+                d["memory"] = a.get("memory")
+            out[n] = d
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._lowerable.clear()
+            self._analyses.clear()
+
+
+#: the process-global registry every profiled dispatch site records to
+GLOBAL_PROFILER = DispatchProfiler()
+
+
+def profile_dispatch(jitted: Callable,
+                     name: Optional[str] = None) -> Callable:
+    """Instrument a jitted epoch/barrier-step callable against the
+    process-global profiler (the seam ops/ and parallel/ builders
+    return through)."""
+    return GLOBAL_PROFILER.wrap(jitted, name)
+
+
+def aot_analysis(jitted: Callable, *args, **kwargs) -> dict:
+    """``.lower().compile()`` an already-traced callable (args may be
+    ShapeDtypeStructs) and extract XLA's static analyses:
+
+    * ``cost`` — flops + bytes accessed (→ arithmetic intensity)
+    * ``memory`` — argument/output/temp/generated-code bytes (the temp
+      figure is the per-dispatch HBM scratch the ledger charges)
+    """
+    lower = getattr(jitted, "lower", None)
+    if lower is None:
+        raise TypeError(f"{jitted!r} has no .lower AOT surface")
+    compiled = lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):       # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    cost = {"flops": float(ca.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0)}
+    mem: dict = {}
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        mem = {
+            "arg_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+            "out_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+            "code_bytes": int(
+                getattr(ma, "generated_code_size_in_bytes", 0)),
+        }
+    return {"cost": cost, "memory": mem}
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger
+# ---------------------------------------------------------------------------
+
+
+def hbm_ledger(jobs: dict, capacity_bytes: int,
+               peak_temp_bytes: int = 0,
+               warn_fraction: float = 0.8) -> dict:
+    """Cluster-wide HBM ledger. ``jobs``: {job: {"bytes": total,
+    "executors": {ident: bytes}, "worker": wid-or-None}} — the federated
+    per-job/per-executor state-bytes snapshot (common/memory.py walks,
+    session + every worker). Resident state plus the analyzed peak
+    per-dispatch temp bytes is charged against ``capacity_bytes``;
+    a job whose own state + the peak temp reaches ``warn_fraction`` of
+    capacity is flagged (eviction-budget territory: time to set
+    agg_hbm_budget/join_hbm_budget or shard the job)."""
+    capacity = int(capacity_bytes)
+    state_total = sum(int(j.get("bytes", 0)) for j in jobs.values())
+    used = state_total + int(peak_temp_bytes)
+    flagged = sorted(
+        name for name, j in jobs.items()
+        if capacity > 0 and
+        int(j.get("bytes", 0)) + peak_temp_bytes >= warn_fraction * capacity)
+    return {
+        "capacity_bytes": capacity,
+        "state_bytes": state_total,
+        "peak_temp_bytes": int(peak_temp_bytes),
+        "used_bytes": used,
+        "headroom_bytes": capacity - used,
+        "utilization": round(used / capacity, 6) if capacity else 0.0,
+        "warn_fraction": warn_fraction,
+        "jobs": {name: dict(j) for name, j in sorted(jobs.items())},
+        "flagged": flagged,
+    }
+
+
+# ---------------------------------------------------------------------------
+# roofline
+# ---------------------------------------------------------------------------
+
+
+def roofline_report(analyses: dict, peak_flops: float,
+                    peak_bandwidth: float) -> dict:
+    """Place each analyzed kernel on the roofline: arithmetic intensity
+    = flops / bytes accessed; attainable flops = min(peak,
+    intensity · bandwidth); ``bound`` says which wall the kernel sits
+    under. ``analyses``: {qualname: aot_analysis() result}."""
+    critical = peak_flops / peak_bandwidth if peak_bandwidth else 0.0
+    kernels: dict = {}
+    for name, a in sorted(analyses.items()):
+        if not isinstance(a, dict) or "error" in a:
+            kernels[name] = {"error": (a or {}).get("error", "unanalyzed")}
+            continue
+        flops = a["cost"]["flops"]
+        nbytes = a["cost"]["bytes_accessed"]
+        intensity = flops / nbytes if nbytes else 0.0
+        attainable = min(peak_flops, intensity * peak_bandwidth) \
+            if peak_bandwidth else peak_flops
+        kernels[name] = {
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "intensity": round(intensity, 4),
+            "bound": ("compute" if critical and intensity >= critical
+                      else "memory"),
+            "attainable_flops": attainable,
+            "pct_of_peak_flops": round(100.0 * attainable / peak_flops, 3)
+            if peak_flops else 0.0,
+            "memory": a.get("memory", {}),
+        }
+    return {
+        "peak_flops": peak_flops,
+        "peak_bandwidth_bytes_per_s": peak_bandwidth,
+        "critical_intensity": round(critical, 4),
+        "kernels": kernels,
+    }
+
+
+def render_roofline_table(report: dict) -> str:
+    rows = [("kernel", "gflops", "mbytes", "flops/byte", "bound",
+             "% of peak")]
+    for name, k in report["kernels"].items():
+        if "error" in k:
+            rows.append((name, "-", "-", "-", "error", k["error"]))
+            continue
+        rows.append((name,
+                     f"{k['flops'] / 1e9:.3f}",
+                     f"{k['bytes_accessed'] / 1e6:.3f}",
+                     f"{k['intensity']:.3f}",
+                     k["bound"],
+                     f"{k['pct_of_peak_flops']:.3f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.append(
+        f"(peak {report['peak_flops'] / 1e12:.1f} TFLOP/s, "
+        f"{report['peak_bandwidth_bytes_per_s'] / 1e9:.0f} GB/s, "
+        f"critical intensity {report['critical_intensity']:.1f} "
+        "flops/byte)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# bench trend
+# ---------------------------------------------------------------------------
+
+#: substrings marking a field where LOWER is better (latency-like);
+#: everything else numeric is treated as higher-is-better (rates)
+_LOWER_BETTER = ("p50", "p90", "p99", "latency", "pause", "_ms",
+                 "duration", "seconds")
+
+
+def _lower_is_better(field: str) -> bool:
+    f = field.lower()
+    return any(m in f for m in _LOWER_BETTER)
+
+
+def _numeric_fields(rec: dict, prefix: str = "") -> dict:
+    out: dict = {}
+    for k, v in rec.items():
+        if isinstance(v, bool) or k in ("n", "rc"):
+            continue
+        if isinstance(v, (int, float)):
+            out[prefix + k] = float(v)
+        elif isinstance(v, dict):
+            out.update(_numeric_fields(v, prefix + k + "."))
+    return out
+
+
+def load_bench_history(root: str = ".") -> list:
+    """Checked-in bench records, oldest first: every BENCH_r*.json
+    round (its ``parsed`` payload) plus every completed phase line in
+    BENCH_partial.json. Each entry: {"label", "ok", "fields"}."""
+    history: list = []
+    for path in sorted(_glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") or {}
+        history.append({
+            "label": f"r{m.group(1)}" if m else os.path.basename(path),
+            "ok": rec.get("rc") == 0,
+            "fields": _numeric_fields(parsed) if isinstance(parsed, dict)
+            else {},
+        })
+    partial = os.path.join(root, "BENCH_partial.json")
+    if os.path.exists(partial):
+        with open(partial) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                payload = rec.get("record") or {}
+                history.append({
+                    "label": f"partial:{rec.get('phase', i)}",
+                    "ok": payload.get("rc", 0) in (0, None),
+                    "fields": _numeric_fields(payload)
+                    if isinstance(payload, dict) else {},
+                })
+    return history
+
+
+def bench_trend(history: list, tolerance: float = 0.2) -> dict:
+    """Per-field trend over the bench history with regression flags: the
+    LAST reported value of a field is compared against the BEST earlier
+    value; a >``tolerance`` relative move in the bad direction (down for
+    rates, up for latencies) flags the field. Rounds that failed
+    (``ok`` false) still contribute whatever fields they salvaged."""
+    series: dict = {}
+    for entry in history:
+        for field, value in entry["fields"].items():
+            series.setdefault(field, []).append((entry["label"], value))
+    fields: dict = {}
+    regressions: list = []
+    for field, points in sorted(series.items()):
+        values = [v for _, v in points]
+        latest_label, latest = points[-1]
+        lower_better = _lower_is_better(field)
+        entry = {
+            "points": [{"label": l, "value": v} for l, v in points],
+            "latest": latest,
+            "best": min(values) if lower_better else max(values),
+            "lower_is_better": lower_better,
+            "regressed": False,
+        }
+        if len(points) > 1:
+            prior = values[:-1]
+            best_prior = min(prior) if lower_better else max(prior)
+            if lower_better:
+                regressed = best_prior > 0 and \
+                    latest > best_prior * (1 + tolerance)
+            else:
+                regressed = best_prior > 0 and \
+                    latest < best_prior * (1 - tolerance)
+            if regressed:
+                entry["regressed"] = True
+                entry["vs_best"] = round(latest / best_prior, 4)
+                regressions.append(field)
+        fields[field] = entry
+    return {"rounds": [e["label"] for e in history],
+            "tolerance": tolerance,
+            "fields": fields,
+            "regressions": regressions}
+
+
+def render_trend_table(trend: dict) -> str:
+    rows = [("field", "points", "best", "latest", "flag")]
+    for field, e in trend["fields"].items():
+        flag = "REGRESSED" if e["regressed"] else ""
+        rows.append((field, str(len(e["points"])),
+                     f"{e['best']:.6g}", f"{e['latest']:.6g}", flag))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    if trend["regressions"]:
+        lines.append(f"regressions (> {trend['tolerance']:.0%} off best): "
+                     + ", ".join(trend["regressions"]))
+    else:
+        lines.append("no regressions flagged")
+    return "\n".join(lines)

@@ -40,6 +40,7 @@ CAT_EPOCH = "epoch"          # whole-epoch + inject/collect conductor spans
 CAT_BARRIER = "barrier"      # per-executor on_barrier work
 CAT_STORAGE = "storage"      # state-table / store commit work
 CAT_EXCHANGE = "exchange"    # cross-process data movement
+CAT_DISPATCH = "dispatch"    # jitted-epoch dispatches (common/profiling.py)
 
 
 @dataclasses.dataclass

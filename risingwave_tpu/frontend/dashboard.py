@@ -48,6 +48,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>serving plane</h2><pre id="serving">loading…</pre>
 <h2>scaling</h2><pre id="scaling">loading…</pre>
 <h2>chaos / fault plane</h2><pre id="chaos">loading…</pre>
+<h2>profiling</h2><pre id="profiling">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
 <h2>storage tier</h2><pre id="storage">loading…</pre>
@@ -71,6 +72,8 @@ async function loadStorage() {
     JSON.stringify(m.autoscaler || {}, null, 2);
   document.getElementById("chaos").textContent =
     JSON.stringify(m.chaos || {}, null, 2);
+  document.getElementById("profiling").textContent =
+    JSON.stringify(m.profiling || {}, null, 2);
   document.getElementById("metrics").textContent =
     JSON.stringify(m, null, 2);
 }
@@ -171,12 +174,24 @@ class DashboardServer:
                 if action == "start":
                     if srv._profiling:
                         return 409, {"error": "profiler already running"}
-                    jax.profiler.start_trace(srv.profiler_dir)
+                    try:
+                        jax.profiler.start_trace(srv.profiler_dir)
+                    except RuntimeError as e:
+                        # the jax profiler is PROCESS-global: a capture
+                        # started by another server instance (or by user
+                        # code) makes start_trace raise — that is the
+                        # idempotency case, not an internal error, so it
+                        # must answer 409 instead of raising out of the
+                        # handler thread as a 500
+                        return 409, {"error": f"profiler already "
+                                              f"running: {e}"}
                     srv._profiling = True
                     return 200, {"ok": True, "dir": srv.profiler_dir}
                 if srv._profiling:
                     try:
                         jax.profiler.stop_trace()
+                    except Exception as e:  # noqa: BLE001 - report, don't 500
+                        return 500, {"error": f"stop_trace failed: {e}"}
                     finally:
                         # even a failed stop ends the capture session —
                         # a sticky True would wedge /start with 409 and

@@ -180,6 +180,63 @@ def render_metrics(session) -> str:
                 lines.append(
                     f'rw_autoscaler_parallelism{{job="{_sanitize(job)}"}} '
                     f'{sig["parallelism"]}')
+    profiling = m.get("profiling") or {}
+    if profiling:
+        # merge worker processes' dispatch records under the same
+        # qualnames (one scrape covers the whole cluster's dispatches)
+        merged: dict = {}
+        sources = [profiling.get("dispatch") or {}]
+        sources += [(wp or {}) for wp in
+                    (profiling.get("workers") or {}).values()]
+        for src in sources:
+            for qn, rec in src.items():
+                agg = merged.setdefault(
+                    qn, {"calls": 0, "total_s": 0.0, "compiles": 0})
+                agg["calls"] += rec.get("calls", 0)
+                agg["total_s"] += rec.get("total_s", 0.0)
+                agg["compiles"] += rec.get("compiles", 0)
+        lines += ["# HELP rw_dispatch_total Jitted-epoch dispatches "
+                  "per qualname (common/profiling.py), session plus "
+                  "every worker process.",
+                  "# TYPE rw_dispatch_total counter"]
+        for qn, rec in sorted(merged.items()):
+            lines.append(
+                f'rw_dispatch_total{{qualname="{_sanitize(qn)}"}} '
+                f'{rec["calls"]}')
+        lines += ["# HELP rw_dispatch_seconds Cumulative dispatch "
+                  "wall seconds per qualname.",
+                  "# TYPE rw_dispatch_seconds counter"]
+        for qn, rec in sorted(merged.items()):
+            lines.append(
+                f'rw_dispatch_seconds{{qualname="{_sanitize(qn)}"}} '
+                f'{round(rec["total_s"], 6)}')
+        lines += ["# HELP rw_compile_total Jit-cache-miss/recompile "
+                  "events per qualname.",
+                  "# TYPE rw_compile_total counter"]
+        for qn, rec in sorted(merged.items()):
+            lines.append(
+                f'rw_compile_total{{qualname="{_sanitize(qn)}"}} '
+                f'{rec["compiles"]}')
+        hbm = profiling.get("hbm") or {}
+        if hbm:
+            lines += ["# HELP rw_hbm_bytes Per-job/per-executor resident "
+                      "device-state bytes charged to the HBM ledger "
+                      "(federated from every worker).",
+                      "# TYPE rw_hbm_bytes gauge"]
+            for job, entry in (hbm.get("jobs") or {}).items():
+                lines.append(
+                    f'rw_hbm_bytes{{job="{_sanitize(job)}",'
+                    f'executor="_total"}} {entry.get("bytes", 0)}')
+                for ident, nb in (entry.get("executors") or {}).items():
+                    lines.append(
+                        f'rw_hbm_bytes{{job="{_sanitize(job)}",'
+                        f'executor="{_sanitize(ident)}"}} {nb}')
+            lines += ["# HELP rw_hbm_headroom_bytes HBM capacity minus "
+                      "resident state and analyzed peak temp bytes "
+                      "([observability] hbm_capacity_bytes).",
+                      "# TYPE rw_hbm_headroom_bytes gauge",
+                      f'rw_hbm_headroom_bytes '
+                      f'{hbm.get("headroom_bytes", 0)}']
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

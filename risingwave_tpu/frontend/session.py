@@ -220,6 +220,8 @@ class Session:
         self._worker_stats: dict[int, dict] = {}
         self._worker_stats_at = 0.0            # monotonic; rate-limits polls
         self._worker_span_ack: dict[int, int] = {}   # last span_seq ingested
+        from ..common.config import ObservabilityConfig
+        self.observability = ObservabilityConfig()
         if rw_config is not None:
             st = rw_config.streaming
             checkpoint_frequency = st.checkpoint_frequency
@@ -230,10 +232,27 @@ class Session:
                 state_store = rw_config.storage.state_store
             if not compactors:
                 compactors = rw_config.storage.compactors
-            self.slow_epoch_threshold_ms = float(st.slow_epoch_threshold_ms)
+            # span ring + slow-epoch knobs: [observability] is the
+            # canonical section; the original [streaming] fields remain a
+            # legacy alias — a set (non-None) observability value wins
+            obs = rw_config.observability
+            self.observability = obs
+            self.slow_epoch_threshold_ms = float(
+                obs.slow_epoch_threshold_ms
+                if obs.slow_epoch_threshold_ms is not None
+                else st.slow_epoch_threshold_ms)
+            ring = (obs.trace_ring_capacity
+                    if obs.trace_ring_capacity is not None
+                    else st.trace_ring_capacity)
             from ..common.tracing import GLOBAL_TRACE
-            if st.trace_ring_capacity != GLOBAL_TRACE.capacity:
-                GLOBAL_TRACE.set_capacity(st.trace_ring_capacity)
+            if ring != GLOBAL_TRACE.capacity:
+                GLOBAL_TRACE.set_capacity(ring)
+        # device profiling plane (common/profiling.py): per-dispatch
+        # telemetry + HBM ledger; pure host bookkeeping, on by default
+        from ..common.profiling import GLOBAL_PROFILER
+        GLOBAL_PROFILER.enabled = self.observability.profiling
+        GLOBAL_PROFILER.span_min_ms = self.observability.dispatch_span_min_ms
+        if rw_config is not None:
             mesh = None
             if st.mesh_shape:
                 # [streaming] mesh_shape: a 1-D device mesh for the
@@ -388,6 +407,11 @@ class Session:
         # parallel/fused.ShardedFusedAgg).
         self._shardfused_engines: dict[str, tuple] = {}
         self._shardfused_markers: set[str] = set()
+        # epochs run by fused engines this session has since dropped,
+        # per dispatch qualname — the profiler's counts are cumulative,
+        # so the live per_epoch invariant ratio must keep dividing by
+        # these epochs after a DROP + re-CREATE
+        self._dispatch_epochs_retired: dict[str, int] = {}
         self.feeds: list[_SourceFeed] = []
         self.backfills: list[_BackfillRef] = []
         # DML rendezvous (reference: DmlManager, src/source/src/
@@ -2578,10 +2602,25 @@ class Session:
             # the job's source feeds die with it: free their split-state
             # tables (collect BEFORE teardown filters them away)
             dead_feeds = [f for f in self.feeds if f.job == stmt.name]
+            group = self._cosched.jobs.get(stmt.name)
             self._cosched.remove(stmt.name)
+            if group is not None and group.n_jobs == 0 and group.epochs_run:
+                # the job emptied its group: its epochs leave the live
+                # registry, so retire them for the per_epoch ratio
+                qn = "build_group_epoch.<locals>.coscheduled_epoch"
+                self._dispatch_epochs_retired[qn] = \
+                    self._dispatch_epochs_retired.get(qn, 0) \
+                    + group.epochs_run
             self._cosched_engines.pop(stmt.name, None)
             self._cosched_markers.discard(stmt.name)
-            self._shardfused_engines.pop(stmt.name, None)
+            dead_sf = self._shardfused_engines.pop(stmt.name, None)
+            if dead_sf is not None and dead_sf[3].epochs_run:
+                sf = dead_sf[3]
+                qn = ("sharded_agg_epoch.<locals>.epoch"
+                      if type(sf).__name__ == "ShardedFusedAgg"
+                      else "sharded_join_epoch.<locals>.epoch")
+                self._dispatch_epochs_retired[qn] = \
+                    self._dispatch_epochs_retired.get(qn, 0) + sf.epochs_run
             self._shardfused_markers.discard(stmt.name)
             if stmt.name in self.jobs:
                 job = self.jobs.pop(stmt.name)
@@ -2803,6 +2842,10 @@ class Session:
     def _tick_impl(self, generate: bool, checkpoint: Optional[bool],
                    mutation: Optional[Mutation]) -> int:
         epoch = self._injected + 1
+        # tag this tick's dispatch spans (common/profiling.py) so a slow
+        # epoch's span-tree capture includes the dispatches that caused it
+        from ..common.profiling import GLOBAL_PROFILER
+        GLOBAL_PROFILER.epoch = epoch
         if checkpoint is None:
             checkpoint = epoch % self.checkpoint_frequency == 0
         # keep the worker registry in sync with the live job set (workers
@@ -3627,6 +3670,62 @@ class Session:
                             .get("jobs", {}))}
             for w in self.workers
         ]
+        # device profiling plane (common/profiling.py): per-qualname
+        # dispatch telemetry + the cluster-wide HBM ledger. The ledger
+        # consumes the ALREADY-federated per-job state-bytes snapshot
+        # above (session-local jobs + every worker's), attributing each
+        # job to the process that hosts its state.
+        from ..common.profiling import GLOBAL_PROFILER, hbm_ledger
+        obs = self.observability
+        job_owner: dict = {name: None for name, job in self.jobs.items()
+                           if job.pipeline is not None}
+        for wid, st in sorted(worker_stats.items()):
+            for name in st.get("state_bytes", {}):
+                job_owner.setdefault(name, wid)
+        ledger_jobs = {}
+        for name, nb in out["state_bytes"].items():
+            if isinstance(nb, dict):
+                total = nb.get("_total", 0)
+                executors = {k: v for k, v in nb.items() if k != "_total"}
+            else:
+                total, executors = int(nb), {}
+            ledger_jobs[name] = {"bytes": int(total),
+                                 "executors": executors,
+                                 "worker": job_owner.get(name)}
+        out["profiling"] = {
+            "enabled": GLOBAL_PROFILER.enabled,
+            "dispatch": GLOBAL_PROFILER.snapshot(),
+            "hbm": hbm_ledger(ledger_jobs, obs.hbm_capacity_bytes,
+                              GLOBAL_PROFILER.peak_temp_bytes(),
+                              obs.hbm_warn_fraction),
+            "workers": {wid: st["profiling"]
+                        for wid, st in sorted(worker_stats.items())
+                        if st.get("profiling")},
+        }
+        # live twin of common/dispatch_count.py: per-qualname dispatch
+        # counts, with the one-dispatch-per-epoch invariants readable
+        # (fused engines report dispatches ÷ epochs_run)
+        dispatch = {"counts": GLOBAL_PROFILER.counts(), "per_epoch": {}}
+        counts = dispatch["counts"]
+        epochs_by_name: dict = dict(self._dispatch_epochs_retired)
+        for g in self._cosched.groups.values():
+            if g.epochs_run:
+                epochs_by_name[
+                    "build_group_epoch.<locals>.coscheduled_epoch"] = \
+                    epochs_by_name.get(
+                        "build_group_epoch.<locals>.coscheduled_epoch", 0) \
+                    + g.epochs_run
+        for _name, (_, _, _, sf) in self._shardfused_engines.items():
+            qn = ("sharded_agg_epoch.<locals>.epoch"
+                  if type(sf).__name__ == "ShardedFusedAgg"
+                  else "sharded_join_epoch.<locals>.epoch")
+            if sf.epochs_run:
+                epochs_by_name[qn] = epochs_by_name.get(qn, 0) \
+                    + sf.epochs_run
+        for qn, epochs in epochs_by_name.items():
+            if qn in counts and epochs:
+                dispatch["per_epoch"][qn] = round(counts[qn] / epochs, 4)
+        out["dispatch"] = dispatch
         return out
 
     def _storage_metrics(self) -> dict:
@@ -3722,6 +3821,19 @@ class Session:
         """Captured slow-epoch span trees (newest last), each
         ``{epoch, latency_ms, checkpoint, spans}``."""
         return list(self._slow_epochs)
+
+    def profile_report(self) -> dict:
+        """Roofline report over every dispatch this process has seen:
+        AOT-``lower().compile()`` each recorded epoch callable (chip-free
+        on the CPU stand-in) and place its arithmetic intensity against
+        the configured chip peaks ([observability] chip_peak_flops /
+        chip_peak_bandwidth). Triggers compiles, so it deliberately does
+        NOT take the session API lock — the profiler registry it reads
+        has its own lock, and ticks/scrapes must not stall behind XLA."""
+        from ..common.profiling import GLOBAL_PROFILER, roofline_report
+        return roofline_report(GLOBAL_PROFILER.analyze(),
+                               self.observability.chip_peak_flops,
+                               self.observability.chip_peak_bandwidth)
 
     @_locked
     def close(self) -> None:

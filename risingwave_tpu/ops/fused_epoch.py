@@ -44,6 +44,7 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..common.profiling import profile_dispatch
 from ..expr import Expr
 
 
@@ -167,8 +168,9 @@ def fused_source_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
     # counter identity for common/dispatch_count.py regressions stays
     # stable across the shared-body refactor
     epoch.__qualname__ = "fused_source_agg_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 def fused_source_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
@@ -197,8 +199,9 @@ def fused_source_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
     """
     epoch = join_epoch_body(chunk_fn, exprs, core, rows_per_chunk)
     epoch.__qualname__ = "fused_source_join_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 def fused_source_session_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
@@ -217,8 +220,9 @@ def fused_source_session_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
     cap)`` packs the emission windows."""
     epoch = session_epoch_body(chunk_fn, exprs, core, rows_per_chunk)
     epoch.__qualname__ = "fused_source_session_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 def fused_source_q3_epoch(chunk_fn: Callable, core, rows_per_chunk: int,
@@ -234,8 +238,9 @@ def fused_source_q3_epoch(chunk_fn: Callable, core, rows_per_chunk: int,
     orders_overflow, agg_overflow, saw_delete]."""
     epoch = q3_epoch_body(chunk_fn, core, rows_per_chunk)
     epoch.__qualname__ = "fused_source_q3_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 #: builder registry — the single path bench.py / frontend wiring use to

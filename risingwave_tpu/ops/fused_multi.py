@@ -36,6 +36,7 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..common.profiling import profile_dispatch
 from ..expr import Expr
 from .fused_epoch import _donate, agg_epoch_body, join_epoch_body
 
@@ -88,8 +89,9 @@ def fused_multi_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
     def epoch(stacked, starts, keys, k: int):
         return vm(stacked, starts, keys, k)
 
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 def fused_multi_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
@@ -107,8 +109,9 @@ def fused_multi_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
     def epoch(stacked, starts, keys, k: int):
         return vm(stacked, starts, keys, k)
 
-    return jax.jit(epoch, static_argnums=(3,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,),
+                                    donate_argnums=_donate(donate)),
+                            epoch.__qualname__)
 
 
 def build_group_epoch(kind: str, chunk_fn: Callable, exprs: Sequence[Expr],
@@ -130,8 +133,10 @@ def build_group_epoch(kind: str, chunk_fn: Callable, exprs: Sequence[Expr],
         keys = jax.vmap(jax.random.fold_in)(base_keys, batch_nos)
         return vm(stacked, starts, keys, k)
 
-    return jax.jit(coscheduled_epoch, static_argnums=(4,),
-                   donate_argnums=_donate(donate))
+    return profile_dispatch(
+        jax.jit(coscheduled_epoch, static_argnums=(4,),
+                donate_argnums=_donate(donate)),
+        coscheduled_epoch.__qualname__)
 
 
 # -- group barrier steps (agg shape) ------------------------------------------
@@ -153,7 +158,7 @@ def multi_agg_probe(core) -> Callable:
     def probe(stacked):
         return vm(stacked)
 
-    return jax.jit(probe)
+    return profile_dispatch(jax.jit(probe), probe.__qualname__)
 
 
 def multi_agg_finish(core) -> Callable:
@@ -164,7 +169,7 @@ def multi_agg_finish(core) -> Callable:
     def finish(stacked):
         return vm(stacked)
 
-    return jax.jit(finish)
+    return profile_dispatch(jax.jit(finish), finish.__qualname__)
 
 
 def gather_job_flush_chunk(core) -> Callable:
@@ -176,4 +181,4 @@ def gather_job_flush_chunk(core) -> Callable:
         st = index_state(stacked, j)
         return core.gather_flush_chunk(st, ranks[j], lo)
 
-    return jax.jit(gather)
+    return profile_dispatch(jax.jit(gather), gather.__qualname__)

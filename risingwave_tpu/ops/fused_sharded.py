@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common.chunk import Column, StreamChunk
+from ..common.profiling import profile_dispatch
 from ..expr import Expr
 
 
@@ -144,7 +145,8 @@ def sharded_agg_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
         return mapped(stacked, start, key)
 
     epoch.__qualname__ = "sharded_agg_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,)),
+                            epoch.__qualname__)
 
 
 def sharded_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
@@ -213,7 +215,8 @@ def sharded_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
         return mapped(stacked, start, key)
 
     epoch.__qualname__ = "sharded_join_epoch.<locals>.epoch"
-    return jax.jit(epoch, static_argnums=(3,))
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,)),
+                            epoch.__qualname__)
 
 
 #: builder registry, mirroring ops/fused_epoch.EPOCH_BUILDERS — the path

@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common.chunk import Column, flatten_shards, gather_units_window
 from ..common.hashing import shard_rows, vnode_of, vnode_to_shard
+from ..common.profiling import profile_dispatch
 from ..ops.fused_multi import (
     gather_job_flush_chunk, index_state, multi_agg_finish, stack_states,
     unstack_states,
@@ -67,7 +68,7 @@ def _sharded_agg_probe(core) -> Callable:
     def probe(stacked, rovf):
         return vm(stacked, rovf)
 
-    return jax.jit(probe)
+    return profile_dispatch(jax.jit(probe), probe.__qualname__)
 
 
 class _ShardedFusedBase:
@@ -249,10 +250,12 @@ class ShardedFusedJoin(_ShardedFusedBase):
             return gather_units_window(flatten_shards(pj), lo,
                                        out_capacity)
 
-        self._gather_flush = jax.jit(gather_flush,
-                                     static_argnames=("out_capacity",))
-        self._gather_probe = jax.jit(gather_probe,
-                                     static_argnames=("out_capacity",))
+        self._gather_flush = profile_dispatch(
+            jax.jit(gather_flush, static_argnames=("out_capacity",)),
+            gather_flush.__qualname__)
+        self._gather_probe = profile_dispatch(
+            jax.jit(gather_probe, static_argnames=("out_capacity",)),
+            gather_probe.__qualname__)
 
     def _build_epoch(self, width: int) -> Callable:
         return sharded_join_epoch(self.chunk_fn, self.exprs, self.core,

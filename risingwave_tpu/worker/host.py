@@ -702,6 +702,7 @@ class WorkerHost:
         (reference: MonitorService.stack_trace + Prometheus exporters,
         src/compute/src/rpc/service/monitor_service.rs:46)."""
         from ..common.memory import pipeline_state_bytes
+        from ..common.profiling import GLOBAL_PROFILER
         from ..common.tracing import GLOBAL_TRACE
         from ..stream.metrics import pipeline_metrics
         from ..stream.trace import executor_tree
@@ -748,6 +749,10 @@ class WorkerHost:
             # imported across live vnode migrations (meta/rescale.py)
             "rescale": {"rows_out": self.migrated_rows_out,
                         "rows_in": self.migrated_rows_in},
+            # device profiling plane: this process's per-dispatch
+            # telemetry (common/profiling.py) — federated into
+            # Session.metrics()["profiling"]["workers"]
+            "profiling": GLOBAL_PROFILER.snapshot(),
             "spans": list(self._span_outbox), "span_seq": self._span_seq,
         }
 

@@ -106,6 +106,7 @@ class TestChBench:
     def test_mixed_workload_single_chip(self):
         _run(None)
 
+    @pytest.mark.slow
     def test_mixed_workload_sharded_mesh(self):
         """The same workload with joins/aggs sharded over a 4-device mesh
         (BASELINE config 5's scale-out shape)."""

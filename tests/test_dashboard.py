@@ -4,6 +4,8 @@ overview, fragment graphs, await-tree dumps)."""
 import json
 import urllib.request
 
+import pytest
+
 from risingwave_tpu.frontend import Session
 from risingwave_tpu.frontend.dashboard import serve_dashboard
 
@@ -96,6 +98,83 @@ def test_dashboard_trace_and_slow_epoch_endpoints():
     finally:
         dash.close()
         s.close()
+
+
+def _post(port, path):
+    """POST returning (status, json) — HTTPError codes included."""
+    import urllib.error
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.mark.slow
+def test_dashboard_profiler_double_start_is_409_not_500(tmp_path):
+    """ISSUE 12 satellite: two /start POSTs must answer 200 then 409 —
+    never raise out of the handler (500) — and /stop without a capture
+    is 409. A full start→stop→start cycle works."""
+    s = Session()
+    dash = serve_dashboard(s, profiler_dir=str(tmp_path / "prof"))
+    try:
+        status, obj = _post(dash.port, "/api/profiler/stop")
+        assert status == 409, obj                 # nothing running yet
+        status, obj = _post(dash.port, "/api/profiler/start")
+        assert status == 200, obj
+        status, obj = _post(dash.port, "/api/profiler/start")
+        assert status == 409 and "error" in obj, obj
+        status, obj = _post(dash.port, "/api/profiler/stop")
+        assert status == 200, obj
+        status, obj = _post(dash.port, "/api/profiler/stop")
+        assert status == 409, obj                 # stop ran exactly once
+        status, obj = _post(dash.port, "/api/profiler/start")
+        assert status == 200, obj                 # restartable
+    finally:
+        dash.close()                              # stops the live capture
+        s.close()
+
+
+@pytest.mark.slow
+def test_dashboard_profiler_foreign_capture_is_409(tmp_path):
+    """The jax profiler is process-global: a capture started OUTSIDE
+    this server (another dashboard instance, user code) makes
+    start_trace raise — that must surface as 409, not a 500 from the
+    handler thread."""
+    import jax
+
+    s = Session()
+    dash = serve_dashboard(s, profiler_dir=str(tmp_path / "a"))
+    jax.profiler.start_trace(str(tmp_path / "foreign"))
+    try:
+        status, obj = _post(dash.port, "/api/profiler/start")
+        assert status == 409 and "error" in obj, (status, obj)
+    finally:
+        jax.profiler.stop_trace()
+        dash.close()
+        s.close()
+
+
+@pytest.mark.slow
+def test_dashboard_close_races_live_capture(tmp_path):
+    """Server shutdown during a live capture stops the device trace
+    exactly once (no dangling capture buffering forever), a /start
+    racing close() answers 503, and a second close() is a no-op."""
+    import jax
+
+    s = Session()
+    dash = serve_dashboard(s, profiler_dir=str(tmp_path / "p"))
+    status, obj = _post(dash.port, "/api/profiler/start")
+    assert status == 200, obj
+    dash.close()                       # must stop_trace exactly once
+    # the capture really ended: a fresh process-global trace can start
+    jax.profiler.start_trace(str(tmp_path / "after"))
+    jax.profiler.stop_trace()
+    dash.close()                       # idempotent
+    s.close()
 
 
 def test_dashboard_profiler_endpoint_gated():

@@ -26,6 +26,8 @@ Reference: exchange_service.rs:74-133, exchange/permit.rs:35-107,
 stream_graph placement + scale.rs vnode mappings, recovery.rs:110.
 """
 
+import pytest
+
 from risingwave_tpu.frontend import Session
 from risingwave_tpu.frontend.build import BuildConfig
 
@@ -154,6 +156,7 @@ class TestSpanningParity:
 
 
 class TestSpanningRecovery:
+    @pytest.mark.slow
     def test_q5_kill9_participant_exactly_once(self, tmp_path):
         """checkpoint → kill -9 one NON-root participant → scoped
         recovery (respawn + rebuild ONLY this fragment graph from
@@ -188,6 +191,7 @@ class TestSpanningRecovery:
         # the uncommitted pre-death generate replays from the seek
         assert got == local_run(Q5, "q5", ticks=4, seed=7)
 
+    @pytest.mark.slow
     def test_q7_kill9_root_worker_exactly_once(self, tmp_path):
         """Same cycle killing the ROOT worker (hosts the materialize):
         q7's join output is keyed by the bid row ids, so replay must
@@ -216,6 +220,7 @@ class TestSpanningRecovery:
         want = local_run(Q7, "q7", ticks=6, seed=42)
         assert got == want and len(got) > 0
 
+    @pytest.mark.slow
     def test_sim_chaos_spanning_kill_converges(self, tmp_path):
         """sim.py chaos menu entry: kill one worker of a spanning
         fragment graph mid-workload; the cluster converges and the final

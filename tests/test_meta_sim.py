@@ -114,6 +114,7 @@ class TestChaosSim:
 
 
 class TestChaosSimWorkers:
+    @pytest.mark.slow
     def test_per_component_worker_kills_converge(self, tmp_path):
         """Chaos with worker PROCESSES: the kill step SIGKILLs individual
         workers (scoped recovery) as well as the whole cluster; MVs still

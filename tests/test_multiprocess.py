@@ -124,6 +124,7 @@ class TestRemoteSource:
 
 
 class TestWorkerKillRecovery:
+    @pytest.mark.slow
     def test_kill9_source_fed_exactly_once(self, tmp_path):
         """SIGKILL the worker mid-stream; the heartbeat detector declares
         its jobs dead, scoped recovery respawns the process over the same

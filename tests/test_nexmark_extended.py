@@ -8,6 +8,8 @@ stream (VERDICT r4 item 7)."""
 import collections
 import datetime
 
+import pytest
+
 from test_nexmark_queries import DDL, TICKS, make_session, replay
 
 
@@ -39,6 +41,7 @@ def test_q0_passthrough():
     assert got == sorted((b[0], b[1], b[2], b[5]) for b in bids)
 
 
+@pytest.mark.slow
 def test_q9_winning_bids():
     got = run_mv("""CREATE MATERIALIZED VIEW q9 AS
         SELECT id, item_name, auction, bidder, price, bid_date_time FROM (
@@ -221,6 +224,7 @@ def test_q18_last_bid():
     assert got == exp and len(got) > 0
 
 
+@pytest.mark.slow
 def test_q20_expand_bid():
     got = run_mv("""CREATE MATERIALIZED VIEW q20 AS
         SELECT auction, bidder, price, channel, item_name, seller, category

@@ -100,6 +100,7 @@ def test_q3_join_filter():
     assert len(got) > 0  # non-trivial
 
 
+@pytest.mark.slow
 def test_q4_avg_final_price():
     got = run_mv("""CREATE MATERIALIZED VIEW q4 AS
         SELECT Q.category, AVG(Q.final) as avg

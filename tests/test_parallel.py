@@ -161,6 +161,7 @@ def test_sharded_mv_q5_core_equivalence(mesh):
     assert _run(sql, "q5c", mesh=mesh) == _run(sql, "q5c", mesh=None)
 
 
+@pytest.mark.slow
 def test_sharded_mv_q7_core_equivalence(mesh):
     sql = """CREATE MATERIALIZED VIEW q7c AS
         SELECT B.auction, B.price, A.seller

@@ -54,6 +54,100 @@ def test_http_scrape():
         s.close()
 
 
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+
+
+def _parse_exposition(text: str) -> dict:
+    """Strict-ish exposition parser: returns {family: {"help": str,
+    "type": str, "samples": [(name, labels, value)]}} and asserts the
+    line grammar as it goes — the lint every past and future metric
+    section must pass."""
+    import re
+
+    families: dict = {}
+    sample_re = re.compile(
+        rf"^({_NAME_RE})(?:\{{(.*)\}})? (\S+)$")
+    label_re = re.compile(rf'^({_NAME_RE})="((?:[^"\\]|\\.)*)"$')
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert re.fullmatch(_NAME_RE, name), line
+            assert help_text.strip(), f"empty HELP: {line!r}"
+            fam = families.setdefault(name, {"samples": []})
+            assert "help" not in fam, f"duplicate HELP for {name}"
+            fam["help"] = help_text
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram",
+                            "summary", "untyped"), line
+            fam = families.setdefault(name, {"samples": []})
+            assert "type" not in fam, f"duplicate TYPE for {name}"
+            fam["type"] = kind
+        elif line.startswith("#"):
+            raise AssertionError(f"unparseable comment line: {line!r}")
+        else:
+            m = sample_re.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name, labels_raw, value = m.groups()
+            labels = {}
+            if labels_raw:
+                for pair in re.split(r",(?=[a-zA-Z_])", labels_raw):
+                    lm = label_re.match(pair)
+                    assert lm, f"bad label pair {pair!r} in {line!r}"
+                    labels[lm.group(1)] = lm.group(2)
+            float(value)                    # must parse
+            # a sample belongs to the family of its metric name (no
+            # _bucket/_sum suffixes are emitted by this codebase)
+            assert name in families, \
+                f"sample {name!r} has no preceding HELP/TYPE"
+            families[name]["samples"].append((name, labels, value))
+    return families
+
+
+def test_exposition_lint_every_family_has_help_and_type(tmp_path):
+    """Satellite (ISSUE 12): parse the FULL exposition from a session
+    exercising many metric sections and assert every rw_* family is
+    well-formed — valid names, quoted labels, one HELP + one TYPE per
+    family, every sample preceded by its family header. A lint for all
+    past and future sections, not just the profiling plane's."""
+    s = Session(workers=1, seed=11, data_dir=str(tmp_path / "lint"))
+    try:
+        s.run_sql("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        s.run_sql("CREATE MATERIALIZED VIEW lm AS SELECT v, count(*) "
+                  "AS c FROM t GROUP BY v")
+        s.run_sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.flush()
+        s.run_sql("SELECT v, c FROM lm")       # serving-plane counters
+        families = _parse_exposition(render_metrics(s))
+        assert families, "empty exposition"
+        for name, fam in families.items():
+            assert name.startswith("rw_"), f"non-rw_ family {name}"
+            assert "help" in fam, f"{name} missing HELP"
+            assert "type" in fam, f"{name} missing TYPE"
+            # a declared family MAY legitimately be empty this scrape
+            # (e.g. rw_chaos_injection_total with no chaos installed);
+            # samples without a declaration are caught in the parser
+        # the sections this cluster shape must light up (PR 1 core, PR 2
+        # storage, PR 8 serving, PR 9 chaos, PR 10 autoscaler, PR 12
+        # profiling) — a renamed family fails here loudly
+        for expected in ("rw_epoch", "rw_executor_counter",
+                         "rw_state_bytes", "rw_worker_up",
+                         "rw_storage_stat", "rw_serving_stat",
+                         "rw_chaos_injection_total", "rw_chaos_stat",
+                         "rw_autoscaler_stat", "rw_autoscaler_enabled",
+                         "rw_dispatch_total", "rw_dispatch_seconds",
+                         "rw_compile_total", "rw_hbm_bytes",
+                         "rw_hbm_headroom_bytes"):
+            assert expected in families, \
+                f"{expected} missing from exposition: {sorted(families)}"
+    finally:
+        s.close()
+
+
 def test_render_slow_epoch_counter():
     s = _session()
     s.run_sql("SET slow_epoch_threshold_ms = 0.0001")

@@ -6,10 +6,13 @@ forever."""
 import os
 import signal
 
+import pytest
+
 from risingwave_tpu.common.config import FaultConfig
 from risingwave_tpu.frontend import Session
 
 
+@pytest.mark.slow
 def test_wedged_worker_trips_scoped_recovery(tmp_path):
     s = Session(data_dir=str(tmp_path / "db"), workers=1,
                 checkpoint_frequency=2,
