@@ -135,6 +135,7 @@ SHARDED_SHARD_COUNTS = (1, 4, 8)
 SHARDED_N_CHUNKS = 128
 SHARDED_WARMUP_CHUNKS = 32
 SHARDED_Q7_N_CHUNKS = 64
+COSCHED_SHARDED_JOBS = 4       # K jobs × S shards phase (surface 6)
 SHARDED_VIRTUAL_DEVICES = 8    # CPU stand-in virtual mesh size
 # serving phase (frontend/serving.py — ROADMAP item 3): concurrent
 # point-lookups + small group-by reads over a LIVE q5 MV while the
@@ -646,10 +647,149 @@ def measure_q7_sharded_fused(n_chunks: int, n_shards: int) -> float:
     return n_chunks * CHUNK / (time.perf_counter() - t0)
 
 
+def measure_q8_sharded_fused(n_chunks: int, n_shards: int) -> float:
+    """Aggregate source rows/s of the q8 session-window core
+    MESH-SHARDED (ops/fused_sharded.sharded_session_epoch): generation,
+    projection, the in-dispatch vnode all_to_all route by session key,
+    per-shard sessionization AND the watermark close in one dispatch
+    per epoch; ONE [n, 6] packed fetch covers all flags and counts."""
+    import jax
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import col
+    from risingwave_tpu.ops.session_window import SessionWindowCore
+    from risingwave_tpu.parallel.fused import ShardedFusedSession
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    exprs = [col(1, INT64), col(5, TIMESTAMP)]   # bidder, date_time
+    schema = Schema((Field("bidder", INT64), Field("ts", TIMESTAMP)))
+    # capacities are PER SHARD: keys partition across the mesh
+    core = SessionWindowCore(
+        schema, key_col=0, ts_col=1, gap_us=Q8_GAP_US,
+        capacity=max(Q8_TABLE_CAP // n_shards, 1 << 14),
+        closed_capacity=max(Q8_CLOSED_CAP // n_shards, 1 << 14))
+    cfg = NexmarkConfig(chunk_capacity=CHUNK)
+    gen = DeviceBidGenerator(cfg)
+    sf = ShardedFusedSession(make_mesh(n_shards), core, gen.chunk_fn(),
+                             exprs, CHUNK)
+    us_per_event = max(1_000_000 // max(cfg.events_per_second, 1), 1)
+
+    def run(n, start_event, batch_no):
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)
+            done += per
+            key = jax.random.fold_in(jax.random.PRNGKey(31), batch_no)
+            batch_no += 1
+            end_event = start_event + per * CHUNK
+            wm = cfg.start_time_us + end_event * us_per_event - Q8_GAP_US
+            sf.run_epoch(start_event, key, per, wm)
+            start_event = end_event
+            sf.flush(out_capacity=CHUNK)
+        return start_event, batch_no
+
+    start_event, batch_no = run(SHARDED_WARMUP_CHUNKS, 0, 0)
+    jax.block_until_ready(sf.stacked.last_ts)
+    t0 = time.perf_counter()
+    run(n_chunks, start_event, batch_no)
+    jax.block_until_ready(sf.stacked.last_ts)
+    return n_chunks * CHUNK / (time.perf_counter() - t0)
+
+
+def measure_q3_sharded_fused(n_chunks: int, n_shards: int) -> float:
+    """Aggregate source rows/s of the TPC-H q3 streaming MV
+    MESH-SHARDED (ops/fused_sharded.sharded_q3_epoch): orders +
+    lineitems route by orderkey, per-shard build/probe/agg, and the
+    GLOBAL top-10 churn (local top-k → all_gather → shared recompute)
+    all inside one dispatch per epoch."""
+    import jax
+    from risingwave_tpu.connector.tpch import (
+        DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+    )
+    from risingwave_tpu.ops.stream_q3 import Q3Core
+    from risingwave_tpu.parallel.fused import ShardedFusedQ3
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    gen = DeviceQ3Generator(TpchQ3Config(chunk_capacity=CHUNK))
+    core = Q3Core(Q3_CUTOFF_DAYS,
+                  orders_capacity=max(Q3_ORDERS_CAP // n_shards, 1 << 14),
+                  agg_capacity=max(Q3_AGG_CAP // n_shards, 1 << 14))
+    sf = ShardedFusedQ3(make_mesh(n_shards), core, gen.chunk_fn(), CHUNK)
+
+    def run(n, start_event, batch_no):
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)
+            done += per
+            key = jax.random.fold_in(jax.random.PRNGKey(37), batch_no)
+            batch_no += 1
+            sf.run_epoch(start_event, key, per)
+            start_event += per * CHUNK
+            sf.flush()
+        return start_event, batch_no
+
+    start_event, batch_no = run(SHARDED_WARMUP_CHUNKS, 0, 0)
+    jax.block_until_ready(sf.stacked.odate)
+    t0 = time.perf_counter()
+    run(n_chunks, start_event, batch_no)
+    jax.block_until_ready(sf.stacked.odate)
+    return n_chunks * CHUNK / (time.perf_counter() - t0)
+
+
+def measure_cosched_sharded(n_chunks: int, n_shards: int,
+                            n_jobs: int) -> float:
+    """Aggregate source rows/s of ``n_jobs`` signature-equal q5-shaped
+    MVs × ``n_shards`` mesh shards — the SIXTH fusion surface
+    (ops/fused_sharded.build_sharded_group_epoch): the whole K×S group
+    is ONE dispatch per epoch, so rows/s counts every job's stream."""
+    import jax
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.ops.grouped_agg import AggCore
+    from risingwave_tpu.parallel.fused import ShardedCoGroup
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+    from risingwave_tpu.stream.coschedule import FusedJobSpec
+
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(WINDOW_US, INT64)),
+        col(0, INT64),
+    ]
+    core = AggCore([INT64, INT64], [0, 1], [count_star()],
+                   max((1 << 21) // n_shards, 1 << 16), CHUNK)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CHUNK))
+    spec = FusedJobSpec("agg", ("bench_sharded_cosched",),
+                        gen.chunk_fn(), tuple(exprs), core, CHUNK, seed=0)
+    group = ShardedCoGroup(make_mesh(n_shards), spec)
+    for j in range(n_jobs):
+        group.add(f"mv{j}", seed=j)
+
+    def run(n):
+        done = 0
+        while done < n:
+            per = min(CHUNKS_PER_EPOCH, n - done)
+            done += per
+            group.run_epoch(per)
+            group.flush()
+
+    run(SHARDED_WARMUP_CHUNKS)
+    jax.block_until_ready(group.stacked.lanes)
+    t0 = time.perf_counter()
+    run(n_chunks)
+    jax.block_until_ready(group.stacked.lanes)
+    return n_jobs * n_chunks * CHUNK / (time.perf_counter() - t0)
+
+
 def run_sharded_phase(n_chunks: int, q7_chunks: int) -> None:
-    """Child entry for the mesh-sharded fused phase: measure q5/q7 at
-    every shard count this process's backend can host, print one JSON
-    line (MULTICHIP-style: n_devices + ok + per-shard-count rates)."""
+    """Child entry for the mesh-sharded fused phase: measure q5 at
+    every shard count this process's backend can host, and the heavier
+    surfaces — q7, q8, q3, and the K×S co-scheduled group — once at
+    the widest mesh; print one JSON line (MULTICHIP-style: n_devices +
+    ok + per-shard-count rates)."""
     import jax
     n_devices = len(jax.devices())
     by_shards: dict = {}
@@ -659,11 +799,19 @@ def run_sharded_phase(n_chunks: int, q7_chunks: int) -> None:
         entry = {"q5_rows_per_sec": round(
             measure_q5_sharded_fused(n_chunks, n), 1)}
         if n == max(c for c in SHARDED_SHARD_COUNTS if c <= n_devices):
-            # q7 once, at the widest mesh (it is the slow measurement)
+            # the slow measurements run once, at the widest mesh
             entry["q7_rows_per_sec"] = round(
                 measure_q7_sharded_fused(q7_chunks, n), 1)
+            entry["q8_rows_per_sec"] = round(
+                measure_q8_sharded_fused(q7_chunks, n), 1)
+            entry["q3_rows_per_sec"] = round(
+                measure_q3_sharded_fused(q7_chunks, n), 1)
+            entry["cosched_rows_per_sec"] = round(
+                measure_cosched_sharded(q7_chunks, n,
+                                        COSCHED_SHARDED_JOBS), 1)
         by_shards[str(n)] = entry
     widest = max((int(k) for k in by_shards), default=0)
+    top = by_shards.get(str(widest), {})
     _emit({
         "metric": "sharded_fused_epochs",
         "unit": "rows/s",
@@ -672,10 +820,14 @@ def run_sharded_phase(n_chunks: int, q7_chunks: int) -> None:
         "backend": jax.default_backend(),
         "sharded_fused_shards": widest,
         "sharded_fused_by_shards": by_shards,
-        "q5_sharded_fused_rows_per_sec": (
-            by_shards.get(str(widest), {}).get("q5_rows_per_sec")),
-        "q7_sharded_fused_rows_per_sec": (
-            by_shards.get(str(widest), {}).get("q7_rows_per_sec")),
+        "q5_sharded_fused_rows_per_sec": top.get("q5_rows_per_sec"),
+        "q7_sharded_fused_rows_per_sec": top.get("q7_rows_per_sec"),
+        "q8_sharded_fused_rows_per_sec": top.get("q8_rows_per_sec"),
+        "q3_sharded_fused_rows_per_sec": top.get("q3_rows_per_sec"),
+        "cosched_sharded_rows_per_sec": top.get("cosched_rows_per_sec"),
+        "cosched_sharded_jobs": (COSCHED_SHARDED_JOBS
+                                 if "cosched_rows_per_sec" in top
+                                 else None),
     })
 
 
@@ -1131,6 +1283,8 @@ def measure_cpu_standin() -> dict:
 _SHARDED_RESULT_FIELDS = (
     "sharded_fused_shards", "sharded_fused_by_shards",
     "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
+    "q8_sharded_fused_rows_per_sec", "q3_sharded_fused_rows_per_sec",
+    "cosched_sharded_rows_per_sec", "cosched_sharded_jobs",
 )
 
 _SERVING_RESULT_FIELDS = (
@@ -1264,10 +1418,13 @@ _SHARED_FIELDS = (
     "coscheduled_n_mvs",
     "p99_barrier_ms", "p50_barrier_ms", "p99_barrier_ms_inflight4",
     # mesh-sharded fused epochs (ops/fused_sharded.py): aggregate rows/s
-    # + shard counts, present on EVERY backend so the TPU-outage fallback
-    # record stays schema-stable
+    # + shard counts — the whole ladder (q5/q7/q8/q3 + the K×S
+    # co-scheduled group, PR 13) — present on EVERY backend so the
+    # TPU-outage fallback record stays schema-stable
     "sharded_fused_shards", "sharded_fused_by_shards",
     "q5_sharded_fused_rows_per_sec", "q7_sharded_fused_rows_per_sec",
+    "q8_sharded_fused_rows_per_sec", "q3_sharded_fused_rows_per_sec",
+    "cosched_sharded_rows_per_sec", "cosched_sharded_jobs",
     # serving plane (frontend/serving.py): cached+two-phase QPS with
     # p50/p99 vs the uncached single-phase baseline, present on every
     # backend (a Session-level CPU measurement) so the fallback record
@@ -1497,14 +1654,18 @@ def run_smoke() -> int:
         assert not any(int(x) for x in jax.device_get(packed3)[1:])
         checks.append("q3=1 dispatch/epoch")
 
-        # mesh-sharded fused epoch (ops/fused_sharded.py) on whatever
+        # mesh-sharded fused epochs (ops/fused_sharded.py) on whatever
         # mesh this backend can host (CI pins CPU without a virtual
         # mesh, so usually 1 device — the invariant is identical)
-        from risingwave_tpu.parallel.fused import ShardedFusedAgg
+        from risingwave_tpu.parallel.fused import (
+            ShardedCoGroup, ShardedFusedAgg, ShardedFusedQ3,
+            ShardedFusedSession,
+        )
         from risingwave_tpu.parallel.sharded_agg import make_mesh
         n_dev = min(len(jax.devices()), 4)
+        mesh = make_mesh(n_dev)
         exprs2, agg2, chunk_fn2 = _cosched_parts()
-        sf = ShardedFusedAgg(make_mesh(n_dev), agg2.core, chunk_fn2,
+        sf = ShardedFusedAgg(mesh, agg2.core, chunk_fn2,
                              exprs2, COSCHED_SMOKE_CHUNK)
         sf.run_epoch(0, jax.random.PRNGKey(0), k)
         sf.flush()
@@ -1514,6 +1675,80 @@ def run_smoke() -> int:
         assert n == 1, f"sharded epoch took {n} dispatches"
         sf.flush()
         checks.append(f"sharded[{n_dev}]=1 dispatch/epoch")
+
+        # sharded q8 session epoch: ONE dispatch regardless of shards/k
+        sw8 = SessionWindowCore(
+            Schema((Field("bidder", INT64), Field("ts", TIMESTAMP))),
+            0, 1, gap_us=5_000, capacity=1 << 10,
+            closed_capacity=1 << 10)
+        gen8 = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+        sfs = ShardedFusedSession(
+            mesh, sw8, gen8.chunk_fn(),
+            [col(1, INT64), col(5, TIMESTAMP)], cap)
+        sfs.run_epoch(0, jax.random.PRNGKey(0), k, 0)
+        sfs.flush(out_capacity=cap)
+        c.reset()
+        sfs.run_epoch(k * cap, jax.random.PRNGKey(1), k, 0)
+        n = c.counts["sharded_session_epoch.<locals>.epoch"]
+        assert n == 1, f"sharded q8 epoch took {n} dispatches"
+        sfs.flush(out_capacity=cap)
+        checks.append(f"sharded-q8[{n_dev}]=1 dispatch/epoch")
+
+        # sharded q3 epoch (incl. the global top-n flush): ONE dispatch
+        q3s = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=1 << 10,
+                     agg_capacity=1 << 10)
+        sfq3 = ShardedFusedQ3(
+            mesh, q3s,
+            DeviceQ3Generator(TpchQ3Config(chunk_capacity=cap)).chunk_fn(),
+            cap)
+        sfq3.run_epoch(0, jax.random.PRNGKey(0), k)
+        sfq3.flush()
+        c.reset()
+        sfq3.run_epoch(k * cap, jax.random.PRNGKey(0), k)
+        n = c.counts["sharded_q3_epoch.<locals>.epoch"]
+        assert n == 1, f"sharded q3 epoch took {n} dispatches"
+        sfq3.flush()
+        checks.append(f"sharded-q3[{n_dev}]=1 dispatch/epoch")
+
+        # K×S co-scheduled group (fusion surface 6): J jobs × S shards,
+        # still exactly ONE dispatch per epoch
+        exprs3, agg3, chunk_fn3 = _cosched_parts()
+        spec3 = FusedJobSpec("agg", ("smoke-sharded",), chunk_fn3,
+                             tuple(exprs3), agg3.core,
+                             COSCHED_SMOKE_CHUNK, seed=0)
+        sgroup = ShardedCoGroup(mesh, spec3)
+        for j in range(jobs):
+            sgroup.add(f"mv{j}", seed=j)
+        sgroup.run_epoch(k)
+        sgroup.flush()
+        c.reset()
+        sgroup.run_epoch(k)
+        n = c.counts[
+            "build_sharded_group_epoch.<locals>.sharded_coscheduled_epoch"]
+        assert n == 1, f"sharded group epoch took {n} dispatches"
+        sgroup.flush()
+        checks.append(
+            f"sharded-cosched[{jobs}x{n_dev}]=1 dispatch/epoch")
+
+        # generic sharded-fused equi-join: k chunks in ONE dispatch
+        from risingwave_tpu.common.chunk import physical_chunk
+        from risingwave_tpu.common.types import Schema as _Schema
+        from risingwave_tpu.ops.join_state import JoinType
+        from risingwave_tpu.parallel.sharded_join import ShardedHashJoin
+        ls = _Schema((Field("k", INT64), Field("v", INT64)))
+        rs = _Schema((Field("k", INT64), Field("w", INT64)))
+        shj = ShardedHashJoin(mesh, ls, rs, [0], [0], JoinType.INNER,
+                              key_capacity=1 << 8, bucket_width=8)
+        def _jb(lo):
+            return shj.batch_chunks([
+                physical_chunk(ls, [(lo + 16 * s + r, r) for r in range(16)],
+                               16) for s in range(n_dev)])
+        shj.step_epoch("left", [_jb(0), _jb(1000)])
+        c.reset()
+        shj.step_epoch("left", [_jb(2000), _jb(3000)])
+        n = c.counts["sharded_equi_join_epoch.<locals>.epoch"]
+        assert n == 1, f"sharded equi-join epoch took {n} dispatches"
+        checks.append(f"sharded-equijoin[{n_dev}]=1 dispatch/epoch")
     # device profiling plane (common/profiling.py): ON by default, and
     # every 1-dispatch assertion above ran THROUGH its wrappers — so the
     # invariants passing IS the proof that profiling adds zero
@@ -1525,7 +1760,12 @@ def run_smoke() -> int:
     for qn in ("build_group_epoch.<locals>.coscheduled_epoch",
                "fused_source_session_epoch.<locals>.epoch",
                "fused_source_q3_epoch.<locals>.epoch",
-               "sharded_agg_epoch.<locals>.epoch"):
+               "sharded_agg_epoch.<locals>.epoch",
+               "sharded_session_epoch.<locals>.epoch",
+               "sharded_q3_epoch.<locals>.epoch",
+               "sharded_equi_join_epoch.<locals>.epoch",
+               "build_sharded_group_epoch.<locals>"
+               ".sharded_coscheduled_epoch"):
         assert prof.get(qn, 0) >= 1, \
             f"profiler missed dispatches for {qn}: {prof}"
     checks.append("profiling on: counters live, 0 added dispatches")

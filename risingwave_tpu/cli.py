@@ -129,8 +129,8 @@ def main(argv=None) -> int:
                      "a new parallelism; autoscaler — dump the scaling "
                      "plane's policy state and executed migrations), "
                      "`ctl profile` (roofline — AOT cost/memory "
-                     "analysis of the fused q5/q7 epochs against the "
-                     "chip roofline, chip-free) and `ctl bench` "
+                     "analysis of every registered fused surface "
+                     "against the chip roofline, chip-free) and `ctl bench` "
                      "(trend — per-field trend with regression flags "
                      "over the checked-in BENCH_r*.json records)")
     ctl.add_argument("job", nargs="?", default=None,
@@ -152,6 +152,10 @@ def main(argv=None) -> int:
                      help="profile roofline: chip HBM bandwidth in "
                      "bytes/s (default [observability] "
                      "chip_peak_bandwidth)")
+    ctl.add_argument("--surface", default=None,
+                     help="profile roofline: analyze ONE registered "
+                     "fused surface (e.g. source_session, "
+                     "sharded:group_agg) instead of the whole ladder")
     ctl.add_argument("--tolerance", type=float, default=0.2,
                      help="bench trend: relative move off the best "
                      "prior value that flags a regression")
@@ -282,59 +286,194 @@ def _ctl(args) -> int:
     return 0
 
 
-def _roofline_targets() -> dict:
-    """Representative fused q5/q7 epoch callables at bench-like shapes
-    (the same builds bench.py measures), for chip-free AOT analysis —
-    nothing is executed, so this works with no chip attached."""
+def _roofline_surfaces() -> dict:
+    """The full fused ladder for chip-free AOT analysis: one lazy
+    builder per registered surface — every ``EPOCH_BUILDERS`` entry
+    (q5/q7/q8/q3), the co-scheduled multi-job epoch, and every
+    ``SHARDED_EPOCH_BUILDERS`` entry (sharded q5/q7/q8/q3, the generic
+    equi-join, the K×S group) — at bench-like shapes. Each builder
+    returns ``(callable, args)``; nothing is executed (AOT
+    lower+compile only), so this works with no chip attached. Sharded
+    surfaces build over the widest mesh THIS process hosts (force a
+    virtual mesh with XLA_FLAGS=--xla_force_host_platform_device_count
+    for multi-shard analysis on CPU)."""
     import jax
     import jax.numpy as jnp
     from .common import INT64, TIMESTAMP
     from .common.types import Field, Schema
     from .connector import NexmarkConfig
     from .connector.nexmark import DeviceBidGenerator
+    from .connector.tpch import (
+        DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+    )
     from .expr import Literal, call, col
     from .expr.agg import count_star
     from .ops.fused_epoch import EPOCH_BUILDERS
+    from .ops.fused_multi import build_group_epoch, stack_states
+    from .ops.fused_sharded import SHARDED_EPOCH_BUILDERS
     from .ops.grouped_agg import AggCore
     from .ops.interval_join import IntervalJoinCore
+    from .ops.session_window import SessionWindowCore
+    from .ops.stream_q3 import Q3Core
+    from .parallel.sharded_agg import make_mesh
 
-    cap, k, window_us = 1024, 8, 10_000_000
+    cap, k, window_us, jobs = 1024, 8, 10_000_000, 8
     gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
     start, key = jnp.int64(0), jax.random.PRNGKey(0)
-    # q5: source → project → grouped agg (the fused_source_agg_epoch
-    # surface bench.py's q5 phase measures)
-    q5_exprs = [call("tumble_start", col(5, TIMESTAMP),
-                     Literal(window_us, INT64)), col(0, INT64)]
-    q5_core = AggCore((INT64, INT64), (0, 1), [count_star()],
-                      table_capacity=1 << 16, out_capacity=cap)
-    q5 = EPOCH_BUILDERS["source_agg"](gen.chunk_fn(), q5_exprs, q5_core,
-                                      cap)
-    # q7: source → project → bucketed interval join + max flush
-    q7_exprs = [call("tumble_start", col(5, TIMESTAMP),
-                     Literal(window_us, INT64)),
-                col(0, INT64), col(2, INT64)]
-    probe_schema = Schema((Field("window_start", TIMESTAMP),
-                           Field("auction", INT64),
-                           Field("price", INT64)))
-    q7_core = IntervalJoinCore(probe_schema, ts_col=0, val_col=2,
-                               window_us=window_us, n_buckets=1 << 12,
-                               lane_width=16)
-    q7 = EPOCH_BUILDERS["source_join"](gen.chunk_fn(), q7_exprs, q7_core,
-                                       cap)
+
+    def q5_parts():
+        exprs = [call("tumble_start", col(5, TIMESTAMP),
+                      Literal(window_us, INT64)), col(0, INT64)]
+        core = AggCore((INT64, INT64), (0, 1), [count_star()],
+                       table_capacity=1 << 16, out_capacity=cap)
+        return exprs, core
+
+    def q7_parts():
+        exprs = [call("tumble_start", col(5, TIMESTAMP),
+                      Literal(window_us, INT64)),
+                 col(0, INT64), col(2, INT64)]
+        core = IntervalJoinCore(
+            Schema((Field("window_start", TIMESTAMP),
+                    Field("auction", INT64), Field("price", INT64))),
+            ts_col=0, val_col=2, window_us=window_us,
+            n_buckets=1 << 12, lane_width=16)
+        return exprs, core
+
+    def q8_parts():
+        exprs = [col(1, INT64), col(5, TIMESTAMP)]
+        core = SessionWindowCore(
+            Schema((Field("bidder", INT64), Field("ts", TIMESTAMP))),
+            key_col=0, ts_col=1, gap_us=500_000,
+            capacity=1 << 16, closed_capacity=1 << 16)
+        return exprs, core
+
+    def q3_parts():
+        core = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=1 << 16,
+                      agg_capacity=1 << 16)
+        return DeviceQ3Generator(TpchQ3Config(chunk_capacity=cap)), core
+
+    def mesh_and_states(core):
+        mesh = make_mesh(min(len(jax.devices()), 8))
+        n = mesh.devices.size
+        return mesh, stack_states([core.init_state() for _ in range(n)])
+
+    def t_q5():
+        exprs, core = q5_parts()
+        fn = EPOCH_BUILDERS["source_agg"](gen.chunk_fn(), exprs, core, cap)
+        return fn, (core.init_state(), start, key, k)
+
+    def t_q7():
+        exprs, core = q7_parts()
+        fn = EPOCH_BUILDERS["source_join"](gen.chunk_fn(), exprs, core,
+                                           cap)
+        return fn, (core.init_state(), start, key, k)
+
+    def t_q8():
+        exprs, core = q8_parts()
+        fn = EPOCH_BUILDERS["source_session"](gen.chunk_fn(), exprs, core,
+                                              cap)
+        return fn, (core.init_state(), start, key, k, jnp.int64(0))
+
+    def t_q3():
+        q3gen, core = q3_parts()
+        fn = EPOCH_BUILDERS["source_q3"](q3gen.chunk_fn(), core, cap)
+        return fn, (core.init_state(), start, key, k)
+
+    def t_multi():
+        exprs, core = q5_parts()
+        fn = build_group_epoch("agg", gen.chunk_fn(), exprs, core, cap)
+        stacked = stack_states([core.init_state() for _ in range(jobs)])
+        starts = jnp.zeros(jobs, jnp.int64)
+        keys = jnp.stack([jax.random.PRNGKey(j) for j in range(jobs)])
+        nos = jnp.zeros(jobs, jnp.int64)
+        return fn, (stacked, starts, keys, nos, k)
+
+    def t_sharded_q5():
+        exprs, core = q5_parts()
+        mesh, stacked = mesh_and_states(core)
+        fn = SHARDED_EPOCH_BUILDERS["source_agg"](
+            gen.chunk_fn(), exprs, core, cap, mesh)
+        return fn, (stacked, start, key, k)
+
+    def t_sharded_q7():
+        exprs, core = q7_parts()
+        mesh, stacked = mesh_and_states(core)
+        fn = SHARDED_EPOCH_BUILDERS["source_join"](
+            gen.chunk_fn(), exprs, core, cap, mesh)
+        return fn, (stacked, start, key, k)
+
+    def t_sharded_q8():
+        exprs, core = q8_parts()
+        mesh, stacked = mesh_and_states(core)
+        fn = SHARDED_EPOCH_BUILDERS["source_session"](
+            gen.chunk_fn(), exprs, core, cap, mesh)
+        return fn, (stacked, start, key, k, jnp.int64(0))
+
+    def t_sharded_q3():
+        q3gen, core = q3_parts()
+        mesh, stacked = mesh_and_states(core)
+        fn = SHARDED_EPOCH_BUILDERS["source_q3"](
+            q3gen.chunk_fn(), core, cap, mesh)
+        return fn, (stacked, start, key, k)
+
+    def t_equi_join():
+        from .connector.nexmark import AUCTION_SCHEMA, BID_SCHEMA
+        from .ops.join_state import JoinCore, JoinType
+        core = JoinCore(BID_SCHEMA, AUCTION_SCHEMA, [0], [0],
+                        JoinType.INNER, key_capacity=1 << 10,
+                        bucket_width=8)
+        mesh, stacked = mesh_and_states(core)
+        n = mesh.devices.size
+        fn = SHARDED_EPOCH_BUILDERS["equi_join"](core, mesh, [0], [0])
+
+        def zero_chunk():
+            from .common.chunk import Column, StreamChunk
+            cols = tuple(
+                Column(jnp.zeros((n, k, cap), f.type.dtype),
+                       jnp.zeros((n, k, cap), jnp.bool_))
+                for f in BID_SCHEMA)
+            return StreamChunk(jnp.zeros((n, k, cap), jnp.int8),
+                               jnp.zeros((n, k, cap), jnp.bool_), cols)
+
+        return fn, (stacked, zero_chunk(), "left")
+
+    def t_sharded_group():
+        exprs, core = q5_parts()
+        mesh = make_mesh(min(len(jax.devices()), 8))
+        n = mesh.devices.size
+        fn = SHARDED_EPOCH_BUILDERS["group_agg"](
+            gen.chunk_fn(), exprs, core, cap, mesh)
+        per_job = [stack_states([core.init_state() for _ in range(n)])
+                   for _ in range(jobs)]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=1), *per_job)
+        starts = jnp.zeros(jobs, jnp.int64)
+        keys = jnp.stack([jax.random.PRNGKey(j) for j in range(jobs)])
+        nos = jnp.zeros(jobs, jnp.int64)
+        return fn, (stacked, starts, keys, nos, k)
+
     return {
-        "fused_source_agg_epoch.<locals>.epoch":
-            (q5, (q5_core.init_state(), start, key, k)),
-        "fused_source_join_epoch.<locals>.epoch":
-            (q7, (q7_core.init_state(), start, key, k)),
+        "source_agg": t_q5, "source_join": t_q7,
+        "source_session": t_q8, "source_q3": t_q3,
+        "multi_agg": t_multi,
+        "sharded:source_agg": t_sharded_q5,
+        "sharded:source_join": t_sharded_q7,
+        "sharded:source_session": t_sharded_q8,
+        "sharded:source_q3": t_sharded_q3,
+        "sharded:equi_join": t_equi_join,
+        "sharded:group_agg": t_sharded_group,
     }
 
 
 def _ctl_profile_roofline(args, _json) -> int:
-    """`ctl profile roofline`: AOT-``lower().compile()`` the fused q5
-    and q7 epochs and print each kernel's flops / bytes accessed /
-    arithmetic intensity / %-of-peak against the chip roofline — the
-    measured-roofline artifact ROADMAP item 1 demands, available
-    chip-free (docs/performance.md)."""
+    """`ctl profile roofline`: AOT-``lower().compile()`` EVERY
+    registered fused surface — the four solo epochs, the co-scheduled
+    multi-job epoch, and all six sharded surfaces — and print each
+    kernel's flops / bytes accessed / arithmetic intensity / %-of-peak
+    against the chip roofline: the measured-roofline artifact ROADMAP
+    item 1 demands, available chip-free (docs/performance.md).
+    ``--surface NAME`` restricts the (expensive) AOT compile to one
+    surface."""
     from .common.config import ObservabilityConfig
     from .common.profiling import (
         aot_analysis, render_roofline_table, roofline_report,
@@ -342,9 +481,25 @@ def _ctl_profile_roofline(args, _json) -> int:
     obs = ObservabilityConfig()
     peak_flops = args.peak_flops or obs.chip_peak_flops
     peak_bw = args.peak_bandwidth or obs.chip_peak_bandwidth
+    surfaces = _roofline_surfaces()
+    pick = getattr(args, "surface", None)
+    if pick is not None:
+        if pick not in surfaces:
+            raise SystemExit(
+                f"unknown surface {pick!r}; choose from: "
+                + ", ".join(sorted(surfaces)))
+        surfaces = {pick: surfaces[pick]}
     analyses = {}
-    for name, (fn, fn_args) in _roofline_targets().items():
-        analyses[name] = aot_analysis(fn, *fn_args)
+    for name, build in surfaces.items():
+        # report keys = the dispatch qualnames common/dispatch_count.py,
+        # the profiler, and Session.metrics()["dispatch"] all share
+        # (unique per surface); the surface name is the selector only
+        try:
+            fn, fn_args = build()
+            analyses[getattr(fn, "__qualname__", name)] = \
+                aot_analysis(fn, *fn_args)
+        except Exception as e:  # noqa: BLE001 - per-surface attribution
+            analyses[name] = {"error": f"{type(e).__name__}: {e}"}
     report = roofline_report(analyses, peak_flops, peak_bw)
     if args.json:
         print(_json.dumps(report, indent=2))

@@ -401,10 +401,12 @@ class Session:
         self._cosched_engines: dict[str, tuple] = {}
         self._cosched_markers: set[str] = set()
         # mesh-sharded fused MVs (ops/fused_sharded.py): with a mesh AND
-        # the coschedule opt-in, eligible MVs tick as ONE dispatch per
-        # epoch across all chips. Engines map job -> (flush/persistence
-        # HashAggExecutor, output queue, device source cursor,
-        # parallel/fused.ShardedFusedAgg).
+        # the coschedule opt-in, eligible MVs join a signature-keyed
+        # K-jobs × S-shards group (parallel/fused.ShardedCoGroup) — a
+        # whole group ticks as ONE dispatch per epoch across all chips.
+        # Engines map job -> (flush/persistence HashAggExecutor, output
+        # queue, device source cursor, its ShardedCoGroup).
+        self._shardfused = None        # lazy ShardedCoScheduler
         self._shardfused_engines: dict[str, tuple] = {}
         self._shardfused_markers: set[str] = set()
         # epochs run by fused engines this session has since dropped,
@@ -1236,17 +1238,22 @@ class Session:
         executed) is the flush/persistence engine, so the state-table
         checkpoint delta and the durable layout are the executor path's
         own code; the MV pipeline is QueueSource → Materialize fed by
-        the sharded group flush. The ONE difference is state placement:
+        the sharded group flush. TWO differences: state placement —
         per-shard AggCore states live stacked under ``P('shard')`` and
         recovery re-shards the committed rows onto THIS session's mesh
         by replaying the vnode mapping (parallel/fused.py
-        ``load_shard_states``) — an 8-shard checkpoint reopens cleanly
-        on a 4-shard mesh."""
+        ``load_shard_states``), so an 8-shard checkpoint reopens cleanly
+        on a 4-shard mesh — and multiplexing: signature-equal MVs join
+        ONE K-jobs × S-shards group (ShardedCoGroup, fusion surface 6),
+        so the whole group is one dispatch per tick, not one per MV."""
         from ..common.types import INT64, VARCHAR
         from ..connector import NexmarkConfig
         from ..connector.nexmark import DeviceBidGenerator
-        from ..parallel.fused import ShardedFusedAgg, load_shard_states
-        from ..stream.coschedule import DeviceSourceCursor, declared_chunk_fn
+        from ..parallel.fused import ShardedCoScheduler, load_shard_states
+        from ..stream.coschedule import (
+            DeviceSourceCursor, FusedJobSpec, agg_signature,
+            declared_chunk_fn,
+        )
         from ..stream.hash_agg import HashAggExecutor, agg_state_schema
         from ..stream.project import ProjectExecutor
         from ..stream.source import MockSource
@@ -1292,9 +1299,23 @@ class Session:
         rows_per_chunk = int(rate) if rate else self.source_chunk_capacity
         src_cfg = NexmarkConfig(chunk_capacity=rows_per_chunk)
         gen = DeviceBidGenerator(src_cfg, seed=self.seed)
-        sf = ShardedFusedAgg(
-            mesh, agg.core, declared_chunk_fn(gen.chunk_fn(), m.col_map),
-            tuple(m.exprs), rows_per_chunk, states=states)
+        source_sig = ("nexmark_bid", src_cfg.chunk_capacity,
+                      src_cfg.events_per_second, src_cfg.active_people,
+                      src_cfg.in_flight_auctions, src_cfg.start_time_us,
+                      m.col_map,
+                      tuple(sorted((m.source.options or {}).items())))
+        spec = FusedJobSpec(
+            kind="agg",
+            signature=agg_signature(agg.core, m.exprs, rows_per_chunk,
+                                    source_sig),
+            chunk_fn=declared_chunk_fn(gen.chunk_fn(), m.col_map),
+            exprs=tuple(m.exprs), core=agg.core,
+            rows_per_chunk=rows_per_chunk, seed=self.seed)
+        if self._shardfused is None or self._shardfused.mesh is not mesh:
+            self._shardfused = ShardedCoScheduler(mesh)
+        group = self._shardfused.add(
+            stmt.name, spec, shard_states=states, start=cursor.events,
+            batch_no=cursor.epochs)
 
         mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
                                  table_id=mv_table_id, definition="")
@@ -1311,7 +1332,7 @@ class Session:
         self.feeds.append(_SourceFeed(q, lambda: None, reader=cursor,
                                       state_table=split_st,
                                       job=stmt.name))
-        self._shardfused_engines[stmt.name] = (agg, q, cursor, sf)
+        self._shardfused_engines[stmt.name] = (agg, q, cursor, group)
         self._shardfused_markers.add(stmt.name)
         if self.data_dir is not None and not self._recovering:
             self.store.log.log_ddl(  # type: ignore[attr-defined]
@@ -1323,24 +1344,26 @@ class Session:
 
     def _shardfused_tick(self, epoch: int, checkpoint: bool,
                          generate: bool) -> None:
-        """Per-tick driver: each mesh-sharded fused MV advances its whole
-        epoch in ONE dispatch across all chips; the flush (one packed
-        fetch for every shard) feeds the Materialize queue; checkpoint
-        barriers write every shard's delta through the engine's own
-        state-table flush."""
-        import jax
+        """Per-tick driver: ONE dispatch per K×S group covers every
+        member MV's whole epoch across all chips; the group flush (one
+        packed [n, J, 3] fetch) feeds each job's Materialize queue;
+        checkpoint barriers write every (job, shard) delta through each
+        job's own state-table flush, then restack once per group."""
         k = self.chunks_per_tick
-        for name, (agg, q, cursor, sf) in self._shardfused_engines.items():
+        for group in list(self._shardfused.groups.values()):
             if generate and k > 0:
-                key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
-                                         cursor.epochs)
-                sf.run_epoch(cursor.events, key, k)
-                cursor.events += k * sf.rows_per_chunk
-                cursor.epochs += 1
-            for ch in sf.flush():
-                q.push(ch)
+                group.run_epoch(k)
+            outs = group.flush()
+            for j, name in enumerate(group.names):
+                _agg, q, cursor, _g = self._shardfused_engines[name]
+                cursor.events = group.starts[j]
+                cursor.epochs = group.batch_nos[j]
+                for ch in outs[name]:
+                    q.push(ch)
             if checkpoint:
-                sf.checkpoint(agg, epoch)
+                group.checkpoint(
+                    {name: self._shardfused_engines[name][0]
+                     for name in group.names}, epoch)
 
     # ------------------------------------------------------ remote MV jobs --
 
@@ -2614,13 +2637,17 @@ class Session:
             self._cosched_engines.pop(stmt.name, None)
             self._cosched_markers.discard(stmt.name)
             dead_sf = self._shardfused_engines.pop(stmt.name, None)
-            if dead_sf is not None and dead_sf[3].epochs_run:
-                sf = dead_sf[3]
-                qn = ("sharded_agg_epoch.<locals>.epoch"
-                      if type(sf).__name__ == "ShardedFusedAgg"
-                      else "sharded_join_epoch.<locals>.epoch")
-                self._dispatch_epochs_retired[qn] = \
-                    self._dispatch_epochs_retired.get(qn, 0) + sf.epochs_run
+            if dead_sf is not None and self._shardfused is not None:
+                _states, sf_group = self._shardfused.remove(stmt.name)
+                if sf_group is not None and sf_group.n_jobs == 0 \
+                        and sf_group.epochs_run:
+                    # the job emptied its K×S group: retire its epochs
+                    # for the per_epoch invariant ratio, like coschedule
+                    qn = ("build_sharded_group_epoch.<locals>"
+                          ".sharded_coscheduled_epoch")
+                    self._dispatch_epochs_retired[qn] = \
+                        self._dispatch_epochs_retired.get(qn, 0) \
+                        + sf_group.epochs_run
             self._shardfused_markers.discard(stmt.name)
             if stmt.name in self.jobs:
                 job = self.jobs.pop(stmt.name)
@@ -3594,13 +3621,16 @@ class Session:
             # epoch co-scheduler: group membership + epochs run
             # (stream/coschedule.py)
             "coschedule": self._cosched.stats(),
-            # mesh-sharded fused MVs: shard count + epochs + grow-retry
-            # events per job (ops/fused_sharded.py, parallel/fused.py)
+            # mesh-sharded fused MVs: shard count + group size + epochs
+            # + grow-retry events per job (ops/fused_sharded.py,
+            # parallel/fused.ShardedCoGroup — signature-equal MVs share
+            # one K×S group, so their stats coincide by design)
             "shardfused": {
-                name: {"shards": sf.n, "epochs_run": sf.epochs_run,
-                       "recv_width": sf.recv_width,
-                       "route_grows": sf.route_grows}
-                for name, (_, _, _, sf) in
+                name: {"shards": g.n, "epochs_run": g.epochs_run,
+                       "recv_width": g.recv_width,
+                       "route_grows": g.route_grows,
+                       "group_jobs": g.n_jobs}
+                for name, (_, _, _, g) in
                 self._shardfused_engines.items()
             },
             # serving plane (frontend/serving.py): plan-cache hit/miss,
@@ -3715,13 +3745,12 @@ class Session:
                     epochs_by_name.get(
                         "build_group_epoch.<locals>.coscheduled_epoch", 0) \
                     + g.epochs_run
-        for _name, (_, _, _, sf) in self._shardfused_engines.items():
-            qn = ("sharded_agg_epoch.<locals>.epoch"
-                  if type(sf).__name__ == "ShardedFusedAgg"
-                  else "sharded_join_epoch.<locals>.epoch")
-            if sf.epochs_run:
-                epochs_by_name[qn] = epochs_by_name.get(qn, 0) \
-                    + sf.epochs_run
+        if self._shardfused is not None:
+            qn = "build_sharded_group_epoch.<locals>.sharded_coscheduled_epoch"
+            for g in self._shardfused.groups.values():
+                if g.epochs_run:
+                    epochs_by_name[qn] = epochs_by_name.get(qn, 0) \
+                        + g.epochs_run
         for qn, epochs in epochs_by_name.items():
             if qn in counts and epochs:
                 dispatch["per_epoch"][qn] = round(counts[qn] / epochs, 4)

@@ -1,16 +1,21 @@
 """Mesh-sharded fused epochs — one dispatch per epoch across ALL chips.
 
-Fifth fusion surface (docs/performance.md): the single-dispatch epochs of
-ops/fused_epoch.py (generate → project → stateful core, one ``lax.scan``)
-promoted from one device to the whole mesh. The epoch body runs UNCHANGED
-per shard under ``shard_map``; the hash-partitioned operator state —
-AggCore tables, IntervalJoinCore bucket rings — lives sharded across the
-mesh axis with a leading ``[n_shards]`` axis (``P('shard')``), and rows
-are routed to their owner shard IN-DISPATCH with one ``lax.all_to_all``
-per scan iteration, keyed by ``vnode_to_shard`` from common/hashing.py —
-the exact contiguous vnode mapping remote exchange and the executor-path
-sharded recovery filter use, so cross-worker routing, in-chip sharding
-and durable re-sharding always agree.
+Fusion surfaces 5 and 6 (docs/performance.md): the single-dispatch
+epochs of ops/fused_epoch.py (generate → project → stateful core, one
+``lax.scan``) promoted from one device to the whole mesh — the FULL solo
+ladder (q5 agg, q7 interval join, q8 session windows, TPC-H q3 with its
+in-dispatch GLOBAL top-n), a generic JoinCore equi-join surface, and the
+co-scheduled group × shard composition (K signature-equal jobs × S
+shards in one dispatch, ``build_sharded_group_epoch``). The epoch body
+runs UNCHANGED per shard under ``shard_map``; the hash-partitioned
+operator state — AggCore tables, IntervalJoinCore bucket rings,
+SessionWindowCore key tables, Q3 orders+agg tables — lives sharded
+across the mesh axis with a leading ``[n_shards]`` axis (``P('shard')``),
+and rows are routed to their owner shard IN-DISPATCH with one
+``lax.all_to_all`` per scan iteration, keyed by ``vnode_to_shard`` from
+common/hashing.py — the exact contiguous vnode mapping remote exchange
+and the executor-path sharded recovery filter use, so cross-worker
+routing, in-chip sharding and durable re-sharding always agree.
 
 Epoch anatomy (one jit call — ``common/dispatch_count.py`` counts it as
 exactly ONE dispatch regardless of shard count or ``k``):
@@ -219,9 +224,311 @@ def sharded_join_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
                             epoch.__qualname__)
 
 
+def sharded_session_epoch(chunk_fn: Callable, exprs: Sequence[Expr], core,
+                          rows_per_chunk: int, mesh,
+                          recv_width: int = 2) -> Callable:
+    """Build ``epoch(stacked_state, start, key, k, watermark)`` for the
+    q8 session-window shape (ops/session_window.SessionWindowCore)
+    sharded over ``mesh``. Routing key = the projected session-key
+    column (``core.key_col``), so every key's whole event history lands
+    on one shard and the per-shard body is exactly the solo session
+    body over that shard's keys — closed-session multisets and per-key
+    open state are bit-identical to the solo epoch (one shard folds its
+    n received chunk slices in global chunk order, and session closure
+    depends only on the per-key event sequence, which that preserves).
+
+    Returns the solo tuple with a leading ``[n_shards]`` axis on every
+    element; ``packed`` grows to ``[n, 6]`` — [n_closed, table_overflow,
+    closed_overflow, saw_delete, out_of_order, route_ovf] per shard —
+    ONE fetch covering every shard's emission count, sticky flags AND
+    the routing overflow that drives the grow-retry."""
+    from jax.sharding import PartitionSpec as P
+
+    (axis, shard_map_compat, shuffle_chunk_local, n,
+     width) = _shard_scan_parts(mesh, recv_width)
+    exprs = tuple(exprs)
+    route = (core.key_col,)
+    recv_cap = width * rows_per_chunk
+
+    def epoch(stacked, start, key, k: int, watermark):
+        kpp = -(-k // n)
+
+        def local(state, start, key, wm):
+            state = _squeeze(state)
+            s = jax.lax.axis_index(axis)
+
+            def body(carry, i):
+                st, rovf = carry
+                gi = i * n + s
+                ch = chunk_fn(start + gi * rows_per_chunk,
+                              jax.random.fold_in(key, gi))
+                if exprs:
+                    ch = ch.with_columns(tuple(e.eval(ch) for e in exprs))
+                ch = StreamChunk(ch.ops, ch.vis & (gi < k), ch.columns)
+                owned = shuffle_chunk_local(ch, n, route)
+                if width < n:
+                    owned, ovf = compact_chunk(owned, recv_cap)
+                    rovf = rovf | ovf
+                return (core.apply_chunk(st, owned), rovf), None
+
+            (state, rovf), _ = jax.lax.scan(
+                body, (state, jnp.zeros((), jnp.bool_)),
+                jnp.arange(kpp, dtype=jnp.int64))
+            state, packed = core.flush_plan(state, wm)
+            snapshot = core.snapshot_closed(state)
+            state = core.finish_flush(state)
+            packed = jnp.concatenate(
+                [packed, rovf.astype(jnp.int64)[None]])
+            return (_unsqueeze(state), _unsqueeze(snapshot), packed[None])
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
+            out_specs=(P(axis),) * 3)
+        return mapped(stacked, start, key, watermark)
+
+    epoch.__qualname__ = "sharded_session_epoch.<locals>.epoch"
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,)),
+                            epoch.__qualname__)
+
+
+def sharded_q3_epoch(chunk_fn: Callable, core, rows_per_chunk: int, mesh,
+                     recv_width: int = 2) -> Callable:
+    """Build ``epoch(stacked_state, start, key, k)`` for the TPC-H q3
+    streaming-MV shape (ops/stream_q3.Q3Core) sharded over ``mesh``.
+    Routing key = the event's orderkey column, so an order row, its
+    lineitems, and their revenue group all co-locate and the per-shard
+    body is exactly the solo q3 body over that shard's orders.
+
+    The top-``limit`` flush is GLOBAL: each shard takes the local
+    top-``limit`` of its candidates (``Q3Core.topk_perm``), one
+    ``lax.all_gather`` unions them (group keys are shard-disjoint, so
+    the global top-``limit`` is always inside the union), and every
+    shard runs the SAME ``flush_from_candidates`` the solo flush uses
+    over the gathered set — the emitted buffer stays replicated across
+    shards and the churn chunk is bit-identical on every shard (the
+    driver reads shard 0's copy). ``packed`` = [n_out,
+    orders_overflow, agg_overflow, saw_delete, route_ovf] per shard."""
+    from jax.sharding import PartitionSpec as P
+
+    (axis, shard_map_compat, shuffle_chunk_local, n,
+     width) = _shard_scan_parts(mesh, recv_width)
+    route = (core.okey_col,)
+    recv_cap = width * rows_per_chunk
+
+    def epoch(stacked, start, key, k: int):
+        kpp = -(-k // n)
+
+        def local(state, start, key):
+            state = _squeeze(state)
+            s = jax.lax.axis_index(axis)
+
+            def body(carry, i):
+                st, rovf = carry
+                gi = i * n + s
+                ch = chunk_fn(start + gi * rows_per_chunk,
+                              jax.random.fold_in(key, gi))
+                ch = StreamChunk(ch.ops, ch.vis & (gi < k), ch.columns)
+                owned = shuffle_chunk_local(ch, n, route)
+                if width < n:
+                    owned, ovf = compact_chunk(owned, recv_cap)
+                    rovf = rovf | ovf
+                return (core.apply_chunk(st, owned), rovf), None
+
+            (state, rovf), _ = jax.lax.scan(
+                body, (state, jnp.zeros((), jnp.bool_)),
+                jnp.arange(kpp, dtype=jnp.int64))
+            okey, rev, odate, prio, live = core.flush_candidates(state)
+            perm = core.topk_perm(okey, rev, live, core.limit)
+            local_cand = (okey[perm], rev[perm], odate[perm], prio[perm],
+                          live[perm])
+            gathered = tuple(
+                jax.lax.all_gather(x, axis).reshape(-1)
+                for x in local_cand)
+            state, out, packed = core.flush_from_candidates(
+                state, *gathered)
+            packed = jnp.concatenate(
+                [packed, rovf.astype(jnp.int64)[None]])
+            return (_unsqueeze(state), _unsqueeze(out), packed[None])
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis),) * 3)
+        return mapped(stacked, start, key)
+
+    epoch.__qualname__ = "sharded_q3_epoch.<locals>.epoch"
+    return profile_dispatch(jax.jit(epoch, static_argnums=(3,)),
+                            epoch.__qualname__)
+
+
+def sharded_equi_join_epoch(core, mesh, left_keys: Sequence[int],
+                            right_keys: Sequence[int]) -> Callable:
+    """Build ``epoch(stacked_state, chunk_batch, side)`` — the GENERIC
+    sharded equi-join surface (ops/join_state.JoinCore, any schema /
+    join type / non-equi condition), fused to one dispatch per epoch.
+
+    ``chunk_batch``: a StreamChunk whose leaves carry leading
+    ``[n_shards, k]`` axes (``k`` same-side input chunks per shard);
+    one ``lax.scan`` shuffles each chunk to its owner shard by that
+    side's join-key columns and applies the UNCHANGED per-shard
+    JoinCore step — k chunks of ingest+probe across the whole mesh in
+    ONE dispatch, where the executor ladder previously paid one
+    dispatch per chunk. Returns ``(stacked_state, emission_grids)``
+    with the emission grids stacked ``[n, k, ...]``; overflow handling
+    stays the caller's functional grow-retry
+    (parallel/sharded_join.ShardedHashJoin.step_epoch)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.sharded_agg import (  # noqa: PLC0415 — layering
+        SHARD_AXIS, shard_map_compat, shuffle_chunk_local,
+    )
+    n = mesh.devices.size
+    keys = {"left": tuple(left_keys), "right": tuple(right_keys)}
+
+    def epoch(stacked, chunk_batch, side: str):
+        side_keys = keys[side]
+
+        def local(state, chunks):
+            state = _squeeze(state)
+            chunks = _squeeze(chunks)          # leaves [k, C]
+
+            def body(st, ch):
+                owned = shuffle_chunk_local(ch, n, side_keys)
+                st, big = core.apply_chunk(st, owned, side=side)
+                return st, big
+
+            state, bigs = jax.lax.scan(body, state, chunks)
+            return _unsqueeze(state), _unsqueeze(bigs)
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)))
+        return mapped(stacked, chunk_batch)
+
+    epoch.__qualname__ = "sharded_equi_join_epoch.<locals>.epoch"
+    return profile_dispatch(jax.jit(epoch, static_argnames=("side",)),
+                            epoch.__qualname__)
+
+
+# ---------------------------------------------------------------------------
+# co-scheduled groups × the shard axis: K jobs × S shards, ONE dispatch
+# ---------------------------------------------------------------------------
+
+
+def shuffle_group_chunks(chunks: StreamChunk, n_shards: int,
+                         key_idx: Sequence[int]) -> StreamChunk:
+    """Grouped in-dispatch hash shuffle: ``chunks`` leaves carry a
+    leading ``[J]`` job axis (one chunk per co-scheduled job); returns
+    leaves ``[J, n·C]`` — each job's owned rows after ONE all_to_all
+    for the whole group. The send-buffer build (argsort + scatter,
+    parallel/sharded_agg.chunk_sendbuf) vmaps per job; the collective
+    is hand-batched over ``[n, J, C]``, so a K-job group pays exactly
+    the single-job shuffle's collective count, and each job's receive
+    buffer keeps the single-job source-shard-major row order (the
+    bit-exactness anchor vs ShardedFusedAgg)."""
+    from ..parallel.sharded_agg import (  # noqa: PLC0415 — layering
+        SHARD_AXIS, chunk_sendbuf,
+    )
+    J, C = chunks.ops.shape[0], chunks.ops.shape[1]
+    key_idx = tuple(key_idx)
+    send = jax.vmap(lambda ch: chunk_sendbuf(ch, n_shards, key_idx))(
+        chunks)                                   # leaves [J, n, C]
+
+    def a2a(x):
+        x = jnp.moveaxis(x, 1, 0)                 # [n, J, C]
+        r = jax.lax.all_to_all(x, SHARD_AXIS, split_axis=0,
+                               concat_axis=0, tiled=True)
+        return jnp.moveaxis(r, 0, 1).reshape((J, n_shards * C))
+
+    return jax.tree_util.tree_map(a2a, send)
+
+
+def build_sharded_group_epoch(chunk_fn: Callable, exprs: Sequence[Expr],
+                              core, rows_per_chunk: int, mesh,
+                              recv_width: int = 2) -> Callable:
+    """The sixth fusion surface (docs/performance.md): a co-scheduled
+    group of K signature-equal source+agg MVs × S mesh shards in ONE
+    dispatch per tick. The two existing multiplexing axes compose —
+    ``build_group_epoch``'s vmap-over-jobs runs INSIDE ``shard_map``:
+    per scan iteration every job generates + projects its chunk (vmap),
+    the whole group's rows route in ONE hand-batched all_to_all
+    (``shuffle_group_chunks``), and each (job, shard) cell folds its
+    owned rows with the unchanged solo AggCore body.
+
+    Signature: ``epoch(stacked, starts[J], base_keys[J], batch_nos[J],
+    k) -> (stacked, route_ovf[n, J])``; ``stacked`` leaves carry
+    ``[n_shards, J, ...]`` (``NamedSharding(mesh, P('shard'))`` on the
+    leading axis). Per-job PRNG folding happens in-dispatch exactly
+    like the mesh-less group epoch (ops/fused_multi.build_group_epoch),
+    and shard s of job j generates that job's global chunks
+    ``{i·n + s}`` exactly like the single-job sharded epochs — so every
+    (job, shard) slice is bit-identical to both the solo fused path and
+    ShardedFusedAgg. common/dispatch_count.py counts this as
+    ``build_sharded_group_epoch.<locals>.sharded_coscheduled_epoch``."""
+    from jax.sharding import PartitionSpec as P
+
+    (axis, shard_map_compat, _shuffle, n,
+     width) = _shard_scan_parts(mesh, recv_width)
+    exprs = tuple(exprs)
+    gk = tuple(core.group_keys)
+    recv_cap = width * rows_per_chunk
+
+    def sharded_coscheduled_epoch(stacked, starts, base_keys, batch_nos,
+                                  k: int):
+        kpp = -(-k // n)
+
+        def local(state, starts, base_keys, batch_nos):
+            state = _squeeze(state)               # leaves [J, ...]
+            s = jax.lax.axis_index(axis)
+            keys = jax.vmap(jax.random.fold_in)(base_keys, batch_nos)
+            J = starts.shape[0]
+
+            def body(carry, i):
+                st, rovf = carry                  # st [J,...], rovf [J]
+                gi = i * n + s
+
+                def gen_one(start_j, key_j):
+                    ch = chunk_fn(start_j + gi * rows_per_chunk,
+                                  jax.random.fold_in(key_j, gi))
+                    proj = ch.with_columns(
+                        tuple(e.eval(ch) for e in exprs))
+                    return StreamChunk(proj.ops, proj.vis & (gi < k),
+                                       proj.columns)
+
+                chunks = jax.vmap(gen_one)(starts, keys)   # leaves [J, C]
+                owned = shuffle_group_chunks(chunks, n, gk)
+                if width < n:
+                    owned, ovf = jax.vmap(
+                        lambda c: compact_chunk(c, recv_cap))(owned)
+                    rovf = rovf | ovf
+                return (jax.vmap(core.apply_chunk)(st, owned), rovf), None
+
+            (state, rovf), _ = jax.lax.scan(
+                body, (state, jnp.zeros((J,), jnp.bool_)),
+                jnp.arange(kpp, dtype=jnp.int64))
+            return _unsqueeze(state), rovf[None]           # [1, J]
+
+        mapped = shard_map_compat(
+            local, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
+            out_specs=(P(axis), P(axis)))
+        return mapped(stacked, starts, base_keys, batch_nos)
+
+    sharded_coscheduled_epoch.__qualname__ = \
+        "build_sharded_group_epoch.<locals>.sharded_coscheduled_epoch"
+    return profile_dispatch(
+        jax.jit(sharded_coscheduled_epoch, static_argnums=(4,)),
+        sharded_coscheduled_epoch.__qualname__)
+
+
 #: builder registry, mirroring ops/fused_epoch.EPOCH_BUILDERS — the path
-#: bench.py and the frontend wiring resolve a sharded surface by shape
+#: bench.py, `ctl profile roofline` and the frontend wiring resolve a
+#: sharded surface by shape, and the set rwlint's dispatch-discipline
+#: closure + the registry-coverage test walk. Signatures vary by shape
+#: (the solo registry has the same property: source_q3 takes no exprs);
+#: resolution is by name, never positional across shapes.
 SHARDED_EPOCH_BUILDERS = {
-    "source_agg": sharded_agg_epoch,     # NEXmark q5 over the mesh
-    "source_join": sharded_join_epoch,   # NEXmark q7 over the mesh
+    "source_agg": sharded_agg_epoch,         # NEXmark q5 over the mesh
+    "source_join": sharded_join_epoch,       # NEXmark q7 over the mesh
+    "source_session": sharded_session_epoch,  # NEXmark q8 over the mesh
+    "source_q3": sharded_q3_epoch,           # TPC-H q3 over the mesh
+    "equi_join": sharded_equi_join_epoch,    # generic JoinCore equi-join
+    "group_agg": build_sharded_group_epoch,  # K jobs × S shards
 }

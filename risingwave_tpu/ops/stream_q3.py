@@ -178,22 +178,45 @@ class Q3Core:
 
     # -- barrier flush ---------------------------------------------------------
 
-    def flush(self, state: Q3State):
-        """Recompute the top-``limit`` by (revenue DESC, orderkey ASC)
-        and emit churn vs the previously emitted rows. Returns
-        (state, out_chunk [2*limit rows: deletes then inserts], packed
-        [n_out, orders_overflow, agg_overflow, saw_delete])."""
-        K = self.limit
+    def flush_candidates(self, state: Q3State):
+        """Full candidate arrays for the top-``limit`` recompute:
+        ``(okey, rev, odate, prio, live)`` over every agg slot. The
+        sharded epoch takes each shard's local top-``limit`` of these
+        (``topk_perm``), all-gathers them, and feeds the union through
+        the SAME ``flush_from_candidates`` the solo flush uses — group
+        keys are shard-disjoint, so the global top-``limit`` is always
+        inside the gathered union and the result is bit-identical."""
         lanes = state.agg.lanes
         live = lanes[0] > 0
         ofs = self.agg.call_lane_ofs
         rev, odate, prio = lanes[ofs[0]], lanes[ofs[1]], lanes[ofs[2]]
         okey = state.agg.table.key_data[0].astype(jnp.int64)
+        return okey, rev, odate, prio, live
 
-        o1 = jnp.argsort(jnp.where(live, okey, _BIG), stable=True)
-        perm = o1[jnp.argsort(jnp.where(live, -rev, _BIG)[o1],
-                              stable=True)][:K]
-        new_valid = live[perm]
+    @staticmethod
+    def topk_perm(okey, rev, valid, limit: int):
+        """Indices of the top-``limit`` candidates by (revenue DESC,
+        orderkey ASC) — two stable argsorts; orderkeys are distinct, so
+        the order is total and independent of candidate array order."""
+        o1 = jnp.argsort(jnp.where(valid, okey, _BIG), stable=True)
+        return o1[jnp.argsort(jnp.where(valid, -rev, _BIG)[o1],
+                              stable=True)][:limit]
+
+    def flush(self, state: Q3State):
+        """Recompute the top-``limit`` by (revenue DESC, orderkey ASC)
+        and emit churn vs the previously emitted rows. Returns
+        (state, out_chunk [2*limit rows: deletes then inserts], packed
+        [n_out, orders_overflow, agg_overflow, saw_delete])."""
+        return self.flush_from_candidates(state, *self.flush_candidates(state))
+
+    def flush_from_candidates(self, state: Q3State, okey, rev, odate,
+                              prio, valid):
+        """The top-``limit`` churn over an arbitrary candidate set (the
+        solo flush passes every agg slot; the sharded flush passes the
+        all-gathered union of per-shard top-``limit`` rows)."""
+        K = self.limit
+        perm = self.topk_perm(okey, rev, valid, K)
+        new_valid = valid[perm]
         new_key, new_rev = okey[perm], rev[perm]
         new_odate, new_prio = odate[perm], prio[perm]
 

@@ -260,11 +260,30 @@ class ShardedHashJoinExecutor(Executor):
         # whenever MAX_PENDING_UNITS batches are resident, bounding HBM.
         self._pending_msgs: list = []      # ("units", big) | ("wm", wm)
         self._n_pending_units = 0
+        # INPUT chunks also batch: a run of same-side chunks is held and
+        # joined by ONE fused dispatch (ShardedHashJoin.step_epoch — the
+        # generic sharded-fused equi-join surface) instead of one
+        # dispatch per chunk; a side switch, watermark, barrier or the
+        # MAX_PENDING_UNITS bound cuts the run
+        self._in_side = None
+        self._in_run: list = []
         if any(self.state_tables.values()):
             self._load_from_state_tables()
 
     #: device-resident unit batches allowed before a forced flush
     MAX_PENDING_UNITS = 16
+
+    def _run_pending_inputs(self) -> None:
+        """Join the buffered same-side input run in one fused dispatch;
+        its emission grids queue for the next output flush in order."""
+        if not self._in_run:
+            return
+        bigs = self.join.step_epoch(self._in_side, self._in_run)
+        for big in bigs:
+            self._pending_msgs.append(("units", big))
+        self._n_pending_units += len(bigs)
+        self._in_side = None
+        self._in_run = []
 
     def _flush_pending(self):
         """Emit buffered match-unit windows and watermarks in arrival
@@ -302,18 +321,24 @@ class ShardedHashJoinExecutor(Executor):
                 _, side, chunk = ev
                 stats.chunks_in += 1
                 stats.capacity_rows_in += chunk.capacity
-                big = self.join.step(
-                    side, split_chunk(chunk, self.n, self.join._sharding))
-                # emission deferred (bounded): the join output stays
+                if self._in_side is not None and self._in_side != side:
+                    # side switch cuts the fused run (arrival order is
+                    # the emission contract)
+                    self._run_pending_inputs()
+                self._in_side = side
+                self._in_run.append(
+                    split_chunk(chunk, self.n, self.join._sharding))
+                # emission deferred (bounded): inputs AND outputs stay
                 # resident on device until the next flush, so the data
-                # path has no host sync per chunk
-                self._pending_msgs.append(("units", big))
-                self._n_pending_units += 1
-                if self._n_pending_units >= self.MAX_PENDING_UNITS:
+                # path has no host sync — and no dispatch — per chunk
+                if (len(self._in_run) + self._n_pending_units
+                        >= self.MAX_PENDING_UNITS):
+                    self._run_pending_inputs()
                     for out in self._flush_pending():
                         yield out
             elif kind == "barrier":
                 barrier = ev[1]
+                self._run_pending_inputs()
                 for out in self._flush_pending():
                     yield out
                 with barrier_timer(stats, self.identity, barrier.epoch.curr):
@@ -329,7 +354,9 @@ class ShardedHashJoinExecutor(Executor):
                 out_idx = self._map_watermark_col(side, wm.col_idx)
                 if out_idx is not None:
                     # buffered in order: a watermark must not overtake
-                    # same-epoch data rows still pending on device
+                    # same-epoch data rows still pending on device —
+                    # including input chunks not yet joined
+                    self._run_pending_inputs()
                     self._pending_msgs.append(
                         ("wm", wm.__class__(out_idx, wm.value)))
 

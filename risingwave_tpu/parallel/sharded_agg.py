@@ -61,10 +61,14 @@ def make_mesh(n_devices: int) -> Mesh:
     return Mesh(np.array(devs[:n_devices]), (SHARD_AXIS,))
 
 
-def shuffle_chunk_local(chunk: StreamChunk, n_shards: int,
-                        key_idx: Sequence[int]) -> StreamChunk:
-    """Inside-shard_map hash shuffle: returns the [n*C] chunk of rows this
-    shard owns after the all-to-all. Pure; requires SHARD_AXIS binding."""
+def chunk_sendbuf(chunk: StreamChunk, n_shards: int,
+                  key_idx: Sequence[int]) -> StreamChunk:
+    """Per-target send buffers for the hash shuffle: a StreamChunk whose
+    leaves are [n_shards, C] — row block ``t`` holds this shard's rows
+    owned by shard ``t`` (vnode hash of the key columns), front-packed.
+    Pure elementwise/sort work, no collectives — so the multi-job group
+    epoch can ``vmap`` it over a leading job axis and hand-batch the ONE
+    all_to_all itself (ops/fused_sharded.shuffle_group_chunks)."""
     C = chunk.capacity
     key_cols = [chunk.columns[i] for i in key_idx]
     vn = vnode_of(key_cols)
@@ -83,21 +87,24 @@ def shuffle_chunk_local(chunk: StreamChunk, n_shards: int,
         return buf.at[jnp.clip(sorted_tgt, 0, n_shards - 1), dest_row].set(
             src, mode="drop")
 
-    send_ops = to_sendbuf(chunk.ops)
-    send_vis = to_sendbuf(chunk.vis)
-    send_cols = [(to_sendbuf(c.data), to_sendbuf(c.mask)) for c in chunk.columns]
+    return StreamChunk(
+        to_sendbuf(chunk.ops), to_sendbuf(chunk.vis),
+        tuple(Column(to_sendbuf(c.data), to_sendbuf(c.mask))
+              for c in chunk.columns))
+
+
+def shuffle_chunk_local(chunk: StreamChunk, n_shards: int,
+                        key_idx: Sequence[int]) -> StreamChunk:
+    """Inside-shard_map hash shuffle: returns the [n*C] chunk of rows this
+    shard owns after the all-to-all. Pure; requires SHARD_AXIS binding."""
+    C = chunk.capacity
+    send = chunk_sendbuf(chunk, n_shards, key_idx)
 
     def a2a(x):
         return jax.lax.all_to_all(x, SHARD_AXIS, split_axis=0, concat_axis=0,
-                                  tiled=True)
+                                  tiled=True).reshape(n_shards * C)
 
-    recv_ops = a2a(send_ops).reshape(n_shards * C)
-    recv_vis = a2a(send_vis).reshape(n_shards * C)
-    recv_cols = tuple(
-        Column(a2a(d).reshape(n_shards * C), a2a(m).reshape(n_shards * C))
-        for d, m in send_cols
-    )
-    return StreamChunk(recv_ops, recv_vis, recv_cols)
+    return jax.tree_util.tree_map(a2a, send)
 
 
 class ShardedHashAgg:

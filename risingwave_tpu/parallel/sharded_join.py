@@ -36,10 +36,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.chunk import StreamChunk, chunk_to_rows
 from ..common.types import Schema
+from ..ops.fused_sharded import sharded_equi_join_epoch
 from ..ops.join_state import JoinCore, JoinType, import_state
-from .sharded_agg import (
-    SHARD_AXIS, make_mesh, shard_map_compat, shuffle_chunk_local,
-)
+from .sharded_agg import SHARD_AXIS, make_mesh
 
 
 class ShardedHashJoin:
@@ -84,29 +83,12 @@ class ShardedHashJoin:
         self.state = jax.device_put(
             state, jax.tree_util.tree_map(lambda _: self._sharding, state))
 
-        core, n, mesh = self.core, self.n, self.mesh
-
-        def make_step(side: str):
-            side_keys = lk if side == "left" else rk
-
-            def local_step(state, chunk: StreamChunk):
-                state = jax.tree_util.tree_map(lambda x: x[0], state)
-                chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
-                owned = shuffle_chunk_local(chunk, n, side_keys)
-                state, big = core.apply_chunk(state, owned, side=side)
-                state = jax.tree_util.tree_map(lambda x: x[None], state)
-                big = jax.tree_util.tree_map(lambda x: x[None], big)
-                return state, big
-
-            return jax.jit(
-                shard_map_compat(
-                    local_step, mesh=mesh,
-                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                )
-            )
-
-        self._step = {"left": make_step("left"), "right": make_step("right")}
+        # the generic sharded-fused equi-join surface
+        # (ops/fused_sharded.SHARDED_EPOCH_BUILDERS["equi_join"]): one
+        # dispatch covers k same-side chunks — shuffle + probe/update
+        # for the whole mesh — where the old per-chunk step ladder paid
+        # one dispatch each
+        self._epoch = sharded_equi_join_epoch(self.core, self.mesh, lk, rk)
 
     # -- stepping with functional growth-on-overflow --------------------------
 
@@ -116,8 +98,32 @@ class ShardedHashJoin:
         [n_shards] axis, mostly-invisible rows). Grows state geometry and
         retries on overflow (single-chip analogue:
         stream/hash_join.py:_apply_growing)."""
+        return self.step_epoch(side, [chunk_batch])[0]
+
+    def step_epoch(self, side: str,
+                   chunk_batches: Sequence[StreamChunk]) -> list:
+        """``k`` same-side chunk batches (each with the leading
+        [n_shards] axis) in ONE fused dispatch — the epoch analogue of
+        ``step``, applied in order. Returns the k per-shard emission
+        grids. Overflow handling is the same functional grow-retry:
+        the epoch's outputs are discarded, geometry grows, and the
+        whole batch replays from the UNTOUCHED previous state.
+
+        The scan length is padded to the next power of two with
+        all-invisible chunks (a no-op for the join body), so
+        data-dependent run lengths from the executor's input batching
+        compile O(log k) epoch variants, not one per distinct k."""
+        k = len(chunk_batches)
+        padded = 1 << (k - 1).bit_length() if k > 1 else 1
+        if padded > k:
+            pad = jax.tree_util.tree_map(jnp.zeros_like, chunk_batches[0])
+            chunk_batches = list(chunk_batches) + [pad] * (padded - k)
+        batch = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=1), *chunk_batches)
+        batch = jax.device_put(
+            batch, jax.tree_util.tree_map(lambda _: self._sharding, batch))
         while True:
-            new_state, big = self._step[side](self.state, chunk_batch)
+            new_state, bigs = self._epoch(self.state, batch, side=side)
             flags = jax.device_get((
                 new_state.left.lane_overflow, new_state.left.ht_overflow,
                 new_state.right.lane_overflow, new_state.right.ht_overflow,
@@ -126,7 +132,8 @@ class ShardedHashJoin:
             ht_ovf = bool(np.any(flags[1]) | np.any(flags[3]))
             if not lane_ovf and not ht_ovf:
                 self.state = new_state
-                return big
+                return [jax.tree_util.tree_map(lambda x, i=i: x[:, i],
+                                               bigs) for i in range(k)]
             new_W = self.core.W * 2 if lane_ovf else self.core.W
             new_cap = self.core.capacity * 2 if ht_ovf else self.core.capacity
             if new_W * new_cap > self.max_state_cells:

@@ -50,10 +50,21 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_fused_q8_q3.py \
     tests/test_coschedule.py \
     tests/test_fused_sharded.py \
+    tests/test_fused_sharded_ladder.py \
+    tests/test_registry_coverage.py \
     tests/test_interval_join.py \
     tests/test_batched_ingest.py \
     tests/test_cli_fragments.py \
     tests/test_bench_hardening.py -m 'not slow' \
+    "$@"
+
+echo "== sharded-ladder heavy parity (slow-marked out of tier-1) =="
+# the K×S group / q8 / q3 sharded checkpoint + re-shard parity runs and
+# the every-builder dispatch/profiler cross-check compile large
+# shard_map programs — tier-2 per the 870s tier-1 wall budget
+python -m pytest -q -p no:cacheprovider -m slow \
+    tests/test_fused_sharded_ladder.py \
+    tests/test_registry_coverage.py \
     "$@"
 
 echo "== serving-plane tests (two-phase agg + plan cache + reads) =="

@@ -1,0 +1,727 @@
+"""The finished sharded-fused ladder (PR 13): mesh-sharded q8 session
+windows and TPC-H q3 (ops/fused_sharded.sharded_session_epoch /
+sharded_q3_epoch + parallel/fused.ShardedFusedSession / ShardedFusedQ3),
+the K-jobs × S-shards co-scheduled group (fusion surface 6:
+build_sharded_group_epoch + ShardedCoGroup), and the generic
+sharded-fused equi-join (ShardedHashJoin.step_epoch). Each surface is
+pinned the same three ways the q5/q7 sharded surfaces were: bit-exact
+against its solo fused counterpart at shard counts {1, 4, 8} (flush
+churn and retraction pairs included), exactly ONE dispatch per epoch
+independent of k / shard count / job count, and checkpoint export →
+kill → import re-sharding onto a different mesh size (8→4) with
+identical continuations. Heavy K×S parity/recovery cases are
+slow-marked and run in scripts/check.sh's fused subset (tier-2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import INT64, TIMESTAMP, chunk_to_rows
+from risingwave_tpu.common.chunk import OP_DELETE, OP_INSERT
+from risingwave_tpu.common.dispatch_count import count_dispatches
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.connector import NexmarkConfig
+from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+from risingwave_tpu.connector.tpch import (
+    DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+)
+from risingwave_tpu.expr import Literal, call, col
+from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.ops.fused_epoch import (
+    fused_source_agg_epoch, fused_source_q3_epoch,
+    fused_source_session_epoch,
+)
+from risingwave_tpu.ops.grouped_agg import AggCore
+from risingwave_tpu.ops.session_window import SessionWindowCore
+from risingwave_tpu.ops.stream_q3 import Q3Core
+from risingwave_tpu.parallel.fused import (
+    ShardedCoGroup, ShardedFusedAgg, ShardedFusedQ3, ShardedFusedSession,
+    load_shard_states, reshard_q3_payloads, reshard_session_payloads,
+)
+from risingwave_tpu.parallel.sharded_agg import make_mesh
+from risingwave_tpu.stream.coschedule import FusedJobSpec
+
+CAP = 256
+N_DEV = 8
+GAP = 100_000
+TIME_BASE = 1_600_000_000_000_000
+
+Q8_EPOCH_FN = "sharded_session_epoch.<locals>.epoch"
+Q3_EPOCH_FN = "sharded_q3_epoch.<locals>.epoch"
+GROUP_EPOCH_FN = \
+    "build_sharded_group_epoch.<locals>.sharded_coscheduled_epoch"
+EQUI_EPOCH_FN = "sharded_equi_join_epoch.<locals>.epoch"
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= N_DEV, "conftest must force 8 CPU devices"
+    return make_mesh(N_DEV)
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# q8 sharded: parity, dispatch count, checkpoint/re-shard
+# ---------------------------------------------------------------------------
+
+
+def _q8_parts(capacity=1 << 12, closed=1 << 13):
+    exprs = [col(1, INT64), col(5, TIMESTAMP)]     # bidder, date_time
+    schema = Schema((Field("bidder", INT64), Field("ts", TIMESTAMP)))
+    core = SessionWindowCore(schema, key_col=0, ts_col=1, gap_us=GAP,
+                             capacity=capacity, closed_capacity=closed)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+    return exprs, core, gen
+
+
+def _closed_rows(chunks):
+    out = []
+    for ch in chunks:
+        vis = np.asarray(ch.vis)
+        cols = [np.asarray(c.data) for c in ch.columns]
+        for r in np.nonzero(vis)[0]:
+            out.append(tuple(int(c[r]) for c in cols))
+    return sorted(out)
+
+
+def _solo_closed(snap, packed):
+    n = int(packed[0])
+    ck, cs, ce, cn = (np.asarray(a) for a in snap)
+    return sorted((int(ck[j]), int(cs[j]), int(ce[j]), int(cn[j]))
+                  for j in range(n))
+
+
+def _open_state(payloads):
+    """{key: (start, last, cnt)} over exported per-shard payloads."""
+    out = {}
+    for p in payloads:
+        occ = np.asarray(p["table_occupied"])
+        live = occ & (np.asarray(p["sess_start"]) >= 0)
+        kd = np.asarray(p["table_key_data"][0])
+        for s in np.nonzero(live)[0]:
+            out[int(kd[s])] = (int(p["sess_start"][s]),
+                               int(p["last_ts"][s]), int(p["count"][s]))
+    return out
+
+
+def _solo_open(state):
+    host = jax.device_get(state)
+    occ = np.asarray(host.table.occupied)
+    live = occ & (np.asarray(host.sess_start) >= 0)
+    kd = np.asarray(host.table.key_data[0])
+    return {int(kd[s]): (int(host.sess_start[s]), int(host.last_ts[s]),
+                         int(host.count[s]))
+            for s in np.nonzero(live)[0]}
+
+
+@pytest.mark.parametrize("n_shards,k", [
+    (8, 8),
+    pytest.param(4, 6, marks=pytest.mark.slow),   # tier-2 (wall budget)
+    pytest.param(1, 4, marks=pytest.mark.slow),
+])
+def test_sharded_session_bit_exact_vs_solo(mesh8, n_shards, k):
+    """Closed-session multisets AND per-key open state equal the solo
+    fused q8 epoch's over two epochs — epoch 1 with a non-closing
+    watermark (cross-epoch session continuation), epoch 2 with a
+    closing one — for full/partial/1-shard meshes and k not divisible
+    by the shard count."""
+    exprs, core, gen = _q8_parts()
+    mesh = mesh8 if n_shards == N_DEV else make_mesh(n_shards)
+    sf = ShardedFusedSession(mesh, core, gen.chunk_fn(), exprs, CAP)
+    solo = fused_source_session_epoch(gen.chunk_fn(), exprs, core, CAP,
+                                      donate=False)
+    st = core.init_state()
+    start = 0
+    watermarks = (0, TIME_BASE + 2 * k * CAP * 1_000)
+    saw_closed = False
+    for epoch, wm in enumerate(watermarks):
+        key = jax.random.fold_in(jax.random.PRNGKey(7), epoch)
+        sf.run_epoch(start, key, k, wm)
+        got = _closed_rows(sf.flush(out_capacity=CAP))
+        st, snap, packed = solo(st, jnp.int64(start), key, k,
+                                jnp.int64(wm))
+        start += k * CAP
+        assert not any(int(x) for x in jax.device_get(packed)[1:])
+        assert got == _solo_closed(snap, packed)
+        saw_closed |= bool(got)
+    assert saw_closed
+    got_open = _open_state(sf.export_host())
+    want_open = _solo_open(st)
+    assert got_open == want_open
+
+
+def test_sharded_session_epoch_dispatch_count():
+    """Exactly ONE dispatch per sharded q8 epoch, independent of k and
+    shard count — the per-epoch non-gather total must not move."""
+    with count_dispatches() as c:
+        exprs, core, gen = _q8_parts()
+        sf = ShardedFusedSession(make_mesh(4), core, gen.chunk_fn(),
+                                 exprs, CAP, recv_width=4)
+        key = jax.random.PRNGKey(17)
+        sf.run_epoch(0, key, 4, 0)
+        sf.flush(out_capacity=CAP)
+        c.reset()
+        sf.run_epoch(4 * CAP, key, 4, 0)
+        assert c.counts[Q8_EPOCH_FN] == 1
+        sf.flush(out_capacity=CAP)
+        n4 = sum(n for name, n in c.counts.items()
+                 if "gather" not in name)
+        c.reset()
+        sf.run_epoch(8 * CAP, key, 8, 0)
+        assert c.counts[Q8_EPOCH_FN] == 1
+        sf.flush(out_capacity=CAP)
+        n8 = sum(n for name, n in c.counts.items()
+                 if "gather" not in name)
+        assert n4 == n8
+
+
+@pytest.mark.slow
+def test_sharded_session_checkpoint_cycle_and_reshard(mesh8):
+    """export_host → kill → import_host (8→8) AND re-shard onto a
+    4-shard mesh (reshard_session_payloads replays the vnode mapping
+    over every open session's key): both continuations emit the solo
+    path's exact closed-session multiset."""
+    exprs, core, gen = _q8_parts()
+    sf = ShardedFusedSession(mesh8, core, gen.chunk_fn(), exprs, CAP)
+    key = jax.random.PRNGKey(5)
+    sf.run_epoch(0, key, 8, 0)
+    sf.flush(out_capacity=CAP)
+    payloads = sf.export_host()
+
+    solo = fused_source_session_epoch(gen.chunk_fn(), exprs, core, CAP,
+                                      donate=False)
+    st = solo(core.init_state(), jnp.int64(0), key, 8, jnp.int64(0))[0]
+    key2 = jax.random.fold_in(jax.random.PRNGKey(5), 1)
+    wm2 = TIME_BASE + 16 * CAP * 1_000
+    st, snap, packed = solo(st, jnp.int64(8 * CAP), key2, 8,
+                            jnp.int64(wm2))
+    want = _solo_closed(snap, packed)
+    assert want
+
+    # same-size import cycle is bit-exact state-wise
+    sf2 = ShardedFusedSession(mesh8, core, gen.chunk_fn(), exprs, CAP)
+    sf2.import_host(payloads)
+    _assert_tree_equal(sf.stacked, sf2.stacked)
+    sf2.run_epoch(8 * CAP, key2, 8, wm2)
+    assert _closed_rows(sf2.flush(out_capacity=CAP)) == want
+
+    # shrink to 4 shards by vnode replay: identical emissions
+    states4 = reshard_session_payloads(core, payloads, 4)
+    sf4 = ShardedFusedSession(make_mesh(4), core, gen.chunk_fn(), exprs,
+                              CAP, states=states4)
+    assert _open_state(sf4.export_host()) == _open_state(payloads)
+    sf4.run_epoch(8 * CAP, key2, 8, wm2)
+    assert _closed_rows(sf4.flush(out_capacity=CAP)) == want
+
+
+# ---------------------------------------------------------------------------
+# q3 sharded: parity (incl. retraction churn), dispatch count, re-shard
+# ---------------------------------------------------------------------------
+
+
+def _q3_parts(orders=1 << 11, agg=1 << 11):
+    gen = DeviceQ3Generator(TpchQ3Config(chunk_capacity=CAP))
+    core = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=orders,
+                  agg_capacity=agg)
+    return gen, core
+
+
+@pytest.mark.parametrize("n_shards", [
+    8,
+    pytest.param(4, marks=pytest.mark.slow),      # tier-2 (wall budget)
+    pytest.param(1, marks=pytest.mark.slow),
+])
+def test_sharded_q3_bit_exact_vs_solo(mesh8, n_shards):
+    """The global top-10 churn chunk — deletes AND inserts, epoch 2
+    retracting epoch 1's departed rows — is BIT-IDENTICAL to the solo
+    fused q3 epoch's, and the replicated emitted buffer matches on
+    every shard."""
+    gen, core = _q3_parts()
+    mesh = mesh8 if n_shards == N_DEV else make_mesh(n_shards)
+    sf = ShardedFusedQ3(mesh, core, gen.chunk_fn(), CAP)
+    solo = fused_source_q3_epoch(gen.chunk_fn(), core, CAP, donate=False)
+    st = core.init_state()
+    start = 0
+    for epoch in range(2):
+        key = jax.random.fold_in(jax.random.PRNGKey(3), epoch)
+        sf.run_epoch(start, key, 8)
+        got = sf.flush()
+        st, out, packed = solo(st, jnp.int64(start), key, 8)
+        start += 8 * CAP
+        assert not any(int(x) for x in jax.device_get(packed)[1:])
+        assert len(got) == 1
+        _assert_tree_equal(got[0], out)
+        ops = np.asarray(out.ops)[np.asarray(out.vis)]
+        if epoch == 0:
+            assert (ops == OP_INSERT).all() and len(ops) == 10
+        else:
+            # the top-n output carries retraction pairs even though
+            # both inputs are append-only
+            assert (ops == OP_DELETE).any() and (ops == OP_INSERT).any()
+    # the emitted top-n buffer is replicated identically across shards
+    solo_h = jax.device_get(st)
+    for p in sf.export_host():
+        np.testing.assert_array_equal(np.asarray(p["emitted_key"]),
+                                      np.asarray(solo_h.emitted_key))
+        np.testing.assert_array_equal(np.asarray(p["emitted_valid"]),
+                                      np.asarray(solo_h.emitted_valid))
+
+
+def test_sharded_q3_epoch_dispatch_count():
+    with count_dispatches() as c:
+        gen, core = _q3_parts()
+        sf = ShardedFusedQ3(make_mesh(4), core, gen.chunk_fn(), CAP,
+                            recv_width=4)
+        key = jax.random.PRNGKey(19)
+        sf.run_epoch(0, key, 4)
+        sf.flush()
+        c.reset()
+        sf.run_epoch(4 * CAP, key, 4)
+        assert c.counts[Q3_EPOCH_FN] == 1
+        sf.flush()
+        n4 = c.total
+        c.reset()
+        sf.run_epoch(8 * CAP, key, 8)
+        assert c.counts[Q3_EPOCH_FN] == 1
+        sf.flush()
+        assert c.total == n4     # per-epoch dispatches independent of k
+
+
+@pytest.mark.slow
+def test_sharded_q3_checkpoint_cycle_and_reshard(mesh8):
+    """export_host → kill → import (8→8) and vnode-replay re-shard onto
+    4 shards (orders + revenue groups follow the orderkey hash, the
+    replicated emitted buffer copies everywhere): both continuations
+    produce the solo path's exact churn."""
+    gen, core = _q3_parts()
+    sf = ShardedFusedQ3(mesh8, core, gen.chunk_fn(), CAP)
+    key = jax.random.PRNGKey(2)
+    sf.run_epoch(0, key, 8)
+    sf.flush()
+    payloads = sf.export_host()
+
+    solo = fused_source_q3_epoch(gen.chunk_fn(), core, CAP, donate=False)
+    st = core.init_state()
+    st, _, _ = solo(st, jnp.int64(0), key, 8)
+    key2 = jax.random.fold_in(jax.random.PRNGKey(2), 1)
+    st, want_out, want_packed = solo(st, jnp.int64(8 * CAP), key2, 8)
+
+    sf2 = ShardedFusedQ3(mesh8, core, gen.chunk_fn(), CAP)
+    sf2.import_host(payloads)
+    _assert_tree_equal(sf.stacked, sf2.stacked)
+    sf2.run_epoch(8 * CAP, key2, 8)
+    _assert_tree_equal(sf2.flush()[0], want_out)
+
+    states4 = reshard_q3_payloads(core, payloads, 4)
+    sf4 = ShardedFusedQ3(make_mesh(4), core, gen.chunk_fn(), CAP,
+                         states=states4)
+    sf4.run_epoch(8 * CAP, key2, 8)
+    _assert_tree_equal(sf4.flush()[0], want_out)
+
+
+# ---------------------------------------------------------------------------
+# K×S co-scheduled group: parity, dispatch count, checkpoint/re-shard
+# ---------------------------------------------------------------------------
+
+
+def _group_parts(table_capacity=1 << 12):
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(1_000_000, INT64)),
+        col(0, INT64),
+    ]
+    core = AggCore([INT64, INT64], [0, 1], [count_star()],
+                   table_capacity, CAP)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=CAP))
+    spec = FusedJobSpec("agg", ("ladder-test",), gen.chunk_fn(),
+                        tuple(exprs), core, CAP, seed=0)
+    return exprs, core, gen, spec
+
+
+def _merged(states):
+    out = {}
+    for st in states:
+        h = jax.device_get(st)
+        occ = np.asarray(h.table.occupied)
+        live = np.asarray(h.lanes[0]) > 0
+        kd = [np.asarray(x) for x in h.table.key_data]
+        km = [np.asarray(x) for x in h.table.key_mask]
+        lanes = [np.asarray(x) for x in h.lanes]
+        for s in np.nonzero(occ & live)[0]:
+            key = tuple(kd[c][s].item() if km[c][s] else None
+                        for c in range(len(kd)))
+            out[key] = tuple(l[s].item() for l in lanes)
+    return out
+
+
+def _rows(chunks, schema):
+    out = []
+    for c in chunks:
+        out.extend(chunk_to_rows(c, schema, with_ops=True, physical=True))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("n_jobs,n_shards", [(1, 8), (3, 4), (3, 8)])
+@pytest.mark.slow
+def test_sharded_group_bit_exact_vs_single_job_sharded(mesh8, n_jobs,
+                                                       n_shards):
+    """Every (job, shard) slice of the K×S group — per-group values AND
+    flush churn (U-/U+ retraction pairs across epochs) — equals what a
+    single-job ShardedFusedAgg produces for that job's seed/cursor,
+    which test_fused_sharded.py pins bit-exact against the solo fused
+    path: the composition inherits both anchors."""
+    exprs, core, gen, spec = _group_parts()
+    mesh = mesh8 if n_shards == N_DEV else make_mesh(n_shards)
+    group = ShardedCoGroup(mesh, spec)
+    for j in range(n_jobs):
+        group.add(f"mv{j}", seed=100 + j)
+    flush_schema = Schema((Field("ws", INT64), Field("auction", INT64),
+                           Field("cnt", INT64)))
+    flushes = []
+    for _ in range(2):
+        group.run_epoch(4)
+        flushes.append(group.flush())
+    for j in range(n_jobs):
+        sf = ShardedFusedAgg(mesh, core, gen.chunk_fn(), exprs, CAP)
+        for e in range(2):
+            key = jax.random.fold_in(jax.random.PRNGKey(100 + j), e)
+            sf.run_epoch(e * 4 * CAP, key, 4)
+            want_chunks = sf.flush()
+            assert _rows(flushes[e][f"mv{j}"], flush_schema) == \
+                _rows(want_chunks, flush_schema)
+        assert _merged(group.shard_states_of(f"mv{j}")) == \
+            sf.merged_group_values()
+
+
+def test_sharded_group_one_dispatch_independent_of_k_and_jobs():
+    """THE tentpole invariant: K jobs × S shards = exactly ONE dispatch
+    per epoch, for K ∈ {1, 4}, and per-epoch dispatch totals that move
+    with neither k nor K."""
+    with count_dispatches() as c:
+        exprs, core, gen, spec = _group_parts()
+        mesh = make_mesh(4)
+        group = ShardedCoGroup(mesh, spec)
+        group.add("mv0", seed=1)
+        group.run_epoch(4)
+        group.flush()
+        c.reset()
+        group.run_epoch(4)
+        assert c.counts[GROUP_EPOCH_FN] == 1
+        assert c.total == 1
+        group.flush()
+        n1 = sum(n for name, n in c.counts.items()
+                 if "gather" not in name)
+        for j in range(1, 4):
+            group.add(f"mv{j}", seed=1 + j)
+        group.run_epoch(4)       # recompile at the new [J]; warm
+        group.flush()
+        c.reset()
+        group.run_epoch(8)       # J and k both changed: still 1
+        assert c.counts[GROUP_EPOCH_FN] == 1
+        assert c.total == 1
+        group.flush()
+        n4 = sum(n for name, n in c.counts.items()
+                 if "gather" not in name)
+        assert n1 == n4
+
+
+def test_sharded_group_membership_change_between_epoch_and_flush():
+    """CREATE/DROP between run_epoch and the next flush: the job axis
+    changes shape mid-stream, so the retry flag from the previous epoch
+    must not survive the restack (regression: a stale [n, J_old] rovf
+    crashed the next probe's vmap)."""
+    exprs, core, gen, spec = _group_parts()
+    group = ShardedCoGroup(make_mesh(2), spec)
+    group.add("mv0", seed=1)
+    group.add("mv1", seed=2)
+    group.run_epoch(2)
+    group.add("mv2", seed=3)         # joins mid-stream, J: 2 -> 3
+    outs = group.flush()
+    assert set(outs) == {"mv0", "mv1", "mv2"}
+    group.run_epoch(2)
+    group.remove("mv1")              # leaves mid-stream, J: 3 -> 2
+    outs = group.flush()
+    assert set(outs) == {"mv0", "mv2"}
+    # the latecomer ticked once, the founders twice — cursors say so
+    assert group.batch_nos == [2, 1]
+
+
+@pytest.mark.slow
+def test_sharded_group_route_overflow_grows_and_stays_exact():
+    """Hot-key skew under a width-1 receive buffer: the group driver
+    grows + retries the WHOLE K×S epoch from the untouched pre-epoch
+    state and every member stays exact."""
+    exprs, core, gen, spec = _group_parts()
+    mesh = make_mesh(N_DEV)
+    group = ShardedCoGroup(mesh, spec, recv_width=1)
+    for j in range(2):
+        group.add(f"mv{j}", seed=50 + j)
+    group.run_epoch(8)
+    group.flush()
+    assert group.route_grows > 0 and group.recv_width > 1
+    solo = fused_source_agg_epoch(gen.chunk_fn(), exprs, core, CAP,
+                                  donate=False)
+    for j in range(2):
+        st = solo(core.init_state(), jnp.int64(0),
+                  jax.random.fold_in(jax.random.PRNGKey(50 + j), 0), 8)
+        host = jax.device_get(st)
+        want = _merged([host])
+        assert _merged(group.shard_states_of(f"mv{j}")) == want
+
+
+@pytest.mark.slow
+def test_sharded_group_checkpoint_cycle_and_reshard(mesh8):
+    """Each member job checkpoints through its OWN HashAggExecutor
+    persistence engine into its own state table; 'kill'; recover the
+    whole group TWICE — onto 8 shards and onto a 4-shard mesh — by
+    replaying the vnode mapping per job (load_shard_states). Both
+    continuations match the single-job sharded path exactly."""
+    from risingwave_tpu.connector import BID_SCHEMA
+    from risingwave_tpu.storage.state_store import MemoryStateStore
+    from risingwave_tpu.storage.state_table import StateTable
+    from risingwave_tpu.stream import HashAggExecutor, ProjectExecutor
+    from risingwave_tpu.stream.hash_agg import agg_state_schema
+    from risingwave_tpu.stream.source import MockSource
+
+    exprs, core, gen, spec = _group_parts()
+    n_jobs = 2
+    store = MemoryStateStore()
+    engines = {}
+    for j in range(n_jobs):
+        proj = ProjectExecutor(MockSource(BID_SCHEMA, []), exprs,
+                               names=("ws", "auction"))
+        st_table = StateTable(
+            store, 10 + j,
+            agg_state_schema([proj.schema[0], proj.schema[1]],
+                             core.agg_calls), [0, 1])
+        eng = HashAggExecutor(proj, [0, 1], list(core.agg_calls),
+                              state_table=None, table_capacity=1 << 12,
+                              out_capacity=CAP)
+        eng.state_table = st_table
+        engines[f"mv{j}"] = eng
+
+    group = ShardedCoGroup(mesh8, spec)
+    for j in range(n_jobs):
+        group.add(f"mv{j}", seed=100 + j)
+    group.run_epoch(8)
+    group.flush()
+    group.checkpoint(engines, epoch=2)
+    store.commit(2)
+    committed = {f"mv{j}": _merged(group.shard_states_of(f"mv{j}"))
+                 for j in range(n_jobs)}
+
+    # expected continuation per job: the single-job sharded driver
+    want = {}
+    for j in range(n_jobs):
+        sf = ShardedFusedAgg(mesh8, core, gen.chunk_fn(), exprs, CAP)
+        for e in range(2):
+            key = jax.random.fold_in(jax.random.PRNGKey(100 + j), e)
+            sf.run_epoch(e * 8 * CAP, key, 8)
+            sf.flush()
+        want[f"mv{j}"] = sf.merged_group_values()
+
+    for new_n in (8, 4):
+        mesh = mesh8 if new_n == N_DEV else make_mesh(new_n)
+        g2 = ShardedCoGroup(mesh, spec)
+        for j in range(n_jobs):
+            rows = list(engines[f"mv{j}"].state_table.scan_all())
+            states = load_shard_states(core, rows, new_n)
+            g2.add(f"mv{j}", shard_states=states, start=8 * CAP,
+                   seed=100 + j, batch_no=1)
+            assert _merged(g2.shard_states_of(f"mv{j}")) == \
+                committed[f"mv{j}"]
+        g2.run_epoch(8)
+        g2.flush()
+        for j in range(n_jobs):
+            assert _merged(g2.shard_states_of(f"mv{j}")) == want[f"mv{j}"]
+
+
+# ---------------------------------------------------------------------------
+# generic sharded-fused equi-join: epoch == per-chunk steps, 1 dispatch
+# ---------------------------------------------------------------------------
+
+
+def _join_parts(n_dev):
+    from risingwave_tpu.common.chunk import physical_chunk
+    from risingwave_tpu.ops.join_state import JoinType
+    from risingwave_tpu.parallel.sharded_join import ShardedHashJoin
+
+    ls = Schema((Field("k", INT64), Field("v", INT64)))
+    rs = Schema((Field("k", INT64), Field("w", INT64)))
+    join = ShardedHashJoin(make_mesh(n_dev), ls, rs, [0], [0],
+                           JoinType.INNER, key_capacity=1 << 8,
+                           bucket_width=8)
+
+    def batch(lo, side_schema):
+        return join.batch_chunks([
+            physical_chunk(side_schema,
+                           [(lo + 8 * s + r, lo + r) for r in range(8)],
+                           8)
+            for s in range(n_dev)])
+
+    return ls, rs, join, batch
+
+
+@pytest.mark.slow
+def test_equi_join_epoch_matches_per_chunk_steps():
+    """step_epoch(side, [c1, c2, c3]) — one dispatch — emits exactly
+    what three sequential step() calls emit, state included."""
+    ls, rs, join_a, batch_a = _join_parts(4)
+    _, _, join_b, batch_b = _join_parts(4)
+    join_a.step("right", batch_a(0, rs))
+    join_b.step("right", batch_b(0, rs))
+    outs_a = join_a.step_epoch(
+        "left", [batch_a(0, ls), batch_a(4, ls), batch_a(100, ls)])
+    outs_b = [join_b.step("left", batch_b(0, ls)),
+              join_b.step("left", batch_b(4, ls)),
+              join_b.step("left", batch_b(100, ls))]
+    rows_a = sorted(r for big in outs_a for r in join_a.collect_rows(big))
+    rows_b = sorted(r for big in outs_b for r in join_b.collect_rows(big))
+    assert rows_a == rows_b and rows_a
+    _assert_tree_equal(join_a.state, join_b.state)
+
+
+@pytest.mark.slow
+def test_equi_join_epoch_dispatch_count_and_grow_retry():
+    """k chunks = ONE dispatch regardless of k; a lane overflow grows
+    geometry and replays the whole batch exactly. Slow-marked per the
+    tier-1 wall budget (several shard_map compiles); bench --smoke
+    keeps a tier-2 1-dispatch assert on this surface too."""
+    with count_dispatches() as c:
+        ls, rs, join, batch = _join_parts(4)
+        join.step_epoch("right", [batch(0, rs)])
+        c.reset()
+        join.step_epoch("left", [batch(0, ls), batch(4, ls)])
+        assert c.counts[EQUI_EPOCH_FN] == 1
+        c.reset()
+        join.step_epoch("left", [batch(8, ls), batch(12, ls),
+                                 batch(16, ls), batch(20, ls)])
+        assert c.counts[EQUI_EPOCH_FN] == 1
+
+    # grow-retry: a build side wider than the bucket width must grow
+    # and still join exactly (hot single key on every row)
+    from risingwave_tpu.common.chunk import physical_chunk
+    from risingwave_tpu.ops.join_state import JoinType
+    from risingwave_tpu.parallel.sharded_join import ShardedHashJoin
+    join2 = ShardedHashJoin(make_mesh(2), ls, rs, [0], [0],
+                            JoinType.INNER, key_capacity=1 << 4,
+                            bucket_width=2)
+    W0 = join2.core.W
+    hot = join2.batch_chunks([
+        physical_chunk(rs, [(7, 8 * s + r) for r in range(8)], 8)
+        for s in range(2)])
+    join2.step_epoch("right", [hot])
+    assert join2.core.W > W0      # geometry grew, batch replayed
+    probe = join2.batch_chunks([
+        physical_chunk(ls, [(7, 1)], 1) for _ in range(2)])
+    out = join2.step_epoch("left", [probe])[0]
+    rows = join2.collect_rows(out)
+    # both shards' build chunks carried the hot key → 16 resident build
+    # rows, probed once per source shard
+    assert len(rows) == 32
+
+
+# ---------------------------------------------------------------------------
+# Session integration: K signature-equal MVs share ONE K×S group
+# ---------------------------------------------------------------------------
+
+SRC_SQL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT,
+    price BIGINT, channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+    extra VARCHAR) WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+MV_SQL = ("CREATE MATERIALIZED VIEW {n} AS SELECT auction, count(*) AS c "
+          "FROM bid GROUP BY auction")
+
+
+def _session(tmp_path=None, mesh_n=0, coschedule=True, **kw):
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend.build import BuildConfig
+    return Session(
+        config=BuildConfig(coschedule=coschedule,
+                           mesh=make_mesh(mesh_n) if mesh_n else None,
+                           agg_table_capacity=1 << 12),
+        source_chunk_capacity=CAP,
+        data_dir=str(tmp_path) if tmp_path else None, **kw)
+
+
+@pytest.mark.slow
+def test_session_two_mvs_share_one_group(tmp_path):
+    """Two signature-equal MVs on a mesh session land in the SAME K×S
+    group (one dispatch per tick for both), their contents match the
+    mesh-less co-scheduled session's, the live per_epoch invariant
+    reads 1.0, and recovery re-shards the whole group onto a smaller
+    mesh with both MVs resuming deterministically."""
+    from risingwave_tpu.common.profiling import GLOBAL_PROFILER
+    GLOBAL_PROFILER.reset()     # per_epoch reads the process-global
+    s = _session(tmp_path, mesh_n=8, checkpoint_frequency=2)
+    s.run_sql(SRC_SQL)
+    s.run_sql(MV_SQL.format(n="m0"))
+    s.run_sql(MV_SQL.format(n="m1"))
+    m = s.metrics()["shardfused"]
+    assert m["m0"]["group_jobs"] == 2 and m["m1"]["group_jobs"] == 2
+    assert m["m0"]["shards"] == 8
+    for _ in range(3):
+        s.tick()
+    got0 = sorted(s.run_sql("SELECT auction, c FROM m0"))
+    got1 = sorted(s.run_sql("SELECT auction, c FROM m1"))
+    md = s.metrics()["dispatch"]
+    qn = GROUP_EPOCH_FN
+    assert md["per_epoch"][qn] == 1.0, md["per_epoch"]
+    s.close()
+
+    c = _session(mesh_n=0)
+    try:
+        c.run_sql(SRC_SQL)
+        c.run_sql(MV_SQL.format(n="m0"))
+        for _ in range(3):
+            c.tick()
+        want = sorted(c.run_sql("SELECT auction, c FROM m0"))
+    finally:
+        c.close()
+    # same seed + same device stream per job: both MVs equal the
+    # co-scheduled session's MV exactly
+    assert got0 == want and got1 == want and len(want) > 10
+
+    # reopen on a SMALLER mesh: the whole 2-job group re-shards
+    s2 = _session(tmp_path, mesh_n=4, checkpoint_frequency=2)
+    try:
+        m2 = s2.metrics()["shardfused"]
+        assert m2["m0"]["shards"] == 4 and m2["m0"]["group_jobs"] == 2
+        assert sorted(s2.run_sql("SELECT auction, c FROM m0")) == got0
+        assert sorted(s2.run_sql("SELECT auction, c FROM m1")) == got1
+        base = sum(v for _, v in got0)
+        for _ in range(2):
+            s2.tick()
+        assert s2.run_sql("SELECT sum(c) FROM m0") == \
+            [(base + 2 * CAP,)]
+    finally:
+        s2.close()
+
+
+@pytest.mark.slow
+def test_session_drop_one_group_member_keeps_the_other():
+    """DROP of one group member keeps the survivor ticking (job-axis
+    restack), and the dropped job's epochs retire into the dispatch
+    per_epoch ratio instead of skewing it."""
+    from risingwave_tpu.common.profiling import GLOBAL_PROFILER
+    GLOBAL_PROFILER.reset()     # per_epoch reads the process-global
+    s = _session(mesh_n=4)
+    try:
+        s.run_sql(SRC_SQL)
+        s.run_sql(MV_SQL.format(n="m0"))
+        s.run_sql(MV_SQL.format(n="m1"))
+        s.tick()
+        s.run_sql("DROP MATERIALIZED VIEW m1")
+        m = s.metrics()["shardfused"]
+        assert set(m) == {"m0"} and m["m0"]["group_jobs"] == 1
+        s.tick()
+        s.tick()
+        assert s.metrics()["shardfused"]["m0"]["epochs_run"] >= 3
+        md = s.metrics()["dispatch"]
+        assert md["per_epoch"][GROUP_EPOCH_FN] == 1.0
+    finally:
+        s.close()

@@ -179,3 +179,117 @@ def test_sharded_fused_epoch_lowers_for_tpu(shape):
                              jax.random.PRNGKey(0), 4)
     assert "stablehlo" in text and ("while" in text or "scan" in text)
     assert "all-to-all" in text or "all_to_all" in text
+
+
+@pytest.mark.parametrize("shape", ["session", "q3"])
+def test_sharded_q8_q3_epochs_lower_for_tpu(shape):
+    """The two NEW shard_map epochs (PR 13: sharded q8 session windows
+    and sharded TPC-H q3 with its in-dispatch global top-n) lower for
+    platform "tpu" chip-free, with the in-dispatch all_to_all visible
+    in the StableHLO — same CI contract as the q5/q7 sharded surfaces."""
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.connector.tpch import (
+        DeviceQ3Generator, Q3_CUTOFF_DAYS, TpchQ3Config,
+    )
+    from risingwave_tpu.expr import col
+    from risingwave_tpu.ops.fused_multi import stack_states
+    from risingwave_tpu.ops.fused_sharded import SHARDED_EPOCH_BUILDERS
+    from risingwave_tpu.ops.session_window import SessionWindowCore
+    from risingwave_tpu.ops.stream_q3 import Q3Core
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    n = 4
+    mesh = make_mesh(n)
+    if shape == "session":
+        core = SessionWindowCore(
+            Schema((Field("bidder", INT64), Field("ts", TIMESTAMP))),
+            key_col=0, ts_col=1, gap_us=5_000,
+            capacity=1 << 10, closed_capacity=1 << 10)
+        gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=256))
+        fused = SHARDED_EPOCH_BUILDERS["source_session"](
+            gen.chunk_fn(), [col(1, INT64), col(5, TIMESTAMP)], core,
+            256, mesh)
+        args = (jnp.int64(0), jax.random.PRNGKey(0), 4, jnp.int64(0))
+    else:
+        core = Q3Core(Q3_CUTOFF_DAYS, orders_capacity=1 << 10,
+                      agg_capacity=1 << 10)
+        gen = DeviceQ3Generator(TpchQ3Config(chunk_capacity=256))
+        fused = SHARDED_EPOCH_BUILDERS["source_q3"](
+            gen.chunk_fn(), core, 256, mesh)
+        args = (jnp.int64(0), jax.random.PRNGKey(0), 4)
+    stacked = stack_states([core.init_state() for _ in range(n)])
+    text = _lower_tpu_jitted(fused, stacked, *args)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+    assert "all-to-all" in text or "all_to_all" in text
+    if shape == "q3":
+        # the global top-n flush all_gathers the candidate union
+        assert "all-gather" in text or "all_gather" in text
+
+
+def test_sharded_group_epoch_lowers_for_tpu():
+    """The K×S co-scheduled group epoch (fusion surface 6:
+    vmap-over-jobs inside shard_map with the hand-batched group
+    all_to_all) lowers for the chip — the tentpole surface compiles
+    even while the tunnel is down."""
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.connector import NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.ops.fused_multi import stack_states
+    from risingwave_tpu.ops.fused_sharded import SHARDED_EPOCH_BUILDERS
+    from risingwave_tpu.ops.grouped_agg import AggCore
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+
+    n, jobs = 4, 8
+    mesh = make_mesh(n)
+    exprs = [call("tumble_start", col(5, TIMESTAMP),
+                  Literal(1_000_000, INT64)), col(0, INT64)]
+    core = AggCore([INT64, INT64], [0, 1], [count_star()], 1 << 10, 128)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=256))
+    fused = SHARDED_EPOCH_BUILDERS["group_agg"](
+        gen.chunk_fn(), exprs, core, 256, mesh)
+    per_job = [stack_states([core.init_state() for _ in range(n)])
+               for _ in range(jobs)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=1), *per_job)
+    starts = jnp.zeros(jobs, jnp.int64)
+    keys = jnp.stack([jax.random.PRNGKey(j) for j in range(jobs)])
+    nos = jnp.zeros(jobs, jnp.int64)
+    text = _lower_tpu_jitted(fused, stacked, starts, keys, nos, 4)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+    assert "all-to-all" in text or "all_to_all" in text
+
+
+def test_sharded_equi_join_epoch_lowers_for_tpu():
+    """The generic sharded-fused equi-join epoch (JoinCore under
+    shard_map, k chunks per dispatch) lowers for platform "tpu"
+    chip-free with the all_to_all routing visible."""
+    from risingwave_tpu.common import INT64
+    from risingwave_tpu.common.types import Field, Schema
+    from risingwave_tpu.ops.fused_multi import stack_states
+    from risingwave_tpu.ops.fused_sharded import SHARDED_EPOCH_BUILDERS
+    from risingwave_tpu.ops.join_state import JoinCore, JoinType
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+    from risingwave_tpu.common.chunk import Column, StreamChunk
+
+    n, k, cap = 4, 3, 64
+    mesh = make_mesh(n)
+    ls = Schema((Field("k", INT64), Field("v", INT64)))
+    rs = Schema((Field("k", INT64), Field("w", INT64)))
+    core = JoinCore(ls, rs, [0], [0], JoinType.INNER,
+                    key_capacity=1 << 8, bucket_width=8)
+    fused = SHARDED_EPOCH_BUILDERS["equi_join"](core, mesh, [0], [0])
+    stacked = stack_states([core.init_state() for _ in range(n)])
+    cols = tuple(Column(jnp.zeros((n, k, cap), jnp.int64),
+                        jnp.zeros((n, k, cap), jnp.bool_))
+                 for _ in range(2))
+    batch = StreamChunk(jnp.zeros((n, k, cap), jnp.int8),
+                        jnp.zeros((n, k, cap), jnp.bool_), cols)
+    text = fused.trace(stacked, batch, side="left").lower(
+        lowering_platforms=("tpu",)).as_text()
+    assert "stablehlo" in text and ("while" in text or "scan" in text)
+    assert "all-to-all" in text or "all_to_all" in text
