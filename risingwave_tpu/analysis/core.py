@@ -305,7 +305,9 @@ def register(cls):
 
 def all_rules() -> List[Rule]:
     # import the rule modules for their registration side effect
-    from . import rules_boundary, rules_purity, rules_state  # noqa: F401
+    from . import (  # noqa: F401
+        rules_boundary, rules_purity, rules_state, rules_tick,
+    )
     return [RULES[k] for k in sorted(RULES)]
 
 

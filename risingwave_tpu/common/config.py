@@ -56,6 +56,20 @@ class StreamingConfig:
     # Refuses loudly (MeshUnavailableError) when the process has fewer
     # devices. 0/None = single-chip.
     mesh_shape: Optional[int] = None
+    # asynchronous epoch pipeline (docs/performance.md "Pipelined
+    # tick"): 1 = the classic synchronous cycle (every fused flush
+    # resolves in its own tick); 2 = double-buffered epochs — each
+    # epoch's packed flush fetch defers across the tick boundary (the
+    # copy streams while the previous barrier's host work runs, so
+    # resolving it next tick is nearly free) and epoch N+1's dispatch
+    # launches before epoch N's flush chunks are decoded/materialized,
+    # so that host work + the checkpoint encode overlap device
+    # compute. State threads on-device, so results are
+    # bit-exact; reads simply see the previous barrier's snapshot
+    # between drain points (checkpoint barriers, FLUSH, DDL). Applies
+    # to the fused surfaces (coschedule/shardfused) and moves the
+    # durable checkpoint encode off the barrier path.
+    pipeline_depth: int = 1
     # LEGACY aliases of [observability] trace_ring_capacity /
     # slow_epoch_threshold_ms (kept so existing configs keep working;
     # an explicitly-set [observability] value wins — see

@@ -57,10 +57,25 @@ from .tracing import CAT_DISPATCH, GLOBAL_TRACE, Span
 
 class DispatchRecord:
     """Telemetry for one dispatch qualname (mutated lock-free on the
-    hot path — single attribute bumps under the GIL)."""
+    hot path — single attribute bumps under the GIL).
+
+    Two clocks per dispatch (profiler honesty under async dispatch):
+    ``total_s``/``last_s``/``max_s`` time the ENQUEUE call — on an
+    asynchronous backend (TPU always; the CPU stand-in's thread pool
+    mostly) that is dispatch-submission latency and reads near-zero
+    under pipelining. ``complete_s`` is the enqueue→host-visible wall
+    time, resolved when a ``common/fetch.py`` future over the
+    dispatch's outputs lands — an upper bound on device latency that
+    includes any host think-time the pipeline deliberately overlapped.
+    """
 
     __slots__ = ("name", "calls", "total_s", "last_s", "max_s",
-                 "compiles", "compile_s")
+                 "compiles", "compile_s", "complete_calls", "complete_s",
+                 "complete_last_s", "inflight")
+
+    #: enqueue timestamps awaiting a completion callback; bounded so
+    #: dispatches whose outputs are never fetched cannot grow it
+    INFLIGHT_CAP = 8
 
     def __init__(self, name: str):
         self.name = name
@@ -70,16 +85,27 @@ class DispatchRecord:
         self.max_s = 0.0
         self.compiles = 0
         self.compile_s = 0.0
+        self.complete_calls = 0
+        self.complete_s = 0.0
+        self.complete_last_s = 0.0
+        self.inflight: list = []
 
     def to_dict(self) -> dict:
-        return {"calls": self.calls,
-                "total_s": round(self.total_s, 6),
-                "last_ms": round(self.last_s * 1e3, 4),
-                "max_ms": round(self.max_s * 1e3, 4),
-                "mean_ms": round(self.total_s / self.calls * 1e3, 4)
-                if self.calls else 0.0,
-                "compiles": self.compiles,
-                "compile_s": round(self.compile_s, 4)}
+        d = {"calls": self.calls,
+             "total_s": round(self.total_s, 6),
+             "last_ms": round(self.last_s * 1e3, 4),
+             "max_ms": round(self.max_s * 1e3, 4),
+             "mean_ms": round(self.total_s / self.calls * 1e3, 4)
+             if self.calls else 0.0,
+             "compiles": self.compiles,
+             "compile_s": round(self.compile_s, 4)}
+        if self.complete_calls:
+            d["complete_calls"] = self.complete_calls
+            d["complete_s"] = round(self.complete_s, 6)
+            d["complete_last_ms"] = round(self.complete_last_s * 1e3, 4)
+            d["complete_mean_ms"] = round(
+                self.complete_s / self.complete_calls * 1e3, 4)
+        return d
 
 
 def _aval(x: Any) -> Any:
@@ -119,6 +145,12 @@ class DispatchProfiler:
         self._lowerable: dict[str, tuple] = {}
         self._analyses: dict[str, dict] = {}
         self._lock = threading.Lock()
+        #: async-pipeline occupancy: completions observed via
+        #: note_complete, and the max number of enqueued-but-unresolved
+        #: dispatches of one qualname seen at a resolve (a depth-2
+        #: pipeline reads 2 here while the synchronous path reads 1)
+        self.completions = 0
+        self.max_inflight = 0
 
     # -- hot path --------------------------------------------------------------
 
@@ -151,6 +183,10 @@ class DispatchProfiler:
             out = jitted(*args, **kwargs)
             dt = time.perf_counter() - t0
             rec.calls += 1
+            # enqueue timestamp for completion latency (resolved when a
+            # fetch future over this dispatch's outputs lands)
+            if len(rec.inflight) < DispatchRecord.INFLIGHT_CAP:
+                rec.inflight.append(t0)
             rec.total_s += dt
             rec.last_s = dt
             if dt > rec.max_s:
@@ -180,6 +216,31 @@ class DispatchProfiler:
             if rec is None:
                 rec = self._records[name] = DispatchRecord(name)
             return rec
+
+    def note_complete(self, name: str) -> None:
+        """A fetch future over ``name``'s outputs just resolved: record
+        enqueue→host-visible latency against the OLDEST outstanding
+        enqueue (FIFO matches the per-qualname dispatch order) and the
+        pipeline occupancy at resolve time (common/fetch.py calls this;
+        attribute bumps only, safe under the GIL)."""
+        if not self.enabled:
+            return
+        rec = self._records.get(name)
+        if rec is None or not rec.inflight:
+            return
+        depth = len(rec.inflight)
+        dt = time.perf_counter() - rec.inflight.pop(0)
+        rec.complete_calls += 1
+        rec.complete_s += dt
+        rec.complete_last_s = dt
+        self.completions += 1
+        if depth > self.max_inflight:
+            self.max_inflight = depth
+
+    def pipeline_stats(self) -> dict:
+        """Occupancy snapshot for the async epoch pipeline."""
+        return {"completions": self.completions,
+                "max_inflight": self.max_inflight}
 
     def _remember_aval(self, name, jitted, args, kwargs) -> None:
         """Snapshot abstract arg shapes for later AOT analysis. No
@@ -262,6 +323,8 @@ class DispatchProfiler:
             self._records.clear()
             self._lowerable.clear()
             self._analyses.clear()
+            self.completions = 0
+            self.max_inflight = 0
 
 
 #: the process-global registry every profiled dispatch site records to

@@ -49,6 +49,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>scaling</h2><pre id="scaling">loading…</pre>
 <h2>chaos / fault plane</h2><pre id="chaos">loading…</pre>
 <h2>profiling</h2><pre id="profiling">loading…</pre>
+<h2>pipeline</h2><pre id="pipeline">loading…</pre>
 <h2>await tree</h2><pre id="await_tree">loading…</pre>
 <h2>slow epochs</h2><pre id="slow_epochs">loading…</pre>
 <h2>storage tier</h2><pre id="storage">loading…</pre>
@@ -74,6 +75,8 @@ async function loadStorage() {
     JSON.stringify(m.chaos || {}, null, 2);
   document.getElementById("profiling").textContent =
     JSON.stringify(m.profiling || {}, null, 2);
+  document.getElementById("pipeline").textContent =
+    JSON.stringify(m.pipeline || {}, null, 2);
   document.getElementById("metrics").textContent =
     JSON.stringify(m, null, 2);
 }

@@ -191,10 +191,12 @@ def render_metrics(session) -> str:
         for src in sources:
             for qn, rec in src.items():
                 agg = merged.setdefault(
-                    qn, {"calls": 0, "total_s": 0.0, "compiles": 0})
+                    qn, {"calls": 0, "total_s": 0.0, "compiles": 0,
+                         "complete_s": 0.0})
                 agg["calls"] += rec.get("calls", 0)
                 agg["total_s"] += rec.get("total_s", 0.0)
                 agg["compiles"] += rec.get("compiles", 0)
+                agg["complete_s"] += rec.get("complete_s", 0.0)
         lines += ["# HELP rw_dispatch_total Jitted-epoch dispatches "
                   "per qualname (common/profiling.py), session plus "
                   "every worker process.",
@@ -217,6 +219,18 @@ def render_metrics(session) -> str:
             lines.append(
                 f'rw_compile_total{{qualname="{_sanitize(qn)}"}} '
                 f'{rec["compiles"]}')
+        lines += ["# HELP rw_dispatch_complete_seconds Cumulative "
+                  "enqueue-to-host-visible completion seconds per "
+                  "qualname, resolved when a fetch future over the "
+                  "dispatch's outputs lands (profiler honesty under "
+                  "async dispatch — enqueue wall time reads near-zero "
+                  "while pipelining).",
+                  "# TYPE rw_dispatch_complete_seconds counter"]
+        for qn, rec in sorted(merged.items()):
+            lines.append(
+                f'rw_dispatch_complete_seconds'
+                f'{{qualname="{_sanitize(qn)}"}} '
+                f'{round(rec["complete_s"], 6)}')
         hbm = profiling.get("hbm") or {}
         if hbm:
             lines += ["# HELP rw_hbm_bytes Per-job/per-executor resident "
@@ -237,6 +251,22 @@ def render_metrics(session) -> str:
                       "# TYPE rw_hbm_headroom_bytes gauge",
                       f'rw_hbm_headroom_bytes '
                       f'{hbm.get("headroom_bytes", 0)}']
+    pipe = m.get("pipeline") or {}
+    if pipe:
+        lines += ["# HELP rw_pipeline_depth Configured asynchronous "
+                  "epoch pipeline depth ([streaming] pipeline_depth; "
+                  "1 = synchronous ticks).",
+                  "# TYPE rw_pipeline_depth gauge",
+                  f"rw_pipeline_depth {pipe.get('depth', 1)}",
+                  "# HELP rw_pipeline_stat Async epoch pipeline "
+                  "counters: flushes deferred across ticks, explicit "
+                  "drains, fetch completions, max in-flight dispatch "
+                  "occupancy, and currently pending flushes.",
+                  "# TYPE rw_pipeline_stat gauge"]
+        for k in ("pending_flushes", "deferred_flushes", "drains",
+                  "completions", "max_inflight"):
+            lines.append(f'rw_pipeline_stat{{stat="{k}"}} '
+                         f'{pipe.get(k, 0)}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

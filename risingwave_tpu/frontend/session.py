@@ -202,7 +202,8 @@ class Session:
                  compactors: int = 0,
                  rw_config=None,
                  fault_config=None,
-                 autoscaler_config=None):
+                 autoscaler_config=None,
+                 pipeline_depth: int = 1):
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
         # style). Reference: load_config + SystemParams (config.rs:128).
@@ -227,6 +228,7 @@ class Session:
             checkpoint_frequency = st.checkpoint_frequency
             in_flight_barriers = st.in_flight_barrier_nums
             source_chunk_capacity = st.chunk_capacity
+            pipeline_depth = st.pipeline_depth
             data_dir = rw_config.storage.data_dir or data_dir
             if state_store is None:
                 state_store = rw_config.storage.state_store
@@ -400,6 +402,15 @@ class Session:
         self._cosched = CoScheduler()
         self._cosched_engines: dict[str, tuple] = {}
         self._cosched_markers: set[str] = set()
+        # asynchronous epoch pipeline ([streaming] pipeline_depth,
+        # docs/performance.md "Pipelined tick"): depth >= 2 defers each
+        # fused group's packed flush fetch to the NEXT tick, so epoch
+        # N+1's dispatch launches while epoch N's stats stream back and
+        # the host decodes/materializes — drained at checkpoint
+        # barriers, FLUSH, DDL and recovery, so committed state is
+        # bit-exact vs the synchronous path
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._pipeline_stats = {"deferred_flushes": 0, "drains": 0}
         # mesh-sharded fused MVs (ops/fused_sharded.py): with a mesh AND
         # the coschedule opt-in, eligible MVs join a signature-keyed
         # K-jobs × S-shards group (parallel/fused.ShardedCoGroup) — a
@@ -1110,6 +1121,9 @@ class Session:
         from ..stream.project import ProjectExecutor
         from ..stream.source import MockSource
 
+        # group membership changes restack the job axis: resolve any
+        # deferred flush first (pipeline_depth >= 2)
+        self._drain_fused_pipeline()
         id0 = self.catalog._next_table_id
         proj = ProjectExecutor(MockSource(m.source.schema, []),
                                list(m.exprs), names=m.proj_names)
@@ -1186,29 +1200,64 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    def _push_cosched_outs(self, outs: dict) -> None:
+        """Feed a resolved group flush into each member MV's
+        Materialize queue (they ride the next barrier)."""
+        for name, chunks in outs.items():
+            q = self._cosched_engines[name][1]
+            for ch in chunks:
+                q.push(ch)
+
     def _cosched_tick(self, epoch: int, checkpoint: bool,
                       generate: bool) -> None:
         """Per-tick driver: ONE fused dispatch per group covers every
         member MV's epoch; the group flush feeds each job's Materialize
         queue; checkpoint barriers reuse the HashAggExecutor's own
-        state-table delta flush, then restack once."""
+        state-table delta flush, then restack once.
+
+        Pipelined cadence (docs/performance.md "Pipelined tick"): the
+        LAST tick's deferred flushes resolve first (their packed fetch
+        has been streaming while the host ran the previous barrier, and
+        their chunks ride THIS barrier), then EVERY group's next epoch
+        is enqueued before any flush decode — the device queue stays
+        full while Python gathers. With ``pipeline_depth >= 2`` the new
+        flush stays pending into the next tick; checkpoint barriers
+        (and generate-off ticks) resolve it synchronously, so committed
+        state is bit-exact vs the synchronous path."""
         k = self.chunks_per_tick
-        for group in list(self._cosched.groups.values()):
-            if generate and k > 0:
+        groups = list(self._cosched.groups.values())
+        # 1. resolve last tick's deferred flushes (pipeline_depth >= 2)
+        for group in groups:
+            if group.pending is not None:
+                self._push_cosched_outs(group.finish_flush())
+        # 2. enqueue every group's epoch (cross-engine overlap)
+        ran = generate and k > 0
+        if ran:
+            for group in groups:
                 group.run_epoch(k)
-            outs = group.flush()
-            ckpt_states = []
-            for j, name in enumerate(group.names):
-                agg, q, cursor = self._cosched_engines[name]
-                cursor.events = group.starts[j]
-                cursor.epochs = group.batch_nos[j]
-                for ch in outs[name]:
-                    q.push(ch)
-                if checkpoint:
+                for j, name in enumerate(group.names):
+                    cursor = self._cosched_engines[name][2]
+                    cursor.events = group.starts[j]
+                    cursor.epochs = group.batch_nos[j]
+        # 3. enqueue every group's probe + start its packed fetch BEFORE
+        #    decoding any of them
+        for group in groups:
+            group.begin_flush()
+        if self.pipeline_depth >= 2 and ran and not checkpoint:
+            # 4a. defer resolution to the next tick / drain point: epoch
+            # N+1 will dispatch before this packed fetch resolves
+            self._pipeline_stats["deferred_flushes"] += len(groups)
+            return
+        # 4b. synchronous resolution (depth 1, checkpoint, or idle tick)
+        for group in groups:
+            self._push_cosched_outs(group.finish_flush())
+            if checkpoint:
+                ckpt_states = []
+                for name in group.names:
+                    agg = self._cosched_engines[name][0]
                     agg.state = group.state_of(name)
                     agg._checkpoint_to_state_table(epoch)
                     ckpt_states.append(agg.state)
-            if checkpoint:
                 group.set_states(ckpt_states)
 
     # ------------------------------------------- mesh-sharded fused MV jobs --
@@ -1258,6 +1307,9 @@ class Session:
         from ..stream.project import ProjectExecutor
         from ..stream.source import MockSource
 
+        # group membership changes restack the job axis: resolve any
+        # deferred flush first (pipeline_depth >= 2)
+        self._drain_fused_pipeline()
         id0 = self.catalog._next_table_id
         proj = ProjectExecutor(MockSource(m.source.schema, []),
                                list(m.exprs), names=m.proj_names)
@@ -1342,28 +1394,64 @@ class Session:
         self._await(job.wait_barrier(self.epoch))
         return []
 
+    def _push_shardfused_outs(self, outs: dict) -> None:
+        for name, chunks in outs.items():
+            q = self._shardfused_engines[name][1]
+            for ch in chunks:
+                q.push(ch)
+
     def _shardfused_tick(self, epoch: int, checkpoint: bool,
                          generate: bool) -> None:
         """Per-tick driver: ONE dispatch per K×S group covers every
         member MV's whole epoch across all chips; the group flush (one
         packed [n, J, 3] fetch) feeds each job's Materialize queue;
         checkpoint barriers write every (job, shard) delta through each
-        job's own state-table flush, then restack once per group."""
+        job's own state-table flush, then restack once per group.
+        Pipelined cadence exactly as ``_cosched_tick``; the sharded
+        grow-retry drains inside ``finish_flush`` before anything else
+        dispatches, and sharded epochs never donate, so the deferred
+        handle's pre-finish state stays valid for the gathers."""
         k = self.chunks_per_tick
-        for group in list(self._shardfused.groups.values()):
-            if generate and k > 0:
+        groups = list(self._shardfused.groups.values())
+        for group in groups:
+            if group.pending is not None:
+                self._push_shardfused_outs(group.finish_flush())
+        ran = generate and k > 0
+        if ran:
+            for group in groups:
                 group.run_epoch(k)
-            outs = group.flush()
-            for j, name in enumerate(group.names):
-                _agg, q, cursor, _g = self._shardfused_engines[name]
-                cursor.events = group.starts[j]
-                cursor.epochs = group.batch_nos[j]
-                for ch in outs[name]:
-                    q.push(ch)
+                for j, name in enumerate(group.names):
+                    cursor = self._shardfused_engines[name][2]
+                    cursor.events = group.starts[j]
+                    cursor.epochs = group.batch_nos[j]
+        for group in groups:
+            group.begin_flush()
+        if self.pipeline_depth >= 2 and ran and not checkpoint:
+            self._pipeline_stats["deferred_flushes"] += len(groups)
+            return
+        for group in groups:
+            self._push_shardfused_outs(group.finish_flush())
             if checkpoint:
                 group.checkpoint(
                     {name: self._shardfused_engines[name][0]
                      for name in group.names}, epoch)
+
+    def _drain_fused_pipeline(self) -> None:
+        """Resolve every deferred fused flush and feed its chunks to the
+        job queues (they ride the next barrier). The pipeline's drain
+        points — DDL, DROP, scoped recovery, checkpoint ticks — call
+        this so membership changes and durable cuts never race an
+        in-flight packed fetch. No-op when nothing is pending (always,
+        at pipeline_depth = 1)."""
+        for group in list(self._cosched.groups.values()):
+            if group.pending is not None:
+                self._push_cosched_outs(group.finish_flush())
+                self._pipeline_stats["drains"] += 1
+        if self._shardfused is not None:
+            for group in list(self._shardfused.groups.values()):
+                if group.pending is not None:
+                    self._push_shardfused_outs(group.finish_flush())
+                    self._pipeline_stats["drains"] += 1
 
     # ------------------------------------------------------ remote MV jobs --
 
@@ -2423,6 +2511,7 @@ class Session:
         # drain pipelined epochs first: the rebuilt jobs will only see
         # barriers from the NEXT injection on, so nothing may stay in
         # flight across the rebuild (dead jobs are tolerated by collect)
+        self._drain_fused_pipeline()
         self._drain_inflight()
         subtree = [name] + self._downstream_names(job)
         non_mv = [n for n in subtree if n not in self.catalog.mvs]
@@ -2614,6 +2703,9 @@ class Session:
                         if ix.table == stmt.name]:
             self._drop(dataclasses.replace(
                 stmt, kind="index", name=ix_name, if_exists=True))
+        # a deferred fused flush must resolve BEFORE membership changes
+        # restack the job axis (and before its chunks would be lost)
+        self._drain_fused_pipeline()
         self._drain_inflight()
         # free the object's durable state (tombstoned in the manifest so
         # recovery and compaction skip it)
@@ -3149,8 +3241,20 @@ class Session:
                     feed.state_table.insert(
                         (VARCHAR.to_physical(sid), int(off)))
                 feed.state_table.commit(e)
-        self.store.commit(e)
+        if self.pipeline_depth >= 2:
+            # off-critical-path checkpoint encode: the committed-delta
+            # serialization + segment write runs on a worker thread and
+            # overlaps the next epoch's device compute; it is JOINED
+            # before any 2PC phase-2 frame below (and on FLUSH/close),
+            # so exactly-once semantics are untouched
+            self.store.commit_async(e)
+        else:
+            self.store.commit(e)
         if self.workers:
+            # the session tier must be durable before phase 2: a worker
+            # committing ahead of a crashed session write would fork
+            # history against the recovery rebuild
+            self.store.join_commits()
             # phase 2 of the cluster checkpoint: workers sealed and
             # acked; only now may their staged epochs become durable
             # (a worker killed before this frame recovers one
@@ -3282,9 +3386,13 @@ class Session:
 
     @_locked
     def flush(self) -> None:
-        """FLUSH: complete a checkpoint epoch (DML + state made durable)."""
+        """FLUSH: complete a checkpoint epoch (DML + state made durable).
+        Joins any deferred checkpoint encode — FLUSH is the durability
+        promise, so it may not return while an async commit is in
+        flight."""
         self.tick(generate=False, checkpoint=True)
         self._drain_inflight()
+        self.store.join_commits()
 
     # ----------------------------------------------------------- mutations --
 
@@ -3636,6 +3744,11 @@ class Session:
             # serving plane (frontend/serving.py): plan-cache hit/miss,
             # two-phase task counts, partials merged, read latency p50/p99
             "serving": self._serving.metrics(),
+            # asynchronous epoch pipeline ([streaming] pipeline_depth):
+            # configured depth, deferred-flush/drain counters, how many
+            # group flushes are pending right now, and the profiler's
+            # completion/occupancy stats (common/profiling.py)
+            "pipeline": self._pipeline_metrics(),
             # per-site retry counters from every boundary (object store,
             # broker, sink delivery) — common/retry.py global registry
             "retry": _retry_snapshot(),
@@ -3757,6 +3870,20 @@ class Session:
         out["dispatch"] = dispatch
         return out
 
+    def _pipeline_metrics(self) -> dict:
+        from ..common.profiling import GLOBAL_PROFILER
+        pending = sum(1 for g in self._cosched.groups.values()
+                      if g.pending is not None)
+        if self._shardfused is not None:
+            pending += sum(1 for g in self._shardfused.groups.values()
+                           if g.pending is not None)
+        return {
+            "depth": self.pipeline_depth,
+            "pending_flushes": pending,
+            **self._pipeline_stats,
+            **GLOBAL_PROFILER.pipeline_stats(),
+        }
+
     def _storage_metrics(self) -> dict:
         """Storage-tier counters for metrics()/Prometheus/dashboard:
         version id, level shape, compaction + vacuum progress (reference:
@@ -3872,6 +3999,7 @@ class Session:
             return
         self._serving.shutdown()      # stop the batch-task pool first
         self._drain_inflight()
+        self.store.join_commits()     # deferred checkpoint encode lands
         for job in list(self.jobs.values()):
             sink = getattr(job.pipeline, "sink", None)
             if sink is not None:

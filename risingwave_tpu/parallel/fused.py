@@ -42,10 +42,11 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common.chunk import Column, flatten_shards, gather_units_window
+from ..common.fetch import PendingFlush, async_fetch, fetch
 from ..common.hashing import (
     shard_rows, vnode_of, vnode_to_shard, vnodes_of_rows,
 )
-from ..common.profiling import profile_dispatch
+from ..common.profiling import GLOBAL_PROFILER, profile_dispatch
 from ..ops.fused_multi import (
     gather_job_flush_chunk, index_state, multi_agg_finish, stack_states,
     unstack_states,
@@ -138,8 +139,7 @@ class _GrowRetryMixin:
         inside flush() for free; this extra fetch is paid only by
         epoch-chaining callers."""
         while self._pending is not None:
-            packed_h = np.asarray(
-                jax.device_get(self._out[self._PACKED_POS]))
+            packed_h = np.asarray(fetch(self._out[self._PACKED_POS]))
             if packed_h[:, self._OVF_COL].any():
                 self._out = self._grow_and_retry()
                 self.stacked = self._out[0]
@@ -151,8 +151,7 @@ class _GrowRetryMixin:
         overflow-free, clear the pending marker, return the host copy
         (ONE fetch per attempt covers flags AND the retry signal)."""
         while True:
-            packed_h = np.asarray(
-                jax.device_get(self._out[self._PACKED_POS]))
+            packed_h = np.asarray(fetch(self._out[self._PACKED_POS]))
             if self._pending is not None and \
                     packed_h[:, self._OVF_COL].any():
                 self._out = self._grow_and_retry()
@@ -211,7 +210,7 @@ class ShardedFusedAgg(_ShardedFusedBase):
         — run_epoch, flush, run_epoch, … — settles inside flush() for
         free; this extra fetch is paid only by epoch-chaining callers."""
         while self._pending is not None:
-            if bool(np.any(np.asarray(jax.device_get(self._rovf)))):
+            if bool(np.any(np.asarray(fetch(self._rovf)))):
                 self.stacked, self._rovf = self._grow_and_retry()
             else:
                 self._pending = None
@@ -233,7 +232,8 @@ class ShardedFusedAgg(_ShardedFusedBase):
         finish. Returns the flush StreamChunks in shard-major order."""
         while True:
             packed, ranks = self._probe(self.stacked, self._rovf)
-            packed_h = np.asarray(jax.device_get(packed))
+            packed_h = np.asarray(
+                fetch(packed, dispatch=self._probe.__qualname__))
             if self._pending is not None and packed_h[:, 2].any():
                 self.stacked, self._rovf = self._grow_and_retry()
                 continue
@@ -808,6 +808,7 @@ class ShardedCoGroup(_GrowRetryMixin):
         self.stacked = None
         self._base_keys = None
         self._rovf = None
+        self.pending: Optional[PendingFlush] = None
         self._probe = _sharded_agg_probe(self.core, job_axis=True)
         self._finish = profile_dispatch(
             jax.jit(jax.vmap(jax.vmap(self.core.finish_flush))),
@@ -839,6 +840,8 @@ class ShardedCoGroup(_GrowRetryMixin):
         per-shard states (recovery re-shard), or None for fresh."""
         if name in self.names:
             raise ValueError(f"job {name!r} already sharded-co-scheduled")
+        assert self.pending is None, \
+            "membership change with a flush in flight (drain first)"
         self._settle()
         self._rovf = None       # shaped [n, J_old]; J changes below
         if shard_states is None:
@@ -865,6 +868,8 @@ class ShardedCoGroup(_GrowRetryMixin):
 
     def remove(self, name: str) -> list:
         """Drop a job; returns its final n solo-shaped shard states."""
+        assert self.pending is None, \
+            "membership change with a flush in flight (drain first)"
         self._settle()
         self._rovf = None       # shaped [n, J_old]; J changes below
         j = self.names.index(name)
@@ -895,7 +900,7 @@ class ShardedCoGroup(_GrowRetryMixin):
 
     def _settle(self) -> None:
         while self._pending is not None:
-            if bool(np.any(np.asarray(jax.device_get(self._rovf)))):
+            if bool(np.any(np.asarray(fetch(self._rovf)))):
                 self.stacked, self._rovf = self._grow_and_retry()
             else:
                 self._pending = None
@@ -915,23 +920,57 @@ class ShardedCoGroup(_GrowRetryMixin):
             self.batch_nos[j] += 1
         self.epochs_run += 1
 
-    def flush(self) -> dict:
-        """Barrier flush for the whole K×S group: ONE packed [n, J, 3]
-        fetch covers every (shard, job) cell's dirty count / overflow /
-        route flag, per-job churn gathers run through one compiled
-        gather with traced (shard, job) indices, one vmapped finish.
-        Returns {job: [StreamChunk, ...]} in shard-major order per job
-        — exactly ShardedFusedAgg.flush per member."""
-        while True:
-            packed, ranks = self._probe(
-                self.stacked,
-                self._rovf if self._rovf is not None
-                else jnp.zeros((self.n, self.n_jobs), jnp.bool_))
+    def begin_flush(self) -> PendingFlush:
+        """Start the K×S barrier flush without resolving it: probe
+        enqueued, packed [n, J, 3] stats streaming host-ward, vmapped
+        finish enqueued eagerly so the next epoch can dispatch before
+        the fetch resolves (pipeline_depth = 2). The route-overflow
+        retry signal rides the same packed fetch, so validation is
+        deferred with it — the grow-retry in ``finish_flush`` replays
+        from the untouched pre-epoch state the ``_pending`` slot holds
+        (sharded epochs never donate)."""
+        assert self.pending is None, "flush already in flight"
+        packed, ranks = self._probe(
+            self.stacked,
+            self._rovf if self._rovf is not None
+            else jnp.zeros((self.n, self.n_jobs), jnp.bool_))
+        self.pending = PendingFlush(
+            self.stacked, packed, ranks,
+            async_fetch(packed, dispatch=self._probe.__qualname__))
+        self.stacked = self._finish(self.stacked)
+        return self.pending
+
+    def finish_flush(self) -> dict:
+        """Resolve the in-flight K×S flush: ONE packed fetch covers
+        every (shard, job) cell's dirty count / overflow / route flag;
+        a set route flag drains the pipeline and grow-retries the whole
+        group's epoch before gathering. Returns
+        {job: [StreamChunk, ...]} in shard-major order per job."""
+        p = self.pending
+        if p is None:
+            p = self.begin_flush()
+        self.pending = None
+        packed_h = np.asarray(p.fetch.result())
+        gather_stacked, ranks = p.stacked, p.ranks
+        retried = False
+        while self._pending is not None and packed_h[:, :, 2].any():
+            # grow-retry drains the pipeline: the replayed epoch (and
+            # its re-probe) must validate before anything else may
+            # dispatch, so this re-fetch is deliberately synchronous
+            gather_stacked, self._rovf = self._grow_and_retry()
+            packed, ranks = self._probe(gather_stacked, self._rovf)
+            # rwlint: allow(sync-fetch-discipline): grow-retry drain — the replayed epoch must validate before the tick proceeds
             packed_h = np.asarray(jax.device_get(packed))
-            if self._pending is not None and packed_h[:, :, 2].any():
-                self.stacked, self._rovf = self._grow_and_retry()
-                continue
-            break
+            # the raw fetch above IS this probe's completion: pop the
+            # profiler's inflight FIFO or every later completion would
+            # match a stale enqueue timestamp
+            GLOBAL_PROFILER.note_complete(self._probe.__qualname__)
+            retried = True
+        if retried:
+            # ONE finish over the settled state (begin_flush already
+            # finished the no-retry case; per-iteration finishes would
+            # just be discarded dispatches)
+            self.stacked = self._finish(gather_stacked)
         self._pending = None
         self._rovf = None
         out: dict = {}
@@ -948,12 +987,19 @@ class ShardedCoGroup(_GrowRetryMixin):
                 lo = 0
                 while lo < n_dirty:
                     chunks.append(self._gather(
-                        self.stacked, ranks, jnp.int64(s), jnp.int64(j),
-                        jnp.int64(lo)))
+                        gather_stacked, ranks, jnp.int64(s),
+                        jnp.int64(j), jnp.int64(lo)))
                     lo += self.core.groups_per_chunk
             out[name] = chunks
-        self.stacked = self._finish(self.stacked)
         return out
+
+    def flush(self) -> dict:
+        """Synchronous barrier flush (begin + finish in one call) —
+        exactly ShardedFusedAgg.flush per member, the pre-pipeline
+        cadence and still the default."""
+        if self.pending is None:
+            self.begin_flush()
+        return self.finish_flush()
 
     # -- durability -----------------------------------------------------------
 

@@ -397,7 +397,8 @@ def _collect_trace(data_dir: str) -> Dict[str, list]:
 
 def run_netsplit(name: str, seed: int = 7, data_dir: Optional[str] = None,
                  base_ticks: int = 2, post_ticks: int = 2,
-                 chunk_capacity: int = 64) -> dict:
+                 chunk_capacity: int = 64,
+                 session_kw: Optional[dict] = None) -> dict:
     """Run one named netsplit scenario end to end and machine-check the
     result: build a 2-worker cluster with the seeded schedule installed,
     run the scenario's MV as a spanning graph, let the injection strike
@@ -434,7 +435,8 @@ def run_netsplit(name: str, seed: int = 7, data_dir: Optional[str] = None,
     sim = SimCluster(data_dir, seed=seed, kill_rate=0.0, workers=2,
                      chaos=schedule, source_chunk_capacity=chunk_capacity,
                      checkpoint_frequency=2, fault_config=fc,
-                     config=BuildConfig(fragment_parallelism=2))
+                     config=BuildConfig(fragment_parallelism=2),
+                     **(session_kw or {}))
     control = Session(seed=42, source_chunk_capacity=chunk_capacity,
                       checkpoint_frequency=2)
     mv = spec["mv"]

@@ -457,6 +457,13 @@ class DurableStateStore(MemoryStateStore):
                                  compact_after=compact_after,
                                  retry_policy=retry_policy)
         self._prepared_epochs: set[int] = set()
+        # off-critical-path checkpoint encode (pipelined tick): at most
+        # ONE deferred commit in flight; ordering is preserved by
+        # joining before starting the next (segments are replayed in
+        # manifest order, so a later epoch's segment must never land
+        # without its predecessor)
+        self._commit_thread: Optional[threading.Thread] = None
+        self._commit_error: Optional[BaseException] = None
         if self.log.exists():
             if recover_at is not None:
                 # spanning-job recovery: the session names the epoch the
@@ -481,6 +488,7 @@ class DurableStateStore(MemoryStateStore):
         later applies and publishes them)."""
         if epoch <= self.committed_epoch or epoch in self._prepared_epochs:
             return
+        self.join_commits()          # manifest ops stay strictly ordered
         from ..common.tracing import CAT_STORAGE, trace_span
         deltas = self._pending_deltas(epoch)
         with trace_span("DurableStateStore.prepare", CAT_STORAGE,
@@ -488,9 +496,59 @@ class DurableStateStore(MemoryStateStore):
             self.log.prepare_epoch(epoch, deltas)
         self._prepared_epochs.add(epoch)
 
+    def commit_async(self, epoch: int) -> None:
+        """Commit ``epoch`` with the delta serialization + segment/
+        manifest IO on a worker thread (the pipelined tick's
+        off-critical-path checkpoint encode). The in-memory commit
+        applies HERE, synchronously — readers see the epoch at once —
+        while durability lands in the background and is joined at the
+        next commit, at ``join_commits()`` (the session calls it before
+        any 2PC phase-2 frame and on FLUSH/close), or at the next
+        synchronous commit. A crash before the join recovers at the
+        previous checkpoint and replays deterministically — the same
+        window as crashing just before a synchronous commit. 2PC
+        participants (prepared epochs) stay fully synchronous: their
+        durability IS the phase-1 ack."""
+        if epoch <= self.committed_epoch:
+            return
+        self.join_commits()          # strict segment ordering + errors
+        if any(e <= epoch for e in self._prepared_epochs):
+            self.commit(epoch)
+            return
+        deltas = self._pending_deltas(epoch)
+        MemoryStateStore.commit(self, epoch)
+        from ..common.tracing import CAT_STORAGE, trace_span
+
+        def _encode_and_publish() -> None:
+            try:
+                with trace_span("DurableStateStore.commit_async",
+                                CAT_STORAGE, epoch=epoch, tid="storage",
+                                tables=len(deltas)):
+                    self.log.append_epoch(epoch, deltas)
+            except BaseException as e:  # noqa: BLE001 - surfaced at join
+                self._commit_error = e
+
+        t = threading.Thread(target=_encode_and_publish, daemon=True,
+                             name="checkpoint-encode")
+        self._commit_thread = t
+        t.start()
+
+    def join_commits(self) -> None:
+        t = self._commit_thread
+        if t is not None and t.is_alive():
+            t.join()
+        self._commit_thread = None
+        err = self._commit_error
+        if err is not None:
+            self._commit_error = None
+            raise RuntimeError(
+                "deferred checkpoint encode failed; the epoch is "
+                "committed in memory but NOT durable") from err
+
     def commit(self, epoch: int) -> None:
         if epoch <= self.committed_epoch:
             return
+        self.join_commits()
         from ..common.tracing import CAT_STORAGE, trace_span
         prepared = {e for e in self._prepared_epochs if e <= epoch}
         if prepared:
@@ -517,6 +575,7 @@ class DurableStateStore(MemoryStateStore):
         deltas = {tid: dict(rows) for tid, rows in deltas.items() if rows}
         if not deltas:
             return 0
+        self.join_commits()
         n = 0
         for tid, rows in deltas.items():
             tbl = self._committed.setdefault(tid, {})
@@ -529,5 +588,6 @@ class DurableStateStore(MemoryStateStore):
         return n
 
     def drop_table(self, table_id: int) -> None:
+        self.join_commits()
         super().drop_table(table_id)
         self.log.drop_table(table_id)

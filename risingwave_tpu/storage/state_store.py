@@ -73,6 +73,20 @@ class MemoryStateStore:
                         tbl[k] = v
         self.committed_epoch = epoch
 
+    # -- async commit surface (pipelined tick, docs/performance.md) -----------
+    # The memory tier commits are dict merges — nothing to offload — so
+    # the base implementations are synchronous aliases. DurableStateStore
+    # overrides them to hand the committed-delta serialization + segment
+    # write to a worker thread (storage/checkpoint.py); every backend
+    # answers the same two calls so the session's commit path stays
+    # tier-agnostic.
+
+    def commit_async(self, epoch: int) -> None:
+        self.commit(epoch)
+
+    def join_commits(self) -> None:
+        """Barrier for any deferred commit work (no-op in memory)."""
+
     # -- read path ------------------------------------------------------------
 
     def _merged_view(self, table_id: int) -> dict:

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.fetch import PendingFlush, async_fetch
 from ..ops.fused_multi import (
     append_state, build_group_epoch, gather_job_flush_chunk, index_state,
     multi_agg_finish, multi_agg_probe, remove_state, stack_states,
@@ -116,6 +117,7 @@ class CoGroup:
             self._finish = multi_agg_finish(spec.core)
             self._gather = gather_job_flush_chunk(spec.core)
         self._join_out = None            # last join epoch's outputs
+        self.pending: Optional[PendingFlush] = None
 
     # -- membership -----------------------------------------------------------
 
@@ -127,6 +129,8 @@ class CoGroup:
             batch_no: int = 0) -> None:
         if name in self.names:
             raise ValueError(f"job {name!r} already co-scheduled")
+        assert self.pending is None, \
+            "membership change with a flush in flight (drain first)"
         if self.stacked is None:
             self.stacked = stack_states([state])
         else:
@@ -139,6 +143,8 @@ class CoGroup:
 
     def remove(self, name: str):
         """Drop a job; returns its final solo-shaped state."""
+        assert self.pending is None, \
+            "membership change with a flush in flight (drain first)"
         j = self.names.index(name)
         st = index_state(self.stacked, j)
         self.stacked = (remove_state(self.stacked, j)
@@ -184,17 +190,36 @@ class CoGroup:
         self.epochs_run += 1
         return res if self.kind == "join" else None
 
-    def flush(self) -> dict:
-        """Barrier flush for the whole group (agg shape): one vmapped
-        probe (+ ONE packed fetch for all J jobs), per-job gather
-        windows, one vmapped finish. Returns {job: [StreamChunk, ...]}.
-        """
+    def begin_flush(self) -> "PendingFlush":
+        """Start the barrier flush WITHOUT resolving it: one vmapped
+        probe is enqueued and its packed [J, 3] stats start streaming to
+        the host (common/fetch.py), then the vmapped finish is enqueued
+        eagerly — finish depends only on device state, so the NEXT
+        epoch's dispatch can launch on finished state before this
+        flush's fetch resolves (pipeline_depth = 2). The pre-finish
+        state rides in the pending handle for the gathers."""
         if self.kind != "agg":
             raise NotImplementedError(
                 "join-group flush is driven by the caller from the "
                 "epoch outputs (bench.py measure pattern)")
+        assert self.pending is None, "flush already in flight"
         packed, ranks = self._probe(self.stacked)
-        packed_h = np.asarray(jax.device_get(packed))
+        self.pending = PendingFlush(
+            self.stacked, packed, ranks,
+            async_fetch(packed, dispatch=self._probe.__qualname__))
+        self.stacked = self._finish(self.stacked)
+        return self.pending
+
+    def finish_flush(self) -> dict:
+        """Resolve the in-flight flush: one packed fetch (already
+        streaming — usually landed) for all J jobs, then per-job gather
+        windows against the pending pre-finish state. Returns
+        {job: [StreamChunk, ...]}."""
+        p = self.pending
+        if p is None:
+            p = self.begin_flush()
+        self.pending = None
+        packed_h = np.asarray(p.fetch.result())
         out: dict = {}
         for j, name in enumerate(self.names):
             n_dirty, overflow = int(packed_h[j, 0]), int(packed_h[j, 1])
@@ -206,12 +231,19 @@ class CoGroup:
             chunks = []
             lo = 0
             while lo < n_dirty:
-                chunks.append(self._gather(self.stacked, ranks,
+                chunks.append(self._gather(p.stacked, p.ranks,
                                            jnp.int64(j), jnp.int64(lo)))
                 lo += self.core.groups_per_chunk
             out[name] = chunks
-        self.stacked = self._finish(self.stacked)
         return out
+
+    def flush(self) -> dict:
+        """Synchronous barrier flush (begin + finish in one call): one
+        vmapped probe, ONE packed fetch, per-job gathers, one vmapped
+        finish — the pre-pipeline cadence, still the default."""
+        if self.pending is None:
+            self.begin_flush()
+        return self.finish_flush()
 
 
 class CoScheduler:

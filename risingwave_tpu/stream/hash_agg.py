@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.chunk import DEFAULT_CHUNK_CAPACITY, Column, StreamChunk
+from ..common.fetch import fetch
 from ..common.types import INT64, Field, Schema
 from ..expr.agg import AggCall
 from ..ops.grouped_agg import AggCore, AggState, load_rows_into_state
@@ -253,7 +254,10 @@ class HashAggExecutor(SingleInputExecutor):
 
     async def on_barrier(self, barrier: Barrier):
         packed, rank = self._probe(self.state)
-        n_dirty, overflow, n_live = (int(x) for x in jax.device_get(packed))
+        # through the async-fetch helper: the packed copy starts
+        # streaming at enqueue, and the tick-path lint
+        # (sync-fetch-discipline) can reason about one crossing
+        n_dirty, overflow, n_live = (int(x) for x in fetch(packed))
         if overflow:
             raise RuntimeError(
                 f"{self.identity}: group table overflow (capacity "

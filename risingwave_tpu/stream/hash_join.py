@@ -29,6 +29,7 @@ from ..common.chunk import (
     DEFAULT_CHUNK_CAPACITY, StreamChunk, count_units, flatten_shards,
     gather_units_window, make_chunk,
 )
+from ..common.fetch import fetch
 from ..ops.join_state import (
     JoinCore, JoinSideState, JoinState, JoinType, apply_evict_side,
     clean_side_below, compact_side, import_state, join_evict_plan,
@@ -176,13 +177,6 @@ class HashJoinExecutor(Executor):
 
         self._gather_at = jax.jit(_gather_at)
         self._evict_plan = jax.jit(join_evict_plan, static_argnums=(1,))
-
-        def _live_counts(state: JoinState):
-            from ..ops.join_state import _side_evictable_keys
-            return jnp.stack([jnp.sum(_side_evictable_keys(state.left)),
-                              jnp.sum(_side_evictable_keys(state.right))])
-
-        self._live_probe = jax.jit(_live_counts)
 
         def _apply_evict(state: JoinState, mask_l, mask_r) -> JoinState:
             return JoinState(left=apply_evict_side(state.left, mask_l),
@@ -436,15 +430,19 @@ class HashJoinExecutor(Executor):
         3/4 of the budget (their durable rows were just written by this
         barrier's checkpoint). Returns True if anything was evicted (the
         caller compacts to reclaim the key slots)."""
-        # cheap gate first: one small reduction + sync, vs the full-sort
-        # evict plan — checkpoints under budget pay only this
-        nl, nr = (int(x) for x in jax.device_get(
-            self._live_probe(self.state)))
-        if max(nl, nr) <= self.hbm_key_budget:
-            return False
+        # ONE packed fetch covers the budget gate AND the plan: the evict
+        # plan's packed already carries [n_evict_l, n_evict_r, n_live_l,
+        # n_live_r] (ops/join_state.join_evict_plan), so the old
+        # two-round-trip cadence — a live-count gate fetch, then the plan
+        # fetch — coalesces into a single device→host transfer per
+        # checkpoint. Under budget the plan's sort is wasted DEVICE work
+        # (async-dispatched, off the critical path); the host sync it
+        # replaces was on it.
         keep = max(self.hbm_key_budget * 3 // 4, 1)
         mask_l, mask_r, packed = self._evict_plan(self.state, keep)
-        nel, ner = (int(x) for x in jax.device_get(packed[:2]))
+        nel, ner, nl, nr = (int(x) for x in fetch(packed[:4]))
+        if max(nl, nr) <= self.hbm_key_budget:
+            return False
         if nel == 0 and ner == 0:
             return False
         for side, mask in (("left", mask_l), ("right", mask_r)):

@@ -67,6 +67,19 @@ python -m pytest -q -p no:cacheprovider -m slow \
     tests/test_registry_coverage.py \
     "$@"
 
+echo "== pipelined tick (async epoch pipeline, fast tier) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_pipeline.py -m 'not slow' \
+    "$@"
+
+echo "== pipelined tick heavy (kill -9 recovery + netsplit composition) =="
+# real process death with a deferred flush + un-joined checkpoint
+# encode, and the q5 netsplit scenario run with pipeline_depth=2 —
+# slow-marked out of tier-1 per the 870s wall budget
+python -m pytest -q -p no:cacheprovider -m slow \
+    tests/test_pipeline.py \
+    "$@"
+
 echo "== serving-plane tests (two-phase agg + plan cache + reads) =="
 python -m pytest -q -p no:cacheprovider \
     tests/test_serving.py \

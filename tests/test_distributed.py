@@ -91,6 +91,7 @@ def local_run(mv_sql: str, name: str, ticks: int, seed=42) -> list:
 
 
 class TestSpanningParity:
+    @pytest.mark.slow  # heavy 2-worker graph; check.sh runs this file unfiltered
     def test_q5_spans_two_workers_bit_exact_per_epoch(self):
         """q5 (join of two sharded hop-window aggs) as a 6-fragment graph
         over 2 workers: every hash fragment's actors own disjoint vnode
@@ -298,6 +299,7 @@ class TestTwoPhasePrepare:
 
 
 class TestSpanningOps:
+    @pytest.mark.slow  # heavy 2-worker graph; check.sh runs this file unfiltered
     def test_placement_persists_and_restart_reuses_it(self, tmp_path):
         d = str(tmp_path / "c")
         s = spanning_session(seed=7, data_dir=d)
@@ -373,6 +375,7 @@ class TestSpanningOps:
         finally:
             s.close()
 
+    @pytest.mark.slow  # heavy 2-worker graph; check.sh runs this file unfiltered
     def test_ctl_cluster_fragments_dumps_placement(self, tmp_path, capsys):
         d = str(tmp_path / "c")
         s = spanning_session(seed=7, data_dir=d)
@@ -422,6 +425,7 @@ class TestServingTwoPhase:
             s.close()
             control.close()
 
+    @pytest.mark.slow  # heavy 2-worker graph; check.sh runs this file unfiltered
     def test_partial_tasks_run_per_vnode_slice_on_two_workers(self):
         s = spanning_session(seed=11)
         control = Session(seed=11, source_chunk_capacity=CAP)

@@ -1,0 +1,355 @@
+"""Asynchronous epoch pipeline (docs/performance.md "Pipelined tick").
+
+Contract under test: ``pipeline_depth = 2`` may only REORDER work —
+epoch N+1's dispatch before epoch N's packed flush fetch, checkpoint
+encode on a worker thread — never change results. Pipelined sessions
+must be bit-exact vs synchronous ones at every drain point (checkpoint
+barriers, FLUSH, DDL), add zero dispatches, survive kill -9 between
+checkpoints, and drain cleanly around membership changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from risingwave_tpu.common.dispatch_count import count_dispatches
+
+CAP = 128
+
+SRC_SQL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT,
+    price BIGINT, channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+    extra VARCHAR) WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+MV_SQL = ("CREATE MATERIALIZED VIEW {n} AS SELECT auction, count(*) AS c "
+          "FROM bid GROUP BY auction")
+# min/max lanes dirty repeatedly per group → flush churn with U-/U+
+# retraction pairs on every barrier
+MV_CHURN_SQL = ("CREATE MATERIALIZED VIEW {n} AS SELECT auction, "
+                "count(*) AS c, min(price) AS lo, max(price) AS hi "
+                "FROM bid GROUP BY auction")
+
+GROUP_EPOCH_FN = "build_group_epoch.<locals>.coscheduled_epoch"
+
+
+def _session(tmp_path=None, pipeline_depth=1, mesh=None, **kw):
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend.build import BuildConfig
+    return Session(config=BuildConfig(coschedule=True, mesh=mesh,
+                                      agg_table_capacity=1 << 12),
+                   source_chunk_capacity=CAP,
+                   data_dir=str(tmp_path) if tmp_path else None,
+                   pipeline_depth=pipeline_depth, **kw)
+
+
+def _mv_rows(s, names):
+    return {n: sorted(tuple(r) for r in s.run_sql(f"SELECT * FROM {n}"))
+            for n in names}
+
+
+def _run(depth, n_mvs, ticks, tmp_path=None, churn=False, mesh=None,
+         probe_each_checkpoint=False, checkpoint_frequency=4):
+    """Tick a (solo|cosched) fused session at the given pipeline depth;
+    returns (rows at each checkpoint tick, rows after the final FLUSH,
+    pipeline metrics)."""
+    sql = MV_CHURN_SQL if churn else MV_SQL
+    names = [f"m{j}" for j in range(n_mvs)]
+    s = _session(tmp_path, pipeline_depth=depth, mesh=mesh,
+                 checkpoint_frequency=checkpoint_frequency,
+                 chunks_per_tick=2)
+    at_checkpoints = []
+    try:
+        s.run_sql(SRC_SQL)
+        for n in names:
+            s.run_sql(sql.format(n=n))
+        for _ in range(ticks):
+            s.tick()
+            if probe_each_checkpoint and \
+                    s.epoch % checkpoint_frequency == 0:
+                # checkpoint ticks are drain points: the pipelined MV
+                # must agree with the synchronous one HERE, not only
+                # after the final flush
+                at_checkpoints.append(_mv_rows(s, names))
+        s.flush()
+        final = _mv_rows(s, names)
+        pipe = s.metrics()["pipeline"]
+    finally:
+        s.close()
+    return at_checkpoints, final, pipe
+
+
+@pytest.mark.parametrize("n_mvs", [1, 3])     # solo group + K=3 group
+def test_pipelined_bit_exact_vs_sync(n_mvs):
+    ck_sync, sync, _ = _run(1, n_mvs, 11, probe_each_checkpoint=True)
+    ck_pipe, pipe, m = _run(2, n_mvs, 11, probe_each_checkpoint=True)
+    assert sync == pipe
+    assert ck_sync == ck_pipe            # equal at every drain point
+    assert m["depth"] == 2 and m["deferred_flushes"] > 0
+    assert m["pending_flushes"] == 0     # flush drained everything
+
+
+def test_pipelined_bit_exact_with_flush_churn():
+    # min/max lanes force U-/U+ retraction pairs in every barrier flush
+    _, sync, _ = _run(1, 2, 9, churn=True)
+    _, pipe, _ = _run(2, 2, 9, churn=True)
+    assert sync == pipe
+
+
+def test_pipelined_shardfused_bit_exact():
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+    _, sync, _ = _run(1, 2, 9, mesh=make_mesh(1))
+    _, pipe, m = _run(2, 2, 9, mesh=make_mesh(1))
+    assert sync == pipe
+    assert m["deferred_flushes"] > 0
+
+
+def test_pipelined_zero_added_dispatches():
+    """Pipelining reorders dispatches across ticks; it must never add
+    one (the live twin of bench.py --smoke's guard)."""
+    def counts_for(depth):
+        with count_dispatches() as c:
+            _run(depth, 2, 9)
+            return dict(c.counts)
+    sync, pipe = counts_for(1), counts_for(2)
+    for qn in (GROUP_EPOCH_FN, "multi_agg_probe.<locals>.probe",
+               "multi_agg_finish.<locals>.finish",
+               "gather_job_flush_chunk.<locals>.gather"):
+        assert sync.get(qn) == pipe.get(qn) and sync.get(qn), \
+            f"{qn}: sync={sync.get(qn)} pipe={pipe.get(qn)}"
+
+
+def test_pipelined_ddl_mid_stream_drains(tmp_path):
+    """CREATE/DROP between ticks restack the job axis: the deferred
+    flush must drain first, and results stay exact vs the synchronous
+    session doing the identical DDL dance."""
+    def run(depth):
+        s = _session(tmp_path / f"d{depth}", pipeline_depth=depth,
+                     checkpoint_frequency=4, chunks_per_tick=2)
+        try:
+            s.run_sql(SRC_SQL)
+            s.run_sql(MV_SQL.format(n="a"))
+            for _ in range(3):
+                s.tick()
+            s.run_sql(MV_SQL.format(n="b"))     # joins the group mid-run
+            for _ in range(3):
+                s.tick()
+            s.run_sql("DROP MATERIALIZED VIEW a")
+            for _ in range(3):
+                s.tick()
+            s.flush()
+            return _mv_rows(s, ["b"])
+        finally:
+            s.close()
+    assert run(1) == run(2)
+
+
+def test_pipelined_pause_resume_drains():
+    def run(depth):
+        s = _session(pipeline_depth=depth, checkpoint_frequency=4,
+                     chunks_per_tick=2)
+        try:
+            s.run_sql(SRC_SQL)
+            s.run_sql(MV_SQL.format(n="m0"))
+            for _ in range(3):
+                s.tick()
+            s.pause()          # generate-off tick: pipeline empties
+            assert s.metrics()["pipeline"]["pending_flushes"] == 0
+            s.resume()
+            for _ in range(3):
+                s.tick()
+            s.flush()
+            return _mv_rows(s, ["m0"])
+        finally:
+            s.close()
+    assert run(1) == run(2)
+
+
+def test_pipelined_recovery_from_abandoned_session(tmp_path):
+    """Crash-shaped recovery (no close, no drain): a pipelined session
+    is abandoned mid-stream with a flush deferred and an async commit
+    possibly un-joined; reopening recovers the last checkpoint cut and
+    replays to the same rows a synchronous control produces."""
+    def run(depth, d):
+        s = _session(d, pipeline_depth=depth, checkpoint_frequency=2,
+                     chunks_per_tick=2)
+        s.run_sql(SRC_SQL)
+        s.run_sql(MV_SQL.format(n="m0"))
+        for _ in range(5):                 # checkpoint at epochs 2 and 4
+            s.tick()
+        return s                           # abandoned: NO close/flush
+
+    s_sync = run(1, tmp_path / "sync")
+    s_pipe = run(2, tmp_path / "pipe")
+    del s_sync, s_pipe                     # crash: no graceful shutdown
+
+    def recover(d):
+        s = _session(d, checkpoint_frequency=2)
+        try:
+            rows = _mv_rows(s, ["m0"])
+            for _ in range(3):             # deterministic replay onward
+                s.tick()
+            s.flush()
+            return rows, _mv_rows(s, ["m0"])
+        finally:
+            s.close()
+
+    assert recover(tmp_path / "sync") == recover(tmp_path / "pipe")
+
+
+def test_commit_async_durability_and_ordering(tmp_path):
+    """DurableStateStore.commit_async: memory-visible immediately,
+    durable after join; ordering across consecutive async commits is
+    strict; a reopened store recovers every joined epoch."""
+    from risingwave_tpu.storage.checkpoint import DurableStateStore
+    st = DurableStateStore(str(tmp_path))
+    for e in (1, 2, 3):
+        st.ingest(7, e, {b"k%d" % e: b"v%d" % e}, set())
+        st.commit_async(e)
+        assert st.get(7, b"k%d" % e) == b"v%d" % e     # visible now
+    st.join_commits()
+    st2 = DurableStateStore(str(tmp_path))
+    assert st2.committed_epoch == 3
+    assert sorted(dict(st2.iter_table(7))) == [b"k1", b"k2", b"k3"]
+
+
+def test_commit_async_error_surfaces_at_join(tmp_path):
+    from risingwave_tpu.storage.checkpoint import DurableStateStore
+    st = DurableStateStore(str(tmp_path))
+    st.ingest(7, 1, {b"k": b"v"}, set())
+
+    def boom(*a, **k):
+        raise OSError("disk gone")
+    st.log.append_epoch = boom
+    st.commit_async(1)
+    with pytest.raises(RuntimeError, match="NOT durable"):
+        st.join_commits()
+    # the error is raised once, then cleared (store reusable for a
+    # retry with the real log)
+    st.join_commits()
+
+
+def test_pipeline_metrics_and_prometheus():
+    from risingwave_tpu.frontend.prometheus import render_metrics
+    s = _session(pipeline_depth=2, checkpoint_frequency=4,
+                 chunks_per_tick=2)
+    try:
+        s.run_sql(SRC_SQL)
+        s.run_sql(MV_SQL.format(n="m0"))
+        for _ in range(5):
+            s.tick()
+        m = s.metrics()["pipeline"]
+        assert m["depth"] == 2
+        assert m["deferred_flushes"] > 0
+        assert m["completions"] > 0
+        text = render_metrics(s)
+        assert "rw_pipeline_depth 2" in text
+        assert 'rw_pipeline_stat{stat="deferred_flushes"}' in text
+        assert "rw_dispatch_complete_seconds" in text
+        # profiler honesty: the group probe records completion latency
+        rec = s.metrics()["profiling"]["dispatch"][
+            "multi_agg_probe.<locals>.probe"]
+        assert rec.get("complete_calls", 0) > 0
+        assert rec.get("complete_s", 0) >= 0
+    finally:
+        s.close()
+
+
+def test_fetch_future_semantics():
+    import jax.numpy as jnp
+    import numpy as np
+    from risingwave_tpu.common.fetch import async_fetch, fetch
+    tree = {"a": jnp.arange(4), "b": (jnp.ones(2), 3)}
+    fut = async_fetch(tree)
+    out = fut.result()
+    assert np.array_equal(out["a"], np.arange(4))
+    assert out["b"][1] == 3
+    assert fut.done() and fut.result() is out       # idempotent
+    assert np.array_equal(fetch(jnp.arange(3)), np.arange(3))
+
+
+_KILL9_SCRIPT = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from tests.test_pipeline import SRC_SQL, MV_SQL, _session
+s = _session({data_dir!r}, pipeline_depth=2, checkpoint_frequency=2,
+             chunks_per_tick=2)
+s.run_sql(SRC_SQL)
+s.run_sql(MV_SQL.format(n="m0"))
+for _ in range(5):
+    s.tick()
+print("TICKED", flush=True)
+os._exit(0)      # kill -9 shaped: no drain, no join, no close
+"""
+
+
+def _sync_history_rows(ticks: int):
+    """Rows a FRESH synchronous session shows after consuming exactly
+    ``ticks`` ticks of the deterministic bid stream — the ground truth
+    any recovered cut must be a prefix of (no mid-run checkpoints, so
+    only the event count matters)."""
+    s = _session(None, pipeline_depth=1, checkpoint_frequency=10_000,
+                 chunks_per_tick=2)
+    try:
+        s.run_sql(SRC_SQL)
+        s.run_sql(MV_SQL.format(n="m0"))
+        for _ in range(ticks):
+            s.tick()
+        s.flush()
+        return _mv_rows(s, ["m0"])
+    finally:
+        s.close()
+
+
+@pytest.mark.slow
+def test_pipelined_kill9_recovery_e2e(tmp_path):
+    """REAL process death mid-pipeline: the child dies via os._exit with
+    a deferred flush outstanding and the last checkpoint's encode
+    possibly un-joined. Recovery must land on SOME committed checkpoint
+    cut that is bit-exact with the synchronous history at that offset
+    (the deferred encode may legitimately cost the final checkpoint —
+    that is the crash window a synchronous commit has too), and replay
+    forward deterministically."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", _KILL9_SCRIPT.format(
+            repo=repo, data_dir=str(tmp_path / "pipe"))],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "TICKED" in out.stdout, out.stderr
+
+    s = _session(tmp_path / "pipe", checkpoint_frequency=2,
+                 chunks_per_tick=2)
+    try:
+        recovered_epoch = s.epoch
+        # checkpoints fell on even epochs 2/4/6; at least one beyond
+        # the initial cut must have survived the kill
+        assert recovered_epoch >= 4 and recovered_epoch % 2 == 0, \
+            recovered_epoch
+        rows = _mv_rows(s, ["m0"])
+        # epoch E == E-1 ticks of the deterministic stream consumed
+        assert rows == _sync_history_rows(recovered_epoch - 1)
+        for _ in range(2):                 # deterministic replay onward
+            s.tick()
+        s.flush()
+        assert _mv_rows(s, ["m0"]) == \
+            _sync_history_rows(recovered_epoch + 1)
+    finally:
+        s.close()
+
+
+@pytest.mark.slow
+def test_pipelined_netsplit_auditor_green(tmp_path):
+    """Chaos-plane composition: the q5 exchange-partition netsplit run
+    with pipeline_depth = 2 on the session still converges bit-exact
+    with the auditor green (the pipeline only touches local fused
+    engines; its drain discipline must not disturb scoped recovery —
+    run_netsplit itself asserts MV parity + the auditor)."""
+    from risingwave_tpu.sim import run_netsplit
+    report = run_netsplit("q5_exchange_partition", seed=7,
+                          data_dir=str(tmp_path),
+                          session_kw={"pipeline_depth": 2})
+    assert report["recovered"], json.dumps(report)[:500]
+    assert all(report["audit"].values()), report["audit"]

@@ -647,6 +647,139 @@ class TestRootNameNormalisation:
         assert findings[0].path == "worker/rogue.py"
 
 
+class TestSyncFetchDiscipline:
+    """sync-fetch-discipline: blocking device fetches on the tick path
+    (Session._tick_impl + the fused engines' per-tick methods) must go
+    through common/fetch.py (PR 14, docs/performance.md "Pipelined
+    tick")."""
+
+    FETCH_STUB = {
+        "common/fetch.py": """
+            import jax
+
+            class FetchFuture:
+                def __init__(self, tree, dispatch=None):
+                    self._tree = tree
+
+                def result(self):
+                    return jax.device_get(self._tree)
+
+            def async_fetch(tree, dispatch=None):
+                return FetchFuture(tree)
+
+            def fetch(tree, dispatch=None):
+                return FetchFuture(tree).result()
+            """,
+    }
+
+    def test_blocking_fetch_in_engine_flush_flagged(self, tmp_path):
+        files = dict(self.FETCH_STUB)
+        files["stream/coschedule.py"] = """
+            import jax
+            import numpy as np
+
+            class CoGroup:
+                def flush(self):
+                    packed, ranks = self._probe(self.stacked)
+                    return np.asarray(jax.device_get(packed))
+            """
+        found = lint_fixture(tmp_path, files, ["sync-fetch-discipline"])
+        assert [f.rule for f in found] == ["sync-fetch-discipline"]
+        assert found[0].path == "stream/coschedule.py"
+        assert "device_get" in found[0].message
+
+    def test_closure_from_tick_impl_through_helper_flagged(self, tmp_path):
+        # the blocking fetch hides one helper away from the tick driver:
+        # reachability (not per-line grep) must find it
+        files = dict(self.FETCH_STUB)
+        files["frontend/session.py"] = """
+            import jax
+
+            def _decode_stats(packed):
+                return jax.device_get(packed)
+
+            class Session:
+                def _cosched_tick(self, epoch):
+                    return _decode_stats(self._probe())
+
+                def _tick_impl(self, generate):
+                    return self._cosched_tick(1)
+            """
+        found = lint_fixture(tmp_path, files, ["sync-fetch-discipline"])
+        assert [f.path for f in found] == ["frontend/session.py"]
+        assert "_decode_stats" in found[0].message
+
+    def test_block_until_ready_and_device_attr_asarray_flagged(
+            self, tmp_path):
+        files = dict(self.FETCH_STUB)
+        files["parallel/fused.py"] = """
+            import jax
+            import numpy as np
+
+            class ShardedCoGroup:
+                def run_epoch(self, k):
+                    jax.block_until_ready(self.stacked)
+
+                def _settle(self):
+                    return np.asarray(self._rovf)
+            """
+        found = lint_fixture(tmp_path, files, ["sync-fetch-discipline"])
+        assert sorted(("block_until_ready" in f.message,
+                       "asarray" in f.message)
+                      for f in found) == [(False, True), (True, False)]
+
+    def test_through_fetch_helper_is_clean(self, tmp_path):
+        # the refactored shape: async_fetch at dispatch time, result()
+        # at flush time — the helper module's own device_get is the one
+        # blessed crossing and stays exempt
+        files = dict(self.FETCH_STUB)
+        files["stream/coschedule.py"] = """
+            import numpy as np
+
+            from ..common.fetch import async_fetch
+
+            class CoGroup:
+                def begin_flush(self):
+                    packed, ranks = self._probe(self.stacked)
+                    self.pending = async_fetch(packed)
+
+                def finish_flush(self):
+                    return np.asarray(self.pending.result())
+            """
+        assert lint_fixture(tmp_path, files,
+                            ["sync-fetch-discipline"]) == []
+
+    def test_non_tick_methods_stay_out_of_scope(self, tmp_path):
+        # checkpoint/debug surfaces (export_host, merged_group_values)
+        # legitimately materialize host copies — not per-tick work
+        files = dict(self.FETCH_STUB)
+        files["parallel/fused.py"] = """
+            import jax
+
+            class ShardedFusedAgg:
+                def export_host(self):
+                    return jax.device_get(self.stacked)
+
+                def merged_group_values(self):
+                    return jax.device_get(self.stacked)
+            """
+        assert lint_fixture(tmp_path, files,
+                            ["sync-fetch-discipline"]) == []
+
+    def test_real_package_has_exactly_one_reasoned_drain_allow(self):
+        """The real tree keeps ONE deliberately blocking fetch — the
+        sharded grow-retry drain — behind a reasoned allow pragma; the
+        rule must see it raw and the driver must suppress it."""
+        from risingwave_tpu.analysis.core import RULES as _R
+        pkg = load_package(package_root())
+        raw = list(_R["sync-fetch-discipline"].check(pkg))
+        assert [f.path for f in raw] == ["parallel/fused.py"], \
+            [f.render() for f in raw]
+        findings, _, _ = lint_package(
+            package_root(), [_R["sync-fetch-discipline"]])
+        assert findings == []
+
+
 class TestSuppressions:
     def test_allow_with_reason_suppresses(self, tmp_path):
         files = dict(DISPATCH_STUB)
