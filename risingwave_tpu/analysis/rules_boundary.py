@@ -199,6 +199,60 @@ call inside frontend/session.py bypasses the cache layer and its
 
 
 @register
+class UdfBoundary(Rule):
+    name = "udf-boundary"
+    title = "user UDF callables invoked only behind the client boundary"
+    ci_label = "udf-boundary"
+    doc = """A registered UDF callable may only run behind the client
+boundary (udf/client.py), which owns the deadlines / respawn+replay /
+fencing / backpressure contract of ISSUE 15 — a tick-path module
+calling user code directly reintroduces exactly the wedge class the
+out-of-process plane exists to kill. Two shapes are flagged: a call
+resolving to ``udf.runtime.eval_udf_batch`` (the one sanctioned
+evaluator) anywhere outside the evaluator itself and the server (the
+wire's far side) — the client's opt-in inproc path carries the package's
+ONE reasoned allow; and grabbing a spec's raw callable out of the
+registry (``get_udf(...).fn(...)`` / ``UDF_SPECS[...].fn(...)``)."""
+
+    TARGET = f"{PKG}.udf.runtime.eval_udf_batch"
+    EXEMPT = ("udf/runtime.py", "udf/server.py")
+    REG_GET = f"{PKG}.udf.registry.get_udf"
+    REG_MAP = f"{PKG}.udf.registry.UDF_SPECS"
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for mod, call in _call_sites(package, targets={self.TARGET},
+                                     exempt=self.EXEMPT):
+            yield Finding(self.name, mod.rel, call.lineno,
+                          call.col_offset,
+                          "direct eval_udf_batch call outside the UDF "
+                          "client boundary (route through "
+                          "udf/client.py UdfPlane.call)")
+        for rel, mod in package.modules.items():
+            if rel in self.EXEMPT:
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute) or \
+                        node.func.attr != "fn":
+                    continue
+                v = node.func.value
+                qn = None
+                if isinstance(v, ast.Call):
+                    qn = package.canonical(
+                        mod.imports.resolve_or_local(v.func))
+                elif isinstance(v, ast.Subscript):
+                    qn = package.canonical(
+                        mod.imports.resolve_or_local(v.value))
+                if qn in (self.REG_GET, self.REG_MAP):
+                    yield Finding(
+                        self.name, mod.rel, node.lineno,
+                        node.col_offset,
+                        "registered UDF callable invoked directly from "
+                        "the registry (route through udf/client.py "
+                        "UdfPlane.call)")
+
+
+@register
 class BoundaryIO(Rule):
     name = "boundary-io"
     title = "object stores opened only behind the retry boundary"

@@ -120,7 +120,7 @@ def main(argv=None) -> int:
                                       "metrics", "trace", "backup",
                                       "restore", "backup-info",
                                       "hummock", "vacuum", "cluster",
-                                      "profile", "bench"])
+                                      "profile", "bench", "udf"])
     ctl.add_argument("sub", nargs="?", default=None,
                      help="subcommand for `ctl cluster` "
                      "(fragments — dump the persisted fragment→worker "
@@ -132,7 +132,10 @@ def main(argv=None) -> int:
                      "analysis of every registered fused surface "
                      "against the chip roofline, chip-free) and `ctl bench` "
                      "(trend — per-field trend with regression flags "
-                     "over the checked-in BENCH_r*.json records)")
+                     "over the checked-in BENCH_r*.json records), and "
+                     "`ctl udf` (serve — run a standalone out-of-process "
+                     "UDF server in the foreground; sessions attach via "
+                     "[udf] addr = \"host:port\" — docs/robustness.md)")
     ctl.add_argument("job", nargs="?", default=None,
                      help="job name for `ctl cluster rescale`")
     ctl.add_argument("--parallelism", type=int, default=None,
@@ -140,8 +143,11 @@ def main(argv=None) -> int:
                      "`ctl cluster rescale` (docs/scaling.md)")
     ctl.add_argument("--data-dir", default=None,
                      help="durable data dir (required for every ctl "
-                     "command except `profile` and `bench`, which read "
-                     "no cluster state)")
+                     "command except `profile`, `bench` and `udf`, "
+                     "which read no cluster state)")
+    ctl.add_argument("--port", type=int, default=0,
+                     help="udf serve: listen port (0 = ephemeral, "
+                     "printed as UDF_READY <port>)")
     ctl.add_argument("--json", action="store_true",
                      help="profile/bench: emit the full JSON report "
                      "instead of the table")
@@ -220,6 +226,15 @@ def _ctl(args) -> int:
             raise SystemExit("usage: ctl bench trend "
                              "[--bench-dir DIR --tolerance T --json]")
         return _ctl_bench_trend(args, _json)
+    if args.what == "udf":
+        if args.sub != "serve":
+            raise SystemExit("usage: ctl udf serve [--port N]")
+        # a PERSISTENT operator-managed server: clients come and go,
+        # registrations outlive any one of them (auto-spawned servers
+        # are one-client; udf/server.py)
+        from .udf.server import main as udf_server_main
+        udf_server_main(["--port", str(args.port), "--persistent"])
+        return 0
     if not args.data_dir:
         raise SystemExit("--data-dir is required")
     if args.what in ("backup", "restore", "backup-info"):

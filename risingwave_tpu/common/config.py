@@ -193,6 +193,43 @@ class FaultConfig:
 
 
 @dataclasses.dataclass
+class UdfConfig:
+    """Out-of-process UDF plane knobs (udf/client.py, docs/robustness.md
+    "UDF isolation plane"; reference capability: the Arrow-Flight UDF
+    boundary of src/udf/src/lib.rs — user code behind a wire so it can
+    never wedge an epoch). Registered UDFs evaluate in a dedicated
+    server PROCESS over the rpc/wire.py frame protocol; the client side
+    enforces per-call deadlines, kill + seeded respawn + bounded-retry
+    batch replay, generation fencing, and bounded in-flight batches."""
+
+    #: "process" = out-of-process evaluation (the default robustness
+    #: contract); "inproc" = the documented DEGRADED mode — user code
+    #: runs inside the calling process on the tick path (tests, or
+    #: environments that cannot spawn subprocesses)
+    mode: str = "process"
+    #: attach to an already-running server ("host:port", e.g. one
+    #: started with `ctl udf serve`) instead of auto-spawning; the
+    #: client cannot kill an external server, so crash recovery
+    #: degrades to reconnect-and-replay
+    addr: Optional[str] = None
+    #: per-call deadline: a batch whose reply misses it is treated as a
+    #: wedged/crashed server — kill, respawn, replay (bounded below)
+    call_timeout_s: float = 10.0
+    #: deadline on server spawn + registration replay
+    spawn_timeout_s: float = 30.0
+    #: bounded-retry replay: attempts per batch beyond the first (each
+    #: retry respawns the server); exhausted retries surface a typed
+    #: UdfTimeoutError/UdfCallError that fails the STATEMENT, never the
+    #: epoch loop
+    max_retries: int = 2
+    #: backpressure: batches admitted into the boundary concurrently;
+    #: excess callers wait up to queue_timeout_s then fail typed
+    #: (UdfOverloadedError) instead of queueing unboundedly
+    max_inflight: int = 4
+    queue_timeout_s: float = 30.0
+
+
+@dataclasses.dataclass
 class AutoscalerConfig:
     """Backlog-driven autoscaler policy (meta/autoscaler.py): watches
     the per-edge exchange counters (permits_waited, backlog —
@@ -279,6 +316,7 @@ class RwConfig:
         default_factory=AutoscalerConfig)
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
+    udf: UdfConfig = dataclasses.field(default_factory=UdfConfig)
 
 
 def _parse_toml_subset(text: str) -> dict:

@@ -51,6 +51,16 @@ DECLARED_SITES: "frozenset[str]" = frozenset({
     "rescale.commit",
     # meta store durable txn append (meta/store.py)
     "meta.store.txn",
+    # out-of-process UDF plane (udf/client.py, udf/server.py — ISSUE
+    # 15): client-side spawn / batch send / reply decode / kill+respawn,
+    # plus the SERVER-side eval site (armed via RWTPU_FAILPOINTS env in
+    # the server subprocess — an "exit" action there is a deterministic
+    # kill -9 of the server mid-batch)
+    "udf.spawn",
+    "udf.call",
+    "udf.reply",
+    "udf.respawn",
+    "udf.server.eval",
 })
 
 #: The RUNTIME registry: seeded from the declaration, grown by
@@ -95,6 +105,17 @@ def fail_point(name: str) -> None:
 
 
 def arm(name: str, action: Any, once: bool = False) -> None:
+    """Arm a site. The site must be REGISTERED (declared up front in
+    ``DECLARED_SITES``, or self-registered by a prior execution): arming
+    an unknown name used to succeed silently and never fire — a typo'd
+    test proved nothing, and a new plane could add sites the crash-point
+    sweep never swept. Registry hygiene (ISSUE 15 satellite): declare
+    the site first, so the sweep and the failpoint-honesty lint see it."""
+    if name not in KNOWN_SITES:
+        raise ValueError(
+            f"failpoint {name!r} is not a declared site — add it to "
+            "common/failpoint.py DECLARED_SITES (the crash-point sweep "
+            "and the failpoint-honesty lint iterate that registry)")
     _ARMED[name] = ("once", action) if once else action
 
 

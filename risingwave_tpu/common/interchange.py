@@ -117,6 +117,93 @@ def arrow_to_chunk(batch, schema: Schema,
                       capacity=capacity or max(len(rows), 1))
 
 
+# -- UDF boundary (columnar wire batches) ------------------------------------
+#
+# The out-of-process UDF plane (udf/client.py ↔ udf/server.py, ISSUE 15)
+# moves argument/result batches over rpc/wire.py JSON frames in an
+# Arrow-ish COLUMNAR encoding: fixed-width columns travel as raw
+# little-endian buffers (base64 inside the frame), string columns as
+# decoded utf-8 value lists (each process keeps its own dictionary — the
+# same rule the worker wire uses), validity masks as raw bool buffers.
+# NO pickle of user values ever crosses the wire; only the registration
+# frame ships the function itself (udf/registry.py).
+
+def _udf_wire_type(t) -> dict:
+    return {"kind": t.kind.name, "scale": t.scale}
+
+
+def udf_type_to_wire(t) -> dict:
+    if t.is_list or t.is_struct:
+        raise TypeError(
+            f"{t.kind.name} cannot cross the UDF wire boundary (its "
+            "values intern into a process-local dictionary); register "
+            "the function under [udf] mode = \"inproc\" instead")
+    return _udf_wire_type(t)
+
+
+def udf_type_from_wire(d: dict):
+    from .types import DataType, TypeKind
+    return DataType(TypeKind[d["kind"]], d.get("scale", 0))
+
+
+def udf_col_to_wire(data, mask, t) -> dict:
+    """One LOGICAL host column → wire dict. ``data`` is a numpy array:
+    object arrays carry already-decoded strings (str/None); any other
+    dtype is the physical encoding — string-typed physical arrays
+    (dictionary ids) are decoded here, masked-out slots to None."""
+    import base64 as _b64
+    mask = np.ascontiguousarray(np.asarray(mask, dtype=bool))
+    out: dict = {"mask": _b64.b64encode(mask.tobytes()).decode()}
+    data = np.asarray(data)
+    if t.is_string:
+        if data.dtype == object:
+            vals = [v if (m and v is not None) else None
+                    for v, m in zip(data, mask)]
+        else:
+            vals = [t.to_python(v) if m else None
+                    for v, m in zip(data, mask)]
+        out.update(enc="utf8", values=vals)
+    else:
+        buf = np.ascontiguousarray(data.astype(t.np_dtype, copy=False))
+        out.update(enc="raw", dtype=buf.dtype.str,
+                   data=_b64.b64encode(buf.tobytes()).decode())
+    return out
+
+
+def wire_to_udf_col(d: dict, t):
+    """Wire dict → (data, mask) host column. String columns decode to
+    object arrays of str/None; fixed-width to their physical dtype."""
+    import base64 as _b64
+    mask = np.frombuffer(_b64.b64decode(d["mask"]), dtype=bool).copy()
+    if d["enc"] == "utf8":
+        data = np.empty(len(mask), dtype=object)
+        for i, v in enumerate(d["values"]):
+            data[i] = v
+        # a None value is a NULL regardless of what the mask said (the
+        # server nulls rows whose function returned None)
+        mask &= np.array([v is not None for v in d["values"]], dtype=bool)
+    else:
+        data = np.frombuffer(_b64.b64decode(d["data"]),
+                             dtype=np.dtype(d["dtype"])).copy()
+    return data, mask
+
+
+def udf_batch_to_wire(datas: Sequence, masks: Sequence, types) -> dict:
+    n = len(np.asarray(masks[0])) if masks else 0
+    return {"n": n,
+            "cols": [udf_col_to_wire(d, m, t)
+                     for d, m, t in zip(datas, masks, types)]}
+
+
+def wire_to_udf_batch(payload: dict, types):
+    datas, masks = [], []
+    for c, t in zip(payload["cols"], types):
+        d, m = wire_to_udf_col(c, t)
+        datas.append(d)
+        masks.append(m)
+    return datas, masks
+
+
 # -- numpy / DLPack ----------------------------------------------------------
 
 def chunk_to_numpy(chunk: StreamChunk) -> dict:

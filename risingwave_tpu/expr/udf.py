@@ -1,18 +1,25 @@
-"""User-defined scalar functions (in-process Python).
+"""User-defined scalar functions — registration + expression glue.
 
 Counterpart of the reference's UDF support
 (reference: src/udf/src/lib.rs:28 ArrowFlightUdfClient + expr_udf.rs —
-external Python/Java UDF servers over Arrow Flight). This build runs the
-UDF *in process*: the host tier already owns a Python interpreter, so the
-Flight hop would add serialization for nothing. The interchange module
-(common/interchange.py) provides the Arrow boundary when out-of-process
-isolation is wanted later.
+external UDF servers over Arrow Flight). Since ISSUE 15 the default is
+the same posture: registered functions evaluate OUT OF PROCESS in a
+dedicated UDF server (udf/server.py) behind the client plane
+(udf/client.py) — per-call deadlines, kill + seeded respawn +
+bounded-retry batch replay, generation fencing, bounded in-flight
+backpressure — so user code can never wedge an epoch
+(docs/robustness.md "UDF isolation plane"). ``[udf] mode = "inproc"``
+keeps the old in-process evaluation as the documented degraded mode;
+both modes share one evaluator (udf/runtime.py), so results are
+bit-exact either way.
 
-UDFs evaluate on the host and are registered as host-callback functions,
-so the enclosing Project/Filter runs eagerly (same rule as the string
-library — some PJRT backends reject host callbacks inside compiled
-programs). NULL handling is strict: any NULL argument yields NULL without
-calling the function.
+This module is only the expression-engine glue: ``register_udf`` /
+``drop_udf`` keep their signatures and SQL call sites unchanged; the
+registered impl converts device columns to host batches, crosses the
+plane, and re-encodes the result (interning returned strings into THIS
+process's dictionary). UDFs stay host-callback functions, so the
+enclosing Project/Filter runs eagerly. NULL handling is strict: any
+NULL argument yields NULL without calling the function.
 """
 
 from __future__ import annotations
@@ -22,7 +29,9 @@ from typing import Callable, Sequence
 import numpy as np
 
 from ..common.types import DataType
-from .expr import HOST_CALLBACK_FNS, _REGISTRY, _strict_mask
+from ..udf.client import udf_plane
+from ..udf.registry import UdfSpec
+from .expr import HOST_CALLBACK_FNS, _REGISTRY
 
 #: names registered through register_udf — drop_udf refuses anything else
 #: (the host-callback set also contains built-in string functions)
@@ -37,31 +46,33 @@ def register_udf(name: str, fn: Callable, arg_types: Sequence[DataType],
     row (logical values: VARCHAR args arrive as str, results re-intern).
     ``vectorized=True``: fn(*numpy_arrays) -> numpy_array over physical
     values (no VARCHAR support).
+
+    Portability is validated HERE (out-of-process mode): a function that
+    cannot ship to the server — unmarshalable closure, non-wire type —
+    refuses at registration, naming ``[udf] mode = "inproc"``.
     """
     name = name.lower()
     if name in _REGISTRY:
         raise ValueError(f"function {name!r} already exists")
-    arg_types = list(arg_types)
+    spec = UdfSpec(name, fn, tuple(arg_types), return_type,
+                   bool(vectorized))
+    plane = udf_plane()
+    plane.register(spec)
     import jax.numpy as jnp
 
     def impl(datas, masks, out_type):
-        mask = _strict_mask(masks)
-        m = np.asarray(mask)
-        if vectorized:
-            arrs = [np.asarray(d) for d in datas]
-            out = np.asarray(fn(*arrs))
-            return jnp.asarray(out.astype(return_type.np_dtype)), mask
-        arrs = [np.asarray(d) for d in datas]
-        out = np.zeros(len(m), return_type.np_dtype)
-        rows = np.nonzero(m)[0]
-        for r in rows:
-            args = [t.to_python(a[r]) for t, a in zip(arg_types, arrs)]
-            v = fn(*args)
-            out[r] = (return_type.to_physical(v)
-                      if v is not None else return_type.null_sentinel())
-            if v is None:
-                m[r] = False
-        return jnp.asarray(out), jnp.asarray(m)
+        data, mask = plane.call(
+            name,
+            [np.asarray(d) for d in datas],
+            [np.asarray(m) for m in masks])
+        if return_type.is_string:
+            # returned strings intern into THIS process's dictionary
+            phys = np.full(len(mask), return_type.null_sentinel(),
+                           return_type.np_dtype)
+            for i in np.nonzero(mask)[0]:
+                phys[i] = return_type.to_physical(data[i])
+            data = phys
+        return jnp.asarray(data), jnp.asarray(mask)
 
     _REGISTRY[name] = (impl, lambda ts: return_type)
     HOST_CALLBACK_FNS.add(name)
@@ -75,3 +86,4 @@ def drop_udf(name: str) -> None:
     _UDF_NAMES.discard(name)
     HOST_CALLBACK_FNS.discard(name)
     _REGISTRY.pop(name, None)
+    udf_plane().drop(name)

@@ -55,6 +55,35 @@ class SqlError(ValueError):
     pass
 
 
+def _udf_snapshot() -> dict:
+    from ..udf.client import udf_plane
+    return udf_plane().snapshot()
+
+
+def _ast_uses_udf(node) -> bool:
+    """True when a query AST calls a REGISTERED UDF anywhere (generic
+    dataclass walk). Placement routing: such plans build session-local —
+    only this process's UDF plane can resolve the name."""
+    import dataclasses as _dc
+    from ..expr.udf import _UDF_NAMES
+    if not _UDF_NAMES:
+        return False
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (list, tuple)):
+            stack.extend(n)
+            continue
+        if not _dc.is_dataclass(n):
+            continue
+        if isinstance(n, A.FuncCall) and \
+                str(n.name).lower() in _UDF_NAMES:
+            return True
+        for f in _dc.fields(n):
+            stack.append(getattr(n, f.name))
+    return False
+
+
 def _retry_snapshot() -> dict:
     from ..common.retry import GLOBAL_RETRY_METRICS
     return GLOBAL_RETRY_METRICS.snapshot()
@@ -282,6 +311,19 @@ class Session:
         self.fault = (fault_config
                       or (rw_config.fault if rw_config is not None
                           else FaultConfig()))
+        # out-of-process UDF plane (ISSUE 15, docs/robustness.md): the
+        # client boundary is PROCESS-global, so a session only imposes
+        # its [udf] section when one was explicitly given — a plain
+        # Session() must not clobber a plane another session (or a
+        # test/chaos harness) already configured. Servers auto-spawn
+        # lazily at the first UDF call; chaos injection traces persist
+        # under the first data_dir a session offers.
+        from ..udf.client import udf_plane
+        if rw_config is not None:
+            udf_plane().configure(rw_config.udf, trace_dir=data_dir)
+        elif data_dir is not None and udf_plane().trace_dir is None:
+            udf_plane().configure(udf_plane().config, trace_dir=data_dir)
+        self.udf_config = udf_plane().config
         self.catalog = Catalog()
         self.data_dir = data_dir
         if data_dir is not None:
@@ -955,9 +997,15 @@ class Session:
             return []
         self._drain_inflight()   # subscribe at a quiesced epoch boundary
         self.catalog._check_free(stmt.name)   # fail BEFORE building executors
-        if self.workers and not pk_prefix:
+        if self.workers and not pk_prefix \
+                and not _ast_uses_udf(stmt.query):
             # index arrangements always build session-local (they scan
             # session-owned base state); worker placement is for plain MVs.
+            # UDF-projecting plans also stay LOCAL: registered UDFs live
+            # behind THIS process's client plane (udf/client.py) — a
+            # worker process has no registration to resolve the name
+            # against, so shipping the plan would fail at build time
+            # (ISSUE 15; per-worker UDF planes are future work).
             # With ≥2 workers, source-fed plans deploy as CROSS-WORKER
             # fragment graphs (vnode-mapped placement, remote exchange);
             # unsupported shapes fall back to whole-job placement.
@@ -3752,6 +3800,10 @@ class Session:
             # per-site retry counters from every boundary (object store,
             # broker, sink delivery) — common/retry.py global registry
             "retry": _retry_snapshot(),
+            # out-of-process UDF plane (udf/client.py): server
+            # generation, call/retry/respawn/timeout counters, fencing
+            # drops, backpressure peaks
+            "udf": _udf_snapshot(),
             # sink-decouple health: degraded flag, undelivered backlog,
             # delivery failure counters per sink job
             "sinks": {

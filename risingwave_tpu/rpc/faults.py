@@ -19,6 +19,8 @@ Link naming (one string per directed edge):
     w0->s     worker 0's replies / barrier acks / data acks
     w0->w1    worker 0's exchange frames toward worker 1 (exg_data/ack)
     s->c0     compactor control (sync frames), and c0->s its replies
+    s->udf    UDF-plane batches toward the UDF server (udf/client.py),
+              and udf->s its replies (ISSUE 15)
     meta      the meta store's durable txn appends (in-process IO)
 
 Rule matching supports ``fnmatch`` patterns and the shorthand

@@ -626,9 +626,32 @@ def run_traffic_spike(seed: int = 7, data_dir: Optional[str] = None,
 # Crash-point sweep (die at every registered failpoint, audit after each)
 # ---------------------------------------------------------------------------
 
+def _sweep_tax(v):
+    """Module-level so the UDF plane ships it to the server BY REFERENCE
+    (udf/registry.py) — the sweep's UDF workload step."""
+    return v * 2 + 1
+
+
+def _chaos_tax(v):
+    """Module-level → ships to the UDF server by reference (the chaos
+    scenario's and the soak's workload UDF)."""
+    return v * 3 + 7
+
+
+def _ensure_udf(name: str, fn) -> None:
+    """Register a harness UDF once per process (INT64 → INT64)."""
+    from .expr.expr import _REGISTRY
+    if name not in _REGISTRY:
+        from .common.types import INT64
+        from .expr.udf import register_udf
+        register_udf(name, fn, [INT64], INT64)
+
+
 def _sweep_workload_stmts(sink_path: str) -> List[tuple]:
     """(sql, kind) steps: DDL first, then interleaved DML/FLUSH with a
-    mid-stream CREATE (so meta-store txns fire mid-workload too)."""
+    mid-stream CREATE (so meta-store txns fire mid-workload too) and a
+    UDF-evaluating SELECT (so the udf.* client failpoint sites fire
+    mid-workload — ISSUE 15)."""
     steps: List[tuple] = [
         ("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)", "ddl:t"),
         ("CREATE MATERIALIZED VIEW m AS SELECT sum(v) AS n FROM t",
@@ -644,6 +667,8 @@ def _sweep_workload_stmts(sink_path: str) -> List[tuple]:
             steps.append(
                 ("CREATE MATERIALIZED VIEW m2 AS "
                  "SELECT count(*) AS c FROM t", "ddl:m2"))
+        if i == 6:
+            steps.append(("SELECT k, sweep_tax(v) FROM t", "query"))
     steps.append(("FLUSH", "flush"))
     return steps
 
@@ -669,6 +694,7 @@ def crash_point_sweep(base_dir: str, sites: Optional[List[str]] = None,
     from .common.audit import ConsistencyAuditor
     from .common.failpoint import arm, disarm, registered_sites
 
+    _ensure_udf("sweep_tax", _sweep_tax)
     sites = sites if sites is not None else registered_sites()
     results: Dict[str, dict] = {}
     for i, site in enumerate(sites):
@@ -696,6 +722,12 @@ def crash_point_sweep(base_dir: str, sites: Optional[List[str]] = None,
             for sql, _kind in steps:
                 control.run_sql(sql.replace(sink_chaos, sink_ctl))
             control.flush()
+            if site.startswith("udf."):
+                # the UDF server is process-global and the control's
+                # SELECT just spawned it — tear it down so the ARMED
+                # run exercises udf.spawn (and the others) itself
+                from .udf.client import udf_plane
+                udf_plane().shutdown_server()
             for sql, kind in steps:
                 if kind == "ddl:snk":
                     # arm AFTER setup DDL: the sweep's subject is the
@@ -852,6 +884,266 @@ def crash_point_sweep_spanning(base_dir: str, seed: int = 3,
     return results
 
 
+# ---------------------------------------------------------------------------
+# UDF-plane chaos + soak (ISSUE 15 — the udf link joins the fault estate)
+# ---------------------------------------------------------------------------
+
+def udf_chaos_schedule(seed: int) -> ChaosSchedule:
+    """Seeded faults on the UDF link: dropped call frames (the client's
+    deadline trips → kill + seeded respawn + batch replay), delayed
+    calls, and duplicated replies (the (gen, rid) fence drops the
+    extras). Registration frames are deliberately NOT dropped (types
+    filter) so a respawn's replay always lands — the drop rule models a
+    flaky data path, the respawn protocol is what absorbs it. The drop
+    rule is COUNT-capped below the retry budget (the same discipline
+    the netsplit scenarios apply with bounded windows): per-seq seeded
+    draws can otherwise align with the retry cadence (register/retry
+    alternate seqs) and starve ANY bounded retry ladder — a statement
+    about the schedule, not the plane."""
+    return ChaosSchedule(seed, [
+        ChaosRule(kind="drop", link="s->udf", types=["udf_call"],
+                  prob=0.3, count=3),
+        ChaosRule(kind="delay", link="s->udf", types=["udf_call"],
+                  prob=0.3, delay_ms=5.0),
+        ChaosRule(kind="duplicate", link="udf->s", prob=0.25),
+    ], name="udf_link_chaos")
+
+
+_UDF_T_DDL = "CREATE TABLE ut (k BIGINT PRIMARY KEY, v BIGINT)"
+_UDF_MV = ("CREATE MATERIALIZED VIEW mu AS "
+           "SELECT k, chaos_tax(v) AS tv FROM ut")
+_COSCHED_MV = ("CREATE MATERIALIZED VIEW cq AS "
+               "SELECT auction, count(*) AS n FROM bid GROUP BY auction")
+
+
+def run_udf_chaos(seed: int = 11, data_dir: Optional[str] = None,
+                  ticks: int = 6, kill_at: int = 3,
+                  pipeline_depth: int = 1,
+                  coschedule: bool = False) -> dict:
+    """The UDF link's netsplit-style scenario: run a UDF-projecting MV
+    (plus, optionally, a co-scheduled fused MV under the pipelined tick
+    plane — ``pipeline_depth=2`` + ``coschedule=True`` is the ISSUE 15
+    acceptance composition) under a seeded udf-link ChaosSchedule, with
+    the server SIGKILLed mid-run, then audit bit-exact against a
+    no-chaos control and return the per-link injection trace — the same
+    (seed, workload) reproduces it identically.
+
+    Unlike the exchange netsplits, chaos and control run SEQUENTIALLY:
+    the UDF plane is process-global, so a lockstep control would share
+    the faulty link."""
+    import tempfile
+
+    from .common.audit import ConsistencyAuditor
+    from .common.config import UdfConfig
+    from .frontend.build import BuildConfig
+    from .udf.client import udf_plane
+
+    _ensure_udf("chaos_tax", _chaos_tax)
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_udfchaos_")
+    plane_cfg = UdfConfig(call_timeout_s=2.0, max_retries=4,
+                          spawn_timeout_s=30.0)
+    udf_plane().configure(plane_cfg, trace_dir=data_dir)
+    udf_plane().shutdown_server()     # fresh incarnation under chaos
+    base_stats = dict(udf_plane().snapshot())
+    session_kw: dict = {"pipeline_depth": pipeline_depth}
+    if coschedule:
+        session_kw["config"] = BuildConfig(coschedule=True)
+
+    def workload(run_sql, tick, kill=None):
+        run_sql(_UDF_T_DDL)
+        if coschedule:
+            run_sql(_BID_DDL)
+            run_sql(_COSCHED_MV)
+        run_sql(_UDF_MV)
+        for i in range(ticks):
+            run_sql(f"INSERT INTO ut VALUES ({i + 1}, {100 * (i + 1)})")
+            if kill is not None and i == kill_at:
+                kill()          # SIGKILL the server; next batch respawns
+            tick()
+        run_sql("SELECT k, chaos_tax(v) FROM ut")
+
+    schedule = udf_chaos_schedule(seed)
+    sim = SimCluster(data_dir, seed=seed, kill_rate=0.0,
+                     chaos=schedule, checkpoint_frequency=2,
+                     **session_kw)
+    try:
+        workload(sim.run_sql, sim.tick, kill=udf_plane().kill_server)
+        sim.flush()
+        trace = {k: v for k, v in _collect_trace(data_dir).items()
+                 if k.split("#")[0] in ("s->udf", "udf->s")}
+        injections = dict(plane().injections)
+        cosched_groups = len(
+            sim.session.metrics().get("coschedule") or {})
+        # the chaos phase's plane deltas are final HERE — the control
+        # phase below must not fold into them
+        stats = udf_plane().snapshot()
+        # chaos OFF for the control phase: clear the client plane AND
+        # retire the chaos-era server — it installed the schedule from
+        # RWTPU_CHAOS at spawn, so keeping it would duplicate the
+        # control's replies (the control would not actually be
+        # chaos-free)
+        install(None)
+        os.environ.pop(CHAOS_ENV, None)
+        sim._chaos_env_set = False
+        udf_plane().shutdown_server()
+        control = Session(checkpoint_frequency=2, **session_kw)
+        try:
+            workload(control.run_sql, control.tick)
+            control.flush()
+            mvs = ["mu"] + (["cq"] if coschedule else [])
+            sim.verify_against(control, mvs)
+            report = ConsistencyAuditor(sim.session).audit(
+                control=control)
+            report.assert_ok()
+            return {
+                "scenario": "udf_link_chaos", "seed": seed,
+                "pipeline_depth": pipeline_depth,
+                "coschedule": coschedule,
+                "cosched_groups": cosched_groups,
+                "respawns": stats["respawns"] - base_stats["respawns"],
+                "spawns": stats["spawns"] - base_stats["spawns"],
+                "timeouts": stats["timeouts"] - base_stats["timeouts"],
+                "stale_replies_dropped":
+                    stats["stale_replies_dropped"]
+                    - base_stats["stale_replies_dropped"],
+                "injections": injections,
+                "trace": trace,
+                "rows": len(sim.mv_rows("mu")),
+                "audit": {k: v.get("ok")
+                          for k, v in report.checks.items()},
+            }
+        finally:
+            control.close()
+    finally:
+        sim.close()
+
+
+def run_udf_soak(duration_s: float = 45.0, seed: int = 5,
+                 data_dir: Optional[str] = None,
+                 kill_every: int = 6,
+                 min_ticks: int = 12) -> dict:
+    """Soak seed (ROADMAP item 5's standing gauntlet, first brick): RPC
+    chaos on the worker exchange links (dup + reorder — absorbed by the
+    seq layer, no recovery expected) + periodic UDF-server SIGKILLs +
+    concurrent serving readers (one of them crossing the UDF boundary),
+    all live for ``duration_s``, then a bit-exact audit against a
+    no-chaos control. Returns a SCHEMA-STABLE numeric record shaped for
+    ``BENCH_partial.json`` (`ctl bench trend` folds it as phase
+    ``udf_soak``)."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from .common.audit import ConsistencyAuditor
+    from .common.config import FaultConfig, UdfConfig
+    from .frontend.build import BuildConfig
+    from .udf.client import udf_plane
+
+    _ensure_udf("soak_tax", _chaos_tax)
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_udfsoak_")
+    udf_plane().configure(UdfConfig(call_timeout_s=5.0, max_retries=4),
+                          trace_dir=data_dir)
+    base = dict(udf_plane().snapshot())
+    schedule = ChaosSchedule(seed, [
+        ChaosRule(kind="duplicate", link="w0<->w1", types=["exg_data"],
+                  prob=0.2),
+        ChaosRule(kind="delay", link="w0<->w1",
+                  types=["exg_data:chunk"], prob=0.2, delay_frames=2),
+    ], name="udf_soak")
+    fc = FaultConfig(worker_epoch_timeout_s=60.0,
+                     exchange_keepalive_s=0.0)
+    sim = SimCluster(data_dir, seed=seed, kill_rate=0.0, workers=2,
+                     chaos=schedule, checkpoint_frequency=4,
+                     source_chunk_capacity=64, fault_config=fc,
+                     config=BuildConfig(fragment_parallelism=2))
+    control = None
+    stop = threading.Event()
+    reader_stats = {"queries": 0, "errors": 0}
+
+    def reader() -> None:
+        while not stop.is_set():
+            try:
+                sim.session.run_sql(
+                    "SELECT auction, num FROM q WHERE auction >= 0")
+                sim.session.run_sql("SELECT k, soak_tax(v) FROM ut")
+                reader_stats["queries"] += 2
+            except Exception:  # noqa: BLE001 - counted, asserted == 0
+                reader_stats["errors"] += 1
+            _time.sleep(0.05)
+
+    t0 = _time.monotonic()
+    ticks = 0
+    threads = []
+    try:
+        control = Session(seed=42, source_chunk_capacity=64,
+                          checkpoint_frequency=4)
+        for sess in (sim.session, control):
+            sess.run_sql(_BID_DDL)
+            sess.run_sql(
+                "CREATE MATERIALIZED VIEW q AS SELECT auction, "
+                "count(*) AS num FROM bid GROUP BY auction")
+            sess.run_sql(_UDF_T_DDL)
+            sess.run_sql("CREATE MATERIALIZED VIEW mu AS "
+                         "SELECT k, soak_tax(v) AS tv FROM ut")
+        assert "q" in sim.session._spanning_specs, \
+            "soak MV did not deploy as a spanning graph"
+        threads = [threading.Thread(target=reader, daemon=True)]
+        for t in threads:
+            t.start()
+        while ticks < min_ticks or \
+                _time.monotonic() - t0 < duration_s:
+            sim.run_sql(
+                f"INSERT INTO ut VALUES ({ticks + 1}, {ticks * 11})")
+            control.run_sql(
+                f"INSERT INTO ut VALUES ({ticks + 1}, {ticks * 11})")
+            sim.tick()
+            control.tick()
+            ticks += 1
+            if kill_every and ticks % kill_every == 0:
+                udf_plane().kill_server()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        sim.verify_against(control, ["q", "mu"])
+        report = ConsistencyAuditor(sim.session).audit(control=control)
+        report.assert_ok()
+        wall = _time.monotonic() - t0
+        stats = udf_plane().snapshot()
+        # exchange-link injections happen in the WORKER processes'
+        # planes; the session federates their snapshots in metrics()
+        chaos_m = sim.session.metrics().get("chaos", {})
+        inj = dict(chaos_m.get("injections") or {})
+        for wst in (chaos_m.get("workers") or {}).values():
+            for k, v in (wst.get("injections") or {}).items():
+                inj[k] = inj.get(k, 0) + v
+        return {
+            "seed": seed,
+            "duration_s": round(wall, 3),
+            "ticks": ticks,
+            "rows_per_sec": round(
+                ticks * 64 / wall, 3) if wall > 0 else 0.0,
+            "udf_calls": stats["calls"] - base["calls"],
+            "udf_spawns": stats["spawns"] - base["spawns"],
+            "udf_respawns": stats["respawns"] - base["respawns"],
+            "udf_timeouts": stats["timeouts"] - base["timeouts"],
+            "udf_stale_drops": stats["stale_replies_dropped"]
+            - base["stale_replies_dropped"],
+            "reader_queries": reader_stats["queries"],
+            "reader_errors": reader_stats["errors"],
+            "chaos_injections": sum(inj.values()),
+            "mv_rows": len(sim.mv_rows("q")),
+            "audit_ok": int(all(v.get("ok")
+                                for v in report.checks.values())),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        sim.close()
+        if control is not None:
+            control.close()
+
+
 def main(argv=None) -> int:
     """CLI for replaying seeds: ``python -m risingwave_tpu.sim
     --netsplit q5_exchange_partition --seed 7 [--replay]`` or
@@ -873,6 +1165,18 @@ def main(argv=None) -> int:
                          "(docs/scaling.md)")
     ap.add_argument("--sites", default=None,
                     help="comma-separated failpoint subset for --sweep")
+    ap.add_argument("--udf-chaos", action="store_true",
+                    help="run the UDF-link chaos scenario: seeded "
+                         "drop/delay/duplicate on s->udf plus a server "
+                         "SIGKILL mid-run, audited bit-exact against a "
+                         "no-chaos control (docs/robustness.md)")
+    ap.add_argument("--udf-soak", action="store_true",
+                    help="run the soak seed: RPC chaos + UDF-server "
+                         "kills + serving readers live together, "
+                         "auditor green; emits the BENCH_partial-shaped "
+                         "udf_soak record")
+    ap.add_argument("--duration", type=float, default=45.0,
+                    help="--udf-soak wall-clock duration in seconds")
     args = ap.parse_args(argv)
     if args.netsplit:
         r1 = run_netsplit(args.netsplit, seed=args.seed,
@@ -903,6 +1207,26 @@ def main(argv=None) -> int:
             seed=args.seed,
             data_dir=tempfile.mkdtemp(prefix="rwtpu_spike_"))
         print(json.dumps(res, indent=2, default=str))
+    if args.udf_chaos:
+        r1 = run_udf_chaos(seed=args.seed,
+                           data_dir=tempfile.mkdtemp(
+                               prefix="rwtpu_udfc1_"))
+        print(json.dumps({k: r1[k] for k in
+                          ("scenario", "seed", "respawns", "timeouts",
+                           "injections", "audit")}, indent=2))
+        if args.replay:
+            r2 = run_udf_chaos(seed=args.seed,
+                               data_dir=tempfile.mkdtemp(
+                                   prefix="rwtpu_udfc2_"))
+            assert r1["trace"] == r2["trace"], (
+                "seeded udf-chaos replay diverged:\n"
+                f"run1: {r1['trace']}\nrun2: {r2['trace']}")
+            print(f"replay OK: "
+                  f"{sum(len(v) for v in r1['trace'].values())} "
+                  "injections reproduced identically")
+    if args.udf_soak:
+        res = run_udf_soak(duration_s=args.duration, seed=args.seed)
+        print(json.dumps({"phase": "udf_soak", "record": res}))
     return 0
 
 

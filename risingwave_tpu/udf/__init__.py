@@ -1,0 +1,27 @@
+"""Out-of-process UDF plane (ISSUE 15, docs/robustness.md).
+
+Counterpart of the reference's Arrow-Flight UDF boundary
+(reference: src/udf/src/lib.rs:28 ArrowFlightUdfClient — user functions
+live behind a wire so one slow, hanging, or crashing UDF can never wedge
+an epoch). Layout:
+
+``registry.py``  UdfSpec + the process-global spec registry + function
+                 shipping (by importable reference, or marshaled code
+                 for lambdas — never pickle of user VALUES).
+``runtime.py``   the one sanctioned evaluator of a registered callable
+                 (shared bit-exact by the server and the inproc
+                 degraded mode; rwlint rule ``udf-boundary`` keeps it
+                 the single choke point).
+``client.py``    UdfPlane — spawn/kill/respawn + per-call deadlines +
+                 bounded-retry batch replay + generation fencing +
+                 bounded in-flight backpressure; routes ``expr/udf.py``.
+``server.py``    the standalone server process (`ctl udf serve`, or
+                 auto-spawned by the plane) answering udf_call frames
+                 over rpc/wire.py with common/interchange.py batches.
+"""
+
+from .client import (  # noqa: F401
+    UdfCallError, UdfError, UdfNotPortableError, UdfOverloadedError,
+    UdfServerError, UdfTimeoutError, udf_plane,
+)
+from .registry import UdfSpec, get_udf  # noqa: F401

@@ -1,0 +1,160 @@
+"""UDF specs + function shipping for the out-of-process plane.
+
+A registered UDF is a ``UdfSpec``; the process-global ``UDF_SPECS``
+registry is the replay source for server (re)spawns: every spawn
+replays every live registration, so a freshly respawned server is
+always a function-complete replacement (the "seeded respawn" of
+ISSUE 15).
+
+Function shipping — the ONE place a function crosses a process
+boundary, at REGISTRATION time (batches never carry code, and no user
+VALUE is ever pickled):
+
+* by reference — ``module:qualname`` when the module imports and the
+  attribute resolves back to the very same object (plain ``def``s in
+  importable modules; the spawned server inherits the client's
+  ``sys.path`` so test-local modules resolve too);
+* by code — ``marshal`` of the code object + defaults + closure cells
+  for lambdas/closures. Marshal carries only code and plain data; the
+  server rebuilds the function against a minimal globals namespace
+  (builtins + numpy/math/re/json), so a closure over sockets, sessions
+  or other live state refuses loudly (``UdfNotPortableError``) instead
+  of half-shipping.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import importlib
+import marshal
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ..common.types import DataType
+
+
+class UdfNotPortableError(TypeError):
+    """The function cannot cross the process boundary (unmarshalable
+    closure, unresolvable reference). Register it under
+    ``[udf] mode = "inproc"`` — the documented degraded mode — or move
+    it to an importable module."""
+
+
+@dataclasses.dataclass(frozen=True)
+class UdfSpec:
+    name: str
+    fn: Callable
+    arg_types: Tuple[DataType, ...]
+    return_type: DataType
+    vectorized: bool = False
+
+
+#: process-global registry: name -> UdfSpec. The client plane replays it
+#: into every (re)spawned server; ``expr/udf.py`` register/drop mutate it.
+UDF_SPECS: Dict[str, UdfSpec] = {}
+
+
+def get_udf(name: str) -> UdfSpec:
+    spec = UDF_SPECS.get(name)
+    if spec is None:
+        raise KeyError(f"no registered UDF {name!r}")
+    return spec
+
+
+# -- function shipping --------------------------------------------------------
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def ship_function(fn: Callable) -> dict:
+    """Function → JSON-safe shipping payload (see module docstring)."""
+    mod = getattr(fn, "__module__", None)
+    qn = getattr(fn, "__qualname__", "") or ""
+    # "__main__" names a DIFFERENT module in the server process (its
+    # own entry point) — scripts' functions must ship by code instead
+    if mod and mod != "__main__" and qn and "<" not in qn \
+            and "." not in qn:
+        try:
+            m = importlib.import_module(mod)
+            if getattr(m, qn, None) is fn:
+                return {"how": "ref", "module": mod, "qualname": qn}
+        except ImportError:
+            pass
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        raise UdfNotPortableError(
+            f"{fn!r} has no code object to ship (builtin/partial?); "
+            "use a plain function, or [udf] mode = \"inproc\"")
+    try:
+        payload = {
+            "how": "code",
+            "code": _b64(marshal.dumps(code)),
+            "name": fn.__name__,
+            "defaults": _b64(marshal.dumps(fn.__defaults__)),
+            "closure": _b64(marshal.dumps(tuple(
+                c.cell_contents for c in (fn.__closure__ or ())))),
+        }
+    except ValueError as e:
+        raise UdfNotPortableError(
+            f"UDF {fn.__name__!r} closes over unmarshalable state "
+            f"({e}); move it to an importable module or register it "
+            "under [udf] mode = \"inproc\"") from None
+    return payload
+
+
+def load_function(d: dict) -> Callable:
+    """Shipping payload → callable (server side)."""
+    if d["how"] == "ref":
+        m = importlib.import_module(d["module"])
+        fn = getattr(m, d["qualname"], None)
+        if not callable(fn):
+            raise UdfNotPortableError(
+                f"{d['module']}:{d['qualname']} did not resolve to a "
+                "callable on the server")
+        return fn
+    import builtins
+    import json as _json
+    import math
+    import re as _re
+    import time as _time
+    import types
+
+    import numpy as _np
+    code = marshal.loads(_unb64(d["code"]))
+    defaults = marshal.loads(_unb64(d["defaults"]))
+    cells = tuple(types.CellType(v)
+                  for v in marshal.loads(_unb64(d["closure"])))
+    # code-shipped functions rebuild against a MINIMAL namespace: a
+    # lambda referencing its defining module's other globals must ship
+    # by reference (importable module) instead
+    glb = {"__builtins__": builtins, "np": _np, "numpy": _np,
+           "math": math, "re": _re, "json": _json, "time": _time}
+    return types.FunctionType(code, glb, d["name"], defaults,
+                              cells or None)
+
+
+def spec_to_wire(spec: UdfSpec) -> dict:
+    from ..common.interchange import udf_type_to_wire
+    return {
+        "name": spec.name,
+        "fn": ship_function(spec.fn),
+        "arg_types": [udf_type_to_wire(t) for t in spec.arg_types],
+        "return_type": udf_type_to_wire(spec.return_type),
+        "vectorized": spec.vectorized,
+    }
+
+
+def spec_from_wire(d: dict) -> UdfSpec:
+    from ..common.interchange import udf_type_from_wire
+    return UdfSpec(
+        name=d["name"],
+        fn=load_function(d["fn"]),
+        arg_types=tuple(udf_type_from_wire(t) for t in d["arg_types"]),
+        return_type=udf_type_from_wire(d["return_type"]),
+        vectorized=bool(d["vectorized"]),
+    )

@@ -1,0 +1,91 @@
+"""The one sanctioned evaluator of a registered UDF callable.
+
+Both sides of the wire run THIS code — the server on decoded wire
+batches, the inproc degraded mode on the same decoded host columns — so
+out-of-process results are bit-exact vs in-process by construction:
+there is exactly one strict-NULL / type-conversion implementation.
+
+The ``udf-boundary`` rwlint rule (analysis/rules_boundary.py) enforces
+the choke point: no module outside this file and ``udf/server.py`` may
+call ``eval_udf_batch`` (the client's inproc path carries the one
+reasoned allow), and nothing may invoke a registry spec's ``.fn``
+directly.
+
+Column convention (host, LOGICAL):
+  * fixed-width arguments/results are numpy arrays in the physical
+    encoding (DECIMAL = scaled int64, BOOL = bool, ...);
+  * string-typed arguments/results are object arrays of ``str``/None —
+    decoded BEFORE this layer (dictionary ids never cross it);
+  * masks are numpy bool arrays; strict-NULL means any NULL argument
+    yields NULL without calling the function, and a function returning
+    None yields NULL.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .registry import UdfSpec
+
+
+def strict_mask(masks: Sequence[np.ndarray]) -> np.ndarray:
+    m = np.asarray(masks[0], dtype=bool).copy()
+    for mm in masks[1:]:
+        m &= np.asarray(mm, dtype=bool)
+    return m
+
+
+def eval_udf_batch(spec: UdfSpec, datas: Sequence[np.ndarray],
+                   masks: Sequence[np.ndarray]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Evaluate one columnar batch. Returns ``(data, mask)`` in the
+    column convention above."""
+    m = strict_mask(masks)
+    rt = spec.return_type
+    if spec.vectorized:
+        # vectorized contract (unchanged from the in-process original):
+        # fn(*numpy_arrays) over PHYSICAL values, full arrays in — the
+        # strict mask applies to the result, not the inputs. No VARCHAR.
+        out = np.asarray(spec.fn(*[np.asarray(d) for d in datas]))
+        return out.astype(rt.np_dtype), m
+    n = len(m)
+    if rt.is_string:
+        out: np.ndarray = np.empty(n, dtype=object)
+        out.fill(None)
+    else:
+        out = np.full(n, rt.null_sentinel(), rt.np_dtype)
+    rows = np.nonzero(m)[0]
+    for r in rows:
+        args = [a[r] if t.is_string else t.to_python(a[r])
+                for t, a in zip(spec.arg_types, datas)]
+        v = spec.fn(*args)
+        if v is None:
+            m[r] = False
+        elif rt.is_string:
+            out[r] = v if isinstance(v, str) else v.decode()
+        else:
+            out[r] = rt.to_physical(v)
+    return out, m
+
+
+def decode_string_args(spec: UdfSpec, datas: Sequence[np.ndarray],
+                       masks: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Physical host columns → the column convention: string-typed args
+    decode dictionary ids to object arrays of str (masked-out slots
+    stay None — their ids are sentinels, not lookups). Runs CLIENT-side
+    in both modes, so the wire and the inproc path see identical
+    inputs."""
+    out: List[np.ndarray] = []
+    for t, d, mk in zip(spec.arg_types, datas, masks):
+        d = np.asarray(d)
+        if t.is_string and d.dtype != object:
+            mk = np.asarray(mk, dtype=bool)
+            dec = np.empty(len(mk), dtype=object)
+            dec.fill(None)
+            for i in np.nonzero(mk)[0]:
+                dec[i] = t.to_python(d[i])
+            d = dec
+        out.append(d)
+    return out

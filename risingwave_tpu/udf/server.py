@@ -1,0 +1,174 @@
+"""Standalone UDF server process.
+
+Counterpart of the reference's external UDF server behind the
+Arrow-Flight boundary (reference: src/udf/src/lib.rs:28 — user code in
+its own process, batches over the wire). Launched by
+``ctl udf serve [--port N]`` for an operator-managed server, or
+auto-spawned by the client plane (udf/client.py) one per client
+process.
+
+Protocol (length-prefixed JSON frames, rpc/wire.py; every frame carries
+the client's generation token ``gen`` which replies echo — the client
+drops replies whose (gen, rid) don't match its current request, so a
+stale or chaos-duplicated reply can never be taken for a fresh one):
+
+    c → s   {"type":"udf_register","rid","gen","spec": spec_to_wire()}
+    c → s   {"type":"udf_call","rid","gen","name",
+             "batch": udf_batch_to_wire()}
+    s → c   {"type":"reply","rid","gen","ok":true,"result": col} |
+            {"type":"reply","rid","gen","ok":false,"error",
+             "error_kind":"user"|"server"}
+    c → s   {"type":"udf_drop","rid","gen","name"}
+    c → s   {"type":"shutdown","rid","gen"}
+
+A user function that raises replies ``error_kind: "user"`` — the client
+surfaces it as a typed statement error WITHOUT burning respawn+replay
+cycles (a deterministic exception would just recur). A function that
+hangs or busy-loops simply never replies: the client's per-call
+deadline kills this process and respawns it. Deliberately NO in-server
+watchdog — the whole point of the plane is that the CLIENT owns the
+robustness contract, so even ``os._exit``-hostile user code is covered.
+
+Evaluation is intentionally inline on the event loop: one batch at a
+time, in arrival order, so replay after a respawn is deterministic.
+Replies ride the ``udf->s`` fault-plane link; the server adopts the
+spawning process's chaos schedule via the RWTPU_CHAOS env (like worker
+processes) and arms RWTPU_FAILPOINTS — an "exit" action at
+``udf.server.eval`` is the deterministic kill-mid-batch the chaos tests
+use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..common.failpoint import fail_point
+from ..common.interchange import udf_col_to_wire, wire_to_udf_batch
+from ..rpc.wire import read_frame, write_frame
+from .registry import UdfSpec, spec_from_wire
+from .runtime import eval_udf_batch
+
+REPLY_LINK = "udf->s"
+
+
+class UdfHost:
+    """One server process: spec table + frame loop."""
+
+    def __init__(self) -> None:
+        self.specs: Dict[str, UdfSpec] = {}
+        self.stats = {"registered": 0, "calls": 0, "rows": 0,
+                      "user_errors": 0}
+
+    def handle_register(self, frame: dict) -> dict:
+        spec = spec_from_wire(frame["spec"])
+        self.specs[spec.name] = spec
+        self.stats["registered"] += 1
+        return {"ok": True}
+
+    def handle_drop(self, frame: dict) -> dict:
+        self.specs.pop(frame.get("name"), None)
+        return {"ok": True}
+
+    def handle_call(self, frame: dict) -> dict:
+        spec = self.specs.get(frame.get("name"))
+        if spec is None:
+            return {"ok": False, "error_kind": "server",
+                    "error": f"UDF {frame.get('name')!r} is not "
+                             "registered on this server"}
+        fail_point("udf.server.eval")
+        datas, masks = wire_to_udf_batch(frame["batch"], spec.arg_types)
+        try:
+            data, mask = eval_udf_batch(spec, datas, masks)
+        except Exception as e:  # noqa: BLE001 - user code; shipped back typed
+            self.stats["user_errors"] += 1
+            return {"ok": False, "error_kind": "user",
+                    "error": f"{type(e).__name__}: {e}"}
+        self.stats["calls"] += 1
+        self.stats["rows"] += int(frame["batch"].get("n") or 0)
+        return {"ok": True,
+                "result": udf_col_to_wire(data, mask, spec.return_type)}
+
+    async def handle_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break                      # client went away
+                t = frame.get("type")
+                if t == "udf_call":
+                    resp = self.handle_call(frame)
+                elif t == "udf_register":
+                    try:
+                        resp = self.handle_register(frame)
+                    except Exception as e:  # noqa: BLE001 - shipped back
+                        resp = {"ok": False, "error_kind": "server",
+                                "error": f"{type(e).__name__}: {e}"}
+                elif t == "udf_drop":
+                    resp = self.handle_drop(frame)
+                elif t == "stats":
+                    resp = {"ok": True, "udf": dict(self.stats)}
+                elif t == "shutdown":
+                    await self._reply(writer, frame, {"ok": True})
+                    break
+                else:
+                    resp = {"ok": False, "error_kind": "server",
+                            "error": f"unknown frame {t!r}"}
+                await self._reply(writer, frame, resp)
+        finally:
+            writer.close()
+
+    @staticmethod
+    async def _reply(writer, frame: dict, resp: dict) -> None:
+        resp.update({"type": "reply", "rid": frame.get("rid"),
+                     "gen": frame.get("gen")})
+        await write_frame(writer, resp, link=REPLY_LINK)
+
+
+async def amain(port: int, trace_path: Optional[str] = None,
+                persistent: bool = False) -> None:
+    from ..common.failpoint import arm_from_env
+    from ..rpc.faults import install_from_env
+    install_from_env(trace_path=trace_path)
+    arm_from_env()
+    host = UdfHost()
+    done = asyncio.Event()
+
+    async def conn(reader, writer):
+        try:
+            await host.handle_conn(reader, writer)
+        finally:
+            # auto-spawned servers are one-client: losing it ends the
+            # process (the plane respawns a fresh one when needed). A
+            # `ctl udf serve` operator server is persistent — clients
+            # come and go, registrations outlive any one of them.
+            if not persistent:
+                done.set()
+
+    server = await asyncio.start_server(conn, "127.0.0.1", port)
+    actual = server.sockets[0].getsockname()[1]
+    print(f"UDF_READY {actual}", flush=True)
+    async with server:
+        await done.wait()
+
+
+def main(argv=None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="out-of-process UDF evaluation server "
+                    "(docs/robustness.md)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--trace-path", default=None,
+                    help="persist chaos injection traces here "
+                         "(rpc/faults.py; inherited schedules only)")
+    ap.add_argument("--persistent", action="store_true",
+                    help="serve successive clients instead of exiting "
+                         "when one disconnects (ctl udf serve)")
+    args = ap.parse_args(argv)
+    asyncio.run(amain(args.port, args.trace_path,
+                      persistent=args.persistent))
+
+
+if __name__ == "__main__":
+    main()

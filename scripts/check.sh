@@ -202,6 +202,26 @@ python -m risingwave_tpu.sim --netsplit exchange_dup_reorder \
 python -m risingwave_tpu.sim --sweep \
     --sites checkpoint.segment.write,checkpoint.commit,sink.deliver,meta.store.txn
 
+echo "== UDF isolation plane (out-of-process user code, fast tier) =="
+# wire codecs, function shipping, bit-exact parity inproc vs process,
+# restart semantics (deadline trip, deterministic kill -9 mid-batch,
+# reply-after-fence, typed errors, backpressure) — docs/robustness.md
+python -m pytest -q -p no:cacheprovider \
+    tests/test_udf_plane.py -m 'not slow' \
+    "$@"
+
+echo "== UDF chaos / soak (server kills + auditor + soak seed — tier-2) =="
+# the seeded udf-link chaos scenario + replay determinism, the
+# kill-mid-epoch acceptance run under pipeline_depth=2 with a
+# co-scheduled group, the crash-point sweep over the udf.* sites,
+# ctl udf serve external attach, and the ~60s soak composition (RPC
+# chaos + UDF-server kills + serving readers, auditor green) whose
+# record feeds `ctl bench trend` — slow-marked out of tier-1 per the
+# 870s wall budget
+python -m pytest -q -p no:cacheprovider -m slow \
+    tests/test_udf_plane.py \
+    "$@"
+
 echo "== rwlint (AST invariant checker, docs/static-analysis.md) =="
 # One AST-grounded pass replaces the five historical grep lints
 # (exchange-boundary, wire-boundary, placement-mutation,

@@ -291,10 +291,32 @@ class TestFailpointRegistry:
         findings, counts, package = lint_package(rules=[rule])
         declared, _, _ = rule.declared(package)
         assert {"checkpoint.prepare", "checkpoint.commit"} <= declared
+        # the UDF plane's sites joined the registry (ISSUE 15)
+        assert {"udf.spawn", "udf.call", "udf.reply", "udf.respawn",
+                "udf.server.eval"} <= declared
         # the lint's static parse of the literal must agree with the
         # runtime mirror
         assert declared == set(declared_sites())
         assert counts["failpoint-honesty"] == 0, findings
+
+    def test_arming_undeclared_site_refuses(self):
+        """Registry hygiene (ISSUE 15 satellite): arming a site that is
+        not in the declared registry used to succeed silently and never
+        fire — a typo'd test proved nothing, and a future plane could
+        add sites the crash-point sweep never iterates. Now it refuses
+        loudly, both directly and via the contextmanager."""
+        import pytest as _pytest
+        from risingwave_tpu.common.failpoint import (
+            arm, disarm, failpoints,
+        )
+        with _pytest.raises(ValueError, match="not a declared site"):
+            arm("udf.totally_bogus", OSError)
+        with _pytest.raises(ValueError, match="not a declared site"):
+            with failpoints(**{"nope.nope": OSError}):
+                pass
+        # declared sites still arm/disarm fine
+        arm("udf.call", OSError, once=True)
+        disarm("udf.call")
 
     def test_meta_store_txn_failpoint_keeps_atomicity(self, tmp_path):
         from risingwave_tpu.common.failpoint import failpoints

@@ -815,6 +815,80 @@ class TestSuppressions:
         assert lint_fixture(tmp_path, files, ["exchange-boundary"]) == []
 
 
+UDF_STUB = {
+    "udf/__init__.py": "",
+    "udf/runtime.py": """
+        def eval_udf_batch(spec, datas, masks):
+            return spec.fn(*datas)
+        """,
+    "udf/registry.py": """
+        UDF_SPECS = {}
+
+        def get_udf(name):
+            return UDF_SPECS[name]
+        """,
+}
+
+
+class TestUdfBoundary:
+    def test_direct_eval_in_tick_module_caught(self, tmp_path):
+        files = dict(UDF_STUB)
+        files["stream/rogue.py"] = """
+            from ..udf.runtime import eval_udf_batch as ev
+
+            def on_chunk(spec, datas, masks):
+                return ev(spec, datas, masks)
+            """
+        found = lint_fixture(tmp_path, files, ["udf-boundary"])
+        assert [f.rule for f in found] == ["udf-boundary"]
+        assert found[0].path == "stream/rogue.py"
+
+    def test_server_side_eval_exempt(self, tmp_path):
+        files = dict(UDF_STUB)
+        files["udf/server.py"] = """
+            from .runtime import eval_udf_batch
+
+            def handle_call(spec, datas, masks):
+                return eval_udf_batch(spec, datas, masks)
+            """
+        assert lint_fixture(tmp_path, files, ["udf-boundary"]) == []
+
+    def test_registry_callable_grab_caught(self, tmp_path):
+        files = dict(UDF_STUB)
+        files["batch/rogue.py"] = """
+            from ..udf.registry import UDF_SPECS, get_udf
+
+            def fast_path(v):
+                direct = get_udf("tax").fn(v)
+                return direct + UDF_SPECS["tax"].fn(v)
+            """
+        found = lint_fixture(tmp_path, files, ["udf-boundary"])
+        assert len(found) == 2
+        assert all(f.path == "batch/rogue.py" for f in found)
+
+    def test_docstring_mention_not_flagged(self, tmp_path):
+        files = dict(UDF_STUB)
+        files["stream/clean.py"] = '''
+            """Never call eval_udf_batch(spec, ...) on the tick path."""
+
+            def on_chunk(call_boundary, batch):
+                return call_boundary(batch)
+            '''
+        assert lint_fixture(tmp_path, files, ["udf-boundary"]) == []
+
+    def test_real_package_clean_with_exactly_one_reasoned_allow(self):
+        """The shipped package carries exactly ONE udf-boundary allow —
+        the client's opt-in inproc evaluator — and lints clean."""
+        findings, counts, _ = lint_package(
+            rules=[RULES["udf-boundary"]])
+        assert counts["udf-boundary"] == 0, findings
+        src = (package_root() / "udf" / "client.py").read_text()
+        allows = [ln for ln in src.splitlines()
+                  if "rwlint: allow(udf-boundary)" in ln]
+        assert len(allows) == 1
+        assert "inproc" in allows[0]    # the reason names the mode
+
+
 class TestWiring:
     def test_package_lints_clean_within_budget(self):
         """Tier-1: the whole package is rwlint-clean, and the full run
