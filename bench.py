@@ -978,6 +978,10 @@ def measure_barrier_latency(in_flight: int = 1) -> dict:
         s.tick()
     s._drain_inflight()
     snap = s.barrier_latency.snapshot()
+    # per-stage waterfall percentiles from the barrier ledger (ISSUE 16)
+    # ride along so the trend record shows WHERE latency moved, not just
+    # that it moved
+    snap["stages"] = s._barrier_ledger.stage_percentiles()
     s.close()
     return snap
 
@@ -1203,6 +1207,10 @@ def run_phase(n_chunks: int, q7_chunks: int, q8_chunks: int,
     lat = measure_barrier_latency(in_flight=1)
     out["p99_barrier_ms"] = lat.get("p99_ms")
     out["p50_barrier_ms"] = lat.get("p50_ms")
+    for stage in ("inject", "pending", "collect", "commit"):
+        pct = (lat.get("stages") or {}).get(stage) or {}
+        out[f"barrier_{stage}_p50_ms"] = pct.get("p50_ms")
+        out[f"barrier_{stage}_p99_ms"] = pct.get("p99_ms")
     lat4 = measure_barrier_latency(in_flight=4)
     out["p99_barrier_ms_inflight4"] = lat4.get("p99_ms")
     _emit(out)
@@ -1472,6 +1480,14 @@ _SHARED_FIELDS = (
     "pipeline_on_p50_barrier_ms", "pipeline_on_p99_barrier_ms",
     "pipeline_off_p50_barrier_ms", "pipeline_off_p99_barrier_ms",
     "p99_barrier_ms", "p50_barrier_ms", "p99_barrier_ms_inflight4",
+    # barrier-observatory waterfall (common/barrier_ledger.py): per-stage
+    # p50/p99 over the same measured window, present on every backend (a
+    # Session-level CPU measurement) so the fallback record stays
+    # schema-stable
+    "barrier_inject_p50_ms", "barrier_inject_p99_ms",
+    "barrier_pending_p50_ms", "barrier_pending_p99_ms",
+    "barrier_collect_p50_ms", "barrier_collect_p99_ms",
+    "barrier_commit_p50_ms", "barrier_commit_p99_ms",
     # mesh-sharded fused epochs (ops/fused_sharded.py): aggregate rows/s
     # + shard counts — the whole ladder (q5/q7/q8/q3 + the K×S
     # co-scheduled group, PR 13) — present on EVERY backend so the

@@ -135,7 +135,11 @@ def main(argv=None) -> int:
                      "over the checked-in BENCH_r*.json records), and "
                      "`ctl udf` (serve — run a standalone out-of-process "
                      "UDF server in the foreground; sessions attach via "
-                     "[udf] addr = \"host:port\" — docs/robustness.md)")
+                     "[udf] addr = \"host:port\" — docs/robustness.md), "
+                     "and `ctl trace` (barrier — the barrier "
+                     "observatory's per-epoch waterfall history and "
+                     "stage percentiles; add --inflight for live "
+                     "stuck-barrier blame — docs/observability.md)")
     ctl.add_argument("job", nargs="?", default=None,
                      help="job name for `ctl cluster rescale`")
     ctl.add_argument("--parallelism", type=int, default=None,
@@ -149,8 +153,12 @@ def main(argv=None) -> int:
                      help="udf serve: listen port (0 = ephemeral, "
                      "printed as UDF_READY <port>)")
     ctl.add_argument("--json", action="store_true",
-                     help="profile/bench: emit the full JSON report "
-                     "instead of the table")
+                     help="profile/bench/trace barrier: emit the full "
+                     "JSON report instead of the table")
+    ctl.add_argument("--inflight", action="store_true",
+                     help="trace barrier: walk the LIVE in-flight "
+                     "barrier accounting and name the actors/links "
+                     "that have not acked (stuck-barrier blame)")
     ctl.add_argument("--peak-flops", type=float, default=None,
                      help="profile roofline: chip peak FLOP/s "
                      "(default [observability] chip_peak_flops)")
@@ -670,10 +678,65 @@ def _ctl_dispatch(args, session, _json) -> None:
     elif args.what == "metrics":
         print(_json.dumps(session.metrics(), indent=2, default=str))
     elif args.what == "trace":
+        if args.sub == "barrier":
+            _ctl_trace_barrier(args, session, _json)
+            return
         # await_tree() federates worker-hosted jobs' trees (and takes the
         # API lock) — a bare dump_session would print them as
         # "<remote; no stats snapshot yet>"
         print(session.await_tree())
+
+
+def _ctl_trace_barrier(args, session, _json) -> None:
+    """`ctl trace barrier [--inflight] [--json]`: the barrier
+    observatory over a live session — waterfall history + per-stage
+    percentiles, or (--inflight) live stuck-barrier blame naming the
+    exact actors/links that have not acked (docs/observability.md)."""
+    from .common.barrier_ledger import ALL_STAGES
+    ledger = session._barrier_ledger
+    if args.json:
+        out = {"history": ledger.history(),
+               "stages": ledger.stage_percentiles(),
+               "summary": ledger.summary()}
+        if args.inflight:
+            out["inflight"] = session.barrier_blame()
+        print(_json.dumps(out, indent=2, default=str))
+        return
+    if args.inflight:
+        findings = session.barrier_blame()
+        if not findings:
+            print("no in-flight barriers (nothing to blame)")
+            return
+        print("epoch\tage_ms\tkind\tjob\tworker\tactor\tlink\treason")
+        for f in findings:
+            age = "" if f["age_ms"] is None else f"{f['age_ms']:.1f}"
+            actor = "" if f["actor"] is None else \
+                f"f{f['fragment']}a{f['actor']}"
+            print(f"{f['epoch']}\t{age}\t{f['kind']}\t"
+                  f"{f['job'] or ''}\t{f['worker']}\t{actor}\t"
+                  f"{f['link'] or ''}\t{f['reason']}")
+        return
+    history = ledger.history()
+    if not history:
+        print("no completed barriers in the history ring")
+        return
+    print("epoch\tckpt\tresult\ttotal_ms\t"
+          + "\t".join(f"{s}_ms" for s in ALL_STAGES))
+    for rec in history:
+        stages = rec["stages"]
+        cells = "\t".join(
+            f"{stages[s]:.2f}" if s in stages else "-"
+            for s in ALL_STAGES)
+        print(f"{rec['epoch']}\t{'y' if rec['checkpoint'] else 'n'}\t"
+              f"{rec['result']}\t{rec['total_ms']:.2f}\t{cells}")
+    print()
+    print("stage\tp50_ms\tp99_ms\tn")
+    percentiles = ledger.stage_percentiles()
+    for stage in ALL_STAGES:
+        pct = percentiles.get(stage)
+        if pct is None:
+            continue
+        print(f"{stage}\t{pct['p50_ms']}\t{pct['p99_ms']}\t{pct['n']}")
 
 
 def _playground(args) -> int:

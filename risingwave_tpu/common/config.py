@@ -285,6 +285,14 @@ class ObservabilityConfig:
     # defaults: 4096 spans, 0.0 = detector off)
     trace_ring_capacity: Optional[int] = None
     slow_epoch_threshold_ms: Optional[float] = None
+    # barrier observatory (common/barrier_ledger.py): how many sealed
+    # per-barrier waterfall records the history ring retains
+    # (rw_catalog.rw_barrier_history, ctl trace barrier)
+    barrier_history_capacity: int = 256
+    # slow-epoch capture ring: how many offending epochs' span-tree +
+    # waterfall captures Session.slow_epochs() retains (was a hardcoded
+    # 16 before the [observability] knob existed)
+    slow_epoch_capture_capacity: int = 16
     # cluster-wide HBM ledger: resident state + analyzed peak temp
     # bytes are charged against this capacity (default 16 GiB ≈ one
     # v5e chip); a job reaching hbm_warn_fraction of it is flagged

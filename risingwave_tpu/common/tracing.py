@@ -153,14 +153,19 @@ def trace_span(name: str, cat: str = CAT_BARRIER,
 # -- Chrome trace-event export ------------------------------------------------
 
 def to_chrome_trace(spans: Iterable[Span],
-                    process_names: Optional[dict] = None) -> dict:
+                    process_names: Optional[dict] = None,
+                    barrier_records: Optional[Iterable[dict]] = None,
+                    ) -> dict:
     """Spans → Chrome trace-event JSON object (Perfetto-loadable).
 
     Every span becomes a complete ("X") event; epoch spans live on the
     ``conductor`` track and executor spans on per-identity tracks, so one
     epoch renders as a timeline across executors. Timestamps are
     microseconds relative to the earliest span so the viewer opens at
-    t=0."""
+    t=0. ``barrier_records`` (BarrierLedger waterfall records) render as
+    flow events ("s"/"t"/"f", one flow id per epoch) arrowing each
+    barrier from its conductor injection through every participating
+    worker's collect back to completion."""
     spans = sorted(spans, key=lambda s: s.ts)
     base = spans[0].ts if spans else 0.0
     events: list[dict] = []
@@ -177,11 +182,50 @@ def to_chrome_trace(spans: Iterable[Span],
             "dur": round(s.dur * 1e6, 3),
             "pid": s.pid, "tid": s.tid, "args": args,
         })
+    events.extend(barrier_flow_events(barrier_records or (), base, names))
     meta: list[dict] = []
     for pid, pname in sorted(names.items()):
         meta.append({"name": "process_name", "ph": "M", "pid": pid,
                      "tid": "", "args": {"name": pname}})
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def barrier_flow_events(records: Iterable[dict], base: float,
+                        names: Optional[dict] = None) -> list[dict]:
+    """BarrierLedger waterfall records → Chrome flow events.
+
+    One flow per barrier (id = epoch): start ("s") on the conductor
+    track at injection, a step ("t") on each participating worker's
+    conductor track at its collect, finish ("f") back on the conductor
+    at completion — Perfetto draws the barrier's cluster-wide path as
+    arrows across process lanes."""
+    out: list[dict] = []
+    for rec in records:
+        t0 = rec.get("injected_at")
+        total_ms = rec.get("total_ms")
+        if t0 is None or total_ms is None:
+            continue          # an in-flight record has no finish yet
+        epoch = rec["epoch"]
+        common = {"name": f"barrier {epoch}", "cat": CAT_EPOCH,
+                  "id": epoch, "tid": "conductor"}
+        out.append({**common, "ph": "s", "pid": 0,
+                    "ts": round((t0 - base) * 1e6, 3),
+                    "args": {"epoch": epoch,
+                             "checkpoint": rec.get("checkpoint")}})
+        for wid, stages in sorted(rec.get("workers", {}).items()):
+            if int(wid) < 0:
+                continue      # session-process detail stays on pid 0
+            pid = int(wid) + 1
+            if names is not None and pid not in names:
+                names[pid] = f"worker-{wid}"
+            wc = stages.get("worker_collect", 0.0)
+            out.append({**common, "ph": "t", "pid": pid,
+                        "ts": round((t0 - base) * 1e6 + wc * 1e3, 3),
+                        "args": {"epoch": epoch}})
+        out.append({**common, "ph": "f", "bp": "e", "pid": 0,
+                    "ts": round((t0 - base) * 1e6 + total_ms * 1e3, 3),
+                    "args": {"epoch": epoch, "result": rec.get("result")}})
+    return out
 
 
 def export_chrome_trace(spans: Iterable[Span],

@@ -45,6 +45,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <h2>cluster</h2><pre id="cluster">loading…</pre>
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>exchange edges</h2><pre id="exchange">loading…</pre>
+<h2>barriers</h2><pre id="barriers">loading…</pre>
 <h2>serving plane</h2><pre id="serving">loading…</pre>
 <h2>scaling</h2><pre id="scaling">loading…</pre>
 <h2>chaos / fault plane</h2><pre id="chaos">loading…</pre>
@@ -67,6 +68,8 @@ async function loadStorage() {
     JSON.stringify(m.storage || {}, null, 2);
   document.getElementById("exchange").textContent =
     JSON.stringify(m.exchange || [], null, 2);
+  document.getElementById("barriers").textContent =
+    JSON.stringify(m.barrier || {}, null, 2);
   document.getElementById("serving").textContent =
     JSON.stringify(m.serving || {}, null, 2);
   document.getElementById("scaling").textContent =

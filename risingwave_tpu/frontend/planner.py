@@ -332,11 +332,16 @@ class Planner:
     """Plans one SELECT against the catalog. ``fresh`` — hidden-column name
     uniquifier shared across nested planners."""
 
-    def __init__(self, catalog: Catalog, lenient: bool = False):
+    def __init__(self, catalog: Catalog, lenient: bool = False,
+                 session=None):
         # lenient = DDL replay during recovery: rules tightened after a
         # statement was logged must WARN, not make the store unloadable
         self.catalog = catalog
         self.lenient = lenient
+        # live Session backing the rw_catalog telemetry relations; None
+        # in session-less contexts (describe, DDL replay) — builders
+        # then return their schema with no rows
+        self.session = session
 
     # -- entry ----------------------------------------------------------------
 
@@ -451,7 +456,8 @@ class Planner:
         # resolve before user relations, served as constant VALUES from
         # the live catalog (reference: frontend system_catalog/)
         from .system_catalog import system_relation
-        sysrel = system_relation(self.catalog, ref.name)
+        sysrel = system_relation(self.catalog, ref.name,
+                                 session=self.session)
         if sysrel is not None:
             schema, rows = sysrel
             lit_rows = tuple(

@@ -45,6 +45,34 @@ def render_metrics(session) -> str:
         if v is not None:
             lines.append(
                 f'rw_barrier_latency_ms{{quantile="{q}"}} {v}')
+    barrier = m.get("barrier") or {}
+    if barrier:
+        lines += ["# HELP rw_barrier_stage_seconds Per-stage barrier "
+                  "waterfall percentile over the ledger history ring "
+                  "(common/barrier_ledger.py stage vocabulary).",
+                  "# TYPE rw_barrier_stage_seconds gauge"]
+        for stage, pct in sorted((barrier.get("stages") or {}).items()):
+            for key, q in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
+                v = pct.get(key)
+                if v is not None:
+                    lines.append(
+                        f'rw_barrier_stage_seconds'
+                        f'{{stage="{_sanitize(stage)}",quantile="{q}"}} '
+                        f'{round(v / 1e3, 6)}')
+        lines += ["# HELP rw_barrier_inflight Barriers injected but not "
+                  "yet fully collected (the async pipeline's in-flight "
+                  "window occupancy).",
+                  "# TYPE rw_barrier_inflight gauge",
+                  f'rw_barrier_inflight {barrier.get("inflight", 0)}',
+                  "# HELP rw_barrier_total Barriers completed by result "
+                  "(ok = collected + committed, failed = a job died "
+                  "during collection).",
+                  "# TYPE rw_barrier_total counter"]
+        totals = barrier.get("total") or {}
+        for result in ("ok", "failed"):
+            lines.append(
+                f'rw_barrier_total{{result="{result}"}} '
+                f'{totals.get(result, 0)}')
     lines += ["# HELP rw_executor_counter Per-executor streaming counters.",
               "# TYPE rw_executor_counter counter"]
     for job, pipeline in (m.get("jobs") or {}).items():

@@ -465,14 +465,18 @@ class RemoteWorker:
         await self.send(frame)
 
     async def get_stats(self, timeout: float = 10.0,
-                        span_ack: Optional[int] = None) -> dict:
+                        span_ack: Optional[int] = None,
+                        stage_ack: Optional[int] = None) -> dict:
         """Fetch this worker's monitor snapshot (executor trees, counters,
-        queue depths, state bytes, tracing spans). ``span_ack`` echoes the
-        last ``span_seq`` this session processed so the worker can discard
-        its retained span batch (a timed-out reply is resent, not lost)."""
+        queue depths, state bytes, tracing spans, barrier stage events).
+        ``span_ack``/``stage_ack`` echo the last ``span_seq``/``stage_seq``
+        this session processed so the worker can discard its retained
+        batches (a timed-out reply is resent, not lost)."""
         req: dict = {"type": "stats"}
         if span_ack is not None:
             req["span_ack"] = span_ack
+        if stage_ack is not None:
+            req["stage_ack"] = stage_ack
         return await asyncio.wait_for(self.request(req, meta=True),
                                       timeout)
 

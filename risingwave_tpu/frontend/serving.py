@@ -58,6 +58,35 @@ class _Retired(Exception):
     """Internal: the entry died (catalog bump raced the lookup)."""
 
 
+def _references_system_relation(sel) -> bool:
+    """True iff the SELECT's FROM tree (joins, TVFs, subqueries, UNION
+    ALL branches included) names a system-catalog relation. Those
+    queries must NEVER enter the plan cache: their VALUES rows are
+    materialized telemetry at plan time, and no data-version seqlock
+    invalidates a stale snapshot of them."""
+    from . import sqlast as A
+    from .system_catalog import SYSTEM_RELATION_NAMES
+
+    def _rel(rel) -> bool:
+        if rel is None:
+            return False
+        if isinstance(rel, A.TableRef):
+            return rel.name.lower() in SYSTEM_RELATION_NAMES
+        if isinstance(rel, A.Join):
+            return _rel(rel.left) or _rel(rel.right)
+        if isinstance(rel, A.WindowTVF):
+            return _rel(rel.table)
+        if isinstance(rel, A.SubqueryRef):
+            return _sel(rel.query)
+        return False
+
+    def _sel(s) -> bool:
+        return _rel(s.from_) or (s.union_all is not None
+                                 and _sel(s.union_all))
+
+    return _sel(sel)
+
+
 class ServingStats:
     """Thread-safe counters + a latency ring for p50/p99."""
 
@@ -73,6 +102,7 @@ class ServingStats:
         self.partials_merged = 0       # partial state rows folded
         self.fallbacks = 0             # BatchFallback → single-phase
         self.locked_reads = 0          # reads that needed the API lock
+        self.system_catalog_reads = 0  # rw_catalog/pg_catalog bypasses
         self.task_workers: collections.Counter = collections.Counter()
         self._lat = collections.deque(maxlen=window)
 
@@ -109,6 +139,7 @@ class ServingStats:
                 "partials_merged": self.partials_merged,
                 "fallbacks": self.fallbacks,
                 "locked_reads": self.locked_reads,
+                "system_catalog_reads": self.system_catalog_reads,
                 "cache_size": cache_size,
                 "queries": self.cache_hits + self.cache_misses,
                 "task_workers": dict(self.task_workers),
@@ -214,6 +245,23 @@ class ServingPlane:
         run the session's stream-fold path."""
         from ..batch.executors import BatchFallback
         t0 = time.perf_counter()
+        if _references_system_relation(sel):
+            # system catalogs are telemetry materialized at plan time:
+            # never cached (no key is ever formed), always planned
+            # fresh under the API lock for a consistent snapshot. NO
+            # _drain_inflight here — these relations read no stream
+            # state, and rw_barrier_inflight exists precisely to be
+            # queried WHILE a barrier is stuck; draining first would
+            # block on (then hide) the very barrier being diagnosed
+            self.stats.bump(system_catalog_reads=1)
+            with session._api_lock:
+                plan = session._plan(sel)
+                session.last_select_schema = [
+                    (f.name, f.type) for f in plan.schema
+                    if not f.name.startswith("_")]
+                rows = session._query_stream_fold(sel, plan)
+            self.stats.record_latency(time.perf_counter() - t0)
+            return rows
         key = repr(sel)
         ent = self._cache_get(key)
         if ent is not None:

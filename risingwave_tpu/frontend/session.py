@@ -278,6 +278,17 @@ class Session:
             from ..common.tracing import GLOBAL_TRACE
             if ring != GLOBAL_TRACE.capacity:
                 GLOBAL_TRACE.set_capacity(ring)
+        # barrier observatory (common/barrier_ledger.py): the per-barrier
+        # waterfall history ring, and the slow-epoch capture ring resized
+        # to its [observability] knob (the maxlen=16 above predates it)
+        cap = max(1, int(self.observability.slow_epoch_capture_capacity))
+        if cap != self._slow_epochs.maxlen:
+            self._slow_epochs = _collections.deque(self._slow_epochs,
+                                                   maxlen=cap)
+        from ..common.barrier_ledger import BarrierLedger
+        self._barrier_ledger = BarrierLedger(
+            self.observability.barrier_history_capacity)
+        self._worker_stage_ack: dict[int, int] = {}  # last stage_seq seen
         # device profiling plane (common/profiling.py): per-dispatch
         # telemetry + HBM ledger; pure host bookkeeping, on by default
         from ..common.profiling import GLOBAL_PROFILER
@@ -890,7 +901,8 @@ class Session:
         """Plan + optimize one SELECT (the full frontend pipeline:
         parse → bind → plan → rule-engine passes)."""
         from .optimizer import optimize
-        plan = Planner(self.catalog, lenient=lenient).plan_select(query)
+        plan = Planner(self.catalog, lenient=lenient,
+                       session=self).plan_select(query)
         return optimize(plan)
 
     def _explain(self, stmt: "A.Explain") -> list:
@@ -3044,6 +3056,12 @@ class Session:
             self._shardfused_tick(epoch, checkpoint,
                                   generate and not self.paused)
         from ..common.tracing import CAT_EPOCH, trace_span
+        import time as _time
+        # barrier observatory: open this epoch's waterfall record and
+        # time the inject stage (host-side perf_counter only — zero
+        # added dispatches, nothing on the device path)
+        self._barrier_ledger.begin(epoch, checkpoint, _time.time())
+        _inj0 = _time.perf_counter()
         with trace_span("barrier.inject", CAT_EPOCH, epoch=epoch,
                         tid="conductor", checkpoint=checkpoint):
             self.dml.drain_into_epoch()
@@ -3077,7 +3095,8 @@ class Session:
                 self._await(_inject_remote())
         self._injected = epoch
         self._inflight.append((epoch, checkpoint))
-        import time as _time
+        self._barrier_ledger.stage(
+            epoch, "inject", (_time.perf_counter() - _inj0) * 1e3)
         # (perf_counter for latency precision, wall clock for span export)
         self._inject_time[epoch] = (_time.perf_counter(), _time.time())
         # pipelined barriers would let an upstream run AHEAD of an active
@@ -3209,11 +3228,35 @@ class Session:
             self._exit_mutation()
 
     def _complete_oldest_impl(self) -> None:
+        from ..common.barrier_ledger import GLOBAL_STAGES
         from ..common.tracing import CAT_EPOCH, GLOBAL_TRACE, Span, trace_span
+        import time as _time
         e, ckpt = self._inflight.pop(0)
-        with trace_span("barrier.collect", CAT_EPOCH, epoch=e,
-                        tid="conductor"):
-            self._await(self._collect_barrier(e))
+        ledger = self._barrier_ledger
+        t_entry = _time.perf_counter()
+        _pend = self._inject_time.get(e)
+        if _pend is not None:
+            # pending: injected, parked in _inflight behind older epochs
+            # (pipelining) — with depth 1 this is ~0 and the waterfall
+            # stage sum reconciles with the barrier latency recorder
+            ledger.stage(e, "pending", (t_entry - _pend[0]) * 1e3)
+        dead_before = len(self._dead_jobs)
+        result = "ok"
+        try:
+            with trace_span("barrier.collect", CAT_EPOCH, epoch=e,
+                            tid="conductor"):
+                self._await(self._collect_barrier(e))
+        except BaseException:
+            ledger.stage(e, "collect",
+                         (_time.perf_counter() - t_entry) * 1e3)
+            ledger.ingest_events(GLOBAL_STAGES.drain())
+            ledger.finish(e, (_time.perf_counter() - t_entry) * 1e3,
+                          "failed")
+            self._inject_time.pop(e, None)
+            raise
+        ledger.stage(e, "collect", (_time.perf_counter() - t_entry) * 1e3)
+        if len(self._dead_jobs) > dead_before:
+            result = "failed"        # collect declared a job dead
         if ckpt and self._dead_jobs:
             # a dead job may have staged a torn subset of its tables for an
             # epoch whose checkpoint it never finished — keep those buffers
@@ -3223,15 +3266,24 @@ class Session:
             for n in self._dead_jobs:
                 self.store.discard_pending_tables(self._job_state_ids(n))
         if ckpt:
+            t_commit = _time.perf_counter()
             with trace_span("checkpoint.commit", CAT_EPOCH, epoch=e,
                             tid="conductor"):
                 self._commit_checkpoint(e)
-        import time as _time
+            ledger.stage(e, "commit",
+                         (_time.perf_counter() - t_commit) * 1e3)
+        # session-process storage/sink stage events (recorded at the 2PC
+        # sites in storage/checkpoint.py and stream/sink.py) fold into
+        # their records here, off the device path. Worker-side events
+        # arrive later over stats federation and attach to the sealed
+        # ring record by epoch.
+        ledger.ingest_events(GLOBAL_STAGES.drain())
         t0 = self._inject_time.pop(e, None)
         if t0 is not None:
             perf0, wall0 = t0
             lat = _time.perf_counter() - perf0
             self.barrier_latency.record(lat)
+            record = ledger.finish(e, lat * 1e3, result)
             # the whole-epoch span (inject → collect/commit): parent of
             # this epoch's executor spans in the trace export
             GLOBAL_TRACE.record(Span(
@@ -3252,9 +3304,15 @@ class Session:
                 self._slow_epochs.append({
                     "epoch": e, "latency_ms": round(lat_ms, 3),
                     "checkpoint": ckpt,
+                    # the offending barrier's waterfall record, refreshed
+                    # post-federation so worker stages are attached
+                    "barrier": ledger.get(e) or record,
                     "spans": [s.to_dict()
                               for s in GLOBAL_TRACE.snapshot(epoch=e)],
                 })
+        else:
+            ledger.finish(e, (_time.perf_counter() - t_entry) * 1e3,
+                          result)
         self.epoch = e
         # control-plane publication (reference: barrier_complete responses +
         # hummock version notifications, SURVEY.md §3.2 tail)
@@ -3757,6 +3815,12 @@ class Session:
         from ..stream.metrics import pipeline_metrics
         out = {
             "barrier_latency": self.barrier_latency.snapshot(),
+            # barrier observatory (common/barrier_ledger.py): in-flight
+            # count + per-stage p50/p99 over the waterfall history ring
+            "barrier": {
+                "inflight": len(self._inflight),
+                **self._barrier_ledger.summary(),
+            },
             "epoch": self.epoch,
             "jobs": {
                 name: pipeline_metrics(job.pipeline)
@@ -3984,7 +4048,8 @@ class Session:
             try:
                 return (w.worker_id, await w.get_stats(
                     timeout=timeout,
-                    span_ack=self._worker_span_ack.get(w.worker_id)))
+                    span_ack=self._worker_span_ack.get(w.worker_id),
+                    stage_ack=self._worker_stage_ack.get(w.worker_id)))
             except Exception:  # noqa: BLE001 - stats are best-effort
                 return None
 
@@ -4000,6 +4065,18 @@ class Session:
             seq = resp.pop("span_seq", None)
             if seq is not None:
                 self._worker_span_ack[wid] = seq
+            # barrier observatory: the worker's epoch-stamped stage
+            # events (storage prepare/settle/commit, worker collect)
+            # attach to their waterfall records in the history ring —
+            # re-ingesting a resent batch only re-sums an epoch already
+            # evicted from the ring, so ack discipline keeps it exact
+            stage_seq = resp.pop("stage_seq", None)
+            events = resp.pop("barrier_stages", []) or []
+            if stage_seq is not None \
+                    and stage_seq != self._worker_stage_ack.get(wid):
+                self._barrier_ledger.ingest_events(events, worker=wid)
+            if stage_seq is not None:
+                self._worker_stage_ack[wid] = stage_seq
             self._worker_stats[wid] = resp
         self._worker_stats_at = _time.monotonic()
         return self._worker_stats
@@ -4022,13 +4099,118 @@ class Session:
         workers as separate processes. Optionally written to ``path``."""
         from ..common.tracing import GLOBAL_TRACE, export_chrome_trace
         self._federate_worker_stats()    # pull workers' latest spans
-        return export_chrome_trace(GLOBAL_TRACE.snapshot(), path=path)
+        return export_chrome_trace(
+            GLOBAL_TRACE.snapshot(), path=path,
+            barrier_records=self._barrier_ledger.history())
 
     @_locked
     def slow_epochs(self) -> list:
         """Captured slow-epoch span trees (newest last), each
         ``{epoch, latency_ms, checkpoint, spans}``."""
         return list(self._slow_epochs)
+
+    @_locked
+    def barrier_blame(self) -> list:
+        """Name who is holding up every in-flight barrier, NOW.
+
+        Walks the live per-epoch accounting — local jobs' barrier
+        events, every RemoteWorker's epoch events + per-job failure
+        maps, and the federated per-exchange-edge counters (whose
+        ``last_barrier_epoch`` says how far the barrier propagated on
+        each link) — and returns one finding per suspect:
+
+          {"epoch", "checkpoint", "age_ms", "kind", "job", "worker",
+           "fragment", "actor", "link", "edge", "reason"}
+
+        ``kind`` is ``local_job`` / ``worker`` / ``exchange_edge``. An
+        exchange finding names the CONSUMER actor of the starved edge
+        (parsed from the ``job:f<u>.<i>->f<d>.<j>`` edge id, resolved
+        to its worker via the persisted placement), which is exactly
+        the actor a partitioned link stops feeding — diagnosis by name
+        within one tick, instead of waiting for the epoch-deadline
+        recovery to kill the worker. Stats frames are chaos-META, so
+        federation works through data-plane partitions. Empty list ⇔
+        nothing in flight or everything already acked."""
+        import re as _re
+        import time as _time
+        findings: list = []
+        if not self._inflight:
+            return findings
+        # best-effort refresh of exchange counters; stats frames bypass
+        # chaos partitions (rpc/faults.META_FRAME_TYPES)
+        worker_stats = self._federate_worker_stats(force=True)
+        edge_re = _re.compile(
+            r"^(?P<job>.+):f(?P<uf>\d+)\.(?P<ua>\d+)"
+            r"->f(?P<df>\d+)\.(?P<da>\d+)$")
+        for epoch, ckpt in self._inflight:
+            t0 = self._inject_time.get(epoch)
+            age_ms = ((_time.perf_counter() - t0[0]) * 1e3
+                      if t0 is not None else None)
+
+            def _add(kind, reason, job=None, worker=None, fragment=None,
+                     actor=None, link=None, edge=None,
+                     _epoch=epoch, _ckpt=ckpt, _age=age_ms):
+                findings.append({
+                    "epoch": _epoch, "checkpoint": bool(_ckpt),
+                    "age_ms": _age, "kind": kind, "job": job,
+                    "worker": worker, "fragment": fragment,
+                    "actor": actor, "link": link, "edge": edge,
+                    "reason": reason,
+                })
+            # local in-process jobs: the barrier event is set when the
+            # barrier flows out of the pipeline's Materialize
+            for name, job in self.jobs.items():
+                ev_map = getattr(job, "_barrier_events", None)
+                if ev_map is None:
+                    continue          # RemoteJob/SpanningJob: below
+                if getattr(job, "_failure", None) is not None:
+                    _add("local_job", f"job failed: "
+                         f"{type(job._failure).__name__}: {job._failure}",
+                         job=name, worker=-1)
+                    continue
+                ev = ev_map.get(epoch)
+                if ev is None or not ev.is_set():
+                    _add("local_job", "barrier not yet emitted by "
+                         "pipeline", job=name, worker=-1)
+            # worker processes: epoch acks + per-job failure maps
+            for w in self.workers:
+                if w.dead:
+                    _add("worker", "worker marked dead",
+                         worker=w.worker_id, link=w.link)
+                    continue
+                errs = w._epoch_errors.get(epoch) or {}
+                for jname, err in sorted(errs.items()):
+                    _add("worker", f"job error: {err}",
+                         job=None if jname == "*" else jname,
+                         worker=w.worker_id, link=w.link)
+                ev = w._epoch_events.get(epoch)
+                if ev is None or not ev.is_set():
+                    _add("worker", "barrier not acked by worker",
+                         worker=w.worker_id, link=w.link)
+            # exchange edges: an "in" edge whose last seen barrier lags
+            # the in-flight epoch is starving its consumer actor
+            for wid, st in sorted(worker_stats.items()):
+                for e in st.get("exchange", ()) or ():
+                    if e.get("dir") != "in":
+                        continue
+                    if int(e.get("last_barrier_epoch") or 0) >= epoch:
+                        continue
+                    m = edge_re.match(e.get("edge", ""))
+                    job = frag = act = None
+                    if m:
+                        job = m.group("job")
+                        frag = int(m.group("df"))
+                        act = int(m.group("da"))
+                    peer = e.get("peer_worker")
+                    link = (f"w{peer}->w{wid}"
+                            if peer is not None else None)
+                    _add("exchange_edge",
+                         "barrier missing on exchange edge "
+                         f"(last seen epoch "
+                         f"{e.get('last_barrier_epoch')})",
+                         job=job, worker=wid, fragment=frag, actor=act,
+                         link=link, edge=e.get("edge"))
+        return findings
 
     def profile_report(self) -> dict:
         """Roofline report over every dispatch this process has seen:

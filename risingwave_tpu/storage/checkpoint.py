@@ -489,10 +489,12 @@ class DurableStateStore(MemoryStateStore):
         if epoch <= self.committed_epoch or epoch in self._prepared_epochs:
             return
         self.join_commits()          # manifest ops stay strictly ordered
+        from ..common.barrier_ledger import timed_stage
         from ..common.tracing import CAT_STORAGE, trace_span
         deltas = self._pending_deltas(epoch)
         with trace_span("DurableStateStore.prepare", CAT_STORAGE,
-                        epoch=epoch, tid="storage", tables=len(deltas)):
+                        epoch=epoch, tid="storage", tables=len(deltas)), \
+                timed_stage(epoch, "storage_prepare"):
             self.log.prepare_epoch(epoch, deltas)
         self._prepared_epochs.add(epoch)
 
@@ -520,10 +522,12 @@ class DurableStateStore(MemoryStateStore):
         from ..common.tracing import CAT_STORAGE, trace_span
 
         def _encode_and_publish() -> None:
+            from ..common.barrier_ledger import timed_stage
             try:
                 with trace_span("DurableStateStore.commit_async",
                                 CAT_STORAGE, epoch=epoch, tid="storage",
-                                tables=len(deltas)):
+                                tables=len(deltas)), \
+                        timed_stage(epoch, "storage_commit"):
                     self.log.append_epoch(epoch, deltas)
             except BaseException as e:  # noqa: BLE001 - surfaced at join
                 self._commit_error = e
@@ -549,19 +553,25 @@ class DurableStateStore(MemoryStateStore):
         if epoch <= self.committed_epoch:
             return
         self.join_commits()
+        from ..common.barrier_ledger import timed_stage
         from ..common.tracing import CAT_STORAGE, trace_span
         prepared = {e for e in self._prepared_epochs if e <= epoch}
         if prepared:
             # phase 2: promote the durably staged segment(s); epochs
             # prepared BEYOND this commit (pipelined checkpoints) keep
             # their staged segments for their own commit frames
-            self.log.settle_prepared(epoch, discard_beyond=False)
+            with trace_span("DurableStateStore.settle", CAT_STORAGE,
+                            epoch=epoch, tid="storage",
+                            prepared=len(prepared)), \
+                    timed_stage(epoch, "storage_settle"):
+                self.log.settle_prepared(epoch, discard_beyond=False)
             self._prepared_epochs -= prepared
         else:
             deltas = self._pending_deltas(epoch)
             with trace_span("DurableStateStore.commit", CAT_STORAGE,
                             epoch=epoch, tid="storage",
-                            tables=len(deltas)):
+                            tables=len(deltas)), \
+                    timed_stage(epoch, "storage_commit"):
                 self.log.append_epoch(epoch, deltas)
         super().commit(epoch)
 

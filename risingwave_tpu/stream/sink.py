@@ -187,7 +187,9 @@ class SinkExecutor(SingleInputExecutor):
             self._seq += 1
         self._pending.clear()
         if not self.degraded:
-            self._try_deliver(epoch)
+            from ..common.barrier_ledger import timed_stage
+            with timed_stage(epoch, "sink_deliver"):
+                self._try_deliver(epoch)
         else:
             # degraded: the log absorbs changes up to the cap; bounded-log
             # backpressure is a LOUD failure, not silent truncation

@@ -506,9 +506,11 @@ class WorkerHost:
                 else:
                     failed[name] = repr(e)
 
+        from ..common.barrier_ledger import timed_stage
         from ..common.tracing import CAT_EPOCH, trace_span
         with trace_span("barrier.collect", CAT_EPOCH, epoch=epoch,
-                        tid="conductor", checkpoint=checkpoint):
+                        tid="conductor", checkpoint=checkpoint), \
+                timed_stage(epoch, "worker_collect"):
             await asyncio.gather(
                 *(collect(n, self.jobs[n]) for n in scope
                   if n in self.jobs))
@@ -727,6 +729,13 @@ class WorkerHost:
             if len(self._span_outbox) > cap:
                 del self._span_outbox[:-cap]
             self._span_seq += 1
+        # barrier observatory: this process's epoch-stamped stage events
+        # (storage prepare/settle/commit, worker collect) ride the SAME
+        # stats frame as spans, with the same retained-until-acked outbox
+        # discipline — no extra RPC, nothing on the barrier path
+        from ..common.barrier_ledger import GLOBAL_STAGES
+        stage_seq, stage_events = GLOBAL_STAGES.drain_outbox(
+            req.get("stage_ack"))
         from ..rpc.faults import chaos_snapshot
         from ..stream.remote_exchange import exchange_stats
         return {
@@ -754,6 +763,7 @@ class WorkerHost:
             # Session.metrics()["profiling"]["workers"]
             "profiling": GLOBAL_PROFILER.snapshot(),
             "spans": list(self._span_outbox), "span_seq": self._span_seq,
+            "barrier_stages": stage_events, "stage_seq": stage_seq,
         }
 
     # -- scan ------------------------------------------------------------------

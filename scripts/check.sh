@@ -108,6 +108,38 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_dashboard.py \
     "$@"
 
+echo "== barrier observatory (ledger + blame + telemetry catalog) =="
+# no 'not slow' filter: the 2-worker federated waterfall and the
+# chaos-partitioned blame acceptance run (barrier_blame + ctl
+# --inflight + rw_catalog.rw_barrier_inflight over pgwire, all before
+# the epoch deadline) are slow-marked but MUST run here
+python -m pytest -q -p no:cacheprovider \
+    tests/test_barrier_observatory.py \
+    "$@"
+
+echo "== ctl trace barrier smoke (history + --inflight + --json) =="
+# end-to-end over a real durable dir: the ctl session recovers the
+# catalog, serves the waterfall tables, names in-flight suspects, and
+# emits machine-parseable JSON with the ledger's three sections
+obs_dir=$(mktemp -d)
+python - "$obs_dir" <<'EOF'
+import sys
+from risingwave_tpu.frontend import Session
+s = Session(data_dir=sys.argv[1], checkpoint_frequency=2)
+s.run_sql("CREATE TABLE obs_t (k BIGINT PRIMARY KEY, v BIGINT)")
+s.run_sql("INSERT INTO obs_t VALUES (1, 10), (2, 20)")
+s.flush()
+assert s._barrier_ledger.history(), "ledger empty after flush"
+s.close()
+EOF
+python -m risingwave_tpu ctl trace barrier --data-dir "$obs_dir"
+python -m risingwave_tpu ctl trace barrier --data-dir "$obs_dir" --inflight
+python -m risingwave_tpu ctl trace barrier --data-dir "$obs_dir" --json \
+    | python -c 'import json,sys; o=json.load(sys.stdin); \
+assert set(o) >= {"history","stages","summary"}, sorted(o); \
+print("ctl trace barrier --json: OK")'
+rm -rf "$obs_dir"
+
 echo "== profiler-overhead smoke (0 added dispatches, bounded wall cost) =="
 # The profiling plane is ON by default: assert that a profiled fused q5
 # epoch still takes EXACTLY one dispatch per epoch (dispatch_count
