@@ -148,6 +148,15 @@ SERVING_THREADS = 4            # concurrent reader threads
 SERVING_TICK_S = 0.1           # live-stream tick cadence during reads
 SERVING_WARM_TICKS = 3
 
+# fleet phase (docs/control-plane.md): one standalone MetaServer + one
+# writer session share a durable dir with N serving FRONTEND PROCESSES,
+# each serving cached MV reads over pgwire to several connections —
+# the multi-tenant deployment shape, measured end to end (attach,
+# notification-driven catalog, admission control, merged QPS/p99).
+FLEET_SECONDS = 3.0            # measured wall clock
+FLEET_FRONTENDS = 2            # serving frontend PROCESSES
+FLEET_CONNS = 4                # pgwire connections per frontend
+
 
 def _emit(obj: dict) -> None:
     print(json.dumps(obj))
@@ -1128,6 +1137,184 @@ def run_serving_phase(seconds: float, n_threads: int) -> None:
     _emit(out)
 
 
+def _pg_startup(sock) -> None:
+    """Minimal pgwire client startup (trust auth) on a raw socket."""
+    import struct
+    body = struct.pack("!I", 196608) + b"user\x00bench\x00\x00"
+    sock.sendall(struct.pack("!I", len(body) + 4) + body)
+    buf = b""
+    while b"Z\x00\x00\x00\x05I" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("pgwire startup EOF")
+        buf += chunk
+
+
+def _pg_query(sock, sql: str) -> bytes:
+    """One simple-protocol query; returns the raw response bytes
+    (ending with ReadyForQuery)."""
+    import struct
+    body = sql.encode() + b"\x00"
+    sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+    buf = b""
+    while not buf.endswith(b"Z\x00\x00\x00\x05I"):
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("pgwire EOF mid-query")
+        buf += chunk
+    return buf
+
+
+def run_fleet_frontend(meta_addr: str, data_dir: str) -> None:
+    """Hidden child entry for --fleet-frontend: attach ONE read-only
+    serving session to the fleet's meta + shared state dir, serve it
+    over pgwire on an ephemeral port, print ``FLEET_READY <port>``,
+    run until the parent writes a line on stdin, then print
+    ``FLEET_STATS {json}`` (admission counters + serving-cache hits)
+    and exit."""
+    import asyncio as _asyncio
+
+    from risingwave_tpu.frontend.pgwire import PgWireServer
+    from risingwave_tpu.frontend.session import Session
+
+    sess = Session(data_dir=data_dir, meta_addr=meta_addr, role="serving")
+    srv = PgWireServer(sess, port=0)
+    loop = _asyncio.new_event_loop()
+    _asyncio.set_event_loop(loop)
+    loop.run_until_complete(srv.start())
+    port = srv._server.sockets[0].getsockname()[1]
+    print(f"FLEET_READY {port}", flush=True)
+
+    def wait_stdin():
+        sys.stdin.readline()           # parent writes STOP (or closes)
+        loop.call_soon_threadsafe(loop.stop)
+
+    threading.Thread(target=wait_stdin, daemon=True).start()
+    loop.run_forever()
+    loop.run_until_complete(srv.close())
+    m = sess.metrics()["serving"]
+    print("FLEET_STATS " + json.dumps(
+        {"admission": srv.admission.snapshot(),
+         "cache_hits": m["cache_hits"],
+         "cache_misses": m["cache_misses"]}), flush=True)
+    sess.close()
+
+
+def run_fleet_phase(seconds: float, n_frontends: int,
+                    n_conns: int) -> None:
+    """Child entry for --fleet-phase: the multi-tenant control plane end
+    to end — a standalone MetaServer and one writer session build an MV
+    over a shared durable hummock dir; ``n_frontends`` serving frontend
+    PROCESSES attach read-only and serve it over pgwire; ``n_conns``
+    connections per frontend hammer the same cached point read. Emits
+    merged fleet QPS + p50/p99 and the admission counters (queued /
+    shed) summed across frontends. One JSON line."""
+    import socket
+    import tempfile
+
+    from risingwave_tpu.frontend.session import Session
+    from risingwave_tpu.meta.server import MetaServer
+
+    d = tempfile.mkdtemp(prefix="rwtpu_bench_fleet_")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(d, "jax_cache"))
+    meta = MetaServer(data_dir=os.path.join(d, "meta"))
+    addr = meta.start()
+    writer = Session(data_dir=d, meta_addr=addr, state_store="hummock")
+    procs: list = []
+    lats: list = []
+    stats: list = []
+    try:
+        writer.run_sql("CREATE TABLE ft (k BIGINT, v BIGINT)")
+        writer.run_sql("INSERT INTO ft VALUES " + ", ".join(
+            f"({i % 64}, {i})" for i in range(512)))
+        writer.run_sql(
+            "CREATE MATERIALIZED VIEW fleet_mv AS SELECT k, "
+            "count(*) AS n, sum(v) AS s FROM ft GROUP BY k")
+        writer.flush()
+
+        ports = []
+        for _ in range(n_frontends):
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fleet-frontend", addr, d],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__))))
+        for pr in procs:
+            while True:
+                line = pr.stdout.readline()
+                if not line:
+                    raise RuntimeError("fleet frontend died during attach")
+                if line.startswith("FLEET_READY "):
+                    ports.append(int(line.split()[1]))
+                    break
+
+        lat_lock = threading.Lock()
+        stop_at = time.perf_counter() + seconds
+
+        def reader(port: int) -> None:
+            sock = socket.create_connection(("127.0.0.1", port))
+            try:
+                _pg_startup(sock)
+                sql = "SELECT k, n, s FROM fleet_mv WHERE k = 7"
+                _pg_query(sock, sql)          # warm the plan cache
+                mine = []
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    _pg_query(sock, sql)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lat_lock:
+                    lats.extend(mine)
+            finally:
+                sock.close()
+
+        threads = [threading.Thread(target=reader, args=(p,))
+                   for p in ports for _ in range(n_conns)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        for pr in procs:
+            try:
+                pr.stdin.write("STOP\n")
+                pr.stdin.flush()
+            except OSError:
+                pass
+            out, _ = pr.communicate(timeout=60)
+            for line in out.splitlines():
+                if line.startswith("FLEET_STATS "):
+                    stats.append(json.loads(line[len("FLEET_STATS "):]))
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+        writer.close()
+        meta.stop()
+
+    lats.sort()
+
+    def pct(q: float):
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1, int(q * len(lats)))], 2)
+
+    _emit({
+        "metric": "fleet_qps", "unit": "queries/s",
+        "value": round(len(lats) / wall, 1) if lats else 0.0,
+        "fleet_qps": round(len(lats) / wall, 1) if lats else 0.0,
+        "fleet_p50_ms": pct(0.50),
+        "fleet_p99_ms": pct(0.99),
+        "fleet_queued": sum(s["admission"]["queued"] for s in stats),
+        "fleet_shed": sum(s["admission"]["shed"] for s in stats),
+        "fleet_frontends": n_frontends,
+        "fleet_conns_per_frontend": n_conns,
+        "fleet_cache_hits": sum(s["cache_hits"] for s in stats),
+    })
+
+
 def run_rescale_phase(ticks: int = 6, cap: int = 256) -> None:
     """Child entry for --rescale-phase: one LIVE 2→4 vnode migration of
     a spanning grouped-agg job on a 4-worker cluster (docs/scaling.md),
@@ -1353,6 +1540,24 @@ _RESCALE_RESULT_FIELDS = (
     "rescale_rows_per_sec_after",
 )
 
+_FLEET_RESULT_FIELDS = (
+    "fleet_qps", "fleet_p50_ms", "fleet_p99_ms",
+    "fleet_queued", "fleet_shed", "fleet_frontends",
+)
+
+
+def measure_fleet_cpu() -> dict:
+    """The multi-tenant fleet phase on the CPU stand-in: standalone
+    meta + writer + 2 serving frontend processes × several pgwire
+    connections each (a Session/control-plane measurement; fresh
+    subprocess like every phase — which itself spawns the frontend
+    processes)."""
+    env = {"JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": None, "TPU_LIBRARY_PATH": None}
+    return _spawn_phase("fleet_cpu", env,
+                        ["--fleet-phase", str(FLEET_SECONDS),
+                         str(FLEET_FRONTENDS), str(FLEET_CONNS)])
+
 
 def measure_rescale_cpu() -> dict:
     """The elastic-scaling phase on the CPU stand-in: a live 2→4 vnode
@@ -1510,6 +1715,12 @@ _SHARED_FIELDS = (
     "rescale_pause_ms", "rescale_moved_vnodes",
     "rescale_rows_per_sec_before", "rescale_rows_per_sec_during",
     "rescale_rows_per_sec_after",
+    # multi-tenant frontend fleet (docs/control-plane.md): merged QPS +
+    # p99 across 2 serving frontend processes over one standalone meta,
+    # plus the admission counters — present on every backend (a
+    # control-plane CPU measurement) so the fallback record stays
+    # schema-stable
+    "fleet_qps", "fleet_p99_ms", "fleet_queued",
 )
 
 
@@ -1557,6 +1768,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - attributed below
         sys.stderr.write(f"bench: rescale phase failed: {e}\n")
         cpu["rescale_error"] = str(e)
+    # fleet phase (control-plane-level, CPU): standalone meta + writer +
+    # serving frontend processes; non-fatal like the serving phase
+    try:
+        fleet = measure_fleet_cpu()
+        for f in _FLEET_RESULT_FIELDS:
+            cpu[f] = fleet.get(f)
+    except Exception as e:  # noqa: BLE001 - attributed below
+        sys.stderr.write(f"bench: fleet phase failed: {e}\n")
+        cpu["fleet_error"] = str(e)
     cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     tpu, tpu_err = measure_tpu()
     if tpu is not None:
@@ -1576,9 +1796,11 @@ def main() -> int:
             # keep the record schema-stable with the stand-in's numbers
             for f in _SHARDED_RESULT_FIELDS:
                 tpu.setdefault(f, cpu.get(f))
-        # serving is a Session-level CPU measurement; the TPU record
-        # carries the stand-in's numbers for schema stability
-        for f in _SERVING_RESULT_FIELDS:
+        # serving/rescale/fleet are Session/control-plane-level CPU
+        # measurements; the TPU record carries the stand-in's numbers
+        # for schema stability
+        for f in (_SERVING_RESULT_FIELDS + _RESCALE_RESULT_FIELDS
+                  + _FLEET_RESULT_FIELDS):
             tpu.setdefault(f, cpu.get(f))
     if tpu is None:
         # tunnel/chip unavailable: fall back to the CPU streaming
@@ -1918,7 +2140,9 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] in ("--phase", "--probe",
                                              "--sharded-phase",
                                              "--serving-phase",
-                                             "--rescale-phase"):
+                                             "--rescale-phase",
+                                             "--fleet-phase",
+                                             "--fleet-frontend"):
         watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
@@ -1949,6 +2173,30 @@ if __name__ == "__main__":
             except Exception as e:
                 _emit(_fail_line(
                     f"serving phase failed: {type(e).__name__}: {e}"))
+                raise SystemExit(2)
+            finally:
+                watchdog.cancel()
+            raise SystemExit(0)
+        if sys.argv[1] == "--fleet-frontend":
+            # hidden child of --fleet-phase: line-oriented protocol on
+            # stdout (FLEET_READY / FLEET_STATS), not a JSON result line
+            run_fleet_frontend(sys.argv[2], sys.argv[3])
+            raise SystemExit(0)
+        if sys.argv[1] == "--fleet-phase":
+            watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                run_fleet_phase(
+                    float(sys.argv[2]) if len(sys.argv) > 2
+                    else FLEET_SECONDS,
+                    int(sys.argv[3]) if len(sys.argv) > 3
+                    else FLEET_FRONTENDS,
+                    int(sys.argv[4]) if len(sys.argv) > 4
+                    else FLEET_CONNS)
+            except Exception as e:
+                _emit(_fail_line(
+                    f"fleet phase failed: {type(e).__name__}: {e}"))
                 raise SystemExit(2)
             finally:
                 watchdog.cancel()

@@ -116,12 +116,15 @@ the diff math that guarantees it lives in meta/rescale.py
 commit_placement, and the raw ``"placement/"`` meta-store keyspace
 belongs to meta/service.py alone. A direct key write or a
 ``save_placement(...)`` call elsewhere bypasses the live-migration
-fencing from PR 10. The grep version fired on every docstring that
+fencing from PR 10. meta/server.py is exempt on the call side: it is
+the wire far-side of the scaling plane, forwarding a MetaClient's
+``save_placement`` RPC (issued from rescale.py) to the one owning
+MetaService. The grep version fired on every docstring that
 mentioned the keyspace; this rule skips docstrings (no Call / no
 non-doc string constant) and still sees f-string key construction."""
 
     KEY_EXEMPT = ("meta/service.py",)
-    CALL_EXEMPT = ("meta/service.py", "meta/rescale.py")
+    CALL_EXEMPT = ("meta/service.py", "meta/rescale.py", "meta/server.py")
     TARGET = f"{PKG}.meta.service.MetaService.save_placement"
 
     def check(self, package: Package) -> Iterator[Finding]:
@@ -250,6 +253,34 @@ registry (``get_udf(...).fn(...)`` / ``UDF_SPECS[...].fn(...)``)."""
                         "registered UDF callable invoked directly from "
                         "the registry (route through udf/client.py "
                         "UdfPlane.call)")
+
+
+@register
+class MetaBoundary(Rule):
+    name = "meta-boundary"
+    title = "the meta store is constructed only inside meta/"
+    ci_label = "meta-boundary"
+    doc = """The control plane owns its durable store: every consumer
+reaches meta state through a ``MetaService`` (in-process) or a
+``MetaClient`` (remote, `ctl meta serve`), both of which serialize
+writes and publish notifications. A raw ``FileMetaStore(...)``
+constructed outside meta/ opens the JSONL behind the control plane's
+back — its writes fire no notifications (serving sessions go stale)
+and race the server's CAS transactions. Alias-aware like the rest of
+this family. Pairs with the placement-mutation rule, which polices the
+``placement/`` keyspace within an already-obtained store."""
+
+    TARGET = f"{PKG}.meta.store.FileMetaStore"
+
+    def check(self, package: Package) -> Iterator[Finding]:
+        for mod, call in _call_sites(package, targets={self.TARGET}):
+            if mod.rel.startswith("meta/"):
+                continue
+            yield Finding(self.name, mod.rel, call.lineno,
+                          call.col_offset,
+                          "raw FileMetaStore construction outside meta/ "
+                          "(go through MetaService or MetaClient so "
+                          "writes notify and serialize)")
 
 
 @register

@@ -28,6 +28,10 @@ def _build_session(args):
         kwargs["state_store"] = args.state_store
     if getattr(args, "compactors", 0):
         kwargs["compactors"] = args.compactors
+    if getattr(args, "meta_addr", None):
+        kwargs["meta_addr"] = args.meta_addr
+    if getattr(args, "role", None):
+        kwargs["role"] = args.role
     fp = getattr(args, "fragment_parallelism", 1)
     mesh_n = getattr(args, "mesh", 0)
     if (fp and fp != 1) or mesh_n:
@@ -72,6 +76,13 @@ def main(argv=None) -> int:
         "loudly when the process has fewer than N devices (on CPU set "
         "XLA_FLAGS=--xla_force_host_platform_device_count=N); 0 = "
         "single-chip")
+    fp_arg.add_argument(
+        "--meta-addr", default=None, metavar="HOST:PORT",
+        help="attach to a standalone meta server (`ctl meta serve`) "
+        "instead of running the control plane in-process — the "
+        "multi-tenant deployment shape: one meta + one shared state "
+        "dir, one writer session, N serving frontends "
+        "(docs/control-plane.md); also settable via [meta] addr")
 
     pg = sub.add_parser("playground", parents=[fp_arg],
                         help="serve SQL over the Postgres wire protocol")
@@ -101,6 +112,13 @@ def main(argv=None) -> int:
     pg.add_argument("--dashboard-port", type=int, default=None,
                     help="serve the meta dashboard (cluster / fragment "
                     "graphs / await-tree) on this port")
+    pg.add_argument("--role", default=None,
+                    choices=["writer", "serving"],
+                    help="session role when attached to a standalone "
+                    "meta (--meta-addr): the single 'writer' conducts "
+                    "barriers and owns DDL; 'serving' frontends are "
+                    "read-mostly replicas sharing the writer's state "
+                    "dir (docs/control-plane.md)")
 
     q = sub.add_parser("sql", parents=[fp_arg],
                        help="run SQL statements and print results")
@@ -120,7 +138,7 @@ def main(argv=None) -> int:
                                       "metrics", "trace", "backup",
                                       "restore", "backup-info",
                                       "hummock", "vacuum", "cluster",
-                                      "profile", "bench", "udf"])
+                                      "profile", "bench", "udf", "meta"])
     ctl.add_argument("sub", nargs="?", default=None,
                      help="subcommand for `ctl cluster` "
                      "(fragments — dump the persisted fragment→worker "
@@ -139,7 +157,11 @@ def main(argv=None) -> int:
                      "and `ctl trace` (barrier — the barrier "
                      "observatory's per-epoch waterfall history and "
                      "stage percentiles; add --inflight for live "
-                     "stuck-barrier blame — docs/observability.md)")
+                     "stuck-barrier blame — docs/observability.md), "
+                     "and `ctl meta` (serve — run a standalone meta "
+                     "server in the foreground over --data-dir; "
+                     "sessions attach with --meta-addr / [meta] addr — "
+                     "docs/control-plane.md)")
     ctl.add_argument("job", nargs="?", default=None,
                      help="job name for `ctl cluster rescale`")
     ctl.add_argument("--parallelism", type=int, default=None,
@@ -242,6 +264,23 @@ def _ctl(args) -> int:
         # are one-client; udf/server.py)
         from .udf.server import main as udf_server_main
         udf_server_main(["--port", str(args.port), "--persistent"])
+        return 0
+    if args.what == "meta":
+        if args.sub != "serve":
+            raise SystemExit("usage: ctl meta serve --data-dir DIR "
+                             "[--port N]")
+        if not args.data_dir:
+            raise SystemExit("--data-dir is required (the meta store "
+                             "lives under DIR/meta)")
+        # the standalone control plane (docs/control-plane.md): serves
+        # the MetaService surface over the wire protocol; prints
+        # "META_READY host:port" once listening. The store lives under
+        # DIR/meta — the SAME path an in-process session over DIR uses,
+        # so `ctl cluster fragments` etc. keep reading it offline.
+        import os as _os
+        from .meta.server import main as meta_server_main
+        meta_server_main(["--data-dir", _os.path.join(args.data_dir, "meta"),
+                          "--port", str(args.port)])
         return 0
     if not args.data_dir:
         raise SystemExit("--data-dir is required")

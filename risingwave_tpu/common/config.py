@@ -306,6 +306,29 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass
+class MetaConfig:
+    """The meta control plane attachment + frontend admission knobs
+    (docs/control-plane.md; reference: src/meta/src/rpc/server.rs).
+
+    ``addr`` empty means in-process meta — the playground default, with
+    behavior bit-identical to before the control plane grew a process
+    boundary. Set it (``host:port``) and the session attaches through a
+    ``MetaClient`` instead; combined with ``Session(role="serving")``
+    that is how a frontend fleet shares one writer's state."""
+
+    #: "host:port" of a `ctl meta serve` process; "" = in-process meta
+    addr: str = ""
+    #: pgwire admission control: max queries executing concurrently per
+    #: frontend process (the rest queue), and per-connection in-flight cap
+    admission_max_inflight: int = 8
+    admission_per_conn_inflight: int = 2
+    #: queries allowed to WAIT beyond the in-flight cap before the
+    #: frontend sheds load with a PG error (bounded queue: overload
+    #: degrades with bounded p99 instead of collapsing)
+    admission_queue_depth: int = 64
+
+
+@dataclasses.dataclass
 class ServerConfig:
     host: str = "127.0.0.1"
     port: int = 4566
@@ -325,6 +348,7 @@ class RwConfig:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
     udf: UdfConfig = dataclasses.field(default_factory=UdfConfig)
+    meta: MetaConfig = dataclasses.field(default_factory=MetaConfig)
 
 
 def _parse_toml_subset(text: str) -> dict:

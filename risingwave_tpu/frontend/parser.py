@@ -35,7 +35,7 @@ KEYWORDS = {
     "last", "ties", "emit", "window", "close", "true", "false", "show",
     "tables", "sources", "flush", "tumble", "hop", "append", "only",
     "sink", "sinks", "over", "partition", "like", "extract", "set", "to",
-    "parameters", "delete", "update", "explain",
+    "parameters", "delete", "update", "explain", "alter", "system",
 }
 
 
@@ -188,6 +188,17 @@ class Parser:
                 self.expect_kw("to")
             t = self.next()
             return A.SetStatement(name, t.value)
+        if self.eat_kw("alter"):
+            # ALTER SYSTEM SET <param> = <value> | TO <value>: the
+            # cluster-wide variant of SET (reference:
+            # src/common/src/system_param/mod.rs hot propagation)
+            self.expect_kw("system")
+            self.expect_kw("set")
+            name = self.ident()
+            if not self.eat_op("="):
+                self.expect_kw("to")
+            t = self.next()
+            return A.SetStatement(name, t.value, system=True)
         raise SqlParseError(f"unsupported statement at {self.peek()}")
 
     def _if_not_exists(self) -> bool:

@@ -30,6 +30,7 @@ import concurrent.futures
 import struct
 from typing import Optional
 
+from ..common.config import MetaConfig
 from ..common.types import DataType, TypeKind
 from .session import Session, SqlError
 
@@ -181,10 +182,86 @@ def _fmt_value(v, t: Optional[DataType]) -> str:
     return str(v)
 
 
+class QueryShed(Exception):
+    """Raised when admission control refuses to queue another query."""
+
+
+class AdmissionController:
+    """Admission control for query execution (the frontend-fleet overload
+    story): the Session executes on ONE worker thread, so overload on a
+    serving frontend shows up as an unbounded executor queue — every
+    queued query pays the full backlog latency and nothing bounds p99.
+    This bounds it: at most ``max_inflight`` queries are dispatched to
+    the worker at once, up to ``queue_depth`` more wait on the asyncio
+    side, and beyond that new queries are SHED with a retryable PG error
+    (SQLSTATE 53300) instead of growing the backlog — overload degrades
+    by queueing with bounded p99, not collapse. A single connection may
+    hold at most ``per_conn_inflight`` slots, so one pipelining client
+    cannot occupy the whole admission window."""
+
+    def __init__(self, max_inflight: int = 8, per_conn_inflight: int = 2,
+                 queue_depth: int = 64):
+        self.max_inflight = max(1, int(max_inflight))
+        self.per_conn_inflight = max(1, int(per_conn_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+        # created eagerly; binds to the running loop on first await (3.10+)
+        self._sem = asyncio.Semaphore(self.max_inflight)
+        self._waiting = 0
+        self._inflight = 0
+        self.stats = {"admitted": 0, "queued": 0, "shed": 0,
+                      "max_queued": 0, "max_inflight": 0}
+
+    def conn_slot(self) -> asyncio.Semaphore:
+        """Per-connection quota, one per accepted connection."""
+        return asyncio.Semaphore(self.per_conn_inflight)
+
+    async def acquire(self, conn_sem: Optional[asyncio.Semaphore]) -> None:
+        would_wait = self._sem.locked() or (
+            conn_sem is not None and conn_sem.locked())
+        if would_wait:
+            if self._waiting >= self.queue_depth:
+                self.stats["shed"] += 1
+                raise QueryShed(
+                    f"server overloaded: {self._inflight} queries in "
+                    f"flight, {self._waiting} queued "
+                    f"(queue depth {self.queue_depth}); retry later")
+            self._waiting += 1
+            self.stats["queued"] += 1
+            self.stats["max_queued"] = max(
+                self.stats["max_queued"], self._waiting)
+        try:
+            if conn_sem is not None:
+                await conn_sem.acquire()
+            try:
+                await self._sem.acquire()
+            except BaseException:
+                if conn_sem is not None:
+                    conn_sem.release()
+                raise
+        finally:
+            if would_wait:
+                self._waiting -= 1
+        self._inflight += 1
+        self.stats["admitted"] += 1
+        self.stats["max_inflight"] = max(
+            self.stats["max_inflight"], self._inflight)
+
+    def release(self, conn_sem: Optional[asyncio.Semaphore]) -> None:
+        self._inflight -= 1
+        self._sem.release()
+        if conn_sem is not None:
+            conn_sem.release()
+
+    def snapshot(self) -> dict:
+        return dict(self.stats, waiting=self._waiting,
+                    inflight=self._inflight)
+
+
 class PgWireServer:
     def __init__(self, session: Session, host: str = "127.0.0.1",
                  port: int = 4566, auth: Optional[dict] = None,
-                 auth_method: str = "md5"):
+                 auth_method: str = "md5",
+                 admission: Optional[MetaConfig] = None):
         """``auth``: user → password map enabling password authentication
         (reference: pg_protocol.rs:220-259 startup auth; SCRAM/TLS are
         not implemented — md5 and cleartext cover psql/psycopg2/JDBC
@@ -200,6 +277,11 @@ class PgWireServer:
         self._conns: set = set()      # live client writers (forced closed)
         # one worker thread: the Session is single-threaded by design
         self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        cfg = admission if admission is not None else MetaConfig()
+        self.admission = AdmissionController(
+            cfg.admission_max_inflight, cfg.admission_per_conn_inflight,
+            cfg.admission_queue_depth)
+        self._conn_slots: dict = {}   # writer -> per-connection semaphore
 
     async def _authenticate(self, reader, writer, user: str) -> bool:
         import hashlib
@@ -266,6 +348,7 @@ class PgWireServer:
         portals: dict[str, tuple[str, Optional[list]]] = {}  # -> (sql, schema)
         skip_until_sync = False
         self._conns.add(writer)
+        self._conn_slots[writer] = self.admission.conn_slot()
         try:
             if not await self._startup(reader, writer):
                 return
@@ -312,6 +395,7 @@ class PgWireServer:
             pass
         finally:
             self._conns.discard(writer)
+            self._conn_slots.pop(writer, None)
             writer.close()
 
     # -- extended-query flow ---------------------------------------------------
@@ -397,7 +481,6 @@ class PgWireServer:
     async def _on_describe(self, writer, body: bytes, stmts,
                            portals) -> bool:
         kind, name = body[0:1], body[1:].split(b"\x00")[0].decode()
-        loop = asyncio.get_running_loop()
         try:
             if kind == b"S":
                 sql, oids = stmts[name]
@@ -408,13 +491,12 @@ class PgWireServer:
                 # schema of a parameterized statement: plan with NULLs
                 probe = _substitute_params(
                     sql, [None] * 64, oids or [0] * 64)
-                schema = await loop.run_in_executor(
-                    self._executor, self._describe, probe)
+                schema = await self._admitted(writer, self._describe, probe)
             else:
                 sql, schema = portals[name]
                 if schema is None:
-                    schema = await loop.run_in_executor(
-                        self._executor, self._describe, sql)
+                    schema = await self._admitted(
+                        writer, self._describe, sql)
                     portals[name] = (sql, schema)
             if schema is None:
                 writer.write(_msg(b"n", b""))        # NoData
@@ -425,13 +507,16 @@ class PgWireServer:
             self._send_error(writer, "unknown statement/portal")
             await writer.drain()
             return False
+        except QueryShed as e:
+            self._send_error(writer, str(e), code="53300")
+            await writer.drain()
+            return False
         except Exception:  # noqa: BLE001 - undescribable: NoData, not fatal
             writer.write(_msg(b"n", b""))
             return True
 
     async def _on_execute(self, writer, body: bytes, portals) -> bool:
         name = body.split(b"\x00")[0].decode()
-        loop = asyncio.get_running_loop()
         try:
             sql, _schema = portals[name]
         except KeyError:
@@ -439,8 +524,12 @@ class PgWireServer:
             await writer.drain()
             return False
         try:
-            rows, schema, command = await loop.run_in_executor(
-                self._executor, self._execute, sql)
+            rows, schema, command = await self._admitted(
+                writer, self._execute, sql)
+        except QueryShed as e:
+            self._send_error(writer, str(e), code="53300")
+            await writer.drain()
+            return False
         except Exception as e:  # noqa: BLE001
             self._send_error(writer, str(e))
             await writer.drain()
@@ -451,6 +540,17 @@ class PgWireServer:
         writer.write(_msg(b"C", _cstr(command)))
         await writer.drain()
         return True
+
+    async def _admitted(self, writer, fn, *args):
+        """Run ``fn`` on the session worker thread under admission
+        control. Raises QueryShed when the wait queue is full."""
+        conn_sem = self._conn_slots.get(writer)
+        await self.admission.acquire(conn_sem)
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                self._executor, fn, *args)
+        finally:
+            self.admission.release(conn_sem)
 
     def _describe(self, sql: str):
         """Worker-thread: output schema of ``sql`` WITHOUT executing it
@@ -498,10 +598,14 @@ class PgWireServer:
             writer.write(_msg(b"Z", b"I"))
             await writer.drain()
             return
-        loop = asyncio.get_running_loop()
         try:
-            rows, schema, command = await loop.run_in_executor(
-                self._executor, self._execute, sql)
+            rows, schema, command = await self._admitted(
+                writer, self._execute, sql)
+        except QueryShed as e:
+            self._send_error(writer, str(e), code="53300")
+            writer.write(_msg(b"Z", b"I"))
+            await writer.drain()
+            return
         except Exception as e:  # noqa: BLE001 - surfaced as ErrorResponse
             self._send_error(writer, str(e))
             writer.write(_msg(b"Z", b"I"))
@@ -537,8 +641,9 @@ class PgWireServer:
             command = type(stmts[-1]).__name__.replace("Statement", "").upper()
         return rows, schema, command
 
-    def _send_error(self, writer, message: str) -> None:
-        payload = (b"S" + _cstr("ERROR") + b"C" + _cstr("XX000")
+    def _send_error(self, writer, message: str,
+                    code: str = "XX000") -> None:
+        payload = (b"S" + _cstr("ERROR") + b"C" + _cstr(code)
                    + b"M" + _cstr(message) + b"\x00")
         writer.write(_msg(b"E", payload))
 

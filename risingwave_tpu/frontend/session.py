@@ -232,7 +232,9 @@ class Session:
                  rw_config=None,
                  fault_config=None,
                  autoscaler_config=None,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1,
+                 meta_addr: Optional[str] = None,
+                 role: str = "writer"):
         # layered config (common/config.py): an RwConfig overrides the
         # keyword defaults; explicit kwargs are not merged (callers pick one
         # style). Reference: load_config + SystemParams (config.rs:128).
@@ -335,6 +337,22 @@ class Session:
         elif data_dir is not None and udf_plane().trace_dir is None:
             udf_plane().configure(udf_plane().config, trace_dir=data_dir)
         self.udf_config = udf_plane().config
+        # multi-tenant attachment (docs/control-plane.md): a "writer"
+        # conducts barriers and owns DDL; a "serving" session is a
+        # read-only frontend sharing one meta + one Hummock dir with the
+        # writer, kept current by meta notifications. In-process meta
+        # (meta_addr None) stays the playground default — bit-identical.
+        if role not in ("writer", "serving"):
+            raise ValueError(f"unknown session role {role!r} "
+                             "(expected 'writer' or 'serving')")
+        if meta_addr is None and rw_config is not None \
+                and getattr(rw_config, "meta", None) is not None:
+            meta_addr = rw_config.meta.addr or None
+        if role == "serving" and meta_addr is None:
+            raise ValueError("a serving session needs a meta_addr "
+                             "to attach to")
+        self.role = role
+        self.meta_addr = meta_addr
         self.catalog = Catalog()
         self.data_dir = data_dir
         if data_dir is not None:
@@ -376,9 +394,13 @@ class Session:
                 # a dedicated compactor role takes over compaction; with
                 # none configured the store folds in-process (background
                 # thread), mirroring the segment log
+                # serving sessions never compact or vacuum: the writer
+                # owns storage maintenance (a reader rewriting runs
+                # would race the writer's version publishes)
                 self.store: MemoryStateStore = HummockStateStore(
                     data_dir, object_store=_obj,
-                    inline_compaction=(compactors == 0))
+                    inline_compaction=(compactors == 0
+                                       and role == "writer"))
             elif state_store == "segment":
                 from ..storage.checkpoint import DurableStateStore
                 self.store = DurableStateStore(data_dir, object_store=_obj)
@@ -396,10 +418,24 @@ class Session:
         # recovery (reference: meta managers, src/meta/src/manager/)
         import os as _os
         from ..meta.service import MetaBackedCatalog, MetaService
-        self.meta = MetaService(
-            data_dir=_os.path.join(data_dir, "meta")
-            if data_dir is not None else None)
+        if meta_addr is not None:
+            # remote control plane: the MetaClient mirrors the
+            # MetaService surface, so every call site below (and the
+            # catalog write-through) works unchanged over the wire
+            from ..meta.client import MetaClient
+            self.meta = MetaClient(meta_addr)
+        else:
+            self.meta = MetaService(
+                data_dir=_os.path.join(data_dir, "meta")
+                if data_dir is not None else None)
         self.catalog_writer = MetaBackedCatalog(self.catalog, self.meta)
+        # set once this writer's lease is superseded (a newer writer
+        # acquired the leader key): barrier injection and checkpoint
+        # commits are refused from then on
+        self._fenced = False
+        # remote reader pins (meta "hummock_pins" channel): the writer's
+        # vacuum treats serving sessions' pinned runs like local pins
+        self._remote_pin_runs: set[str] = set()
         # session-generation fencing token (ISSUE 9): monotone across
         # session restarts (persisted in the meta store) and bumped on
         # every scoped recovery. Stamped on every session→worker frame;
@@ -407,9 +443,20 @@ class Session:
         # session drops acks from older generations) nor commit
         # checkpoints (the worker refuses commit frames older than a
         # job's deployment generation).
-        self._generation = int(
-            self.meta.store.get("session_generation") or "0") + 1
-        self.meta.store.put("session_generation", str(self._generation))
+        if role == "writer":
+            self._generation = int(
+                self.meta.store.get("session_generation") or "0") + 1
+            self.meta.store.put("session_generation",
+                                str(self._generation))
+            if meta_addr is not None:
+                # the same token doubles as the writer's leader-lease
+                # fencing generation (last writer wins; no election —
+                # the single-leader assumption, docs/control-plane.md)
+                self.meta.acquire_leader(self._generation)
+        else:
+            # read-only attachment: adopt (never advance) the token
+            self._generation = int(
+                self.meta.store.get("session_generation") or "0")
         self._jobs_to_recover: list[str] = []
         self._dead_jobs: set[str] = set()
         self.meta.on_job_failure(self._jobs_to_recover.append)
@@ -445,7 +492,10 @@ class Session:
         # the failure detector's clock is the epoch counter: align it with
         # the session's starting epoch or a recovered session (epoch >> 0)
         # would instantly expire every worker registered at clock 0
-        self.meta.advance_epoch_clock(self.epoch)
+        # (writers only: a reader attaching on a stale store snapshot
+        # must not drag the shared clock backwards)
+        if role == "writer":
+            self.meta.advance_epoch_clock(self.epoch)
         self.jobs: dict[str, StreamJob] = {}          # mv/table name -> job
         # epoch co-scheduler: eligible MVs' epochs batched into one
         # dispatch per tick (stream/coschedule.py; [streaming]
@@ -570,8 +620,15 @@ class Session:
                 c = CompactorClient(data_dir, k)
                 c.spawn()
                 self.compactors.append(c)
-        if data_dir is not None:
+        if role == "serving":
+            # no jobs, no DDL replay, no barrier conduction: rebuild the
+            # catalog read cache from the meta store and follow the
+            # writer through notifications
+            self._attach_serving()
+        elif data_dir is not None:
             self._recover()
+        if meta_addr is not None:
+            self._attach_meta_observers()
 
     def _recover(self) -> None:
         """Crash recovery: replay the logged DDL over the recovered store.
@@ -683,6 +740,164 @@ class Session:
         finally:
             self._recovering = False
 
+    # -- multi-tenant attachment (docs/control-plane.md) -----------------------
+
+    def _attach_serving(self) -> None:
+        """Read-only attachment: the catalog read cache comes from the
+        meta store's ``catalog/`` keyspace, the data comes from the
+        shared Hummock dir, and both are kept current by notifications
+        (no jobs, no ticks, no generation bump — the writer owns those)."""
+        self._load_catalog_from_meta()
+        self._report_reader_pins()
+
+    def _load_catalog_from_meta(self) -> None:
+        """Rebuild the catalog from the persisted summaries the writer's
+        ``MetaBackedCatalog`` write-through maintains. Bracketed by the
+        seqlock: an optimistic reader racing the swap retries."""
+        import json as _json
+        from ..common.types import DataType, Field, Schema, TypeKind
+        from .catalog import (IndexDef, MaterializedViewDef, SinkDef,
+                              SourceDef, TableDef, type_from_name)
+
+        def _typ(name: str) -> DataType:
+            try:
+                return type_from_name(name)
+            except ValueError:
+                return DataType(TypeKind(name))
+
+        rows = self.meta.store.list_prefix("catalog/")
+        self._enter_mutation()
+        try:
+            cat = self.catalog
+            cat.sources.clear(); cat.tables.clear(); cat.mvs.clear()
+            cat.sinks.clear(); cat.indexes.clear()
+            max_id = 0
+            for _key, raw in rows:
+                d = _json.loads(raw)
+                kind, name = d["kind"], d["name"]
+                tid = int(d.get("table_id", -1))
+                max_id = max(max_id, tid)
+                pk = tuple(d.get("pk", ()))
+                if kind == "index":
+                    cat.indexes[name] = IndexDef(
+                        name, d.get("table", ""),
+                        tuple(d.get("columns", ())),
+                        d.get("mv_name", ""))
+                    continue
+                schema = Schema([Field(n, _typ(t))
+                                 for n, t in d.get("columns", [])])
+                if kind == "source":
+                    cat.sources[name] = SourceDef(
+                        name, schema, d.get("connector", ""), {})
+                elif kind == "table":
+                    cat.tables[name] = TableDef(name, schema, pk, tid)
+                elif kind == "materialized_view":
+                    cat.mvs[name] = MaterializedViewDef(
+                        name, schema, pk, tid, d.get("definition", ""))
+                elif kind == "sink":
+                    cat.sinks[name] = SinkDef(
+                        name, schema, d.get("connector", ""), {},
+                        d.get("from_name", ""), tid)
+            cat._next_table_id = max(cat._next_table_id, max_id + 1)
+        finally:
+            self._serving.invalidate_catalog()
+            self._exit_mutation()
+
+    def _attach_meta_observers(self) -> None:
+        """Subscribe to the remote meta's push channels. Observers run
+        on the MetaClient's subscription thread; every mutation they
+        perform is seqlock-bracketed so concurrent lock-free reads
+        retry instead of tearing."""
+        notif = self.meta.notifications
+        notif.subscribe("system_params", self._on_system_params_push)
+        notif.subscribe("leader", self._on_leader_push)
+        if self.role == "serving":
+            notif.subscribe("catalog", self._on_catalog_push)
+            notif.subscribe("checkpoint", self._on_checkpoint_push)
+        else:
+            notif.subscribe("hummock_pins", self._on_pins_push)
+            manager = getattr(self.store, "manager", None)
+            if manager is not None:
+                manager.external_refs = lambda: set(self._remote_pin_runs)
+        self.meta.on_resync(self._on_meta_resync)
+
+    def _on_catalog_push(self, _version: int, _info) -> None:
+        try:
+            self._load_catalog_from_meta()
+        except Exception:
+            pass        # next notification (or resync) retries
+
+    def _on_checkpoint_push(self, _version: int, _info) -> None:
+        refresh = getattr(self.store, "refresh", None)
+        if refresh is None:
+            return
+        try:
+            self._enter_mutation()
+            try:
+                refresh()
+            finally:
+                self._exit_mutation()
+            self._report_reader_pins()
+        except Exception:
+            pass        # transient object-store race; next checkpoint retries
+
+    def _on_system_params_push(self, _version: int, info) -> None:
+        try:
+            self._apply_system_param(info["name"], info["value"])
+        except Exception:
+            pass
+
+    def _on_leader_push(self, _version: int, info) -> None:
+        # only a STRICTLY newer generation fences: the subscription
+        # replays the log from the start, so our own (and older
+        # writers') acquisition events come past every observer
+        generation = info.get("generation")
+        if self.role == "writer" and generation is not None \
+                and generation > self._generation:
+            self._fenced = True
+
+    def _on_pins_push(self, _version: int, info) -> None:
+        self._remote_pin_runs = set(info.get("ssts", ()))
+
+    def _on_meta_resync(self) -> None:
+        """The meta process restarted (its notification log reset): the
+        durable state survived in its store, so re-read everything we
+        track through notifications. Writers re-check the lease but
+        never re-acquire — an auto-re-acquire could steal the lease back
+        from a legitimately newer writer."""
+        try:
+            if self.role == "writer":
+                from ..meta.client import MetaFenced
+                try:
+                    self.meta.assert_leader()
+                except MetaFenced:
+                    self._fenced = True
+            else:
+                self._load_catalog_from_meta()
+                self._on_checkpoint_push(0, None)
+        except Exception:
+            pass
+
+    def _report_reader_pins(self) -> None:
+        """Tell meta which SST runs this reader's current version holds
+        so the writer's vacuum spares them (the remote analogue of the
+        manager's local pin lease)."""
+        runs = getattr(self.store, "version_runs", None)
+        report = getattr(self.meta, "report_pins", None)
+        if runs is None or report is None:
+            return
+        try:
+            report(runs())
+        except Exception:
+            pass
+
+    def _check_fenced(self) -> None:
+        if self._fenced:
+            from ..meta.client import MetaFenced
+            raise MetaFenced(
+                "this session's writer lease was superseded; barrier "
+                "conduction and checkpoint commits are refused")
+
     # ------------------------------------------------------------------ SQL --
 
     @_locked
@@ -701,6 +916,13 @@ class Session:
         return out
 
     def _run_statement(self, stmt: A.Statement) -> list:
+        if self.role == "serving" and isinstance(stmt, (
+                A.CreateSource, A.CreateTable, A.CreateMaterializedView,
+                A.CreateSink, A.CreateIndex, A.DropStatement, A.Insert,
+                A.Delete, A.Update, A.FlushStatement)):
+            raise SqlError(
+                "serving sessions are read-only: run DDL/DML on the "
+                "writer session (docs/control-plane.md)")
         if isinstance(stmt, (A.CreateSource, A.CreateTable,
                              A.CreateMaterializedView, A.CreateSink,
                              A.CreateIndex)):
@@ -771,14 +993,25 @@ class Session:
 
     def _set_param(self, stmt: A.SetStatement) -> list:
         """Runtime-mutable system params (reference:
-        src/common/src/system_param/mod.rs — hot-propagated; here applied
-        directly since the session IS the cluster)."""
+        src/common/src/system_param/mod.rs — hot-propagated). ``SET``
+        applies to this session; ``ALTER SYSTEM SET`` additionally
+        publishes a ``system_params`` notification through meta so every
+        attached session (writer and readers alike) applies it live."""
         from ..common.config import MUTABLE_SYSTEM_PARAMS
         name = stmt.name.lower()
         coerce = MUTABLE_SYSTEM_PARAMS.get(name)
         if coerce is None:
             raise SqlError(f"unknown or immutable parameter {stmt.name!r}")
         value = coerce(stmt.value)
+        self._apply_system_param(name, value)
+        if getattr(stmt, "system", False):
+            self.meta.notifications.notify(
+                "system_params", {"name": name, "value": value})
+        return []
+
+    def _apply_system_param(self, name: str, value) -> None:
+        """Assign one mutable param (idempotent: a session's own ALTER
+        SYSTEM comes back to it on the notification channel too)."""
         if name == "checkpoint_frequency":
             if value < 1:
                 raise SqlError("checkpoint_frequency must be >= 1")
@@ -789,7 +1022,6 @@ class Session:
             self.barrier_interval_ms = value   # read live by the CLI ticker
         elif name == "slow_epoch_threshold_ms":
             self.slow_epoch_threshold_ms = max(0.0, value)
-        return []
 
     def parameters(self) -> list:
         """SHOW PARAMETERS rows (name, value)."""
@@ -3020,6 +3252,14 @@ class Session:
 
     def _tick_impl(self, generate: bool, checkpoint: Optional[bool],
                    mutation: Optional[Mutation]) -> int:
+        if self.role == "serving":
+            raise RuntimeError(
+                "serving sessions do not conduct barriers: only the "
+                "writer session ticks (docs/control-plane.md)")
+        # a fenced ex-writer must not inject another barrier: a newer
+        # writer owns conduction now (lease loss arrives either on the
+        # leader notification channel or as a refused publish/commit)
+        self._check_fenced()
         epoch = self._injected + 1
         # tag this tick's dispatch spans (common/profiling.py) so a slow
         # epoch's span-tree capture includes the dispatches that caused it
@@ -3028,7 +3268,14 @@ class Session:
         if checkpoint is None:
             checkpoint = epoch % self.checkpoint_frequency == 0
         # keep the worker registry in sync with the live job set (workers
-        # register with last_heartbeat = the current epoch clock)
+        # register with last_heartbeat = the current epoch clock). With a
+        # remote meta, re-anchor the epoch clock FIRST: a restarted meta
+        # process comes back with clock 0, and letting sync_jobs register
+        # at 0 before completion advances to `epoch` would expire every
+        # job in one jump (in-process meta: clock already equals
+        # self.epoch, so this is a no-op kept off that path)
+        if self.meta_addr is not None:
+            self.meta.advance_epoch_clock(self.epoch)
         self.meta.sync_jobs(self.jobs.keys())
         if mutation is None and self._pending_mutation is not None:
             mutation = self._pending_mutation
@@ -3317,16 +3564,35 @@ class Session:
         # control-plane publication (reference: barrier_complete responses +
         # hummock version notifications, SURVEY.md §3.2 tail)
         self.meta.advance_epoch_clock(e)
-        self.meta.publish_barrier(e, ckpt)
-        if ckpt:
-            self.meta.publish_checkpoint(e)
-            if self.compactors:
-                self._kick_compaction()
+        try:
+            self.meta.publish_barrier(e, ckpt)
+            if ckpt:
+                self.meta.publish_checkpoint(e)
+        except Exception as exc:
+            # a refused publish is how a stale writer learns it lost the
+            # lease when the leader notification hasn't landed yet
+            if type(exc).__name__ == "MetaFenced":
+                self._fenced = True
+            raise
+        if ckpt and self.compactors:
+            self._kick_compaction()
 
     def _commit_checkpoint(self, e: int) -> None:
         """Phase 2 of the cluster checkpoint for epoch ``e``: split
         offsets + the session store tier, then the workers' staged
         epochs."""
+        # lease check BEFORE anything becomes durable: a stale ex-writer
+        # (remote meta, lease superseded) must not commit. One host-side
+        # RPC per checkpoint — nothing on the device path.
+        self._check_fenced()
+        assert_leader = getattr(self.meta, "assert_leader", None)
+        if assert_leader is not None and self.role == "writer":
+            from ..meta.client import MetaFenced
+            try:
+                assert_leader()
+            except MetaFenced:
+                self._fenced = True
+                raise
         # persist source split offsets atomically with the epoch commit
         # (reference: split state committed with the checkpoint barrier)
         from ..common.types import VARCHAR
@@ -4232,7 +4498,8 @@ class Session:
         if self.loop.is_closed():
             return
         self._serving.shutdown()      # stop the batch-task pool first
-        self._drain_inflight()
+        if not self._fenced:
+            self._drain_inflight()
         self.store.join_commits()     # deferred checkpoint encode lands
         for job in list(self.jobs.values()):
             sink = getattr(job.pipeline, "sink", None)
@@ -4288,6 +4555,14 @@ class Session:
         self.loop.run_until_complete(_drain_finalizers())
         self.loop.run_until_complete(self.loop.shutdown_asyncgens())
         self.loop.close()
+        # detach from a remote meta last: observers above may still have
+        # been delivering (the in-process MetaService has no close)
+        meta_close = getattr(self.meta, "close", None)
+        if meta_close is not None:
+            try:
+                meta_close()
+            except Exception:  # noqa: BLE001 - already dying
+                pass
 
     def _bump_generation(self) -> None:
         """Advance the session-generation fencing token (persisted in

@@ -321,10 +321,13 @@ class FlushStatement:
 
 @dataclasses.dataclass(frozen=True)
 class SetStatement:
-    """SET param = value (system params / session vars)."""
+    """SET param = value (system params / session vars). ``system``
+    marks the ALTER SYSTEM SET variant: the change propagates to every
+    session attached to the same meta via a notification."""
 
     name: str
     value: Any
+    system: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

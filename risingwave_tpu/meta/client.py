@@ -1,0 +1,403 @@
+"""MetaClient: the MetaService surface over a wire connection.
+
+Drop-in for the in-process ``MetaService``: the Session constructs one
+of these instead when given a ``meta_addr`` and every existing call
+site — ``meta.store.put``, ``meta.job_heartbeat``, ``meta.publish_barrier``,
+``MetaBackedCatalog`` write-throughs — works unchanged. Two sockets per
+client (the reference frontend's pair of meta channels,
+src/rpc_client/src/meta_client.rs):
+
+* a **sync request channel** — strict request/reply frames under a lock
+  (the CompactorClient idiom). Store ops re-raise ``TxnConflict``
+  exactly as the local store does; lease-fenced publishes raise
+  ``MetaFenced``.
+* a **subscription channel** — a daemon reader thread that receives
+  notification pushes and fans them out to locally registered
+  observers through ``_NotificationRelay`` (same ``subscribe``/
+  ``notify``/``current_version`` surface as ``NotificationManager``).
+
+Reconnect story: a failed request retries once after re-dialing with
+backoff (every mutation on this surface is idempotent — puts, deletes,
+heartbeats, publishes). The subscription thread re-dials forever until
+``close()``; because the server's notification log is in-memory, a meta
+restart resets versions, so after every re-subscribe the client fires
+its registered **resync callbacks** — the session uses these to reload
+the catalog from the (persisted) meta store, refresh its storage view,
+re-assert the writer lease, and invalidate plan caches. Readers
+therefore resume on the persisted meta store after a kill -9 without
+operator involvement.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Callable, List, Optional, Set, Tuple
+
+from ..rpc.wire import read_frame_sync, write_frame_sync
+from .service import MetaService
+from .store import TxnConflict
+
+#: chaos-plane link name for every client->meta frame (sim.py scenarios
+#: inject drops/latency here the same way they do on exchange links)
+META_LINK = "meta"
+
+
+class MetaUnavailable(ConnectionError):
+    """The meta server could not be reached within the reconnect budget."""
+
+
+class MetaFenced(RuntimeError):
+    """This writer's lease generation was superseded — it must stop
+    conducting barriers and committing checkpoints immediately."""
+
+
+class RemoteMetaStore:
+    """``MetaStore`` surface over the sync request channel."""
+
+    def __init__(self, client: "MetaClient"):
+        self._client = client
+
+    def get(self, key: str) -> Optional[str]:
+        return self._client.call("store.get", {"key": key})
+
+    def put(self, key: str, value: str) -> None:
+        self._client.call("store.put", {"key": key, "value": value})
+
+    def delete(self, key: str) -> None:
+        self._client.call("store.delete", {"key": key})
+
+    def list_prefix(self, prefix: str) -> List[Tuple[str, str]]:
+        rows = self._client.call("store.list_prefix", {"prefix": prefix})
+        return [(k, v) for k, v in rows]
+
+    def txn(self, preconditions=None, ops=None) -> None:
+        self._client.call("store.txn", {
+            "preconditions": [[k, v] for k, v in (preconditions or [])],
+            "ops": [list(op) for op in (ops or [])]})
+
+    def compact(self) -> None:  # server-side concern; no-op remotely
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _NotificationRelay:
+    """Local observer registry fed by the subscription channel; mirrors
+    the ``NotificationManager`` surface the session and catalog use."""
+
+    def __init__(self, client: "MetaClient"):
+        self._client = client
+        self._lock = threading.Lock()
+        self._version = 0
+        self._log: List[Tuple[int, str, Any]] = []
+        self._observers: dict = {}
+
+    @property
+    def current_version(self) -> int:
+        return self._version
+
+    def notify(self, channel: str, info: Any) -> int:
+        """Publish through the server; local observers fire when the
+        push comes back on the subscription channel (total order is the
+        server's, not the caller's)."""
+        return self._client.call("notify", {"channel": channel,
+                                            "info": info})
+
+    def subscribe(self, channel: str, fn: Callable[[int, Any], None],
+                  from_version: int = 0) -> int:
+        with self._lock:
+            replay = [(v, ch, info) for v, ch, info in self._log
+                      if ch == channel and v > from_version]
+            self._observers.setdefault(channel, []).append(fn)
+            version = self._version
+        for v, _ch, info in replay:
+            fn(v, info)
+        return version
+
+    def unsubscribe(self, channel: str, fn) -> None:
+        with self._lock:
+            obs = self._observers.get(channel, [])
+            if fn in obs:
+                obs.remove(fn)
+
+    # -- fed by the subscription reader thread --------------------------------
+
+    def _deliver(self, version: int, channel: str, info: Any) -> None:
+        with self._lock:
+            self._version = max(self._version, version)
+            self._log.append((version, channel, info))
+            observers = list(self._observers.get(channel, []))
+        for fn in observers:
+            try:
+                fn(version, info)
+            except Exception:
+                pass
+
+    def _reset(self) -> None:
+        """Server restarted: its in-memory log (and versions) reset."""
+        with self._lock:
+            self._log = []
+            self._version = 0
+
+
+class MetaClient:
+    """One frontend's attachment to a remote meta control plane."""
+
+    HEARTBEAT_TTL_EPOCHS = MetaService.HEARTBEAT_TTL_EPOCHS
+
+    #: give up on the sync channel after this long without a connection
+    RECONNECT_TIMEOUT_S = 10.0
+    _BACKOFF_S = (0.02, 0.05, 0.1, 0.2, 0.5, 1.0)
+
+    def __init__(self, addr: str, session_id: Optional[str] = None,
+                 reconnect_timeout_s: Optional[float] = None):
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.addr = addr
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        if reconnect_timeout_s is not None:
+            self.RECONNECT_TIMEOUT_S = reconnect_timeout_s
+        #: the writer session's fencing token (None for serving sessions)
+        self.generation: Optional[int] = None
+        self._lock = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        self._failure_fns: List[Callable[[str], None]] = []
+        self._resync_fns: List[Callable[[], None]] = []
+        self._reported_pins: Set[str] = set()
+        self.stats = {"reconnects": 0, "resyncs": 0, "requests": 0}
+        self.store = RemoteMetaStore(self)
+        self.notifications = _NotificationRelay(self)
+        self._dial()  # fail fast on a bad address
+        self._sub_thread = threading.Thread(
+            target=self._subscription_loop, name="meta-subscriber",
+            daemon=True)
+        self._sub_thread.start()
+
+    # -- sync request channel --------------------------------------------------
+
+    def _dial(self) -> socket.socket:
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=10.0)
+            return self._sock
+
+    def _drop_conn(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _reconnect(self) -> None:
+        deadline = time.monotonic() + self.RECONNECT_TIMEOUT_S
+        for i in range(10 ** 6):
+            if self._closed:
+                raise MetaUnavailable("meta client closed")
+            try:
+                self._dial()
+                self.stats["reconnects"] += 1
+                # a new meta process does not know our pins: re-report
+                if self._reported_pins:
+                    self._request("pins.report",
+                                  {"ssts": sorted(self._reported_pins)})
+                return
+            except OSError:
+                self._drop_conn()
+                if time.monotonic() >= deadline:
+                    raise MetaUnavailable(
+                        f"meta at {self.addr} unreachable for "
+                        f"{self.RECONNECT_TIMEOUT_S:.0f}s")
+                time.sleep(self._BACKOFF_S[min(i, len(self._BACKOFF_S) - 1)])
+
+    def _request(self, method: str, params: Optional[dict]) -> Any:
+        with self._lock:
+            sock = self._dial()
+            write_frame_sync(sock, {"method": method,
+                                    "params": params or {}},
+                             link=META_LINK)
+            reply = read_frame_sync(sock)
+        if reply is None:
+            raise ConnectionError("meta connection closed mid-request")
+        if reply.get("ok"):
+            return reply.get("result")
+        error = reply.get("error")
+        message = reply.get("message", "")
+        if error == "txn_conflict":
+            raise TxnConflict(message)
+        if error == "fenced":
+            raise MetaFenced(message)
+        raise RuntimeError(f"meta {method} failed: {message}")
+
+    def call(self, method: str, params: Optional[dict] = None) -> Any:
+        """One request/reply; on a broken connection, re-dial with
+        backoff and retry once (all meta mutations are idempotent)."""
+        if self._closed:
+            raise MetaUnavailable("meta client closed")
+        self.stats["requests"] += 1
+        with self._lock:
+            try:
+                return self._request(method, params)
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, MetaUnavailable):
+                    raise
+                self._drop_conn()
+                self._reconnect()
+                return self._request(method, params)
+
+    def ping(self) -> dict:
+        return self.call("ping")
+
+    # -- MetaService surface ---------------------------------------------------
+
+    def register_job(self, name: str) -> int:
+        return self.call("register_job", {"name": name})
+
+    def deregister_job(self, name: str) -> None:
+        self.call("deregister_job", {"name": name})
+
+    def job_heartbeat(self, name: str) -> None:
+        self.call("job_heartbeat", {"name": name})
+
+    def sync_jobs(self, names) -> None:
+        self.call("sync_jobs", {"names": list(names)})
+
+    def advance_epoch_clock(self, epoch: int) -> None:
+        self.call("advance_epoch_clock", {"epoch": epoch})
+
+    def check_job_failures(self) -> list:
+        failed = self.call("check_job_failures") or []
+        for name in failed:
+            for fn in list(self._failure_fns):
+                fn(name)
+        return failed
+
+    def on_job_failure(self, fn: Callable[[str], None]) -> None:
+        self._failure_fns.append(fn)
+
+    def register_compute(self, worker_id: int, host: str, port: int,
+                         parallelism: int = 1) -> None:
+        self.call("register_compute", {
+            "worker_id": worker_id, "host": host, "port": port,
+            "parallelism": parallelism})
+
+    def save_placement(self, placement) -> None:
+        self.call("save_placement", {"placement": placement.to_json()})
+
+    def load_placement(self, job: str):
+        from .fragment import FragmentPlacement
+        raw = self.call("load_placement", {"job": job})
+        return None if raw is None else FragmentPlacement.from_json(raw)
+
+    def drop_placement(self, job: str) -> None:
+        self.call("drop_placement", {"job": job})
+
+    def all_placements(self) -> dict:
+        from .fragment import FragmentPlacement
+        out = {}
+        for job, raw in (self.call("all_placements") or {}).items():
+            out[job] = FragmentPlacement.from_json(raw)
+        return out
+
+    def publish_barrier(self, epoch: int, checkpoint: bool) -> None:
+        self.call("publish_barrier", {
+            "epoch": epoch, "checkpoint": checkpoint,
+            "generation": self.generation})
+
+    def publish_checkpoint(self, committed_epoch: int) -> None:
+        self.call("publish_checkpoint", {
+            "committed_epoch": committed_epoch,
+            "generation": self.generation})
+
+    # -- leader lease ----------------------------------------------------------
+
+    def acquire_leader(self, generation: int) -> int:
+        """Claim the writer lease under this session's generation.
+        Last writer wins; the previous holder is fenced from then on."""
+        self.generation = generation
+        return self.call("lease.acquire", {
+            "session": self.session_id, "generation": generation})
+
+    def assert_leader(self) -> None:
+        """Raise ``MetaFenced`` if this client no longer holds the lease."""
+        self.call("lease.assert", {"generation": self.generation})
+
+    # -- remote pin registry ---------------------------------------------------
+
+    def report_pins(self, ssts) -> None:
+        self._reported_pins = set(ssts)
+        self.call("pins.report", {"ssts": sorted(self._reported_pins)})
+
+    def pins_union(self) -> Set[str]:
+        return set(self.call("pins.union") or [])
+
+    # -- subscription channel --------------------------------------------------
+
+    def on_resync(self, fn: Callable[[], None]) -> None:
+        """Register a callback fired after every (re)subscription —
+        i.e. at attach and after a meta restart/reconnect. The session
+        hooks catalog reload, store refresh, and lease re-assertion here."""
+        self._resync_fns.append(fn)
+
+    def _subscription_loop(self) -> None:
+        first = True
+        while not self._closed:
+            sock = None
+            try:
+                sock = socket.create_connection(self._addr, timeout=10.0)
+                write_frame_sync(sock, {"method": "subscribe",
+                                        "params": {"from_version": 0}},
+                                 link=META_LINK)
+                if not first:
+                    # server may have restarted: mirror log is stale,
+                    # and registered resync callbacks re-read durable
+                    # state (initial attach does that work inline)
+                    self.notifications._reset()
+                    self._fire_resync()
+                first = False
+                while not self._closed:
+                    frame = read_frame_sync(sock)
+                    if frame is None:
+                        break
+                    self.notifications._deliver(
+                        frame["version"], frame["channel"], frame["info"])
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            if not self._closed:
+                time.sleep(0.1)
+
+    def _fire_resync(self) -> None:
+        self.stats["resyncs"] += 1
+        for fn in list(self._resync_fns):
+            try:
+                fn()
+            except Exception:
+                pass
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        self._drop_conn()
+        if self._sub_thread.is_alive():
+            self._sub_thread.join(timeout=2.0)
+
+
+def leader_record(session: str, generation: int) -> str:
+    """The JSON the leader lease key holds (kept next to the client so
+    tests and ctl can decode it without importing the server)."""
+    return json.dumps({"session": session, "generation": generation})

@@ -63,6 +63,11 @@ class HummockManager:
         # orphan about to become referenced — registering the upload
         # closes that window (reference: vacuum's SST-id watermark)
         self._pending_uploads: Set[str] = set()
+        # optional provider of EXTRA referenced SSTs: remote reader
+        # sessions report their pinned runs through the meta control
+        # plane, and the writer installs a hook here so vacuum treats
+        # them like local pins (docs/control-plane.md)
+        self.external_refs: Optional[Callable[[], Set[str]]] = None
         # observability counters (surfaced via Session.metrics()["storage"]
         # and the Prometheus exposition)
         self.stats = {
@@ -99,6 +104,18 @@ class HummockManager:
         """The current version (immutable snapshot; safe to hold)."""
         with self._lock:
             return self._version
+
+    def reload(self) -> HummockVersion:
+        """Adopt the PUBLISHED version (reader processes: another
+        process's manager is the committer — our in-memory copy only
+        chases it). Pins keep the snapshots they leased."""
+        with self._lock:
+            v = self._load_or_init()
+            self._version = v
+            self.stats["version_id"] = v.vid
+            self.stats["l0_runs"] = len(v.l0)
+            self.stats["l1_runs"] = len(v.l1)
+            return v
 
     def exists(self) -> bool:
         return self.store.exists(VERSION_KEY)
@@ -247,6 +264,8 @@ class HummockManager:
                 refs.update(v.all_runs())
             for t in self._inflight.values():
                 refs.update(t.inputs)
+            if self.external_refs is not None:
+                refs.update(self.external_refs())
             return refs
 
     def _protected_prefixes(self) -> List[str]:

@@ -18,6 +18,10 @@ class NotificationManager:
         self._version = 0
         self._log: List[Tuple[int, str, Any]] = []   # (version, channel, info)
         self._observers: Dict[str, List[Callable[[int, Any], None]]] = {}
+        # wildcard observers see every channel: (version, channel, info).
+        # The meta server's subscription push uses this — one remote
+        # frontend is one observer, fanning out per-channel on its side.
+        self._all_observers: List[Callable[[int, str, Any], None]] = []
 
     @property
     def current_version(self) -> int:
@@ -29,6 +33,8 @@ class NotificationManager:
         self._log.append((self._version, channel, info))
         for fn in self._observers.get(channel, []):
             fn(self._version, info)
+        for fn in list(self._all_observers):
+            fn(self._version, channel, info)
         return self._version
 
     def subscribe(self, channel: str,
@@ -47,3 +53,18 @@ class NotificationManager:
         obs = self._observers.get(channel, [])
         if fn in obs:
             obs.remove(fn)
+
+    def subscribe_all(self, fn: Callable[[int, str, Any], None],
+                      from_version: int = 0) -> int:
+        """Register a wildcard observer; replays every channel's deltas
+        after ``from_version`` first (snapshot catch-up), same contract
+        as ``subscribe``. Returns the version the observer is current to."""
+        for v, ch, info in self._log:
+            if v > from_version:
+                fn(v, ch, info)
+        self._all_observers.append(fn)
+        return self._version
+
+    def unsubscribe_all(self, fn) -> None:
+        if fn in self._all_observers:
+            self._all_observers.remove(fn)

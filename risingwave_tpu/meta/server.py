@@ -1,0 +1,348 @@
+"""MetaServer: the control plane as its own process.
+
+The paper's layer map makes the meta node a distinct role — "cluster
+brain: catalog, barrier injection bookkeeping, Hummock version
+management, scheduling" (reference: src/meta/src/rpc/server.rs). Until
+now our ``MetaService`` was an object living *inside* the session, so a
+second frontend could never attach. This module lifts the exact same
+surface behind the ``rpc/wire.py`` frame protocol:
+
+* **request/reply** — each connection carries sequential
+  ``{"method", "params"}`` frames answered by ``{"ok", "result"}`` or
+  ``{"ok": false, "error", "message"}``. The method names mirror
+  ``MetaService``/``MetaStore`` one-to-one so ``MetaClient`` can be a
+  drop-in for the in-process service.
+* **subscription push** — a connection that sends ``subscribe`` is
+  switched into one-way push mode: the server replays the notification
+  log from the requested version, then streams every subsequent
+  ``notify`` as its own frame. This is the reference's
+  ``NotificationService`` observer stream (meta/src/rpc/server.rs +
+  notification.rs) — readers learn about DDL, checkpoints, and system
+  params without polling.
+* **leader lease** — a single persisted store key (``leader``) holding
+  ``{"session", "generation"}``. Acquisition is last-writer-wins (no
+  election — the single-leader assumption is documented in
+  docs/control-plane.md); *fencing* is enforced server-side: barrier /
+  checkpoint publishes carrying a stale generation are refused, so an
+  ex-writer that lost the lease can neither conduct nor commit.
+* **remote pin registry** — serving sessions report the SST runs their
+  pinned snapshots reference; the union is pushed on the
+  ``hummock_pins`` channel so the writer's vacuum can treat remote
+  readers like local pins (storage safety rule: an object may be
+  deleted iff no version, pin, or in-flight task references it).
+
+The server is runnable two ways: in-thread (``MetaServer.start()`` —
+tests, playground composition) and as a standalone process
+(``python -m risingwave_tpu.meta.server`` / ``ctl meta serve``). State
+durability is exactly the MetaService's: a ``FileMetaStore`` JSONL under
+``data_dir`` when one is given, so kill -9 + restart resumes catalog,
+placements, and the leader lease; the notification log is in-memory and
+dies with the process — reconnecting clients must full-resync, which
+``MetaClient`` does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import threading
+from typing import Any, Dict, Optional, Set
+
+from ..rpc.wire import pack_frame, read_frame
+from .service import MetaService
+from .store import TxnConflict
+
+#: store key holding the writer lease (persisted: fencing survives a
+#: meta restart on the same data dir)
+LEADER_KEY = "leader"
+
+
+class MetaServer:
+    """Serve one ``MetaService`` over wire frames.
+
+    All request handling runs on the asyncio loop thread, so the
+    underlying ``MetaService`` needs no extra locking: frames on one
+    connection are sequential, and frames across connections are
+    serialized by the loop.
+    """
+
+    def __init__(self, data_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.service = MetaService(data_dir=data_dir)
+        self._host = host
+        self._port = port
+        self.addr: Optional[str] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        # conn-id -> set of SST names its pinned snapshots reference
+        self._remote_pins: Dict[int, Set[str]] = {}
+        self._conn_ids = iter(range(1, 1 << 62))
+        self.stats = {"connections": 0, "requests": 0, "subscribers": 0,
+                      "fenced_rejections": 0}
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> str:
+        """Start serving on a daemon thread; returns ``host:port``."""
+        self._thread = threading.Thread(
+            target=self._run, name="meta-server", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("MetaServer failed to start")
+        assert self.addr is not None
+        return self.addr
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(self._open())
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(self._close())
+            loop.close()
+
+    async def _open(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self._host, self._port)
+        sock = self._server.sockets[0]
+        host, port = sock.getsockname()[:2]
+        self.addr = f"{host}:{port}"
+
+    async def _close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for task in asyncio.all_tasks(self._loop):
+            if task is not asyncio.current_task():
+                task.cancel()
+        await asyncio.sleep(0)
+        close = getattr(self.service.store, "close", None)
+        if close is not None:
+            close()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+        loop = self._loop
+        if loop.is_running():
+            loop.call_soon_threadsafe(loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._loop = None
+
+    # -- connection handling ---------------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        conn_id = next(self._conn_ids)
+        self.stats["connections"] += 1
+        observer = None
+        try:
+            while True:
+                req = await read_frame(reader)
+                if req is None:
+                    break
+                self.stats["requests"] += 1
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                if method == "subscribe":
+                    observer = await self._subscribe(writer, params)
+                    continue
+                try:
+                    result = self._dispatch(conn_id, method, params)
+                    reply = {"ok": True, "result": result}
+                except TxnConflict as e:
+                    reply = {"ok": False, "error": "txn_conflict",
+                             "message": str(e)}
+                except Fenced as e:
+                    self.stats["fenced_rejections"] += 1
+                    reply = {"ok": False, "error": "fenced",
+                             "message": str(e)}
+                except Exception as e:  # surface, don't kill the conn
+                    reply = {"ok": False, "error": "internal",
+                             "message": f"{type(e).__name__}: {e}"}
+                writer.write(pack_frame(reply))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass
+        finally:
+            if observer is not None:
+                self.service.notifications.unsubscribe_all(observer)
+                self.stats["subscribers"] -= 1
+            if self._remote_pins.pop(conn_id, None) is not None:
+                self._notify_pins()
+            writer.close()
+
+    async def _subscribe(self, writer: asyncio.StreamWriter,
+                         params: dict):
+        """Switch this connection into push mode: replay from
+        ``from_version`` then stream live notifications. Pushes are
+        fire-and-forget writes from the loop thread — a slow subscriber
+        buffers in its transport, a dead one is dropped on write error."""
+        from_version = int(params.get("from_version", 0))
+
+        def push(version: int, channel: str, info: Any) -> None:
+            try:
+                writer.write(pack_frame({"channel": channel, "info": info,
+                                         "version": version}))
+            except Exception:
+                pass
+
+        # subscribe to every channel: the client-side relay fans out
+        self.service.notifications.subscribe_all(
+            push, from_version=from_version)
+        self.stats["subscribers"] += 1
+        await writer.drain()
+        return push
+
+    # -- request dispatch ------------------------------------------------------
+
+    def _dispatch(self, conn_id: int, method: str, p: dict) -> Any:
+        svc = self.service
+        store = svc.store
+        if method == "ping":
+            return {"version": svc.notifications.current_version}
+        # meta store surface
+        if method == "store.get":
+            return store.get(p["key"])
+        if method == "store.put":
+            store.put(p["key"], p["value"])
+            return None
+        if method == "store.delete":
+            store.delete(p["key"])
+            return None
+        if method == "store.list_prefix":
+            return [[k, v] for k, v in store.list_prefix(p["prefix"])]
+        if method == "store.txn":
+            pre = [(k, v) for k, v in p.get("preconditions", [])]
+            ops = [tuple(op) for op in p.get("ops", [])]
+            store.txn(preconditions=pre, ops=ops)
+            return None
+        # notification hub
+        if method == "notify":
+            return svc.notifications.notify(p["channel"], p["info"])
+        if method == "current_version":
+            return svc.notifications.current_version
+        # job worker registry
+        if method == "register_job":
+            w = svc.register_job(p["name"])
+            return w.worker_id
+        if method == "deregister_job":
+            svc.deregister_job(p["name"])
+            return None
+        if method == "job_heartbeat":
+            svc.job_heartbeat(p["name"])
+            return None
+        if method == "sync_jobs":
+            svc.sync_jobs(p["names"])
+            return None
+        if method == "advance_epoch_clock":
+            svc.advance_epoch_clock(p["epoch"])
+            return None
+        if method == "check_job_failures":
+            return svc.check_job_failures()
+        if method == "register_compute":
+            svc.register_compute(p["worker_id"], p["host"], p["port"],
+                                 p.get("parallelism", 1))
+            return None
+        # fragment placement
+        if method == "save_placement":
+            from .fragment import FragmentPlacement
+            svc.save_placement(FragmentPlacement.from_json(p["placement"]))
+            return None
+        if method == "load_placement":
+            placement = svc.load_placement(p["job"])
+            return None if placement is None else placement.to_json()
+        if method == "drop_placement":
+            svc.drop_placement(p["job"])
+            return None
+        if method == "all_placements":
+            return {job: pl.to_json()
+                    for job, pl in svc.all_placements().items()}
+        # barrier conduction (fenced: only the current leader publishes)
+        if method == "publish_barrier":
+            self._check_fence(p)
+            svc.publish_barrier(p["epoch"], p["checkpoint"])
+            return None
+        if method == "publish_checkpoint":
+            self._check_fence(p)
+            svc.publish_checkpoint(p["committed_epoch"])
+            return None
+        # leader lease
+        if method == "lease.acquire":
+            store.put(LEADER_KEY, json.dumps(
+                {"session": p["session"], "generation": p["generation"]}))
+            svc.notifications.notify(
+                "leader", {"session": p["session"],
+                           "generation": p["generation"]})
+            return p["generation"]
+        if method == "lease.assert":
+            self._check_fence(p)
+            return True
+        # remote pin registry (vacuum safety for reader snapshots)
+        if method == "pins.report":
+            self._remote_pins[conn_id] = set(p["ssts"])
+            self._notify_pins()
+            return None
+        if method == "pins.union":
+            return sorted(self._pins_union())
+        raise ValueError(f"unknown meta method: {method}")
+
+    def _check_fence(self, p: dict) -> None:
+        raw = self.service.store.get(LEADER_KEY)
+        if raw is None:
+            return
+        holder = json.loads(raw)
+        generation = p.get("generation")
+        if generation is not None and generation != holder["generation"]:
+            raise Fenced(
+                f"generation {generation} fenced by leader "
+                f"{holder['session']} generation {holder['generation']}")
+
+    def _pins_union(self) -> Set[str]:
+        out: Set[str] = set()
+        for ssts in self._remote_pins.values():
+            out.update(ssts)
+        return out
+
+    def _notify_pins(self) -> None:
+        self.service.notifications.notify(
+            "hummock_pins", {"ssts": sorted(self._pins_union())})
+
+
+class Fenced(RuntimeError):
+    """A stale writer tried to publish under a lost lease."""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="risingwave-meta",
+        description="Serve the meta control plane over wire frames.")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--data-dir", default=None,
+                    help="durable meta store directory (JSONL)")
+    args = ap.parse_args(argv)
+    server = MetaServer(data_dir=args.data_dir, host=args.host,
+                        port=args.port)
+    addr = server.start()
+    # machine-readable readiness line: subprocess drivers parse this
+    print(f"META_READY {addr}", flush=True)
+    try:
+        assert server._thread is not None
+        while server._thread.is_alive():
+            server._thread.join(timeout=1.0)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,8 +173,11 @@ class MetaBackedCatalog:
         schema = getattr(obj, "schema", None)
         if schema is not None:
             d["columns"] = [(f.name, f.type.kind.value) for f in schema]
+        # "table"/"columns"/"mv_name" carry IndexDef (no schema attr, so
+        # the "columns" key cannot collide with the schema list above) —
+        # serving sessions rebuild index entries from these
         for attr in ("table_id", "connector", "pk", "definition",
-                     "from_name"):
+                     "from_name", "table", "columns", "mv_name"):
             v = getattr(obj, attr, None)
             if v is not None and v != "":
                 d[attr] = list(v) if isinstance(v, tuple) else v

@@ -1018,6 +1018,87 @@ def run_udf_chaos(seed: int = 11, data_dir: Optional[str] = None,
         sim.close()
 
 
+def meta_chaos_schedule(seed: int) -> ChaosSchedule:
+    """Seeded delays on the session→meta RPC link (meta/client.py
+    META_LINK). Delay-only BY DESIGN: the meta protocol is sequential
+    request/reply on one socket with no per-request ids, so the
+    absorb-or-degrade contract under latency is "ticks slow down,
+    nothing diverges" — frame drops/dups model a failed meta process,
+    which is the kill -9 restart test's job
+    (tests/test_meta_control_plane.py), not a frame-level fault."""
+    from .meta.client import META_LINK
+    return ChaosSchedule(seed, [
+        ChaosRule(kind="delay", link=META_LINK, prob=0.4, delay_ms=3.0),
+    ], name="meta_link_delay")
+
+
+def run_meta_chaos(seed: int = 13, data_dir: Optional[str] = None,
+                   ticks: int = 5) -> dict:
+    """Meta-link latency scenario (docs/control-plane.md): a writer
+    session attached to a STANDALONE MetaServer runs DDL + DML + ticks
+    while every meta RPC frame is seeded-delayed; a serving session then
+    attaches over the same slow link and must converge on the writer's
+    catalog and data. Audited bit-exact against an in-process control
+    (which never touches the faulty link). Returns the per-link
+    injection trace — the same seed reproduces it identically."""
+    import tempfile
+
+    from .common.audit import ConsistencyAuditor
+    from .meta.client import META_LINK
+    from .meta.server import MetaServer
+
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_metachaos_")
+    install(meta_chaos_schedule(seed))
+    meta = MetaServer(data_dir=os.path.join(data_dir, "meta"))
+    addr = meta.start()
+    writer = Session(data_dir=data_dir, meta_addr=addr,
+                     state_store="hummock", checkpoint_frequency=2)
+    control = Session(checkpoint_frequency=2)
+    reader: Optional[Session] = None
+    try:
+        for s in (writer, control):
+            s.run_sql("CREATE TABLE mt (k BIGINT, v BIGINT)")
+            s.run_sql("CREATE MATERIALIZED VIEW mq AS SELECT k, "
+                      "count(*) AS n, sum(v) AS s FROM mt GROUP BY k")
+        for i in range(ticks):
+            stmt = f"INSERT INTO mt VALUES ({i % 3}, {i * 10})"
+            writer.run_sql(stmt)
+            control.run_sql(stmt)
+            writer.tick()
+            control.tick()
+        writer.flush()
+        control.flush()
+        # a reader attaching OVER the slow link still converges: its
+        # catalog load + snapshot adoption are plain meta RPCs
+        reader = Session(data_dir=data_dir, meta_addr=addr,
+                         role="serving")
+        got = sorted(reader.run_sql("SELECT * FROM mq"))
+        want = sorted(control.run_sql("SELECT * FROM mq"))
+        assert got == want, (
+            f"reader diverged under meta-link delay: {got[:5]} vs "
+            f"{want[:5]}")
+        report = ConsistencyAuditor(writer).audit(control=control)
+        report.assert_ok()
+        injections = dict(plane().injections)
+        trace = {k: v for k, v in _collect_trace(data_dir).items()
+                 if k.split("#")[0] == META_LINK}
+        return {
+            "scenario": "meta_link_delay", "seed": seed,
+            "rows": len(got),
+            "injections": injections,
+            "meta_requests": writer.meta.stats["requests"],
+            "audit": {k: v.get("ok") for k, v in report.checks.items()},
+            "trace": trace,
+        }
+    finally:
+        install(None)
+        if reader is not None:
+            reader.close()
+        writer.close()
+        control.close()
+        meta.stop()
+
+
 def run_udf_soak(duration_s: float = 45.0, seed: int = 5,
                  data_dir: Optional[str] = None,
                  kill_every: int = 6,
@@ -1170,6 +1251,12 @@ def main(argv=None) -> int:
                          "drop/delay/duplicate on s->udf plus a server "
                          "SIGKILL mid-run, audited bit-exact against a "
                          "no-chaos control (docs/robustness.md)")
+    ap.add_argument("--meta-chaos", action="store_true",
+                    help="run the meta-link latency scenario: a writer "
+                         "attached to a standalone MetaServer plus a "
+                         "serving reader over a seeded-delayed RPC "
+                         "link, audited bit-exact against an "
+                         "in-process control (docs/control-plane.md)")
     ap.add_argument("--udf-soak", action="store_true",
                     help="run the soak seed: RPC chaos + UDF-server "
                          "kills + serving readers live together, "
@@ -1220,6 +1307,23 @@ def main(argv=None) -> int:
                                    prefix="rwtpu_udfc2_"))
             assert r1["trace"] == r2["trace"], (
                 "seeded udf-chaos replay diverged:\n"
+                f"run1: {r1['trace']}\nrun2: {r2['trace']}")
+            print(f"replay OK: "
+                  f"{sum(len(v) for v in r1['trace'].values())} "
+                  "injections reproduced identically")
+    if args.meta_chaos:
+        r1 = run_meta_chaos(seed=args.seed,
+                            data_dir=tempfile.mkdtemp(
+                                prefix="rwtpu_metac1_"))
+        print(json.dumps({k: r1[k] for k in
+                          ("scenario", "seed", "rows", "injections",
+                           "audit")}, indent=2))
+        if args.replay:
+            r2 = run_meta_chaos(seed=args.seed,
+                                data_dir=tempfile.mkdtemp(
+                                    prefix="rwtpu_metac2_"))
+            assert r1["trace"] == r2["trace"], (
+                "seeded meta-chaos replay diverged:\n"
                 f"run1: {r1['trace']}\nrun2: {r2['trace']}")
             print(f"replay OK: "
                   f"{sum(len(v) for v in r1['trace'].values())} "

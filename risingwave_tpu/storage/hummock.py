@@ -339,6 +339,25 @@ class HummockStateStore(MemoryStateStore):
                     raise
         raise AssertionError("unreachable")
 
+    def refresh(self) -> int:
+        """Adopt the latest PUBLISHED version: re-fold committed state
+        and chase the committing process's epoch (serving sessions call
+        this on every checkpoint notification — docs/control-plane.md).
+        Local pending buffers are untouched; readers have none. Returns
+        the committed epoch now visible."""
+        if not self.manager.exists():
+            return self.committed_epoch
+        epoch, tables = self._load_tables()
+        self.manager.reload()
+        self._committed = tables
+        self.committed_epoch = epoch
+        return epoch
+
+    def version_runs(self) -> list:
+        """The SST runs the currently adopted version references —
+        what a reader session reports to meta as its remote pin."""
+        return sorted(self.manager.version.all_runs())
+
     # -- write path -----------------------------------------------------------
 
     def commit(self, epoch: int) -> None:

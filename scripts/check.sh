@@ -254,6 +254,27 @@ python -m pytest -q -p no:cacheprovider -m slow \
     tests/test_udf_plane.py \
     "$@"
 
+echo "== control plane (meta process + frontend fleet + admission) =="
+# Fast tier: AdmissionController bounded-queue units, the [meta] config
+# section, the ALTER SYSTEM parse, and a live MetaServer + MetaClient
+# loopback roundtrip (store CAS, notifications, placements, lease).
+# Slow tier (out of tier-1 per the 870s wall budget): the fleet
+# acceptance surface — one writer + two serving sessions over one meta
+# process + one Hummock dir, last-writer-wins fencing, meta kill -9 →
+# restart → reconnect → auditor green, pgwire SSL/GSSENC probes, 4x
+# admission overload with zero dropped connections, and the
+# zero-added-dispatch parity guard at pipeline_depth 1 and 2.
+python -m pytest -q -p no:cacheprovider \
+    tests/test_control_plane.py -m 'not slow' \
+    "$@"
+python -m pytest -q -p no:cacheprovider -m slow \
+    tests/test_control_plane.py \
+    "$@"
+# seeded meta-link delay chaos: a serving reader attaches over a slow
+# meta link while the writer commits; auditor green + identical
+# injection trace on replay (docs/control-plane.md)
+python -m risingwave_tpu.sim --meta-chaos --seed 13 --replay
+
 echo "== rwlint (AST invariant checker, docs/static-analysis.md) =="
 # One AST-grounded pass replaces the five historical grep lints
 # (exchange-boundary, wire-boundary, placement-mutation,

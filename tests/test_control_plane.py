@@ -1,0 +1,628 @@
+"""Meta as a process — the ISSUE 17 control-plane surface.
+
+Four layers, mirroring docs/control-plane.md:
+
+1. Pure units (tier-1): the pgwire AdmissionController's bounded-queue
+   semantics, the ``[meta]`` config section, and the ``ALTER SYSTEM``
+   parse — no Session, no sockets beyond a loopback meta roundtrip.
+2. Meta wire protocol: a real MetaServer + MetaClient over loopback —
+   store CAS transactions, notification push, placements, and the
+   last-writer-wins leader lease.
+3. Fleet semantics (slow): one writer + two serving sessions sharing a
+   durable Hummock dir through one meta process — reads, plan-cache
+   hits, DDL/ALTER SYSTEM propagation, read-only enforcement, fencing,
+   and the kill -9 → restart → reconnect fault path.
+4. Frontend overload (slow): 4x-quota pgwire load queues with zero
+   dropped connections; beyond the bounded queue the server sheds with
+   SQLSTATE 53300 instead of collapsing.  Plus the SSLRequest /
+   GSSENCRequest plaintext-refusal probes and the zero-added-dispatch
+   guard (a remote meta must not change the device story).
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from risingwave_tpu.common.config import MetaConfig, load_config
+from risingwave_tpu.frontend.pgwire import AdmissionController, QueryShed
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# =====================================================================
+# 1. pure units — tier-1
+# =====================================================================
+
+class TestAdmissionController:
+    def test_fast_path_admits_without_queueing(self):
+        async def go():
+            ac = AdmissionController(max_inflight=2, per_conn_inflight=2,
+                                     queue_depth=4)
+            conn = ac.conn_slot()
+            await ac.acquire(conn)
+            snap = ac.snapshot()
+            assert snap["admitted"] == 1 and snap["inflight"] == 1
+            assert snap["queued"] == 0 and snap["shed"] == 0
+            ac.release(conn)
+            assert ac.snapshot()["inflight"] == 0
+        asyncio.run(go())
+
+    def test_queue_then_shed_beyond_depth(self):
+        """max_inflight=1, queue_depth=1: the second query queues, the
+        third sheds — the queue is BOUNDED, overload cannot pile up."""
+        async def go():
+            ac = AdmissionController(max_inflight=1, per_conn_inflight=8,
+                                     queue_depth=1)
+            c1, c2, c3 = (ac.conn_slot() for _ in range(3))
+            await ac.acquire(c1)                  # occupies the slot
+            waiter = asyncio.ensure_future(ac.acquire(c2))
+            await asyncio.sleep(0)                # let it enter the queue
+            assert ac.snapshot()["waiting"] == 1
+            with pytest.raises(QueryShed) as ei:
+                await ac.acquire(c3)              # queue full: shed
+            assert "overloaded" in str(ei.value)
+            ac.release(c1)                        # waiter drains
+            await asyncio.wait_for(waiter, timeout=5)
+            ac.release(c2)
+            snap = ac.snapshot()
+            assert snap["shed"] == 1 and snap["queued"] == 1
+            assert snap["max_queued"] == 1 and snap["waiting"] == 0
+            assert snap["inflight"] == 0
+        asyncio.run(go())
+
+    def test_per_connection_cap_queues_own_conn_only(self):
+        """A connection at its own in-flight cap queues even when the
+        global pool has room; a different connection sails through."""
+        async def go():
+            ac = AdmissionController(max_inflight=8, per_conn_inflight=1,
+                                     queue_depth=4)
+            hog, other = ac.conn_slot(), ac.conn_slot()
+            await ac.acquire(hog)
+            second = asyncio.ensure_future(ac.acquire(hog))
+            await asyncio.sleep(0)
+            assert ac.snapshot()["waiting"] == 1   # same-conn query waits
+            await asyncio.wait_for(ac.acquire(other), timeout=5)
+            ac.release(hog)                        # unblock the hog's 2nd
+            await asyncio.wait_for(second, timeout=5)
+            ac.release(hog)
+            ac.release(other)
+            assert ac.snapshot()["inflight"] == 0
+        asyncio.run(go())
+
+
+class TestMetaConfigSection:
+    def test_defaults_mean_in_process_meta(self):
+        cfg = MetaConfig()
+        assert cfg.addr == ""                      # playground default
+        assert cfg.admission_max_inflight == 8
+        assert cfg.admission_per_conn_inflight == 2
+        assert cfg.admission_queue_depth == 64
+
+    def test_meta_section_round_trips_from_toml(self, tmp_path):
+        p = tmp_path / "risingwave.toml"
+        p.write_text(
+            '[meta]\naddr = "127.0.0.1:5690"\n'
+            "admission_max_inflight = 4\n"
+            "admission_queue_depth = 16\n")
+        cfg = load_config(str(p))
+        assert cfg.meta.addr == "127.0.0.1:5690"
+        assert cfg.meta.admission_max_inflight == 4
+        assert cfg.meta.admission_queue_depth == 16
+        assert cfg.meta.admission_per_conn_inflight == 2   # untouched
+
+
+class TestAlterSystemParse:
+    def test_alter_system_set_is_system_scoped(self):
+        from risingwave_tpu.frontend import sqlast as A
+        from risingwave_tpu.frontend.parser import parse_sql
+        (stmt,) = parse_sql("ALTER SYSTEM SET checkpoint_frequency = 4")
+        assert isinstance(stmt, A.SetStatement)
+        assert stmt.name.lower() == "checkpoint_frequency"
+        assert stmt.system is True
+        (plain,) = parse_sql("SET checkpoint_frequency = 4")
+        assert plain.system is False               # session-local SET
+
+
+# =====================================================================
+# 2. meta wire protocol — server + client over loopback
+# =====================================================================
+
+class TestMetaWireProtocol:
+    def _serve(self, tmp_path):
+        from risingwave_tpu.meta.server import MetaServer
+        server = MetaServer(data_dir=str(tmp_path / "meta"))
+        return server, server.start()
+
+    def test_store_ops_txn_conflict_and_notifications(self, tmp_path):
+        from risingwave_tpu.meta.client import MetaClient
+        from risingwave_tpu.meta.store import TxnConflict
+        server, addr = self._serve(tmp_path)
+        a = MetaClient(addr)
+        b = MetaClient(addr)
+        try:
+            a.store.put("k/1", "v1")
+            assert b.store.get("k/1") == "v1"
+            assert ("k/1", "v1") in b.store.list_prefix("k/")
+            # CAS: b's precondition stales out after a's write
+            a.store.put("k/1", "v2")
+            with pytest.raises(TxnConflict):
+                b.store.txn(preconditions=[("k/1", "v1")],
+                            ops=[("put", "k/1", "v3")])
+            b.store.delete("k/1")
+            assert a.store.get("k/1") is None
+            # notification push crosses clients within one version
+            got = []
+            b.notifications.subscribe("catalog",
+                                      lambda v, info: got.append(info))
+            a.notifications.notify("catalog", {"ddl": "create"})
+            deadline = time.monotonic() + 5
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got and got[0]["ddl"] == "create"
+        finally:
+            a.close()
+            b.close()
+            server.stop()
+
+    def test_placements_survive_the_wire(self, tmp_path):
+        from risingwave_tpu.meta.client import MetaClient
+        from risingwave_tpu.meta.fragment import (ActorPlacement,
+                                                  FragmentPlacement)
+        server, addr = self._serve(tmp_path)
+        c = MetaClient(addr)
+        try:
+            pl = FragmentPlacement(
+                job="mv_q", root_worker=0,
+                actors={1: [ActorPlacement(fragment_id=1, actor=0,
+                                           worker=0, vnode_start=0,
+                                           vnode_end=128)],
+                        2: [ActorPlacement(fragment_id=2, actor=0,
+                                           worker=1, vnode_start=128,
+                                           vnode_end=256)]})
+            c.save_placement(pl)
+            back = c.load_placement("mv_q")
+            assert back is not None and back.to_json() == pl.to_json()
+            assert "mv_q" in c.all_placements()
+            c.drop_placement("mv_q")
+            assert c.load_placement("mv_q") is None
+        finally:
+            c.close()
+            server.stop()
+
+    def test_leader_lease_last_writer_wins(self, tmp_path):
+        from risingwave_tpu.meta.client import MetaClient, MetaFenced
+        server, addr = self._serve(tmp_path)
+        old = MetaClient(addr)
+        new = MetaClient(addr)
+        try:
+            old.acquire_leader(generation=1)
+            old.assert_leader()                    # holds
+            new.acquire_leader(generation=2)       # supersedes
+            new.assert_leader()
+            with pytest.raises(MetaFenced):
+                old.assert_leader()
+            with pytest.raises(MetaFenced):        # fenced publishes too
+                old.publish_checkpoint(committed_epoch=7)
+        finally:
+            old.close()
+            new.close()
+            server.stop()
+
+
+# =====================================================================
+# 3. fleet semantics — writer + serving sessions over one meta
+# =====================================================================
+
+DDL = """
+CREATE TABLE ft (k BIGINT PRIMARY KEY, v BIGINT);
+CREATE MATERIALIZED VIEW fmv AS
+  SELECT k, count(*) AS n, sum(v) AS s FROM ft GROUP BY k;
+"""
+
+
+def _writer(tmp_path, addr, **kw):
+    from risingwave_tpu.frontend import Session
+    return Session(data_dir=str(tmp_path), meta_addr=addr,
+                   state_store="hummock", checkpoint_frequency=2, **kw)
+
+
+def _reader(tmp_path, addr):
+    from risingwave_tpu.frontend import Session
+    return Session(data_dir=str(tmp_path), meta_addr=addr, role="serving")
+
+
+def _poll(fn, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            out = fn()
+            if out:
+                return out
+        except Exception:
+            if time.monotonic() >= deadline:
+                raise
+        if time.monotonic() >= deadline:
+            return fn()
+        time.sleep(interval)
+
+
+@pytest.mark.slow
+class TestMultiTenantFleet:
+    def test_writer_two_readers_share_one_store(self, tmp_path):
+        from risingwave_tpu.frontend.session import SqlError
+        from risingwave_tpu.meta.server import MetaServer
+        server = MetaServer(data_dir=str(tmp_path / "meta"))
+        addr = server.start()
+        w = _writer(tmp_path, addr)
+        readers = []
+        try:
+            w.run_sql(DDL)
+            w.run_sql("INSERT INTO ft VALUES " + ", ".join(
+                f"({i % 8}, {i})" for i in range(64)))
+            w.run_sql("FLUSH")
+            want = sorted(w.run_sql("SELECT k, n, s FROM fmv"))
+            readers = [_reader(tmp_path, addr) for _ in range(2)]
+            for r in readers:
+                got = sorted(r.run_sql("SELECT k, n, s FROM fmv"))
+                assert got == want
+                # the second identical read comes out of the plan cache
+                r.run_sql("SELECT k, n, s FROM fmv")
+                assert r.metrics()["serving"]["cache_hits"] >= 1
+
+            # serving sessions are read-only and never conduct barriers
+            r0 = readers[0]
+            with pytest.raises(SqlError, match="read-only"):
+                r0.run_sql("INSERT INTO ft VALUES (99, 99)")
+            with pytest.raises(SqlError, match="read-only"):
+                r0.run_sql("CREATE TABLE rogue (k BIGINT PRIMARY KEY)")
+            with pytest.raises(RuntimeError, match="writer session"):
+                r0.tick()
+
+            # writer DDL reaches every reader within one notification
+            w.run_sql("CREATE MATERIALIZED VIEW fmv2 AS "
+                      "SELECT count(*) AS total FROM ft")
+            w.run_sql("FLUSH")
+            total = w.run_sql("SELECT total FROM fmv2")
+            for r in readers:
+                got = _poll(lambda r=r: r.run_sql("SELECT total FROM fmv2"))
+                assert got == total
+
+            # ALTER SYSTEM propagates live to the whole fleet
+            w.run_sql("ALTER SYSTEM SET checkpoint_frequency = 7")
+            w.run_sql("ALTER SYSTEM SET barrier_interval_ms = 250")
+            for s in [w] + readers:
+                _poll(lambda s=s: s.checkpoint_frequency == 7
+                      and s.barrier_interval_ms == 250)
+                assert s.checkpoint_frequency == 7
+                assert s.barrier_interval_ms == 250
+        finally:
+            for r in readers:
+                r.close()
+            w.close()
+            server.stop()
+
+    def test_new_writer_fences_the_old_one(self, tmp_path):
+        """Last writer wins: a takeover attach under the next persisted
+        generation fences the previous writer — the ex-writer can
+        neither inject barriers nor commit checkpoints, while direct
+        meta RPCs under its stale generation are refused server-side."""
+        from risingwave_tpu.meta.client import MetaFenced
+        from risingwave_tpu.meta.server import MetaServer
+        server = MetaServer(data_dir=str(tmp_path / "meta"))
+        addr = server.start()
+        w1 = _writer(tmp_path, addr)
+        w2 = None
+        try:
+            w1.run_sql("CREATE TABLE t1 (k BIGINT PRIMARY KEY, "
+                       "v BIGINT)")
+            w1.run_sql("INSERT INTO t1 VALUES (1, 1)")
+            w1.run_sql("FLUSH")
+            g1 = w1._generation
+            w2 = _writer(tmp_path, addr)       # takeover: generation+1
+            assert w2._generation > g1
+
+            # the server refuses the stale generation outright ...
+            with pytest.raises(MetaFenced):
+                w1.meta.publish_checkpoint(committed_epoch=99)
+
+            # ... and the ex-writer's own barrier path locks out (the
+            # lease-loss notification or a refused publish, whichever
+            # lands first)
+            def fenced():
+                try:
+                    w1.tick()
+                    return False
+                except MetaFenced:
+                    return True
+            assert _poll(fenced)
+            with pytest.raises(MetaFenced):
+                w1.tick()
+
+            # the new writer owns conduction and keeps working
+            w2.run_sql("INSERT INTO t1 VALUES (2, 2)")
+            w2.run_sql("FLUSH")
+            assert sorted(w2.run_sql("SELECT k, v FROM t1")) == [
+                (1, 1), (2, 2)]
+        finally:
+            w1.close()
+            if w2 is not None:
+                w2.close()
+            server.stop()
+
+
+@pytest.mark.slow
+class TestMetaKillDashNine:
+    def _spawn_meta(self, metadir, port):
+        env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.meta.server",
+             "--data-dir", metadir, "--port", str(port)],
+            stdout=subprocess.PIPE, text=True, cwd=ROOT, env=env)
+        line = proc.stdout.readline()
+        assert line.startswith("META_READY "), line
+        return proc, line.split()[1].strip()
+
+    def test_kill_restart_reconnect_resume(self, tmp_path):
+        metadir = str(tmp_path / "meta")
+        proc, addr = self._spawn_meta(metadir, 0)
+        port = int(addr.rpartition(":")[2])
+        w = None
+        try:
+            w = _writer(tmp_path, addr)
+            w.run_sql(DDL)
+            w.run_sql("INSERT INTO ft VALUES (1, 10), (2, 20)")
+            w.run_sql("FLUSH")
+
+            proc.kill()                     # SIGKILL: no goodbye frame
+            proc.wait(timeout=10)
+            proc, addr2 = self._spawn_meta(metadir, port)
+            assert addr2 == addr            # same endpoint, same store
+
+            # the writer reconnects transparently and resumes barriers
+            w.run_sql("INSERT INTO ft VALUES (3, 30)")
+            w.run_sql("FLUSH")
+            assert sorted(w.run_sql("SELECT k, s FROM fmv")) == [
+                (1, 10), (2, 20), (3, 30)]
+            assert w.meta.stats["reconnects"] >= 1
+            from risingwave_tpu.common.audit import ConsistencyAuditor
+            ConsistencyAuditor(w).audit().assert_ok()
+
+            # a fresh reader can attach to the restarted meta
+            r = _reader(tmp_path, addr)
+            try:
+                assert sorted(r.run_sql("SELECT k, s FROM fmv")) == [
+                    (1, 10), (2, 20), (3, 30)]
+            finally:
+                r.close()
+        finally:
+            if w is not None:
+                w.close()
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=10)
+
+
+# =====================================================================
+# 4. frontend overload + protocol probes + dispatch parity
+# =====================================================================
+
+def _pg_recv_until_ready(sock):
+    buf = b""
+    while b"Z\x00\x00\x00\x05I" not in buf:
+        d = sock.recv(65536)
+        if not d:
+            raise ConnectionError("server closed the connection")
+        buf += d
+    return buf
+
+
+def _pg_connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    body = struct.pack("!I", 196608) + b"user\x00cp\x00\x00"
+    s.sendall(struct.pack("!I", len(body) + 4) + body)
+    _pg_recv_until_ready(s)
+    return s
+
+
+def _pg_query(sock, sql):
+    body = sql.encode() + b"\x00"
+    sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+    return _pg_recv_until_ready(sock)
+
+
+@pytest.mark.slow
+class TestPgwireFrontend:
+    def _serve(self, admission=None):
+        from risingwave_tpu.frontend import Session
+        from risingwave_tpu.frontend.pgwire import PgWireServer
+        sess = Session()
+        sess.run_sql("CREATE TABLE pt (k BIGINT PRIMARY KEY, v BIGINT)")
+        sess.run_sql("INSERT INTO pt VALUES " + ", ".join(
+            f"({i}, {i * 2})" for i in range(32)))
+        sess.run_sql("CREATE MATERIALIZED VIEW pmv AS "
+                     "SELECT count(*) AS n, sum(v) AS s FROM pt")
+        sess.run_sql("FLUSH")
+        srv = PgWireServer(sess, "127.0.0.1", 0, admission=admission)
+        loop = asyncio.new_event_loop()
+        import threading
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(srv.start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(timeout=30)
+        port = srv._server.sockets[0].getsockname()[1]
+
+        def stop():
+            async def _close():
+                await srv.close()
+            fut = asyncio.run_coroutine_threadsafe(_close(), loop)
+            fut.result(timeout=10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=10)
+            sess.close()
+
+        return srv, port, stop
+
+    def test_ssl_and_gssenc_probes_get_plaintext_refusal(self):
+        """Satellite: psql-style clients probe SSLRequest (80877103)
+        and GSSENCRequest (80877104) before StartupMessage; the server
+        answers each with the single byte 'N' and keeps the connection
+        usable for a plaintext startup on the same socket."""
+        srv, port, stop = self._serve()
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=30)
+            try:
+                for code in (80877103, 80877104):   # SSL, then GSSENC
+                    s.sendall(struct.pack("!II", 8, code))
+                    assert s.recv(1) == b"N"
+                body = struct.pack("!I", 196608) + b"user\x00cp\x00\x00"
+                s.sendall(struct.pack("!I", len(body) + 4) + body)
+                _pg_recv_until_ready(s)             # startup completes
+                out = _pg_query(s, "SELECT n, s FROM pmv")
+                assert b"E" != out[:1] and b"D" in out
+            finally:
+                s.close()
+        finally:
+            stop()
+
+    def test_4x_quota_overload_queues_without_drops(self):
+        """4x the in-flight quota: everything queues and completes —
+        zero sheds, zero dropped connections, the queue high-water mark
+        stays within the configured bound."""
+        import threading
+        cfg = MetaConfig(admission_max_inflight=2,
+                         admission_per_conn_inflight=1,
+                         admission_queue_depth=64)
+        srv, port, stop = self._serve(admission=cfg)
+        try:
+            n_conns, per_conn = 8, 4                # 4x the quota of 2
+            errors, oks = [], []
+            lock = threading.Lock()
+
+            def worker():
+                try:
+                    s = _pg_connect(port)
+                    try:
+                        for _ in range(per_conn):
+                            out = _pg_query(s, "SELECT n, s FROM pmv")
+                            with lock:
+                                (errors if b"C53300" in out
+                                 else oks).append(out)
+                    finally:
+                        s.close()
+                except Exception as e:              # dropped connection
+                    with lock:
+                        errors.append(e)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_conns)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors                       # no drops, no sheds
+            assert len(oks) == n_conns * per_conn
+            snap = srv.admission.snapshot()
+            assert snap["shed"] == 0
+            assert snap["max_inflight"] <= 2
+            assert snap["max_queued"] <= cfg.admission_queue_depth
+        finally:
+            stop()
+
+    def test_beyond_queue_depth_sheds_53300_not_collapse(self):
+        """queue_depth=0 turns every would-wait query into a 53300
+        shed — the connection survives and later queries succeed."""
+        import threading
+        cfg = MetaConfig(admission_max_inflight=1,
+                         admission_per_conn_inflight=1,
+                         admission_queue_depth=0)
+        srv, port, stop = self._serve(admission=cfg)
+        try:
+            n_conns, per_conn = 6, 3
+            shed, ok, broken = [], [], []
+            lock = threading.Lock()
+            gate = threading.Barrier(n_conns)
+
+            def worker():
+                try:
+                    s = _pg_connect(port)
+                    gate.wait(timeout=30)
+                    try:
+                        for _ in range(per_conn):
+                            out = _pg_query(s, "SELECT n, s FROM pmv")
+                            with lock:
+                                (shed if b"C53300" in out
+                                 else ok).append(out)
+                    finally:
+                        s.close()
+                except Exception as e:
+                    with lock:
+                        broken.append(e)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_conns)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not broken                   # shed ≠ disconnect
+            assert len(shed) + len(ok) == n_conns * per_conn
+            assert ok                           # service degraded, alive
+            snap = srv.admission.snapshot()
+            assert snap["shed"] == len(shed)
+            assert snap["max_queued"] == 0      # nothing ever piled up
+        finally:
+            stop()
+
+
+@pytest.mark.slow
+class TestRemoteMetaDispatchParity:
+    def test_zero_added_dispatches_depth_1_and_2(self, tmp_path):
+        """Acceptance: attaching through a MetaServer instead of the
+        in-process meta adds ZERO device dispatches on the tick path —
+        per-qualname equality at pipeline_depth 1 and 2. Meta traffic
+        is host-side wire IO; the fused epoch story must not notice."""
+        from risingwave_tpu.common.dispatch_count import count_dispatches
+        from risingwave_tpu.frontend import Session
+        from risingwave_tpu.meta.server import MetaServer
+
+        def run(d, depth, meta_addr):
+            with count_dispatches() as c:
+                s = Session(data_dir=str(d), meta_addr=meta_addr,
+                            state_store="hummock", pipeline_depth=depth,
+                            checkpoint_frequency=2)
+                try:
+                    s.run_sql(DDL)
+                    for i in range(4):
+                        s.run_sql(f"INSERT INTO ft VALUES "
+                                  f"({i % 4}, {i})")
+                        s.tick()
+                    s.flush()
+                finally:
+                    s.close()
+                return dict(c.counts)
+
+        for depth in (1, 2):
+            local = run(tmp_path / f"local{depth}", depth, None)
+            rdir = tmp_path / f"remote{depth}"
+            server = MetaServer(data_dir=str(rdir / "meta"))
+            addr = server.start()
+            try:
+                remote = run(rdir, depth, addr)
+            finally:
+                server.stop()
+            assert remote == local, (depth, remote, local)
+            assert local                     # the guard saw real ticks

@@ -261,6 +261,51 @@ class TestBoundaryIO:
         assert lint_fixture(tmp_path, files, ["boundary-io"]) == []
 
 
+class TestMetaBoundary:
+    GREP = re.compile(r"FileMetaStore\(")
+
+    META_STUB = {
+        "meta/store.py": """
+            class FileMetaStore:
+                def __init__(self, root):
+                    self.root = root
+            """,
+        "meta/service.py": """
+            from .store import FileMetaStore
+
+            class MetaService:
+                def __init__(self, root):
+                    self.store = FileMetaStore(root)
+            """,
+    }
+
+    def test_alias_caught_where_grep_missed(self, tmp_path):
+        files = dict(self.META_STUB)
+        files["frontend/rogue.py"] = """
+            from ..meta.store import FileMetaStore as MS
+
+            def open_raw(root):
+                return MS(root)
+            """
+        src = textwrap.dedent(files["frontend/rogue.py"])
+        assert not self.GREP.search(src)
+        found = lint_fixture(tmp_path, files, ["meta-boundary"])
+        assert [f.rule for f in found] == ["meta-boundary"]
+
+    def test_meta_internal_and_docstring_quiet(self, tmp_path):
+        files = dict(self.META_STUB)
+        files["frontend/clean.py"] = '''
+            """Never FileMetaStore(...) — go through MetaService."""
+            from ..meta.service import MetaService
+
+            def attach(root):
+                return MetaService(root)
+            '''
+        src = textwrap.dedent(files["frontend/clean.py"])
+        assert self.GREP.search(src)
+        assert lint_fixture(tmp_path, files, ["meta-boundary"]) == []
+
+
 FUSED_FIXTURE_PRELUDE = """
     import jax
 
