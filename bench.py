@@ -1373,6 +1373,37 @@ def run_rescale_phase(ticks: int = 6, cap: int = 256) -> None:
         s.close()
 
 
+def run_failover_phase(seed: int = 7) -> None:
+    """Child entry for --failover-phase: one full leader-failover
+    acceptance run (sim.run_failover — kill -9 the writer process
+    mid-stream, a standby auto-promotes, exactly-once audited),
+    recording the recovery-time numbers ISSUE 18 publishes: MTTR
+    (kill → standby conducting), leader-down detection latency, and the
+    p99 gap between committed checkpoints over the whole run — the
+    unavailability window a serving operator actually experiences
+    (dominated by the failover gap). One JSON line."""
+    import tempfile
+
+    from risingwave_tpu.sim import run_failover
+
+    r = run_failover(seed=seed,
+                     data_dir=tempfile.mkdtemp(prefix="rwtpu_benchfo_"))
+    gaps = sorted(r.get("gap_samples_ms") or [0.0])
+    p99 = gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+    _emit({
+        "metric": "failover_mttr_ms", "unit": "ms",
+        "value": r["mttr_ms"],
+        "failover_mttr_ms": r["mttr_ms"],
+        "failover_detect_ms": r["detect_ms"],
+        "failover_p99_unavail_ms": round(p99, 3),
+        "failover_lease_ttl_s": r["lease_ttl_s"],
+        "failover_terms": r["terms"],
+        "failover_elections_lost": r["elections_lost"],
+        "failover_audit_ok": int(all(r["audit"].values())),
+        "failovers": r["failovers"],
+    })
+
+
 def run_phase(n_chunks: int, q7_chunks: int, q8_chunks: int,
               q3_chunks: int) -> None:
     """Child entry: measure everything on this process's backend, print one
@@ -1544,6 +1575,22 @@ _FLEET_RESULT_FIELDS = (
     "fleet_qps", "fleet_p50_ms", "fleet_p99_ms",
     "fleet_queued", "fleet_shed", "fleet_frontends",
 )
+
+_FAILOVER_RESULT_FIELDS = (
+    "failover_mttr_ms", "failover_p99_unavail_ms",
+    "failover_detect_ms",
+)
+
+
+def measure_failover_cpu() -> dict:
+    """The leader-failover phase on the CPU stand-in: one full
+    sim.run_failover acceptance run (standalone meta + doomed writer
+    process + 2 standbys; a control-plane measurement — fresh
+    subprocess like every phase, which itself spawns the writer
+    process it kills)."""
+    env = {"JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": None, "TPU_LIBRARY_PATH": None}
+    return _spawn_phase("failover_cpu", env, ["--failover-phase"])
 
 
 def measure_fleet_cpu() -> dict:
@@ -1721,6 +1768,12 @@ _SHARED_FIELDS = (
     # control-plane CPU measurement) so the fallback record stays
     # schema-stable
     "fleet_qps", "fleet_p99_ms", "fleet_queued",
+    # leader failover (docs/control-plane.md "Election"): kill -9 →
+    # standby auto-promotion MTTR + the p99 committed-checkpoint gap
+    # (the unavailability window), present on every backend (a
+    # control-plane CPU measurement) so the fallback record stays
+    # schema-stable
+    "failover_mttr_ms", "failover_p99_unavail_ms", "failover_detect_ms",
 )
 
 
@@ -1777,6 +1830,16 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - attributed below
         sys.stderr.write(f"bench: fleet phase failed: {e}\n")
         cpu["fleet_error"] = str(e)
+    # leader-failover phase (control-plane-level, CPU): kill -9 the
+    # writer process, time the standby's auto-promotion; non-fatal like
+    # the serving phase
+    try:
+        failover = measure_failover_cpu()
+        for f in _FAILOVER_RESULT_FIELDS:
+            cpu[f] = failover.get(f)
+    except Exception as e:  # noqa: BLE001 - attributed below
+        sys.stderr.write(f"bench: failover phase failed: {e}\n")
+        cpu["failover_error"] = str(e)
     cpu_rps, cpu_q7 = cpu["value"], cpu["q7_rows_per_sec"]
     tpu, tpu_err = measure_tpu()
     if tpu is not None:
@@ -1800,7 +1863,7 @@ def main() -> int:
         # measurements; the TPU record carries the stand-in's numbers
         # for schema stability
         for f in (_SERVING_RESULT_FIELDS + _RESCALE_RESULT_FIELDS
-                  + _FLEET_RESULT_FIELDS):
+                  + _FLEET_RESULT_FIELDS + _FAILOVER_RESULT_FIELDS):
             tpu.setdefault(f, cpu.get(f))
     if tpu is None:
         # tunnel/chip unavailable: fall back to the CPU streaming
@@ -2142,7 +2205,8 @@ if __name__ == "__main__":
                                              "--serving-phase",
                                              "--rescale-phase",
                                              "--fleet-phase",
-                                             "--fleet-frontend"):
+                                             "--fleet-frontend",
+                                             "--failover-phase"):
         watchdog = threading.Timer(INIT_WATCHDOG_SECS, _watchdog_fire)
         watchdog.daemon = True
         watchdog.start()
@@ -2197,6 +2261,20 @@ if __name__ == "__main__":
             except Exception as e:
                 _emit(_fail_line(
                     f"fleet phase failed: {type(e).__name__}: {e}"))
+                raise SystemExit(2)
+            finally:
+                watchdog.cancel()
+            raise SystemExit(0)
+        if sys.argv[1] == "--failover-phase":
+            watchdog = threading.Timer(WATCHDOG_SECS, _watchdog_fire)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                run_failover_phase(
+                    int(sys.argv[2]) if len(sys.argv) > 2 else 7)
+            except Exception as e:
+                _emit(_fail_line(
+                    f"failover phase failed: {type(e).__name__}: {e}"))
                 raise SystemExit(2)
             finally:
                 watchdog.cancel()

@@ -113,12 +113,14 @@ def main(argv=None) -> int:
                     help="serve the meta dashboard (cluster / fragment "
                     "graphs / await-tree) on this port")
     pg.add_argument("--role", default=None,
-                    choices=["writer", "serving"],
+                    choices=["writer", "serving", "standby"],
                     help="session role when attached to a standalone "
                     "meta (--meta-addr): the single 'writer' conducts "
                     "barriers and owns DDL; 'serving' frontends are "
                     "read-mostly replicas sharing the writer's state "
-                    "dir (docs/control-plane.md)")
+                    "dir; 'standby' serves reads AND races the "
+                    "election when the writer's lease expires, "
+                    "promoting in place (docs/control-plane.md)")
 
     q = sub.add_parser("sql", parents=[fp_arg],
                        help="run SQL statements and print results")
@@ -160,8 +162,11 @@ def main(argv=None) -> int:
                      "stuck-barrier blame — docs/observability.md), "
                      "and `ctl meta` (serve — run a standalone meta "
                      "server in the foreground over --data-dir; "
-                     "sessions attach with --meta-addr / [meta] addr — "
-                     "docs/control-plane.md)")
+                     "sessions attach with --meta-addr / [meta] addr; "
+                     "leader — who holds the lease: session, term, TTL "
+                     "remaining, failover count and term history, read "
+                     "live over --meta-addr or offline from --data-dir "
+                     "— docs/control-plane.md)")
     ctl.add_argument("job", nargs="?", default=None,
                      help="job name for `ctl cluster rescale`")
     ctl.add_argument("--parallelism", type=int, default=None,
@@ -209,6 +214,11 @@ def main(argv=None) -> int:
     ctl.add_argument("--force", action="store_true",
                      help="vacuum: actually delete (default is a dry "
                      "run; only safe with no live session on the dir)")
+    ctl.add_argument("--lease-ttl", type=float, default=None,
+                     help="meta serve: leader lease TTL in seconds — a "
+                     "writer that misses heartbeats for this long is "
+                     "declared down and standbys race the election "
+                     "(default 2.0; docs/control-plane.md)")
 
     comp = sub.add_parser(
         "compactor",
@@ -266,9 +276,13 @@ def _ctl(args) -> int:
         udf_server_main(["--port", str(args.port), "--persistent"])
         return 0
     if args.what == "meta":
+        if args.sub == "leader":
+            return _ctl_meta_leader(args, _json)
         if args.sub != "serve":
             raise SystemExit("usage: ctl meta serve --data-dir DIR "
-                             "[--port N]")
+                             "[--port N --lease-ttl S] | "
+                             "ctl meta leader (--meta-addr HOST:PORT | "
+                             "--data-dir DIR) [--json]")
         if not args.data_dir:
             raise SystemExit("--data-dir is required (the meta store "
                              "lives under DIR/meta)")
@@ -279,8 +293,11 @@ def _ctl(args) -> int:
         # so `ctl cluster fragments` etc. keep reading it offline.
         import os as _os
         from .meta.server import main as meta_server_main
-        meta_server_main(["--data-dir", _os.path.join(args.data_dir, "meta"),
-                          "--port", str(args.port)])
+        argv = ["--data-dir", _os.path.join(args.data_dir, "meta"),
+                "--port", str(args.port)]
+        if args.lease_ttl is not None:
+            argv += ["--lease-ttl", str(args.lease_ttl)]
+        meta_server_main(argv)
         return 0
     if not args.data_dir:
         raise SystemExit("--data-dir is required")
@@ -589,6 +606,74 @@ def _ctl_bench_trend(args, _json) -> int:
         print(_json.dumps(trend, indent=2))
     else:
         print(render_trend_table(trend))
+    return 0
+
+
+def _ctl_meta_leader(args, _json) -> int:
+    """`ctl meta leader`: who holds the leader lease — session, term,
+    TTL remaining, how it was acquired, failover count, and the term
+    history. Live over ``--meta-addr`` (asks the server, which owns the
+    in-memory deadline), or offline from ``--data-dir`` (reads the
+    persisted lease record; TTL remaining is server memory and shows as
+    unknown — docs/control-plane.md "Election")."""
+    import os
+    if getattr(args, "meta_addr", None):
+        from .meta.client import MetaClient
+        client = MetaClient(args.meta_addr, session_id="ctl-leader")
+        try:
+            info = client.lease_info()
+        finally:
+            client.close()
+    elif args.data_dir:
+        from .meta.service import MetaService
+        path = os.path.join(args.data_dir, "meta", "meta.jsonl")
+        if not os.path.exists(path):
+            raise SystemExit(f"{args.data_dir!r} holds no meta store")
+        meta = MetaService(data_dir=os.path.join(args.data_dir, "meta"))
+        try:
+            store = meta.store
+            info = {"holder": None, "term": None, "acquired_at": None,
+                    "reason": None, "lease_ttl_s": None,
+                    "ttl_remaining_s": None, "expired": None,
+                    "failovers": int(store.get("leader_failovers")
+                                     or "0"),
+                    "history": _json.loads(
+                        store.get("leader_history") or "[]")}
+            raw = store.get("leader")
+            if raw is not None:
+                holder = _json.loads(raw)
+                info["holder"] = holder.get("session")
+                info["term"] = int(holder.get(
+                    "term", holder.get("generation", 0)))
+                info["acquired_at"] = holder.get("acquired_at")
+                info["reason"] = holder.get("reason")
+        finally:
+            meta.store.close()
+    else:
+        raise SystemExit("ctl meta leader needs --meta-addr HOST:PORT "
+                         "(live) or --data-dir DIR (offline)")
+    if args.json:
+        print(_json.dumps(info, indent=2))
+        return 0
+    if info.get("holder") is None:
+        print("leader: (none)")
+    else:
+        ttl = info.get("ttl_remaining_s")
+        ttl_s = "unknown (offline)" if ttl is None else f"{ttl:.3f}s"
+        print(f"leader:    {info['holder']}")
+        print(f"term:      {info['term']}")
+        print(f"reason:    {info.get('reason') or '-'}")
+        print(f"ttl left:  {ttl_s}"
+              + ("  [EXPIRED]" if info.get("expired") else ""))
+    print(f"failovers: {info.get('failovers', 0)}")
+    history = info.get("history") or []
+    if history:
+        print("term\tholder\treason\tleaderless_s")
+        for h in history:
+            gap = h.get("leaderless_s")
+            print(f"{h.get('term')}\t{h.get('holder')}\t"
+                  f"{h.get('reason')}\t"
+                  f"{'' if gap is None else f'{gap:.3f}'}")
     return 0
 
 

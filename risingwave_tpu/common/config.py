@@ -326,6 +326,17 @@ class MetaConfig:
     #: frontend sheds load with a PG error (bounded queue: overload
     #: degrades with bounded p99 instead of collapsing)
     admission_queue_depth: int = 64
+    #: leader lease TTL: a writer missing this many seconds of
+    #: heartbeats is declared down and standbys elect (bounds failover
+    #: MTTR from above; too low and a long GC pause looks like death)
+    lease_ttl_s: float = 2.0
+    #: writer heartbeat period; keep well under lease_ttl_s so several
+    #: consecutive renewals must fail before the lease expires
+    heartbeat_s: float = 0.5
+    #: per-candidate jitter cap before racing lease.acquire on
+    #: leader_down — spreads CAS attempts without delaying the winner
+    #: by more than this
+    election_backoff_ms: float = 100.0
 
 
 @dataclasses.dataclass

@@ -43,6 +43,7 @@ pre { background: #fff; border: 1px solid #ddd; padding: 1em;
 <p><a href="/api/trace" download="trace.json">download Chrome trace</a>
 (load in Perfetto / chrome://tracing)</p>
 <h2>cluster</h2><pre id="cluster">loading…</pre>
+<h2>leadership</h2><pre id="leadership">loading…</pre>
 <h2>fragment graphs</h2><pre id="fragments">loading…</pre>
 <h2>exchange edges</h2><pre id="exchange">loading…</pre>
 <h2>barriers</h2><pre id="barriers">loading…</pre>
@@ -64,6 +65,8 @@ async function load(id, url, text) {
 async function loadStorage() {
   const r = await fetch("/api/metrics");
   const m = await r.json();
+  document.getElementById("leadership").textContent =
+    JSON.stringify(m.leadership || {}, null, 2);
   document.getElementById("storage").textContent =
     JSON.stringify(m.storage || {}, null, 2);
   document.getElementById("exchange").textContent =

@@ -155,6 +155,34 @@ def render_metrics(session) -> str:
         for wid, n in (serving.get("task_workers") or {}).items():
             lines.append(
                 f'rw_serving_task_total{{worker="{_sanitize(wid)}"}} {n}')
+    lead = m.get("leadership") or {}
+    if lead:
+        lines += ["# HELP rw_leader_term This session's lease term "
+                  "(strictly monotonic across failovers; the un-fenced "
+                  "conductor holds the highest).",
+                  "# TYPE rw_leader_term gauge",
+                  f'rw_leader_term {lead.get("term") or 0}',
+                  "# HELP rw_leader_is_writer 1 when this session is "
+                  "the un-fenced barrier conductor, else 0.",
+                  "# TYPE rw_leader_is_writer gauge",
+                  f'rw_leader_is_writer {lead.get("is_writer", 0)}',
+                  "# HELP rw_failover_total Leadership transitions this "
+                  "session performed, by kind (promotion, demotion, "
+                  "election_lost).",
+                  "# TYPE rw_failover_total counter",
+                  f'rw_failover_total{{kind="promotion"}} '
+                  f'{lead.get("promotions", 0)}',
+                  f'rw_failover_total{{kind="demotion"}} '
+                  f'{lead.get("demotions", 0)}',
+                  f'rw_failover_total{{kind="election_lost"}} '
+                  f'{lead.get("elections_lost", 0)}',
+                  "# HELP rw_failover_duration_seconds leader_down-to-"
+                  "promoted wall seconds of the most recent failover "
+                  "this session won.",
+                  "# TYPE rw_failover_duration_seconds gauge"]
+        if lead.get("last_failover_ms") is not None:
+            lines.append(f'rw_failover_duration_seconds '
+                         f'{round(lead["last_failover_ms"] / 1e3, 6)}')
     chaos = m.get("chaos") or {}
     if chaos:
         lines += ["# HELP rw_chaos_injection_total Network fault plane "

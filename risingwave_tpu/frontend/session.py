@@ -340,18 +340,50 @@ class Session:
         # multi-tenant attachment (docs/control-plane.md): a "writer"
         # conducts barriers and owns DDL; a "serving" session is a
         # read-only frontend sharing one meta + one Hummock dir with the
-        # writer, kept current by meta notifications. In-process meta
-        # (meta_addr None) stays the playground default — bit-identical.
-        if role not in ("writer", "serving"):
+        # writer, kept current by meta notifications; a "standby" is a
+        # serving session that VOLUNTEERED for election — on a
+        # leader_down push it races lease.acquire and the CAS winner
+        # promotes in place to writer. In-process meta (meta_addr None)
+        # stays the playground default — bit-identical.
+        if role not in ("writer", "serving", "standby"):
             raise ValueError(f"unknown session role {role!r} "
-                             "(expected 'writer' or 'serving')")
+                             "(expected 'writer', 'serving' or 'standby')")
         if meta_addr is None and rw_config is not None \
                 and getattr(rw_config, "meta", None) is not None:
             meta_addr = rw_config.meta.addr or None
-        if role == "serving" and meta_addr is None:
-            raise ValueError("a serving session needs a meta_addr "
+        if role in ("serving", "standby") and meta_addr is None:
+            raise ValueError(f"a {role} session needs a meta_addr "
                              "to attach to")
-        self.role = role
+        #: election eligibility survives role flips: a promoted standby
+        #: that later demotes goes back to waiting for leader_down
+        self._standby = role == "standby"
+        self.role = "serving" if role == "standby" else role
+        role = self.role
+        # failover knobs ([meta] section): the TTL itself is enforced
+        # server-side (`ctl meta serve --lease-ttl`); the client keeps
+        # the heartbeat cadence and the election jitter cap
+        _meta_cfg = (getattr(rw_config, "meta", None)
+                     if rw_config is not None else None)
+        self._lease_ttl_s = (float(_meta_cfg.lease_ttl_s)
+                             if _meta_cfg is not None else 2.0)
+        self._lease_heartbeat_s = (float(_meta_cfg.heartbeat_s)
+                                   if _meta_cfg is not None else 0.5)
+        self._election_backoff_s = (
+            float(_meta_cfg.election_backoff_ms) / 1000.0
+            if _meta_cfg is not None else 0.1)
+        # leadership telemetry (metrics()["leadership"] → Prometheus
+        # rw_leader_* / rw_failover_* families)
+        self._leadership: dict = {
+            "promotions": 0, "demotions": 0, "elections_lost": 0,
+            "lease_lost": 0, "last_failover_ms": None}
+        # post-promotion vacuum grace: the runs the promoted writer's
+        # adopted version referenced, protected until readers re-report
+        # pins (one notification round-trip) or the deadline passes
+        self._pin_grace_refs: set[str] = set()
+        self._pin_grace_deadline = 0.0
+        self._pin_grace_epoch = 0
+        self._election_lock = threading.Lock()
+        self._election_busy = False
         self.meta_addr = meta_addr
         self.catalog = Catalog()
         self.data_dir = data_dir
@@ -450,9 +482,11 @@ class Session:
                                 str(self._generation))
             if meta_addr is not None:
                 # the same token doubles as the writer's leader-lease
-                # fencing generation (last writer wins; no election —
-                # the single-leader assumption, docs/control-plane.md)
+                # TERM (strictly newer terms win the CAS; TTL expiry
+                # triggers standby election — docs/control-plane.md)
                 self.meta.acquire_leader(self._generation)
+                self.meta.start_heartbeat(self._lease_heartbeat_s,
+                                          on_lost=self._on_lease_lost)
         else:
             # read-only attachment: adopt (never advance) the token
             self._generation = int(
@@ -811,6 +845,9 @@ class Session:
         notif = self.meta.notifications
         notif.subscribe("system_params", self._on_system_params_push)
         notif.subscribe("leader", self._on_leader_push)
+        # every remote session hears about a dead leader; only standbys
+        # (_on_leader_down checks) actually race the election
+        notif.subscribe("leader_down", self._on_leader_down)
         if self.role == "serving":
             notif.subscribe("catalog", self._on_catalog_push)
             notif.subscribe("checkpoint", self._on_checkpoint_push)
@@ -818,7 +855,7 @@ class Session:
             notif.subscribe("hummock_pins", self._on_pins_push)
             manager = getattr(self.store, "manager", None)
             if manager is not None:
-                manager.external_refs = lambda: set(self._remote_pin_runs)
+                manager.external_refs = self._external_pin_refs
         self.meta.on_resync(self._on_meta_resync)
 
     def _on_catalog_push(self, _version: int, _info) -> None:
@@ -858,6 +895,13 @@ class Session:
 
     def _on_pins_push(self, _version: int, info) -> None:
         self._remote_pin_runs = set(info.get("ssts", ()))
+        # post-promotion grace ends after ONE notification round-trip:
+        # our first checkpoint notify made readers refresh and re-report,
+        # and this push is the server's updated union — from here the
+        # live pin registry protects everything a reader still holds
+        if self._pin_grace_refs \
+                and self.store.committed_epoch > self._pin_grace_epoch:
+            self._pin_grace_refs = set()
 
     def _on_meta_resync(self) -> None:
         """The meta process restarted (its notification log reset): the
@@ -897,6 +941,236 @@ class Session:
             raise MetaFenced(
                 "this session's writer lease was superseded; barrier "
                 "conduction and checkpoint commits are refused")
+
+    # -- leader failover (docs/control-plane.md "Election") --------------------
+
+    def _external_pin_refs(self) -> set:
+        """What the vacuum must spare beyond local pins: the live remote
+        pin registry, plus — inside the post-promotion grace window —
+        every run the version adopted at promotion referenced (a reader
+        that reconnected mid-failover may hold pins the registry forgot
+        until it re-reports)."""
+        refs = set(self._remote_pin_runs)
+        if self._pin_grace_refs:
+            import time as _t
+            if _t.monotonic() < self._pin_grace_deadline:
+                refs |= self._pin_grace_refs
+            else:
+                self._pin_grace_refs = set()
+        return refs
+
+    def _on_lease_lost(self, _exc) -> None:
+        """Heartbeat thread: a renewal came back LeaseLost — another
+        session holds a newer term. Flag only; the next conduction
+        attempt raises MetaFenced and the tick path demotes us."""
+        self._fenced = True
+        self._leadership["lease_lost"] += 1
+
+    def _on_leader_down(self, _version: int, info) -> None:
+        """Subscription thread: the server's TTL detector declared the
+        leader dead. Standbys race ``lease.acquire`` at down-term + 1 on
+        a dedicated thread (promotion takes the session lock and does
+        real work — it must never block notification delivery)."""
+        if not self._standby or self.role == "writer":
+            return
+        with self._election_lock:
+            if self._election_busy:
+                return
+            self._election_busy = True
+        down_term = int(info.get("term", info.get("generation", 0)) or 0)
+        threading.Thread(target=self._run_election, args=(down_term,),
+                         name="leader-election", daemon=True).start()
+
+    def _run_election(self, down_term: int) -> None:
+        """One election round. Every candidate computes the SAME target
+        term — down-term + 1, taken from the ``leader_down`` payload the
+        server pushed once per expiry — so the server CAS admits exactly
+        one; losers take the typed LeaseLost and stay serving. The term
+        must NOT be re-derived from the store here: a late candidate
+        reading ``session_generation`` after the winner bumped it would
+        compute term + 2, be admitted as "strictly newer", and take the
+        leadership right back — a split brain by term escalation. The
+        winner starts heartbeating BEFORE the (possibly long) promotion
+        so the lease cannot expire under it."""
+        from ..meta.client import LeaseLost, MetaUnavailable
+        import hashlib as _hl
+        import time as _t
+        try:
+            if self._election_backoff_s > 0:
+                # deterministic per-session jitter spreads the CAS storm
+                h = int(_hl.sha256(
+                    self.meta.session_id.encode()).hexdigest(), 16)
+                _t.sleep((h % 1000) / 1000.0 * self._election_backoff_s)
+            t0 = _t.monotonic()
+            term = int(down_term) + 1
+            try:
+                self.meta.acquire_leader(term, reason="election")
+            except (LeaseLost, MetaUnavailable):
+                self._leadership["elections_lost"] += 1
+                return
+            self.meta.start_heartbeat(self._lease_heartbeat_s,
+                                      on_lost=self._on_lease_lost)
+            try:
+                self.promote(term)
+            except Exception:
+                # a wedged half-promotion must not hold the lease: stop
+                # renewing so the TTL frees it for the next candidate
+                self.meta.stop_heartbeat()
+                raise
+            self._leadership["last_failover_ms"] = round(
+                (_t.monotonic() - t0) * 1e3, 3)
+        except Exception:  # noqa: BLE001 - election must not kill the relay
+            pass
+        finally:
+            with self._election_lock:
+                self._election_busy = False
+
+    @_locked
+    def promote(self, term: int) -> None:
+        """In-place standby → writer takeover under ``term``: adopt the
+        committed Hummock cut read-write, rebuild every streaming job by
+        replaying the DDL log (the same ``_recover`` path a restarted
+        writer takes — jobs land on their last committed checkpoint and
+        source readers seek persisted offsets, so the takeover is
+        exactly-once), then resume barrier conduction. The caller must
+        already hold the lease at ``term``."""
+        if self.role == "writer":
+            return
+        self._enter_mutation()
+        try:
+            self._fenced = False
+            self._generation = int(term)
+            self.meta.store.put("session_generation",
+                                str(self._generation))
+            for w in self.workers:
+                w.generation = self._generation
+            # adopt the committed cut (the version manifest carries the
+            # DDL log, so refresh() brings that too)
+            refresh = getattr(self.store, "refresh", None)
+            if refresh is not None:
+                refresh()
+            # vacuum grace: spare every run the adopted version
+            # references until readers re-report under this writer
+            import time as _t
+            runs = getattr(self.store, "version_runs", None)
+            self._pin_grace_refs = (set(runs()) if runs is not None
+                                    else set())
+            self._pin_grace_deadline = (_t.monotonic()
+                                        + max(self._lease_ttl_s, 1.0))
+            self._pin_grace_epoch = self.store.committed_epoch
+            try:
+                self._remote_pin_runs = set(self.meta.pins_union())
+            except Exception:
+                pass
+            # observer rewiring: a writer must not chase its own
+            # commits through catalog/checkpoint pushes
+            notif = self.meta.notifications
+            notif.unsubscribe("catalog", self._on_catalog_push)
+            notif.unsubscribe("checkpoint", self._on_checkpoint_push)
+            notif.subscribe("hummock_pins", self._on_pins_push,
+                            from_version=notif.current_version)
+            manager = getattr(self.store, "manager", None)
+            if manager is not None:
+                manager.external_refs = self._external_pin_refs
+            # rebuild jobs from the DDL log exactly like a restarted
+            # writer: from an EMPTY catalog (replayed CREATEs write
+            # through to meta idempotently)
+            cat = self.catalog
+            cat.sources.clear(); cat.tables.clear(); cat.mvs.clear()
+            cat.sinks.clear(); cat.indexes.clear()
+            cat._next_table_id = 1
+            self.role = "writer"
+            self.epoch = max(1, self.store.committed_epoch)
+            self._injected = self.epoch
+            self._inflight.clear()
+            self._inject_time.clear()
+            self._pending_mutation = None
+            if self.data_dir is not None:
+                self._recover()
+            # the writer owns storage maintenance now (serving sessions
+            # opened with compaction routed away)
+            if getattr(self.store, "inline_compaction", None) is False \
+                    and not self.compactors:
+                self.store.inline_compaction = True
+            self.meta.advance_epoch_clock(self.epoch)
+            self._leadership["promotions"] += 1
+        finally:
+            self._serving.invalidate_catalog()
+            self._exit_mutation()
+
+    def _demote_to_serving(self) -> None:
+        """A fenced ex-writer (partitioned, not dead — a successor holds
+        a newer term) converts itself into a WORKING serving session
+        instead of crashing: stop conducting, discard uncommitted
+        in-flight epochs (the successor's recovery replays them from
+        committed offsets exactly once), drop the jobs, and follow the
+        new writer through notifications like any other reader."""
+        self.meta.stop_heartbeat()
+        self._inflight.clear()
+        self._inject_time.clear()
+        self._pending_mutation = None
+        for job in list(self.jobs.values()):
+            sink = getattr(job.pipeline, "sink", None)
+            if sink is not None:
+                try:
+                    sink.close()
+                except Exception:  # noqa: BLE001 - already dying
+                    pass
+        jobs = list(self.jobs.values())
+        if jobs:
+            async def _stop_all():
+                await asyncio.gather(*(j.stop() for j in jobs),
+                                     return_exceptions=True)
+                for _ in range(3):
+                    await asyncio.sleep(0)
+            try:
+                self._await(_stop_all())
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self.jobs.clear()
+        self.feeds.clear()
+        self.backfills.clear()
+        self._table_queues.clear()
+        from ..stream.coschedule import CoScheduler
+        self._cosched = CoScheduler()
+        self._cosched_engines.clear()
+        self._cosched_markers.clear()
+        self._shardfused = None
+        self._shardfused_engines.clear()
+        self._shardfused_markers.clear()
+        self._dead_jobs.clear()
+        self._jobs_to_recover.clear()
+        # discard staged-but-uncommitted state: fully discarded is the
+        # demotion half of "committed exactly once or fully discarded"
+        pending = getattr(self.store, "_pending", None)
+        if pending is not None:
+            pending.clear()
+        if getattr(self.store, "inline_compaction", None) is True:
+            self.store.inline_compaction = False
+        self.role = "serving"
+        self._fenced = False   # the serving read path is healthy
+        self._leadership["demotions"] += 1
+        notif = self.meta.notifications
+        notif.subscribe("catalog", self._on_catalog_push,
+                        from_version=notif.current_version)
+        notif.subscribe("checkpoint", self._on_checkpoint_push,
+                        from_version=notif.current_version)
+        try:
+            self._load_catalog_from_meta()
+        except Exception:  # noqa: BLE001 - next push retries
+            pass
+        self._on_checkpoint_push(0, None)
+
+    def _maybe_demote(self, exc: BaseException) -> None:
+        """Conduction raised: if it was the fencing signal on a remote
+        control plane, demote in place (swallowing demotion errors — the
+        caller re-raises the original MetaFenced either way)."""
+        if (type(exc).__name__ == "MetaFenced" and self._fenced
+                and self.role == "writer" and self.meta_addr is not None):
+            try:
+                self._demote_to_serving()
+            except Exception:  # noqa: BLE001 - keep the fencing signal
+                pass
 
     # ------------------------------------------------------------------ SQL --
 
@@ -3247,6 +3521,12 @@ class Session:
         self._enter_mutation()
         try:
             return self._tick_impl(generate, checkpoint, mutation)
+        except Exception as exc:
+            # a fenced ex-writer on a remote control plane demotes to a
+            # working serving session instead of wedging (the original
+            # MetaFenced still surfaces so the driver knows)
+            self._maybe_demote(exc)
+            raise
         finally:
             self._exit_mutation()
 
@@ -3763,7 +4043,11 @@ class Session:
         promise, so it may not return while an async commit is in
         flight."""
         self.tick(generate=False, checkpoint=True)
-        self._drain_inflight()
+        try:
+            self._drain_inflight()
+        except Exception as exc:
+            self._maybe_demote(exc)
+            raise
         self.store.join_commits()
 
     # ----------------------------------------------------------- mutations --
@@ -4122,6 +4406,18 @@ class Session:
             # serving plane (frontend/serving.py): plan-cache hit/miss,
             # two-phase task counts, partials merged, read latency p50/p99
             "serving": self._serving.metrics(),
+            # leader failover plane (docs/control-plane.md "Election"):
+            # current role/term, fencing state, promotion/demotion
+            # counters → rw_leader_* / rw_failover_* Prometheus families
+            "leadership": {
+                "role": self.role,
+                "standby": self._standby,
+                "term": self._generation,
+                "is_writer": int(self.role == "writer"
+                                 and not self._fenced),
+                "fenced": self._fenced,
+                **self._leadership,
+            },
             # asynchronous epoch pipeline ([streaming] pipeline_depth):
             # configured depth, deferred-flush/drain counters, how many
             # group flushes are pending right now, and the profiler's

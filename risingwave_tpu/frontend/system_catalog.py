@@ -266,6 +266,31 @@ def _rw_autoscaler_decisions(catalog, session):
     return schema, rows
 
 
+def _rw_leader_history(catalog, session):
+    """Leader-lease acquisition history (meta/server.py persists it):
+    one row per term — who held it, when, and why (bootstrap, takeover
+    attach, or a TTL-expiry election). In-process meta has no lease, so
+    the relation is empty there."""
+    schema = Schema.of(
+        ("term", INT64), ("holder", VARCHAR), ("acquired_at", FLOAT64),
+        ("reason", VARCHAR), ("leaderless_s", FLOAT64),
+        ("current", BOOL))
+    if session is None:
+        return schema, []
+    lease_info = getattr(session.meta, "lease_info", None)
+    if lease_info is None:
+        return schema, []          # in-process meta: no lease surface
+    try:
+        info = lease_info()
+    except Exception:
+        return schema, []
+    rows = [(h.get("term"), h.get("holder"), h.get("acquired_at"),
+             h.get("reason"), h.get("leaderless_s"),
+             h.get("term") == info.get("term"))
+            for h in info.get("history", ())]
+    return schema, rows
+
+
 _RELATIONS = {
     "pg_tables": _pg_tables,
     "pg_catalog.pg_tables": _pg_tables,
@@ -287,6 +312,7 @@ _SESSION_RELATIONS = {
     "rw_dispatch_profiles": _rw_dispatch_profiles,
     "rw_hbm_ledger": _rw_hbm_ledger,
     "rw_autoscaler_decisions": _rw_autoscaler_decisions,
+    "rw_leader_history": _rw_leader_history,
 }
 _SESSION_RELATIONS.update({f"rw_catalog.{n}": b
                            for n, b in list(_SESSION_RELATIONS.items())})

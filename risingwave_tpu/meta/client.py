@@ -18,7 +18,12 @@ src/rpc_client/src/meta_client.rs):
 
 Reconnect story: a failed request retries once after re-dialing with
 backoff (every mutation on this surface is idempotent — puts, deletes,
-heartbeats, publishes). The subscription thread re-dials forever until
+heartbeats, publishes). The ONE exception is the lease surface:
+``lease.acquire``/``lease.renew`` are never retried, because a replayed
+acquire after a competitor already won the CAS would hand two sessions
+the same term — a split brain, not a transient (they surface
+``MetaUnavailable`` instead and let the election layer re-evaluate with
+a fresh term). The subscription thread re-dials forever until
 ``close()``; because the server's notification log is in-memory, a meta
 restart resets versions, so after every re-subscribe the client fires
 its registered **resync callbacks** — the session uses these to reload
@@ -53,6 +58,12 @@ class MetaUnavailable(ConnectionError):
 class MetaFenced(RuntimeError):
     """This writer's lease generation was superseded — it must stop
     conducting barriers and committing checkpoints immediately."""
+
+
+class LeaseLost(RuntimeError):
+    """A lease acquire/renew was refused: another session holds (or just
+    won) the lease. Terminal for the caller's claim on that term — never
+    retried, never mapped to ``TxnConflict``."""
 
 
 class RemoteMetaStore:
@@ -166,11 +177,15 @@ class MetaClient:
         self.generation: Optional[int] = None
         self._lock = threading.RLock()
         self._sock: Optional[socket.socket] = None
+        self._had_conn = False
         self._closed = False
         self._failure_fns: List[Callable[[str], None]] = []
         self._resync_fns: List[Callable[[], None]] = []
         self._reported_pins: Set[str] = set()
-        self.stats = {"reconnects": 0, "resyncs": 0, "requests": 0}
+        self._hb_stop: Optional[threading.Event] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self.stats = {"reconnects": 0, "resyncs": 0, "requests": 0,
+                      "heartbeats": 0, "lease_lost": 0}
         self.store = RemoteMetaStore(self)
         self.notifications = _NotificationRelay(self)
         self._dial()  # fail fast on a bad address
@@ -186,6 +201,20 @@ class MetaClient:
             if self._sock is None:
                 self._sock = socket.create_connection(
                     self._addr, timeout=10.0)
+                if self._had_conn:
+                    # EVERY re-dial counts and re-reports, no matter
+                    # which caller noticed the broken socket first (the
+                    # heartbeat thread absorbs connection errors without
+                    # retrying, so _reconnect is not the only path here)
+                    self.stats["reconnects"] += 1
+                    if self._reported_pins:
+                        try:
+                            self._request(
+                                "pins.report",
+                                {"ssts": sorted(self._reported_pins)})
+                        except Exception:  # noqa: BLE001 - resync re-reports
+                            pass
+                self._had_conn = True
             return self._sock
 
     def _drop_conn(self) -> None:
@@ -203,12 +232,9 @@ class MetaClient:
             if self._closed:
                 raise MetaUnavailable("meta client closed")
             try:
+                # _dial counts the reconnect and re-reports pins (a new
+                # meta process does not know them)
                 self._dial()
-                self.stats["reconnects"] += 1
-                # a new meta process does not know our pins: re-report
-                if self._reported_pins:
-                    self._request("pins.report",
-                                  {"ssts": sorted(self._reported_pins)})
                 return
             except OSError:
                 self._drop_conn()
@@ -218,12 +244,29 @@ class MetaClient:
                         f"{self.RECONNECT_TIMEOUT_S:.0f}s")
                 time.sleep(self._BACKOFF_S[min(i, len(self._BACKOFF_S) - 1)])
 
+    #: methods the retry-once path must NEVER replay: a second acquire
+    #: after a competitor won the CAS would be a split brain, and a
+    #: replayed renew could resurrect a lease the TTL already expired
+    _LEASE_METHODS = frozenset({"lease.acquire", "lease.renew"})
+
     def _request(self, method: str, params: Optional[dict]) -> Any:
+        frame = {"method": method, "params": params or {},
+                 # frame type for chaos-plane `types=[...]` rules
+                 "type": method}
+        if method.startswith("lease."):
+            # own chaos stream (META_LINK + "#clease"): heartbeats are
+            # wall-clock-driven, so they must not consume seqs from the
+            # deterministic store/publish frame stream
+            frame["chan"] = "lease"
+        elif method == "pins.report":
+            # same reasoning: serving sessions re-report pins when
+            # checkpoint NOTIFICATIONS land (a wall-clock thread), so a
+            # pin report racing a main-thread RPC must not perturb the
+            # deterministic stream's seq numbering
+            frame["chan"] = "pins"
         with self._lock:
             sock = self._dial()
-            write_frame_sync(sock, {"method": method,
-                                    "params": params or {}},
-                             link=META_LINK)
+            write_frame_sync(sock, frame, link=META_LINK)
             reply = read_frame_sync(sock)
         if reply is None:
             raise ConnectionError("meta connection closed mid-request")
@@ -235,11 +278,15 @@ class MetaClient:
             raise TxnConflict(message)
         if error == "fenced":
             raise MetaFenced(message)
+        if error == "lease_lost":
+            raise LeaseLost(message)
         raise RuntimeError(f"meta {method} failed: {message}")
 
     def call(self, method: str, params: Optional[dict] = None) -> Any:
         """One request/reply; on a broken connection, re-dial with
-        backoff and retry once (all meta mutations are idempotent)."""
+        backoff and retry once (all meta mutations are idempotent —
+        EXCEPT the lease surface, which is never retried: see
+        ``_LEASE_METHODS``)."""
         if self._closed:
             raise MetaUnavailable("meta client closed")
         self.stats["requests"] += 1
@@ -250,6 +297,10 @@ class MetaClient:
                 if isinstance(e, MetaUnavailable):
                     raise
                 self._drop_conn()
+                if method in self._LEASE_METHODS:
+                    raise MetaUnavailable(
+                        f"meta unreachable during {method} "
+                        f"(not retried: non-idempotent): {e}") from e
                 self._reconnect()
                 return self._request(method, params)
 
@@ -319,16 +370,83 @@ class MetaClient:
 
     # -- leader lease ----------------------------------------------------------
 
-    def acquire_leader(self, generation: int) -> int:
-        """Claim the writer lease under this session's generation.
-        Last writer wins; the previous holder is fenced from then on."""
-        self.generation = generation
-        return self.call("lease.acquire", {
-            "session": self.session_id, "generation": generation})
+    def acquire_leader(self, generation: int,
+                       reason: Optional[str] = None) -> int:
+        """Claim the writer lease at this term (== generation). The
+        server CAS admits a strictly newer term or the holder re-arming;
+        a refused claim raises ``LeaseLost`` and this client's term
+        stays unset — a losing election candidate remains a clean
+        serving session."""
+        params = {"session": self.session_id,
+                  "generation": int(generation), "term": int(generation)}
+        if reason is not None:
+            params["reason"] = reason
+        term = int(self.call("lease.acquire", params))
+        self.generation = term
+        return term
+
+    def renew_leader(self) -> float:
+        """Heartbeat the held lease; returns the new server deadline.
+        ``LeaseLost`` means another session took the term: stop
+        heartbeating and let the fencing path demote us."""
+        return self.call("lease.renew", {
+            "session": self.session_id, "term": self.generation,
+            "generation": self.generation})
 
     def assert_leader(self) -> None:
         """Raise ``MetaFenced`` if this client no longer holds the lease."""
         self.call("lease.assert", {"generation": self.generation})
+
+    def lease_info(self) -> dict:
+        """Holder/term/TTL/failover-count snapshot (``ctl meta leader``,
+        the system catalog, and the split-brain probe read this)."""
+        return self.call("lease.info") or {}
+
+    def start_heartbeat(self, interval_s: float,
+                        on_lost: Optional[Callable[[Exception], None]]
+                        = None) -> None:
+        """Run a daemon renewal loop for the held lease. Transient link
+        trouble is ignored — the server-side TTL is the sole judge of
+        liveness; ``LeaseLost`` fires ``on_lost`` once and stops the
+        loop (the session demotes via the MetaFenced path)."""
+        self.stop_heartbeat()
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                if self._closed or stop.is_set():
+                    break
+                try:
+                    self.renew_leader()
+                    self.stats["heartbeats"] += 1
+                except LeaseLost as e:
+                    self.stats["lease_lost"] += 1
+                    if on_lost is not None:
+                        try:
+                            on_lost(e)
+                        except Exception:
+                            pass
+                    break
+                except Exception:
+                    # unreachable/slow meta: keep trying on schedule —
+                    # if we really are dead to the server, the TTL
+                    # expires and a successor fences us on reconnect
+                    continue
+
+        self._hb_stop = stop
+        self._hb_thread = threading.Thread(
+            target=loop, name="lease-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        stop, thread = self._hb_stop, self._hb_thread
+        self._hb_stop = None
+        self._hb_thread = None
+        if stop is not None:
+            stop.set()
+        if (thread is not None and thread.is_alive()
+                and thread is not threading.current_thread()):
+            thread.join(timeout=2.0)
 
     # -- remote pin registry ---------------------------------------------------
 
@@ -353,7 +471,14 @@ class MetaClient:
             sock = None
             try:
                 sock = socket.create_connection(self._addr, timeout=10.0)
+                # chan: the subscribe handshake is sent from THIS
+                # daemon thread while the main thread keeps issuing
+                # sync RPCs — its frame must ride its own chaos stream
+                # or the dial race would perturb the deterministic
+                # stream's seq numbering run to run
                 write_frame_sync(sock, {"method": "subscribe",
+                                        "type": "subscribe",
+                                        "chan": "sub",
                                         "params": {"from_version": 0}},
                                  link=META_LINK)
                 if not first:
@@ -392,6 +517,7 @@ class MetaClient:
 
     def close(self) -> None:
         self._closed = True
+        self.stop_heartbeat()
         self._drop_conn()
         if self._sub_thread.is_alive():
             self._sub_thread.join(timeout=2.0)
@@ -400,4 +526,5 @@ class MetaClient:
 def leader_record(session: str, generation: int) -> str:
     """The JSON the leader lease key holds (kept next to the client so
     tests and ctl can decode it without importing the server)."""
-    return json.dumps({"session": session, "generation": generation})
+    return json.dumps({"session": session, "generation": generation,
+                       "term": generation})

@@ -19,11 +19,19 @@ surface behind the ``rpc/wire.py`` frame protocol:
   ``NotificationService`` observer stream (meta/src/rpc/server.rs +
   notification.rs) — readers learn about DDL, checkpoints, and system
   params without polling.
-* **leader lease** — a single persisted store key (``leader``) holding
-  ``{"session", "generation"}``. Acquisition is last-writer-wins (no
-  election — the single-leader assumption is documented in
-  docs/control-plane.md); *fencing* is enforced server-side: barrier /
-  checkpoint publishes carrying a stale generation are refused, so an
+* **leader lease** — a TTL lease with monotonic *terms*
+  (docs/control-plane.md "The election protocol"). The persisted store
+  key (``leader``) holds ``{"session", "term", "acquired_at", "reason"}``;
+  ``lease.acquire`` is a CAS that admits only a strictly newer term (or
+  the same ``(session, term)`` re-arming itself) — a racing candidate
+  loses with a typed ``lease_lost`` error, never a retryable conflict.
+  ``lease.renew`` heartbeats extend the deadline, which lives in server
+  memory only (a meta restart re-arms one fresh TTL — renewals must not
+  consume durable-store IO or the chaos plane's deterministic frame
+  stream). The loop thread runs an expiry detector: a lease past its
+  deadline pushes one ``leader_down`` notification so standbys can race
+  ``lease.acquire`` for term+1. *Fencing* stays server-side: barrier /
+  checkpoint publishes carrying a stale term are refused, so an
   ex-writer that lost the lease can neither conduct nor commit.
 * **remote pin registry** — serving sessions report the SST runs their
   pinned snapshots reference; the union is pushed on the
@@ -48,6 +56,7 @@ import asyncio
 import json
 import sys
 import threading
+import time
 from typing import Any, Dict, Optional, Set
 
 from ..rpc.wire import pack_frame, read_frame
@@ -57,6 +66,17 @@ from .store import TxnConflict
 #: store key holding the writer lease (persisted: fencing survives a
 #: meta restart on the same data dir)
 LEADER_KEY = "leader"
+#: persisted acquisition history (term, holder, acquired_at, reason) —
+#: the rw_leader_history catalog relation and `ctl meta leader` read it
+LEADER_HISTORY_KEY = "leader_history"
+#: persisted count of elections (acquisitions over an EXPIRED lease)
+LEADER_FAILOVERS_KEY = "leader_failovers"
+LEADER_HISTORY_CAP = 64
+
+#: default TTL: a writer that misses this many seconds of heartbeats is
+#: declared down ([meta] lease_ttl_s overrides; heartbeats default to
+#: lease_ttl_s / 4 client-side)
+DEFAULT_LEASE_TTL_S = 2.0
 
 
 class MetaServer:
@@ -69,7 +89,8 @@ class MetaServer:
     """
 
     def __init__(self, data_dir: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S):
         self.service = MetaService(data_dir=data_dir)
         self._host = host
         self._port = port
@@ -82,7 +103,21 @@ class MetaServer:
         self._remote_pins: Dict[int, Set[str]] = {}
         self._conn_ids = iter(range(1, 1 << 62))
         self.stats = {"connections": 0, "requests": 0, "subscribers": 0,
-                      "fenced_rejections": 0}
+                      "fenced_rejections": 0, "lease_renews": 0,
+                      "leader_expiries": 0}
+        # TTL lease state. The deadline is deliberately MEMORY-only:
+        # persisting every renewal would fsync the JSONL store several
+        # times a second AND feed wall-clock-driven events into the
+        # chaos plane's deterministic meta-IO stream. A restarted meta
+        # re-arms one fresh TTL for whatever holder the store records —
+        # the holder's next heartbeat confirms it, or expiry elects.
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._lease_deadline: Optional[float] = None
+        # term whose leader_down has already been pushed (one
+        # notification per expiry, not one per detector sweep)
+        self._down_term: Optional[int] = None
+        if self.service.store.get(LEADER_KEY) is not None:
+            self._lease_deadline = time.time() + self.lease_ttl_s
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -114,6 +149,9 @@ class MetaServer:
         sock = self._server.sockets[0]
         host, port = sock.getsockname()[:2]
         self.addr = f"{host}:{port}"
+        # TTL expiry detector on the SAME loop thread as request
+        # handling — the single-threaded MetaService invariant holds
+        self._expiry_task = self._loop.create_task(self._expiry_loop())
 
     async def _close(self) -> None:
         if self._server is not None:
@@ -158,6 +196,9 @@ class MetaServer:
                 try:
                     result = self._dispatch(conn_id, method, params)
                     reply = {"ok": True, "result": result}
+                except LeaseLost as e:
+                    reply = {"ok": False, "error": "lease_lost",
+                             "message": str(e)}
                 except TxnConflict as e:
                     reply = {"ok": False, "error": "txn_conflict",
                              "message": str(e)}
@@ -269,20 +310,21 @@ class MetaServer:
         # barrier conduction (fenced: only the current leader publishes)
         if method == "publish_barrier":
             self._check_fence(p)
-            svc.publish_barrier(p["epoch"], p["checkpoint"])
+            svc.publish_barrier(p["epoch"], p["checkpoint"],
+                                term=p.get("generation"))
             return None
         if method == "publish_checkpoint":
             self._check_fence(p)
-            svc.publish_checkpoint(p["committed_epoch"])
+            svc.publish_checkpoint(p["committed_epoch"],
+                                   term=p.get("generation"))
             return None
-        # leader lease
+        # leader lease (TTL + term-fenced election)
         if method == "lease.acquire":
-            store.put(LEADER_KEY, json.dumps(
-                {"session": p["session"], "generation": p["generation"]}))
-            svc.notifications.notify(
-                "leader", {"session": p["session"],
-                           "generation": p["generation"]})
-            return p["generation"]
+            return self._lease_acquire(p)
+        if method == "lease.renew":
+            return self._lease_renew(p)
+        if method == "lease.info":
+            return self._lease_info()
         if method == "lease.assert":
             self._check_fence(p)
             return True
@@ -300,11 +342,151 @@ class MetaServer:
         if raw is None:
             return
         holder = json.loads(raw)
-        generation = p.get("generation")
-        if generation is not None and generation != holder["generation"]:
+        h_term = int(holder.get("term", holder.get("generation", 0)))
+        term = p.get("generation", p.get("term"))
+        if term is not None and int(term) != h_term:
             raise Fenced(
-                f"generation {generation} fenced by leader "
-                f"{holder['session']} generation {holder['generation']}")
+                f"term {term} fenced by leader "
+                f"{holder['session']} term {h_term}")
+
+    # -- TTL leader lease ------------------------------------------------------
+
+    def _lease_acquire(self, p: dict) -> int:
+        """CAS on the lease record. Admits a strictly newer term (a new
+        writer attaching, or an election winner at down-term + 1) or the
+        holder itself re-arming; every other claimant gets the typed
+        ``LeaseLost`` — NEVER a retryable conflict, because a replayed
+        acquire after a competitor won would be a split brain."""
+        now = time.time()
+        session = p["session"]
+        term = int(p.get("term", p.get("generation")))
+        store = self.service.store
+        raw = store.get(LEADER_KEY)
+        reason = str(p.get("reason") or "bootstrap")
+        leaderless_s = None
+        if raw is not None:
+            holder = json.loads(raw)
+            h_term = int(holder.get("term", holder.get("generation", 0)))
+            expired = (self._lease_deadline is not None
+                       and now >= self._lease_deadline)
+            if holder.get("session") == session and term == h_term:
+                # the holder re-asserting its own lease: re-arm only
+                self._lease_deadline = now + self.lease_ttl_s
+                self._down_term = None
+                return term
+            if term <= h_term:
+                raise LeaseLost(
+                    f"lease.acquire term {term} refused: "
+                    f"{holder.get('session')} holds term {h_term}"
+                    + (" (expired)" if expired else " (live)"))
+            if p.get("reason") is None:
+                reason = "election" if expired else "takeover"
+            if expired and self._lease_deadline is not None:
+                leaderless_s = now - self._lease_deadline
+        record = {"session": session, "term": term, "generation": term,
+                  "acquired_at": now, "reason": reason}
+        try:
+            store.txn(preconditions=[(LEADER_KEY, raw)],
+                      ops=[("put", LEADER_KEY,
+                            json.dumps(record, sort_keys=True))])
+        except TxnConflict as e:
+            # the CAS itself lost (a durable-IO race under chaos): a
+            # competitor moved the record between read and write
+            raise LeaseLost(f"lease.acquire CAS lost: {e}") from e
+        self._lease_deadline = now + self.lease_ttl_s
+        self._down_term = None
+        if reason == "election":
+            n = int(store.get(LEADER_FAILOVERS_KEY) or "0") + 1
+            store.put(LEADER_FAILOVERS_KEY, str(n))
+        entry = {"term": term, "holder": session, "acquired_at": now,
+                 "reason": reason}
+        if leaderless_s is not None:
+            entry["leaderless_s"] = round(leaderless_s, 3)
+        hist = json.loads(store.get(LEADER_HISTORY_KEY) or "[]")
+        hist.append(entry)
+        store.put(LEADER_HISTORY_KEY,
+                  json.dumps(hist[-LEADER_HISTORY_CAP:]))
+        self.service.notifications.notify("leader", {
+            "session": session, "generation": term, "term": term,
+            "deadline": self._lease_deadline, "reason": reason})
+        return term
+
+    def _lease_renew(self, p: dict) -> float:
+        """Heartbeat: extend the holder's deadline. Wire + memory only —
+        no store IO (see __init__). A renewal under a superseded or
+        vanished lease is ``LeaseLost``: the heartbeat thread must stop,
+        not retry."""
+        session = p["session"]
+        term = int(p.get("term", p.get("generation")))
+        raw = self.service.store.get(LEADER_KEY)
+        if raw is None:
+            raise LeaseLost(f"lease.renew term {term}: no lease held")
+        holder = json.loads(raw)
+        h_term = int(holder.get("term", holder.get("generation", 0)))
+        if holder.get("session") != session or h_term != term:
+            raise LeaseLost(
+                f"lease.renew for {session} term {term} refused: "
+                f"{holder.get('session')} holds term {h_term}")
+        self._lease_deadline = time.time() + self.lease_ttl_s
+        if self._down_term == term:
+            # the holder came back before any candidate won: revive (a
+            # successor, if one is mid-election, still fences by term)
+            self._down_term = None
+        self.stats["lease_renews"] += 1
+        return self._lease_deadline
+
+    def _lease_info(self) -> dict:
+        store = self.service.store
+        now = time.time()
+        info: Dict[str, Any] = {
+            "holder": None, "term": None, "acquired_at": None,
+            "reason": None, "lease_ttl_s": self.lease_ttl_s,
+            "ttl_remaining_s": None, "expired": None,
+            "failovers": int(store.get(LEADER_FAILOVERS_KEY) or "0"),
+            "history": json.loads(store.get(LEADER_HISTORY_KEY) or "[]"),
+        }
+        raw = store.get(LEADER_KEY)
+        if raw is not None:
+            holder = json.loads(raw)
+            info["holder"] = holder.get("session")
+            info["term"] = int(holder.get("term",
+                                          holder.get("generation", 0)))
+            info["acquired_at"] = holder.get("acquired_at")
+            info["reason"] = holder.get("reason")
+            if self._lease_deadline is not None:
+                info["ttl_remaining_s"] = round(
+                    self._lease_deadline - now, 3)
+                info["expired"] = now >= self._lease_deadline
+        return info
+
+    async def _expiry_loop(self) -> None:
+        """Detect a holder that stopped heartbeating and push ONE
+        ``leader_down`` so standbys race ``lease.acquire`` at term+1."""
+        interval = max(0.02, min(self.lease_ttl_s / 4.0, 0.25))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self._check_lease_expiry()
+            except Exception:  # noqa: BLE001 - detector must outlive IO
+                pass
+
+    def _check_lease_expiry(self) -> None:
+        raw = self.service.store.get(LEADER_KEY)
+        if raw is None or self._lease_deadline is None:
+            return
+        now = time.time()
+        if now < self._lease_deadline:
+            return
+        holder = json.loads(raw)
+        term = int(holder.get("term", holder.get("generation", 0)))
+        if self._down_term == term:
+            return
+        self._down_term = term
+        self.stats["leader_expiries"] += 1
+        self.service.notifications.notify("leader_down", {
+            "session": holder.get("session"), "term": term,
+            "generation": term, "deadline": self._lease_deadline,
+            "detected_at": now})
 
     def _pins_union(self) -> Set[str]:
         out: Set[str] = set()
@@ -321,6 +503,13 @@ class Fenced(RuntimeError):
     """A stale writer tried to publish under a lost lease."""
 
 
+class LeaseLost(RuntimeError):
+    """A lease acquire/renew was refused: a competitor holds (or won)
+    the lease. Typed distinctly from ``TxnConflict`` because the caller
+    must NOT retry — a replayed acquire after a competitor won would be
+    a split brain."""
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="risingwave-meta",
@@ -329,9 +518,11 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--data-dir", default=None,
                     help="durable meta store directory (JSONL)")
+    ap.add_argument("--lease-ttl", type=float, default=DEFAULT_LEASE_TTL_S,
+                    help="leader lease TTL in seconds (default %(default)s)")
     args = ap.parse_args(argv)
     server = MetaServer(data_dir=args.data_dir, host=args.host,
-                        port=args.port)
+                        port=args.port, lease_ttl_s=args.lease_ttl)
     addr = server.start()
     # machine-readable readiness line: subprocess drivers parse this
     print(f"META_READY {addr}", flush=True)

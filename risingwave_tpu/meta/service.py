@@ -139,13 +139,23 @@ class MetaService:
 
     # -- barrier conduction publishing ----------------------------------------
 
-    def publish_barrier(self, epoch: int, checkpoint: bool) -> None:
-        self.notifications.notify(
-            "barrier", {"epoch": epoch, "checkpoint": checkpoint})
+    def publish_barrier(self, epoch: int, checkpoint: bool,
+                        term: Optional[int] = None) -> None:
+        """``term`` is the publisher's lease term (remote writers only):
+        carrying it in the payload lets observers — notably the
+        split-brain probe — verify that conduction terms never move
+        backwards across a failover."""
+        info = {"epoch": epoch, "checkpoint": checkpoint}
+        if term is not None:
+            info["term"] = int(term)
+        self.notifications.notify("barrier", info)
 
-    def publish_checkpoint(self, committed_epoch: int) -> None:
-        self.notifications.notify(
-            "checkpoint", {"committed_epoch": committed_epoch})
+    def publish_checkpoint(self, committed_epoch: int,
+                           term: Optional[int] = None) -> None:
+        info = {"committed_epoch": committed_epoch}
+        if term is not None:
+            info["term"] = int(term)
+        self.notifications.notify("checkpoint", info)
 
 
 class MetaBackedCatalog:

@@ -1029,6 +1029,13 @@ def meta_chaos_schedule(seed: int) -> ChaosSchedule:
     from .meta.client import META_LINK
     return ChaosSchedule(seed, [
         ChaosRule(kind="delay", link=META_LINK, prob=0.4, delay_ms=3.0),
+        # EVERY lease heartbeat delayed too (the lease.* frames ride
+        # their own `meta#clease` chaos stream — meta/client.py): a slow
+        # meta link slows renewals down but must NEVER expire a live
+        # writer's lease, or latency alone would trigger failovers —
+        # run_meta_chaos asserts the term never moved
+        ChaosRule(kind="delay", link=META_LINK, types=["lease.renew"],
+                  prob=1.0, delay_ms=2.0),
     ], name="meta_link_delay")
 
 
@@ -1079,14 +1086,28 @@ def run_meta_chaos(seed: int = 13, data_dir: Optional[str] = None,
             f"{want[:5]}")
         report = ConsistencyAuditor(writer).audit(control=control)
         report.assert_ok()
+        # a slow meta link is NOT a dead writer: with every renewal
+        # delayed (schedule rule 2) the lease must still be held at
+        # term 1 with zero failovers — latency degrades tick rate, never
+        # leadership (docs/control-plane.md "Election")
+        lease = writer.meta.lease_info()
+        assert lease.get("term") == 1 and not lease.get("failovers"), (
+            f"slow meta link caused a spurious failover: {lease}")
         injections = dict(plane().injections)
+        # replay compares ONLY the deterministic request stream (key
+        # exactly META_LINK): the wall-clock-paced side streams —
+        # lease heartbeats (#clease), subscription dials (#csub),
+        # notification-driven pin reports (#cpins) — legitimately vary
+        # run to run
         trace = {k: v for k, v in _collect_trace(data_dir).items()
-                 if k.split("#")[0] == META_LINK}
+                 if k == META_LINK}
         return {
             "scenario": "meta_link_delay", "seed": seed,
             "rows": len(got),
             "injections": injections,
             "meta_requests": writer.meta.stats["requests"],
+            "lease_term": lease.get("term"),
+            "failovers": lease.get("failovers", 0),
             "audit": {k: v.get("ok") for k, v in report.checks.items()},
             "trace": trace,
         }
@@ -1096,6 +1117,268 @@ def run_meta_chaos(seed: int = 13, data_dir: Optional[str] = None,
             reader.close()
         writer.close()
         control.close()
+        meta.stop()
+
+
+_FAILOVER_TABLE_DDL = "CREATE TABLE ft (k BIGINT, v BIGINT)"
+_FAILOVER_MV_DDL = ("CREATE MATERIALIZED VIEW fmv AS SELECT k, "
+                    "count(*) AS n, sum(v) AS s FROM ft GROUP BY k")
+
+
+def failover_chaos_schedule(seed: int) -> ChaosSchedule:
+    """Seeded chaos the DOOMED writer of ``run_failover`` conducts
+    under. The meta-RPC delays are confined to the first 20 frames of
+    the deterministic request stream — a window that closes during DDL
+    (before the insert loop, whose tail is truncated at the wall-clock
+    SIGKILL instant), so the injection trace replays identically even
+    though the kill lands at a different frame each run. The second
+    rule delays EVERY lease heartbeat; those ride their own
+    ``meta#clease`` stream (wall-clock-paced, excluded from the replay
+    comparison) and must not expire the lease while the writer lives."""
+    from .meta.client import META_LINK
+    return ChaosSchedule(seed, [
+        ChaosRule(kind="delay", link=META_LINK, prob=0.5, delay_ms=2.0,
+                  frames=[0, 20]),
+        ChaosRule(kind="delay", link=META_LINK, types=["lease.renew"],
+                  prob=1.0, delay_ms=1.0),
+    ], name="failover_writer_chaos")
+
+
+def _failover_writer_main(data_dir: str, addr: str, seed: int) -> int:
+    """Entry for the doomed-writer CHILD process of ``run_failover``
+    (spawned as ``sim --failover-writer DIR ADDR SEED`` and SIGKILLed
+    mid-stream — kill -9, no demotion, no goodbye). Chaos installs HERE
+    only; the parent's standbys run chaos-free. Reports readiness and
+    every committed epoch on stdout so the parent can time the kill."""
+    install(failover_chaos_schedule(seed), trace_path=os.path.join(
+        data_dir, "chaos_trace_writer.jsonl"))
+    w = Session(data_dir=data_dir, meta_addr=addr, state_store="hummock",
+                checkpoint_frequency=2)
+    w.run_sql(_FAILOVER_TABLE_DDL)
+    w.run_sql(_FAILOVER_MV_DDL)
+    print("WRITER_READY", flush=True)
+    i = 0
+    while True:
+        w.run_sql(f"INSERT INTO ft VALUES ({i % 5}, {i})")
+        w.tick()
+        i += 1
+        print(f"WRITER_COMMITTED {w.store.committed_epoch}", flush=True)
+
+
+def run_failover(seed: int = 7, data_dir: Optional[str] = None,
+                 lease_ttl_s: float = 1.0,
+                 kill_after_commits: int = 3,
+                 tail_inserts: int = 6) -> dict:
+    """Leader-failover acceptance scenario (docs/control-plane.md,
+    ISSUE 18): SIGKILL the writer PROCESS mid-stream while it conducts
+    under seeded chaos → the meta server's TTL detector pushes one
+    ``leader_down`` → two chaos-free standbys race ``lease.acquire`` at
+    term+1 → exactly one promotes in place and resumes conduction, with
+    NO operator action. The monitor (a plain MetaClient subscribed to
+    the barrier/checkpoint/leader channels) is the split-brain probe:
+    conduction terms never move backwards, per-term epochs and committed
+    epochs stay strictly increasing across the handover. Exactly-once is
+    audited bit-exact: the committed table rows replayed into a fresh
+    in-process control must yield the same MV — the killed writer's
+    in-flight epoch either committed once or left no trace."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+    import time as _time
+
+    from .common.audit import ConsistencyAuditor
+    from .meta.client import META_LINK, MetaClient
+    from .meta.server import MetaServer
+
+    data_dir = data_dir or tempfile.mkdtemp(prefix="rwtpu_failover_")
+    meta = MetaServer(data_dir=os.path.join(data_dir, "meta"),
+                      lease_ttl_s=lease_ttl_s)
+    addr = meta.start()
+
+    mon = MetaClient(addr, session_id="failover-monitor")
+    events: List[tuple] = []
+    ev_lock = threading.Lock()
+
+    def _watch(channel: str) -> None:
+        def cb(_version, info, _ch=channel):
+            with ev_lock:
+                events.append((_ch, _time.monotonic(), info))
+        mon.notifications.subscribe(channel, cb)
+
+    for ch in ("barrier", "checkpoint", "leader", "leader_down"):
+        _watch(ch)
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    child_err = open(os.path.join(data_dir, "writer.stderr"), "w",
+                     encoding="utf-8")
+    child = subprocess.Popen(
+        [_sys.executable, "-m", "risingwave_tpu.sim",
+         "--failover-writer", data_dir, addr, str(seed)],
+        stdout=subprocess.PIPE, stderr=child_err, text=True, env=env)
+    state = {"ready": False, "committed": 0}
+
+    def _drain() -> None:
+        # a dedicated drain keeps the child's stdout pipe from filling
+        # (a blocked writer would stop heartbeating and die of TTL
+        # expiry BEFORE the kill — a different scenario)
+        for line in child.stdout:
+            line = line.strip()
+            if line == "WRITER_READY":
+                state["ready"] = True
+            elif line.startswith("WRITER_COMMITTED"):
+                state["committed"] = int(line.split()[1])
+
+    threading.Thread(target=_drain, daemon=True).start()
+
+    def _wait(cond, timeout_s: float, what: str) -> None:
+        deadline = _time.monotonic() + timeout_s
+        while not cond():
+            if child.poll() is not None:
+                raise AssertionError(
+                    f"doomed writer died early (rc={child.returncode}) "
+                    f"waiting for {what}; see "
+                    f"{data_dir}/writer.stderr")
+            if _time.monotonic() >= deadline:
+                raise AssertionError(f"timed out waiting for {what}")
+            _time.sleep(0.02)
+
+    standbys: List[Session] = []
+    control: Optional[Session] = None
+    try:
+        _wait(lambda: state["ready"], 180.0, "writer DDL")
+        # standbys attach once the catalog exists; chaos-free, serving
+        # reads until the election
+        standbys = [Session(data_dir=data_dir, meta_addr=addr,
+                            role="standby", checkpoint_frequency=2)
+                    for _ in range(2)]
+        _wait(lambda: state["committed"] >= kill_after_commits,
+              120.0, f"{kill_after_commits} committed epochs")
+        killed_at = state["committed"]
+        kill_t = _time.monotonic()
+        child.kill()
+        child.wait(timeout=30)
+
+        def _promoted():
+            return next((s for s in standbys
+                         if s._leadership["promotions"]), None)
+
+        deadline = kill_t + lease_ttl_s * 10 + 60
+        while _promoted() is None and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        promoted = _promoted()
+        assert promoted is not None, (
+            "no standby promoted after the writer kill: "
+            f"{[s._leadership for s in standbys]}")
+        mttr_ms = (_time.monotonic() - kill_t) * 1e3
+        # let every candidate's election thread settle before judging
+        # the race — a loser mid-acquire is not yet a loser
+        _wait_settled = _time.monotonic() + 30
+        while any(s._election_busy for s in standbys) \
+                and _time.monotonic() < _wait_settled:
+            _time.sleep(0.02)
+        assert sum(s._leadership["promotions"] for s in standbys) == 1, (
+            "split brain: more than one standby promoted: "
+            f"{[s._leadership for s in standbys]}")
+        loser = next(s for s in standbys if s is not promoted)
+        assert loser.role == "serving", loser.role
+
+        # the promoted writer resumes conduction under term 2 — and the
+        # losing standby keeps serving reads throughout
+        for j in range(tail_inserts):
+            promoted.run_sql(
+                f"INSERT INTO ft VALUES ({j % 5}, {10_000 + j})")
+            promoted.tick()
+        promoted.flush()
+        rows = promoted.run_sql("SELECT k, v FROM ft")
+        vs = [r[1] for r in rows]
+        assert len(vs) == len(set(vs)), (
+            "duplicate rows survived the failover: an epoch applied "
+            "twice")
+        assert len(loser.run_sql("SELECT k, n, s FROM fmv")) > 0
+
+        # exactly-once, bit-exact: the committed rows replayed into a
+        # fresh control must rebuild the same MV state the promoted
+        # writer recovered + maintained across the handover
+        control = Session(checkpoint_frequency=2)
+        control.run_sql(_FAILOVER_TABLE_DDL)
+        control.run_sql(_FAILOVER_MV_DDL)
+        ordered = sorted(rows, key=lambda r: r[1])
+        for off in range(0, len(ordered), 8):
+            chunk = ordered[off:off + 8]
+            control.run_sql("INSERT INTO ft VALUES " + ", ".join(
+                f"({k}, {v})" for k, v in chunk))
+            control.tick()
+        control.flush()
+        report = ConsistencyAuditor(promoted).audit(
+            control=control, mv_names=["fmv"])
+        report.assert_ok()
+
+        # -- the monitor's split-brain probe --------------------------------
+        with ev_lock:
+            evs = list(events)
+        downs = [e for e in evs if e[0] == "leader_down"]
+        assert len(downs) == 1 and downs[0][2]["term"] == 1, downs
+        leader_terms = [int(e[2]["term"]) for e in evs
+                        if e[0] == "leader"]
+        assert leader_terms == sorted(set(leader_terms)), (
+            f"leader terms not strictly increasing: {leader_terms}")
+        assert [e[2]["reason"] for e in evs
+                if e[0] == "leader"].count("election") == 1
+        pub_terms = [int(e[2]["term"]) for e in evs
+                     if e[0] in ("barrier", "checkpoint")
+                     and e[2].get("term") is not None]
+        assert all(a <= b for a, b in zip(pub_terms, pub_terms[1:])), (
+            f"conduction terms moved backwards: {pub_terms}")
+        by_term: Dict[int, List[int]] = {}
+        for e in evs:
+            if e[0] == "barrier" and e[2].get("term") is not None:
+                by_term.setdefault(int(e[2]["term"]), []).append(
+                    int(e[2]["epoch"]))
+        for term, epochs in by_term.items():
+            assert all(a < b for a, b in zip(epochs, epochs[1:])), (
+                f"term {term} epochs not strictly increasing: {epochs}")
+        commits = [int(e[2]["committed_epoch"]) for e in evs
+                   if e[0] == "checkpoint"]
+        assert all(a < b for a, b in zip(commits, commits[1:])), (
+            f"committed epochs not strictly increasing: {commits}")
+        detect_ms = (downs[0][1] - kill_t) * 1e3
+        ckpt_times = [e[1] for e in evs if e[0] == "checkpoint"]
+        gaps = [(b - a) * 1e3
+                for a, b in zip(ckpt_times, ckpt_times[1:])]
+
+        info = mon.lease_info()
+        assert info["failovers"] == 1 and info["term"] == 2, info
+        trace = {k: v for k, v in _collect_trace(data_dir).items()
+                 if k == META_LINK}
+        return {
+            "scenario": "leader_failover", "seed": seed,
+            "lease_ttl_s": lease_ttl_s,
+            "killed_at_commit": killed_at,
+            "rows": len(rows),
+            "terms": sorted(by_term),
+            "failovers": info["failovers"],
+            "detect_ms": round(detect_ms, 3),
+            "mttr_ms": round(mttr_ms, 3),
+            "unavail_ms": round(max(gaps), 3) if gaps else None,
+            "gap_samples_ms": [round(g, 3) for g in gaps],
+            "elections_lost": sum(s._leadership["elections_lost"]
+                                  for s in standbys),
+            "audit": {k: v.get("ok") for k, v in report.checks.items()},
+            "trace": trace,
+        }
+    finally:
+        mon.close()
+        for s in standbys:
+            s.close()
+        if control is not None:
+            control.close()
+        if child.poll() is None:
+            child.kill()
+        child_err.close()
         meta.stop()
 
 
@@ -1257,6 +1540,16 @@ def main(argv=None) -> int:
                          "serving reader over a seeded-delayed RPC "
                          "link, audited bit-exact against an "
                          "in-process control (docs/control-plane.md)")
+    ap.add_argument("--failover", action="store_true",
+                    help="run the leader-failover acceptance scenario: "
+                         "kill -9 the writer process mid-stream under "
+                         "seeded chaos → a standby auto-promotes within "
+                         "the lease TTL with no operator action, "
+                         "exactly-once audited, split-brain probe green "
+                         "(docs/control-plane.md)")
+    ap.add_argument("--failover-writer", nargs=3,
+                    metavar=("DIR", "ADDR", "SEED"),
+                    help=argparse.SUPPRESS)
     ap.add_argument("--udf-soak", action="store_true",
                     help="run the soak seed: RPC chaos + UDF-server "
                          "kills + serving readers live together, "
@@ -1265,6 +1558,9 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=45.0,
                     help="--udf-soak wall-clock duration in seconds")
     args = ap.parse_args(argv)
+    if args.failover_writer:
+        d, addr, s = args.failover_writer
+        return _failover_writer_main(d, addr, int(s))
     if args.netsplit:
         r1 = run_netsplit(args.netsplit, seed=args.seed,
                           data_dir=tempfile.mkdtemp(prefix="rwtpu_ns1_"))
@@ -1324,6 +1620,25 @@ def main(argv=None) -> int:
                                     prefix="rwtpu_metac2_"))
             assert r1["trace"] == r2["trace"], (
                 "seeded meta-chaos replay diverged:\n"
+                f"run1: {r1['trace']}\nrun2: {r2['trace']}")
+            print(f"replay OK: "
+                  f"{sum(len(v) for v in r1['trace'].values())} "
+                  "injections reproduced identically")
+    if args.failover:
+        r1 = run_failover(seed=args.seed,
+                          data_dir=tempfile.mkdtemp(
+                              prefix="rwtpu_fo1_"))
+        print(json.dumps({k: r1[k] for k in
+                          ("scenario", "seed", "killed_at_commit",
+                           "terms", "failovers", "detect_ms",
+                           "mttr_ms", "unavail_ms", "rows", "audit")},
+                         indent=2))
+        if args.replay:
+            r2 = run_failover(seed=args.seed,
+                              data_dir=tempfile.mkdtemp(
+                                  prefix="rwtpu_fo2_"))
+            assert r1["trace"] == r2["trace"], (
+                "seeded failover replay diverged:\n"
                 f"run1: {r1['trace']}\nrun2: {r2['trace']}")
             print(f"replay OK: "
                   f"{sum(len(v) for v in r1['trace'].values())} "

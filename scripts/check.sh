@@ -275,6 +275,54 @@ python -m pytest -q -p no:cacheprovider -m slow \
 # injection trace on replay (docs/control-plane.md)
 python -m risingwave_tpu.sim --meta-chaos --seed 13 --replay
 
+echo "== leader failover (TTL lease, term-fenced election) =="
+# Fast tier (tier-1): the lease protocol on a bare MetaServer — the
+# CAS race admits exactly one same-term candidate (typed LeaseLost for
+# the loser), renew-after-supersede is refused, the client NEVER
+# retries lease.acquire/lease.renew over a broken link, the TTL
+# detector pushes exactly one leader_down per term, and seeded delay
+# on the lease.renew chaos stream slows heartbeats WITHOUT a spurious
+# failover.
+python -m pytest -q -p no:cacheprovider \
+    tests/test_failover.py -m 'not slow' \
+    "$@"
+# Slow tier (out of tier-1 per the 870s wall budget): the promotion
+# lifecycle over real Sessions (standby auto-promotes, reader keeps
+# pins across the handover, fenced ex-writer demotes to serving), the
+# rw_leader_history catalog relation, the ctl smoke, and the kill -9
+# acceptance scenario.
+python -m pytest -q -p no:cacheprovider -m slow \
+    tests/test_failover.py \
+    "$@"
+# the acceptance run itself under the chaos plane: SIGKILL the writer
+# process mid-stream → standby promotes within the TTL, exactly-once
+# audit green, identical meta-link injection trace on --replay
+# (docs/control-plane.md "Leader failover")
+python -m risingwave_tpu.sim --failover --seed 7 --replay
+# ctl smoke: who holds the lease — live over the wire, then offline
+# from the durable store (TTL remaining is server memory → "unknown")
+fo_dir=$(mktemp -d)
+python - "$fo_dir" <<'EOF'
+import os, subprocess, sys
+from risingwave_tpu.meta.server import MetaServer
+from risingwave_tpu.meta.client import MetaClient
+d = sys.argv[1]
+srv = MetaServer(data_dir=os.path.join(d, "meta"), lease_ttl_s=30.0)
+addr = srv.start()
+c = MetaClient(addr, session_id="check-sh-writer")
+c.acquire_leader(1)
+out = subprocess.run(
+    [sys.executable, "-m", "risingwave_tpu", "ctl", "meta", "leader",
+     "--meta-addr", addr], capture_output=True, text=True, timeout=120)
+assert out.returncode == 0, out.stderr
+assert "check-sh-writer" in out.stdout, out.stdout
+sys.stdout.write(out.stdout)
+c.close()
+srv.stop()
+EOF
+python -m risingwave_tpu ctl meta leader --data-dir "$fo_dir"
+rm -rf "$fo_dir"
+
 echo "== rwlint (AST invariant checker, docs/static-analysis.md) =="
 # One AST-grounded pass replaces the five historical grep lints
 # (exchange-boundary, wire-boundary, placement-mutation,

@@ -334,7 +334,8 @@ class TestMultiTenantFleet:
 
             # ... and the ex-writer's own barrier path locks out (the
             # lease-loss notification or a refused publish, whichever
-            # lands first)
+            # lands first) — then the session DEMOTES itself to a
+            # working serving session instead of staying wedged
             def fenced():
                 try:
                     w1.tick()
@@ -342,8 +343,11 @@ class TestMultiTenantFleet:
                 except MetaFenced:
                     return True
             assert _poll(fenced)
-            with pytest.raises(MetaFenced):
+            assert w1.role == "serving"
+            with pytest.raises(RuntimeError, match="serving sessions"):
                 w1.tick()
+            # the demoted session still answers reads
+            assert sorted(w1.run_sql("SELECT k, v FROM t1")) == [(1, 1)]
 
             # the new writer owns conduction and keeps working
             w2.run_sql("INSERT INTO t1 VALUES (2, 2)")

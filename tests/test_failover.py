@@ -1,0 +1,411 @@
+"""Leader failover (ISSUE 18): TTL leases, term-fenced election,
+standby promotion.
+
+Fast tier: the lease protocol itself — the CAS race admits exactly one
+candidate (typed LeaseLost for the loser, never a retryable conflict),
+renewals under a superseded lease are refused, the client NEVER retries
+lease.acquire/lease.renew over a broken link (a replayed acquire after a
+competitor won would be a split brain), the server's TTL detector pushes
+exactly ONE leader_down per term, and a slow meta link delays heartbeats
+without ever expiring a live holder's lease.
+
+Slow tier: the full promotion lifecycle over real Sessions (writer dies
+→ standby promotes in place, pinned readers keep their SSTs, the fenced
+ex-writer demotes to serving) and the kill -9 acceptance scenario
+(sim.run_failover).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.meta.client import (
+    LeaseLost, MetaClient, MetaUnavailable,
+)
+from risingwave_tpu.meta.server import MetaServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _server(tmp_path, ttl: float = 30.0):
+    srv = MetaServer(data_dir=str(tmp_path / "meta"), lease_ttl_s=ttl)
+    return srv, srv.start()
+
+
+def _poll(fn, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"poll timed out after {timeout}s: {fn}")
+
+
+class TestLeaseProtocol:
+    def test_cas_race_admits_exactly_one(self, tmp_path):
+        """Satellite (split-brain regression): two sessions race
+        lease.acquire at the SAME term; the store CAS admits exactly
+        one, and the loser gets the typed LeaseLost — not a retryable
+        txn_conflict an eager client might replay into a split brain."""
+        srv, addr = _server(tmp_path)
+        a = MetaClient(addr, session_id="cand-a")
+        b = MetaClient(addr, session_id="cand-b")
+        try:
+            results = {}
+            gate = threading.Barrier(2)
+
+            def race(name, client):
+                gate.wait()
+                try:
+                    client.acquire_leader(1, reason="election")
+                    results[name] = "won"
+                except LeaseLost:
+                    results[name] = "lost"
+                except Exception as e:  # noqa: BLE001 - typed-loss audit
+                    results[name] = f"WRONG:{type(e).__name__}"
+
+            ts = [threading.Thread(target=race, args=(n, c))
+                  for n, c in (("a", a), ("b", b))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=10)
+            assert sorted(results.values()) == ["lost", "won"], results
+            # the loser's term stays unset: it remains a clean
+            # serving/standby session, not a half-writer
+            winner, loser = ((a, b) if results["a"] == "won"
+                             else (b, a))
+            assert winner.generation == 1 and loser.generation is None
+            info = winner.lease_info()
+            assert info["holder"] == winner.session_id
+            # an "election"-reason acquire counts as a failover
+            assert info["term"] == 1 and info["failovers"] == 1
+        finally:
+            a.close()
+            b.close()
+            srv.stop()
+
+    def test_renew_under_superseded_lease_is_lease_lost(self, tmp_path):
+        srv, addr = _server(tmp_path)
+        old = MetaClient(addr, session_id="old-writer")
+        new = MetaClient(addr, session_id="new-writer")
+        try:
+            old.acquire_leader(1)
+            # a strictly newer term is admitted even over a LIVE holder
+            # (the takeover attach path); the old writer's next renewal
+            # must come back as the typed loss, stopping its heartbeat
+            new.acquire_leader(2)
+            with pytest.raises(LeaseLost):
+                old.renew_leader()
+            # re-asserting the stale term is refused the same way
+            with pytest.raises(LeaseLost):
+                old.acquire_leader(1)
+        finally:
+            old.close()
+            new.close()
+            srv.stop()
+
+    def test_stale_or_equal_term_refused_both_live_and_expired(
+            self, tmp_path):
+        srv, addr = _server(tmp_path, ttl=0.3)
+        w = MetaClient(addr, session_id="w")
+        c = MetaClient(addr, session_id="challenger")
+        try:
+            w.acquire_leader(1)
+            with pytest.raises(LeaseLost, match="live"):
+                c.acquire_leader(1)
+            _poll(lambda: w.lease_info().get("expired"))
+            # expiry alone never transfers the lease at the SAME term:
+            # candidates must go through leader_down's term + 1
+            with pytest.raises(LeaseLost, match="expired"):
+                c.acquire_leader(1)
+            assert c.acquire_leader(2, reason="election") == 2
+        finally:
+            w.close()
+            c.close()
+            srv.stop()
+
+    def test_lease_methods_never_retried(self, tmp_path, monkeypatch):
+        """Satellite (retry audit): a broken connection during
+        store.put is reconnected and replayed (idempotent), but
+        lease.acquire/lease.renew are NEVER retried — the reply may
+        have been lost AFTER a competitor won, and a replay would
+        acquire a lease the client must not hold."""
+        srv, addr = _server(tmp_path)
+        c = MetaClient(addr, session_id="audit")
+        attempts = []
+        orig = MetaClient._request
+
+        def flaky(self, method, params=None):
+            attempts.append(method)
+            if method in ("store.put", "lease.acquire", "lease.renew") \
+                    and attempts.count(method) == 1:
+                self._drop_conn()
+                raise ConnectionError("injected link break")
+            return orig(self, method, params)
+
+        monkeypatch.setattr(MetaClient, "_request", flaky)
+        try:
+            c.call("store.put", {"key": "k", "value": "v"})
+            assert attempts.count("store.put") == 2      # retried
+            assert c.call("store.get", {"key": "k"}) == "v"
+            with pytest.raises(MetaUnavailable, match="not retried"):
+                c.acquire_leader(1)
+            assert attempts.count("lease.acquire") == 1  # NOT retried
+            assert c.generation is None
+            # the server never saw the acquire: a clean client takes it
+            c.acquire_leader(1)
+            c.generation = 1
+            with pytest.raises(MetaUnavailable, match="not retried"):
+                c.renew_leader()
+            assert attempts.count("lease.renew") == 1    # NOT retried
+        finally:
+            c.close()
+            srv.stop()
+
+    def test_expiry_pushes_exactly_one_leader_down(self, tmp_path):
+        srv, addr = _server(tmp_path, ttl=0.3)
+        w = MetaClient(addr, session_id="w")
+        obs = MetaClient(addr, session_id="obs")
+        downs = []
+        obs.notifications.subscribe(
+            "leader_down", lambda _v, info: downs.append(info))
+        try:
+            w.acquire_leader(1)          # no heartbeat: left to expire
+            _poll(lambda: downs)
+            time.sleep(0.8)              # detector keeps polling...
+            assert len(downs) == 1, downs    # ...but pushes ONCE per term
+            assert downs[0]["term"] == 1
+            s = MetaClient(addr, session_id="standby")
+            try:
+                assert s.acquire_leader(
+                    downs[0]["term"] + 1, reason="election") == 2
+                info = s.lease_info()
+                assert info["failovers"] == 1
+                assert info["reason"] == "election"
+                assert [h["term"] for h in info["history"]] == [1, 2]
+                assert info["history"][-1]["leaderless_s"] >= 0
+            finally:
+                s.close()
+        finally:
+            w.close()
+            obs.close()
+            srv.stop()
+
+    def test_heartbeat_keeps_lease_alive_and_stops_on_loss(
+            self, tmp_path):
+        srv, addr = _server(tmp_path, ttl=0.4)
+        w = MetaClient(addr, session_id="w")
+        usurper = MetaClient(addr, session_id="usurper")
+        lost = []
+        try:
+            w.acquire_leader(1)
+            w.start_heartbeat(0.1, on_lost=lost.append)
+            time.sleep(1.2)              # several TTLs: renewals hold it
+            info = w.lease_info()
+            assert info["term"] == 1 and not info["expired"]
+            assert w.stats["heartbeats"] >= 3
+            usurper.acquire_leader(2)
+            _poll(lambda: lost)          # one typed loss, loop stopped
+            assert isinstance(lost[0], LeaseLost)
+            assert w.stats["lease_lost"] == 1
+            hb = w.stats["heartbeats"]
+            time.sleep(0.4)
+            assert w.stats["heartbeats"] == hb   # loop really stopped
+        finally:
+            w.close()
+            usurper.close()
+            srv.stop()
+
+    def test_slow_meta_link_never_expires_a_live_lease(self, tmp_path):
+        """Satellite: seeded delay on every lease.renew frame (the
+        meta#clease chaos stream) slows heartbeats down but must NEVER
+        cause a spurious failover — the TTL outlives any delay the
+        chaos plane injects."""
+        from risingwave_tpu.meta.client import META_LINK
+        from risingwave_tpu.rpc.faults import (
+            ChaosRule, ChaosSchedule, install,
+        )
+        srv, addr = _server(tmp_path, ttl=0.6)
+        install(ChaosSchedule(3, [
+            ChaosRule(kind="delay", link=META_LINK,
+                      types=["lease.renew"], prob=1.0, delay_ms=50.0),
+        ], name="slow_renew"))
+        w = MetaClient(addr, session_id="w")
+        try:
+            w.acquire_leader(1)
+            w.start_heartbeat(0.1)
+            time.sleep(1.5)
+            info = w.lease_info()
+            assert info["term"] == 1 and not info["expired"], info
+            assert info["failovers"] == 0
+            assert w.stats["heartbeats"] >= 3
+        finally:
+            install(None)
+            w.close()
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestCtlMetaLeader:
+    def test_ctl_meta_leader_live_and_offline(self, tmp_path):
+        """Satellite: `ctl meta leader` answers from a live server
+        (holder/term/TTL remaining) and offline from the store dir
+        (TTL unknown — the deadline is server memory). Slow tier: two
+        subprocess interpreter spins; check.sh also smokes it."""
+        srv, addr = _server(tmp_path)
+        w = MetaClient(addr, session_id="ctl-test-writer")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        try:
+            w.acquire_leader(1)
+            live = subprocess.run(
+                [sys.executable, "-m", "risingwave_tpu", "ctl", "meta",
+                 "leader", "--meta-addr", addr, "--json"],
+                capture_output=True, text=True, env=env, timeout=120)
+            assert live.returncode == 0, live.stderr
+            info = json.loads(live.stdout)
+            assert info["holder"] == "ctl-test-writer"
+            assert info["term"] == 1
+            assert info["ttl_remaining_s"] is not None
+        finally:
+            w.close()
+            srv.stop()
+        off = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "ctl", "meta",
+             "leader", "--data-dir", str(tmp_path)],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert off.returncode == 0, off.stderr
+        assert "ctl-test-writer" in off.stdout
+        assert "unknown (offline)" in off.stdout
+
+
+DDL = "CREATE TABLE t1 (k BIGINT PRIMARY KEY, v BIGINT)"
+MV = ("CREATE MATERIALIZED VIEW m1 AS SELECT v, count(*) AS n "
+      "FROM t1 GROUP BY v")
+
+
+@pytest.mark.slow
+class TestPromotionLifecycle:
+    def test_standby_promotes_reader_keeps_pins_ex_writer_demotes(
+            self, tmp_path):
+        """The tentpole end to end over real Sessions: the writer stops
+        renewing (a partitioned heartbeat), the server declares it down,
+        the standby auto-promotes in place and resumes conduction under
+        term 2; a serving reader holding pinned SSTs keeps reading
+        correct rows across the handover (the post-promotion vacuum
+        grace window); the fenced ex-writer demotes to serving on its
+        first refused publish instead of crashing."""
+        from risingwave_tpu.frontend.session import Session
+
+        d = str(tmp_path / "cluster")
+        srv = MetaServer(data_dir=os.path.join(d, "meta"),
+                         lease_ttl_s=1.0)
+        addr = srv.start()
+        w = Session(data_dir=d, meta_addr=addr, state_store="hummock",
+                    checkpoint_frequency=2)
+        standby = reader = None
+        try:
+            w.run_sql(DDL)
+            w.run_sql(MV)
+            for i in range(4):
+                w.run_sql(f"INSERT INTO t1 VALUES ({i}, {i % 2})")
+                w.tick()
+            w.flush()
+            standby = Session(data_dir=d, meta_addr=addr,
+                              role="standby", checkpoint_frequency=2)
+            reader = Session(data_dir=d, meta_addr=addr, role="serving")
+            before = sorted(reader.run_sql("SELECT v, n FROM m1"))
+            assert before == [(0, 2), (1, 2)]
+            assert standby.role == "serving" and standby._standby
+
+            # the writer's heartbeat dies (partition/SIGSTOP stand-in);
+            # the TTL detector fires and the standby wins the election
+            w.meta.stop_heartbeat()
+            _poll(lambda: standby._leadership["promotions"] == 1,
+                  timeout=30)
+            assert standby.role == "writer"
+            assert standby._generation == 2
+
+            # pin safety: the reader keeps its pinned snapshot across
+            # the handover — correct rows, no missing-SST error, even
+            # after the promoted writer commits + compacts + vacuums
+            for j in range(4):
+                standby.run_sql(f"INSERT INTO t1 VALUES ({10 + j}, 7)")
+                standby.tick()
+            standby.flush()
+            assert sorted(reader.run_sql("SELECT v, n FROM m1")) \
+                == [(0, 2), (1, 2), (7, 4)]
+
+            # the fenced ex-writer: first conduction attempt under the
+            # lost lease raises MetaFenced, then it DEMOTES to serving
+            # (no crash, no second conductor), still answering reads
+            w.run_sql("INSERT INTO t1 VALUES (99, 99)")
+            with pytest.raises(Exception, match="superseded|fenced"):
+                for _ in range(3):
+                    w.tick()
+            assert _poll(lambda: w.role == "serving", timeout=15)
+            assert w._fenced is False
+            got = sorted(w.run_sql("SELECT v, n FROM m1"))
+            assert got == [(0, 2), (1, 2), (7, 4)]
+            # the discarded in-flight insert (99) left no trace — the
+            # exactly-once "fully discarded" half
+            assert (99, 99) not in standby.run_sql("SELECT k, v FROM t1")
+            m = standby.metrics()["leadership"]
+            assert m["role"] == "writer" and m["term"] == 2
+            assert m["is_writer"] == 1 and m["promotions"] == 1
+            assert w.metrics()["leadership"]["demotions"] == 1
+        finally:
+            for s in (reader, standby):
+                if s is not None:
+                    s.close()
+            w.close()
+            srv.stop()
+
+    def test_rw_leader_history_catalog_relation(self, tmp_path):
+        from risingwave_tpu.frontend.session import Session
+
+        d = str(tmp_path / "cluster")
+        srv = MetaServer(data_dir=os.path.join(d, "meta"),
+                         lease_ttl_s=30.0)
+        addr = srv.start()
+        w = Session(data_dir=d, meta_addr=addr, state_store="hummock",
+                    checkpoint_frequency=2)
+        try:
+            w.run_sql(DDL)
+            rows = w.run_sql(
+                "SELECT term, holder, reason, current "
+                "FROM rw_catalog.rw_leader_history")
+            assert len(rows) == 1
+            term, holder, reason, current = rows[0]
+            assert term == 1 and holder == w.meta.session_id
+            assert reason == "bootstrap" and current
+        finally:
+            w.close()
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestKillDashNineFailover:
+    def test_run_failover_kill9_acceptance(self):
+        """The acceptance scenario (docs/control-plane.md): SIGKILL the
+        writer PROCESS mid-stream under seeded chaos → a standby
+        auto-promotes with no operator action, the split-brain probe
+        stays green, and the committed rows replayed into a fresh
+        control rebuild the identical MV (exactly-once)."""
+        from risingwave_tpu.sim import run_failover
+
+        r = run_failover(seed=11)
+        assert r["failovers"] == 1
+        assert r["terms"] == [1, 2]
+        assert all(r["audit"].values()), r["audit"]
+        assert r["elections_lost"] == 1
+        assert r["mttr_ms"] < (r["lease_ttl_s"] + 30) * 1000
+        assert r["trace"], "no deterministic injections recorded"

@@ -133,8 +133,8 @@ def test_exposition_lint_every_family_has_help_and_type(tmp_path):
             # samples without a declaration are caught in the parser
         # the sections this cluster shape must light up (PR 1 core, PR 2
         # storage, PR 8 serving, PR 9 chaos, PR 10 autoscaler, PR 12
-        # profiling, PR 16 barrier observatory) — a renamed family
-        # fails here loudly
+        # profiling, PR 16 barrier observatory, PR 18 leadership) — a
+        # renamed family fails here loudly
         for expected in ("rw_epoch", "rw_executor_counter",
                          "rw_state_bytes", "rw_worker_up",
                          "rw_storage_stat", "rw_serving_stat",
@@ -144,7 +144,10 @@ def test_exposition_lint_every_family_has_help_and_type(tmp_path):
                          "rw_compile_total", "rw_hbm_bytes",
                          "rw_hbm_headroom_bytes",
                          "rw_barrier_stage_seconds",
-                         "rw_barrier_inflight", "rw_barrier_total"):
+                         "rw_barrier_inflight", "rw_barrier_total",
+                         "rw_leader_term", "rw_leader_is_writer",
+                         "rw_failover_total",
+                         "rw_failover_duration_seconds"):
             assert expected in families, \
                 f"{expected} missing from exposition: {sorted(families)}"
     finally:
