@@ -31,6 +31,7 @@ PKG = "risingwave_tpu"
 REGISTRIES = (
     ("ops/fused_epoch.py", "EPOCH_BUILDERS"),
     ("ops/fused_sharded.py", "SHARDED_EPOCH_BUILDERS"),
+    ("ops/fused_hetero.py", "HETERO_EPOCH_BUILDERS"),
 )
 
 #: builders outside the registries that still own a one-dispatch

@@ -531,10 +531,52 @@ def _roofline_surfaces() -> dict:
         nos = jnp.zeros(jobs, jnp.int64)
         return fn, (stacked, starts, keys, nos, k)
 
+    def t_hetero_padded():
+        import numpy as np
+        from .ops.fused_hetero import HETERO_EPOCH_BUILDERS
+        from .stream.tick_compiler import skeletonize_exprs
+        exprs, core = q5_parts()
+        skel, hole_types, params = skeletonize_exprs(tuple(exprs), 7)
+        fn = HETERO_EPOCH_BUILDERS["padded_agg"](
+            gen.chunk_fn(), skel, core, cap)
+        stacked = stack_states([core.init_state() for _ in range(jobs)])
+        starts = jnp.zeros(jobs, jnp.int64)
+        keys = jnp.stack([jax.random.PRNGKey(j) for j in range(jobs)])
+        nos = jnp.zeros(jobs, jnp.int64)
+        ps = tuple(jnp.asarray(np.full(jobs, params[h], t.np_dtype))
+                   for h, t in enumerate(hole_types))
+        return fn, (stacked, starts, keys, nos, ps, k)
+
+    def t_hetero_mega():
+        from .expr.agg import agg
+        from .ops.fused_hetero import HETERO_EPOCH_BUILDERS
+        from .stream.coschedule import FusedJobSpec
+
+        def spec_of(exprs, core):
+            return FusedJobSpec(
+                kind="agg", signature=("roofline",),
+                chunk_fn=gen.chunk_fn(), exprs=tuple(exprs),
+                core=core, rows_per_chunk=cap, seed=0)
+
+        exprs1, core1 = q5_parts()
+        exprs2 = [col(0, INT64), col(2, INT64)]
+        core2 = AggCore((INT64,), (0,),
+                        [count_star(), agg("sum", 1, INT64)],
+                        table_capacity=1 << 14, out_capacity=cap)
+        fn = HETERO_EPOCH_BUILDERS["mega_agg"](
+            [spec_of(exprs1, core1), spec_of(exprs2, core2)])
+        states = (core1.init_state(), core2.init_state())
+        starts = jnp.zeros(2, jnp.int64)
+        keys = jnp.stack([jax.random.PRNGKey(j) for j in range(2)])
+        nos = jnp.zeros(2, jnp.int64)
+        return fn, (states, starts, keys, nos, k)
+
     return {
         "source_agg": t_q5, "source_join": t_q7,
         "source_session": t_q8, "source_q3": t_q3,
         "multi_agg": t_multi,
+        "hetero:padded_agg": t_hetero_padded,
+        "hetero:mega_agg": t_hetero_mega,
         "sharded:source_agg": t_sharded_q5,
         "sharded:source_join": t_sharded_q7,
         "sharded:source_session": t_sharded_q8,

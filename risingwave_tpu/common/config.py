@@ -48,6 +48,14 @@ class StreamingConfig:
     # group instead of one executor pipeline each; ineligible shapes
     # fall back to the solo executor path (docs/performance.md)
     coschedule: bool = False
+    # the heterogeneous tick compiler (stream/tick_compiler.py):
+    # eligible MVs created while this is true join a compiled dispatch
+    # schedule — jobs sharing an operator skeleton pad into shape-class
+    # supergroups (one vmapped dispatch per class), the rest
+    # concatenate into jitted mega-epochs — so N dissimilar small MVs
+    # tick in a handful of dispatches instead of N. Recompiled only on
+    # DDL; takes precedence over ``coschedule`` for eligible shapes.
+    tick_compiler: bool = False
     # device mesh for the mesh-sharded paths (parallel/): N >= 1 builds a
     # 1-D mesh over the first N local devices (BuildConfig.mesh) so
     # grouped aggs/joins shard across chips — and, with ``coschedule``

@@ -370,6 +370,28 @@ def aot_analysis(jitted: Callable, *args, **kwargs) -> dict:
     return {"cost": cost, "memory": mem}
 
 
+def per_job_attribution(total_seconds: float, weights: dict) -> dict:
+    """Split one fused dispatch qualname's measured wall seconds over
+    its member jobs (the tick compiler's padded supergroups and
+    mega-epochs run MANY jobs inside one dispatch record, so per-job
+    cost must be attributed, not measured).
+
+    ``weights``: {job: weight} — the per-job work proxy carried in the
+    extended [J, 3] packed-stats layout (cumulative flushed-group
+    counts, packed slot 0). Jobs with zero observed weight across the
+    board fall back to an equal split; the result is an ESTIMATE
+    (proportional model), not a per-job measurement."""
+    jobs = list(weights)
+    if not jobs:
+        return {}
+    total_w = float(sum(weights.values()))
+    if total_w <= 0:
+        share = float(total_seconds) / len(jobs)
+        return {j: round(share, 9) for j in jobs}
+    return {j: round(float(total_seconds) * float(w) / total_w, 9)
+            for j, w in weights.items()}
+
+
 # ---------------------------------------------------------------------------
 # HBM ledger
 # ---------------------------------------------------------------------------

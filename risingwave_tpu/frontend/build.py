@@ -64,6 +64,12 @@ class BuildConfig:
     # Opt-in ([streaming] coschedule = true); ineligible shapes build
     # the normal executor pipeline.
     coschedule: bool = False
+    # The heterogeneous tick compiler (stream/tick_compiler.py):
+    # eligible MVs join a compiled dispatch schedule — shape-class
+    # padded supergroups plus jitted mega-epochs — so DISSIMILAR small
+    # MVs fuse too. Opt-in ([streaming] tick_compiler = true); wins
+    # over ``coschedule`` for eligible shapes.
+    tick_compiler: bool = False
     # HBM pressure: cap on live groups per grouped-agg executor; coldest
     # groups evict to the state table at checkpoints and fault back in on
     # access (reference: cache/managed_lru.rs). None = grow-or-raise.

@@ -323,6 +323,45 @@ def render_metrics(session) -> str:
                   "completions", "max_inflight"):
             lines.append(f'rw_pipeline_stat{{stat="{k}"}} '
                          f'{pipe.get(k, 0)}')
+    het = m.get("hetero") or {}
+    if het.get("jobs"):
+        lines += ["# HELP rw_hetero_jobs MVs registered with the "
+                  "heterogeneous tick compiler "
+                  "(stream/tick_compiler.py).",
+                  "# TYPE rw_hetero_jobs gauge",
+                  f"rw_hetero_jobs {het.get('jobs', 0)}",
+                  "# HELP rw_hetero_dispatches_per_tick Compiled "
+                  "schedule size: epoch dispatches issued per tick "
+                  "(shape-class supergroups + mega-epochs).",
+                  "# TYPE rw_hetero_dispatches_per_tick gauge",
+                  f"rw_hetero_dispatches_per_tick "
+                  f"{het.get('dispatches_per_tick', 0)}",
+                  "# HELP rw_hetero_schedule_compiles Schedule "
+                  "recompilations (DDL-driven re-bucketing) since "
+                  "session start.",
+                  "# TYPE rw_hetero_schedule_compiles counter",
+                  f"rw_hetero_schedule_compiles "
+                  f"{het.get('schedule_compiles', 0)}",
+                  "# HELP rw_hetero_group_jobs Member MVs per compiled "
+                  "dispatch group.",
+                  "# TYPE rw_hetero_group_jobs gauge"]
+        for i, g in enumerate(het.get("groups") or []):
+            lines.append(
+                f'rw_hetero_group_jobs{{group="{i}",'
+                f'kind="{_sanitize(g.get("kind", ""))}"}} '
+                f'{len(g.get("jobs") or [])}')
+        attr = het.get("attribution") or {}
+        if any(attr.values()):
+            lines += ["# HELP rw_hetero_flush_weight Per-job cost "
+                      "attribution weight (cumulative dirty groups "
+                      "flushed) within each fused dispatch.",
+                      "# TYPE rw_hetero_flush_weight counter"]
+            for qn, jobs in sorted(attr.items()):
+                for job, w in sorted(jobs.items()):
+                    lines.append(
+                        f'rw_hetero_flush_weight'
+                        f'{{qualname="{_sanitize(qn)}",'
+                        f'job="{_sanitize(job)}"}} {w}')
     retry = m.get("retry") or {}
     if retry:
         lines += ["# HELP rw_retry_total Per-site boundary retry "

@@ -315,6 +315,7 @@ class Session:
                 topn_table_capacity=st.topn_table_capacity,
                 fragment_parallelism=st.fragment_parallelism,
                 coschedule=st.coschedule,
+                tick_compiler=st.tick_compiler,
                 mesh=mesh)
         # fault-tolerance knobs for every external boundary (object-store
         # retry, sink degrade, broker reconnect, worker deadlines) —
@@ -557,6 +558,16 @@ class Session:
         self._shardfused = None        # lazy ShardedCoScheduler
         self._shardfused_engines: dict[str, tuple] = {}
         self._shardfused_markers: set[str] = set()
+        # the heterogeneous tick compiler (stream/tick_compiler.py;
+        # [streaming] tick_compiler = true): eligible MVs — even
+        # DISSIMILAR ones — join a compiled dispatch schedule
+        # (shape-class padded supergroups + jitted mega-epochs),
+        # recompiled lazily on DDL. Engines map job -> (flush
+        # HashAggExecutor, output queue, device source cursor).
+        from ..stream.tick_compiler import TickCompiler
+        self._hetero = TickCompiler()
+        self._hetero_engines: dict[str, tuple] = {}
+        self._hetero_markers: set[str] = set()
         # epochs run by fused engines this session has since dropped,
         # per dispatch qualname — the profiler's counts are cumulative,
         # so the live per_epoch invariant ratio must keep dividing by
@@ -700,9 +711,17 @@ class Session:
                 self._shardfused_markers.add(
                     line[len("-- shardfused"):].strip())
                 continue
+            if line.startswith("-- hetero"):
+                # tick-compiled MV (stream/tick_compiler.py): replay
+                # routes back into the compiled schedule or refuses
+                # loudly — marker-directed in both directions, same as
+                # the coschedule marker above
+                self._hetero_markers.add(line[len("-- hetero"):].strip())
+                continue
             if not line.startswith("-- reschedule"):
                 if (resched_cfg or self._cosched_markers
-                        or self._shardfused_markers) \
+                        or self._shardfused_markers
+                        or self._hetero_markers) \
                         and "drop" in line.lower():
                     try:
                         for stmt in parse_sql(piece):
@@ -710,6 +729,7 @@ class Session:
                                 resched_cfg.pop(stmt.name, None)
                                 self._cosched_markers.discard(stmt.name)
                                 self._shardfused_markers.discard(stmt.name)
+                                self._hetero_markers.discard(stmt.name)
                     except Exception:  # noqa: BLE001 - replay parses below
                         pass
                 continue
@@ -757,7 +777,8 @@ class Session:
         try:
             for piece in ddl:
                 if piece.strip().startswith(("-- reschedule",
-                                             "-- coschedule")):
+                                             "-- coschedule",
+                                             "-- hetero")):
                     continue
                 for stmt in parse_sql(piece):
                     name = getattr(stmt, "name", None)
@@ -1138,6 +1159,10 @@ class Session:
         self._shardfused = None
         self._shardfused_engines.clear()
         self._shardfused_markers.clear()
+        from ..stream.tick_compiler import TickCompiler
+        self._hetero = TickCompiler()
+        self._hetero_engines.clear()
+        self._hetero_markers.clear()
         self._dead_jobs.clear()
         self._jobs_to_recover.clear()
         # discard staged-but-uncommitted state: fully discarded is the
@@ -1575,6 +1600,29 @@ class Session:
                 "the session with a device mesh ([streaming] mesh_shape / "
                 "BuildConfig.mesh) and [streaming] coschedule = true — or "
                 "DROP and re-CREATE it")
+        if not pk_prefix \
+                and getattr(self.config, "tick_compiler", False) \
+                and self.config.mesh is None \
+                and self.config.fragment_parallelism <= 1 \
+                and self.config.agg_hbm_budget is None \
+                and (not self._recovering
+                     or stmt.name in self._hetero_markers):
+            # the heterogeneous tick compiler (stream/tick_compiler.py):
+            # an eligible MV joins the compiled dispatch schedule even
+            # when no signature-equal sibling exists — shape-class
+            # padding / mega-epoch concatenation replace the exact-
+            # signature grouping rule. Wins over ``coschedule`` when
+            # both are set; ineligible shapes fall through. Recovery is
+            # marker-directed in both directions, like coschedule.
+            res, cosched_plan = self._try_hetero_mv(stmt)
+            if res is not None:
+                return res
+        if self._recovering and stmt.name in self._hetero_markers:
+            raise SqlError(
+                f"MV {stmt.name!r} was created tick-compiled; reopen the "
+                "session with [streaming] tick_compiler = true and a "
+                "compatible config (no mesh, fragment_parallelism 1, "
+                "no agg_hbm_budget) — or DROP and re-CREATE it")
         if not pk_prefix and getattr(self.config, "coschedule", False) \
                 and self.config.mesh is None \
                 and self.config.fragment_parallelism <= 1 \
@@ -1826,6 +1874,177 @@ class Session:
                     ckpt_states.append(agg.state)
                 group.set_states(ckpt_states)
 
+    # ------------------------------------------ tick-compiled fused MV jobs --
+
+    def _try_hetero_mv(self, stmt: A.CreateMaterializedView):
+        """Route an eligible source+agg plan into the tick compiler
+        (stream/tick_compiler.py): UNEQUAL jobs are fused into minimal
+        dispatches — shape-class supergroups (padded + vmapped) plus
+        jitted mega-epochs for the singletons. Returns ``(result,
+        plan)``; result is None when the shape is ineligible (the solo
+        executor fallback, which reuses ``plan``)."""
+        from ..stream.coschedule import match_coschedulable
+        if not any(sd.connector == "nexmark"
+                   for sd in self.catalog.sources.values()):
+            return None, None
+        plan = self._plan(stmt.query, lenient=self._recovering)
+        m = match_coschedulable(plan)
+        if m is None:
+            return None, plan
+        return self._create_mv_hetero(stmt, plan, m), plan
+
+    def _create_mv_hetero(self, stmt: A.CreateMaterializedView,
+                          plan, m) -> list:
+        """Build one tick-compiled fused MV job. Mirrors
+        ``_create_mv_coscheduled`` — a real HashAggExecutor (never
+        executed) remains the flush/persistence engine so state-table
+        checkpointing and recovery load are the executor path's own
+        code — but registration goes to the TickCompiler, which
+        skeletonizes the plan and re-buckets the whole job set into
+        shape-class supergroups + mega-epochs on the next tick."""
+        from ..common.types import INT64, VARCHAR
+        from ..connector import NexmarkConfig
+        from ..connector.nexmark import DeviceBidGenerator
+        from ..stream.coschedule import (
+            DeviceSourceCursor, FusedJobSpec, agg_signature,
+            declared_chunk_fn,
+        )
+        from ..stream.hash_agg import HashAggExecutor, agg_state_schema
+        from ..stream.project import ProjectExecutor
+        from ..stream.source import MockSource
+
+        # registration dissolves every group (schedule recompile):
+        # resolve any deferred flush first (pipeline_depth >= 2)
+        self._drain_fused_pipeline()
+        id0 = self.catalog._next_table_id
+        proj = ProjectExecutor(MockSource(m.source.schema, []),
+                               list(m.exprs), names=m.proj_names)
+        key_fields = [proj.schema[i] for i in m.group_keys]
+        st = StateTable(self.store, self.catalog.next_table_id(),
+                        agg_state_schema(key_fields, m.agg_calls),
+                        list(range(len(m.group_keys))))
+        agg = HashAggExecutor(
+            proj, list(m.group_keys), list(m.agg_calls), state_table=st,
+            table_capacity=self.config.agg_table_capacity,
+            out_capacity=self.config.chunk_capacity)
+        split_st = StateTable(
+            self.store, self.catalog.next_table_id(),
+            Schema((Field("split_id", VARCHAR),
+                    Field("next_offset", INT64))), [0])
+        cursor = DeviceSourceCursor()
+        if self._recovering:
+            offsets = {VARCHAR.to_python(r[0]): int(r[1])
+                       for r in split_st.scan_all()}
+            if offsets:
+                cursor.seek(offsets)
+        mv_table_id = self.catalog.next_table_id()
+        q = QueueSource(plan.schema)
+        mat = MaterializeExecutor(
+            q, StateTable(self.store, mv_table_id, plan.schema,
+                          list(plan.pk)))
+        rate = (m.source.options or {}).get("rows_per_chunk")
+        rows_per_chunk = int(rate) if rate else self.source_chunk_capacity
+        src_cfg = NexmarkConfig(chunk_capacity=rows_per_chunk)
+        gen = DeviceBidGenerator(src_cfg, seed=self.seed)
+        source_sig = ("nexmark_bid", src_cfg.chunk_capacity,
+                      src_cfg.events_per_second, src_cfg.active_people,
+                      src_cfg.in_flight_auctions, src_cfg.start_time_us,
+                      m.col_map,
+                      tuple(sorted((m.source.options or {}).items())))
+        spec = FusedJobSpec(
+            kind="agg",
+            signature=agg_signature(agg.core, m.exprs, rows_per_chunk,
+                                    source_sig),
+            chunk_fn=declared_chunk_fn(gen.chunk_fn(), m.col_map),
+            exprs=tuple(m.exprs), core=agg.core,
+            rows_per_chunk=rows_per_chunk, seed=self.seed)
+
+        mv = MaterializedViewDef(stmt.name, plan.schema, tuple(plan.pk),
+                                 table_id=mv_table_id, definition="")
+        mv.n_visible = sum(  # type: ignore[attr-defined]
+            1 for f in plan.schema if not f.name.startswith("_"))
+        mv.state_table_ids = (st.table_id,)  # type: ignore[attr-defined]
+        mv.query_ast = stmt.query  # type: ignore[attr-defined]
+        mv.table_id_range = (  # type: ignore[attr-defined]
+            id0, self.catalog._next_table_id)
+        self.catalog_writer.add_mv(mv)
+        job = StreamJob(stmt.name, mat, [q])
+        self.jobs[stmt.name] = job
+        job.start(self.loop)
+        self.feeds.append(_SourceFeed(q, lambda: None, reader=cursor,
+                                      state_table=split_st,
+                                      job=stmt.name))
+        self._hetero.add(stmt.name, spec, agg.state,
+                         n_source_cols=len(m.col_map),
+                         start=cursor.events, batch_no=cursor.epochs)
+        self._fold_hetero_retired()
+        self._hetero_engines[stmt.name] = (agg, q, cursor)
+        if self.data_dir is not None and not self._recovering:
+            self.store.log.log_ddl(  # type: ignore[attr-defined]
+                f"-- hetero {stmt.name}")
+        self._pending_mutation = Mutation(MutationKind.ADD, stmt.name)
+        q.push(Barrier.new(self.epoch))
+        self._await(job.wait_barrier(self.epoch))
+        return []
+
+    def _fold_hetero_retired(self) -> None:
+        """Fold dissolved groups' epochs-run into the retirement ledger
+        so the dispatch/epoch invariant (``per_epoch == 1.0``) survives
+        schedule recompilation: the counts a dead group accumulated
+        still back the dispatches it issued."""
+        for qn, n in self._hetero.take_retired().items():
+            self._dispatch_epochs_retired[qn] = (
+                self._dispatch_epochs_retired.get(qn, 0) + n)
+
+    def _push_hetero_outs(self, outs: dict) -> None:
+        for name, chunks in outs.items():
+            q = self._hetero_engines[name][1]
+            for ch in chunks:
+                q.push(ch)
+
+    def _hetero_tick(self, epoch: int, checkpoint: bool,
+                     generate: bool) -> None:
+        """Per-tick driver for the tick compiler: one dispatch per
+        compiled group (shape-class supergroup or mega-epoch) covers
+        every member MV's epoch. Mirrors ``_cosched_tick`` — pipelined
+        cadence, deferred flush at ``pipeline_depth >= 2``, checkpoint
+        write-back through each job's own HashAggExecutor — but the
+        schedule is (re)compiled lazily here, only when DDL has marked
+        it dirty since the last tick."""
+        self._hetero.ensure_compiled()
+        k = self.chunks_per_tick
+        groups = list(self._hetero.groups)
+        # 1. resolve last tick's deferred flushes (pipeline_depth >= 2)
+        for group in groups:
+            if group.pending is not None:
+                self._push_hetero_outs(group.finish_flush())
+        # 2. enqueue every group's epoch (cross-group overlap)
+        ran = generate and k > 0
+        if ran:
+            for group in groups:
+                group.run_epoch(k)
+                for j, name in enumerate(group.names):
+                    cursor = self._hetero_engines[name][2]
+                    cursor.events = group.starts[j]
+                    cursor.epochs = group.batch_nos[j]
+        # 3. enqueue every group's probe + packed fetch before decoding
+        for group in groups:
+            group.begin_flush()
+        if self.pipeline_depth >= 2 and ran and not checkpoint:
+            self._pipeline_stats["deferred_flushes"] += len(groups)
+            return
+        # 4. synchronous resolution (depth 1, checkpoint, or idle tick)
+        for group in groups:
+            self._push_hetero_outs(group.finish_flush())
+            if checkpoint:
+                ckpt_states = []
+                for name in group.names:
+                    agg = self._hetero_engines[name][0]
+                    agg.state = group.state_of(name)
+                    agg._checkpoint_to_state_table(epoch)
+                    ckpt_states.append(agg.state)
+                group.set_states(ckpt_states)
+
     # ------------------------------------------- mesh-sharded fused MV jobs --
 
     def _try_shardfused_mv(self, stmt: A.CreateMaterializedView):
@@ -2012,6 +2231,10 @@ class Session:
         for group in list(self._cosched.groups.values()):
             if group.pending is not None:
                 self._push_cosched_outs(group.finish_flush())
+                self._pipeline_stats["drains"] += 1
+        for group in list(self._hetero.groups):
+            if group.pending is not None:
+                self._push_hetero_outs(group.finish_flush())
                 self._pipeline_stats["drains"] += 1
         if self._shardfused is not None:
             for group in list(self._shardfused.groups.values()):
@@ -3294,6 +3517,14 @@ class Session:
                     + group.epochs_run
             self._cosched_engines.pop(stmt.name, None)
             self._cosched_markers.discard(stmt.name)
+            if stmt.name in self._hetero.jobs:
+                # dissolve-then-recompile: the member's groups retire
+                # their epochs into the compiler ledger; fold it so the
+                # per_epoch invariant ratio survives the DROP
+                self._hetero.remove(stmt.name)
+                self._fold_hetero_retired()
+            self._hetero_engines.pop(stmt.name, None)
+            self._hetero_markers.discard(stmt.name)
             dead_sf = self._shardfused_engines.pop(stmt.name, None)
             if dead_sf is not None and self._shardfused is not None:
                 _states, sf_group = self._shardfused.remove(stmt.name)
@@ -3577,6 +3808,12 @@ class Session:
             # queues BEFORE the barrier below
             self._cosched_tick(epoch, checkpoint,
                                generate and not self.paused)
+        if self._hetero.jobs:
+            # tick-compiled groups: the compiler's minimal dispatch
+            # schedule (shape-class supergroups + mega-epochs) covers
+            # every registered MV's epoch in a handful of dispatches
+            self._hetero_tick(epoch, checkpoint,
+                              generate and not self.paused)
         if self._shardfused_engines:
             # mesh-sharded fused MVs: one dispatch per MV per epoch
             # across ALL chips (ops/fused_sharded.py)
@@ -4391,6 +4628,10 @@ class Session:
             # epoch co-scheduler: group membership + epochs run
             # (stream/coschedule.py)
             "coschedule": self._cosched.stats(),
+            # heterogeneous tick compiler: dispatch schedule shape +
+            # per-job cost attribution (stream/tick_compiler.py)
+            "hetero": {**self._hetero.stats(),
+                       "attribution": self._hetero.attribution()},
             # mesh-sharded fused MVs: shard count + group size + epochs
             # + grow-retry events per job (ops/fused_sharded.py,
             # parallel/fused.ShardedCoGroup — signature-equal MVs share
@@ -4542,6 +4783,10 @@ class Session:
                 if g.epochs_run:
                     epochs_by_name[qn] = epochs_by_name.get(qn, 0) \
                         + g.epochs_run
+        for g in self._hetero.groups:
+            if g.epochs_run:
+                epochs_by_name[g.epoch_qualname] = \
+                    epochs_by_name.get(g.epoch_qualname, 0) + g.epochs_run
         for qn, epochs in epochs_by_name.items():
             if qn in counts and epochs:
                 dispatch["per_epoch"][qn] = round(counts[qn] / epochs, 4)
@@ -4552,6 +4797,8 @@ class Session:
         from ..common.profiling import GLOBAL_PROFILER
         pending = sum(1 for g in self._cosched.groups.values()
                       if g.pending is not None)
+        pending += sum(1 for g in self._hetero.groups
+                       if g.pending is not None)
         if self._shardfused is not None:
             pending += sum(1 for g in self._shardfused.groups.values()
                            if g.pending is not None)

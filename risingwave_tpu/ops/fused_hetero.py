@@ -1,0 +1,295 @@
+"""Heterogeneous fused epochs — UNEQUAL jobs in minimal dispatches.
+
+The tick-compiler's device layer (stream/tick_compiler.py is the
+host-side scheduler over these builders). ops/fused_multi.py stacks
+jobs whose traces are IDENTICAL — same exprs, same capacities, same
+literals — so a realistic tenant mix of hundreds of small *dissimilar*
+MVs still pays one dispatch each. Two new surfaces close that gap:
+
+* **Padded shape-class supergroups** (``build_padded_group_epoch``):
+  jobs whose epoch bodies share an operator SKELETON — same projection
+  structure, same agg calls, same group keys — but differ in literal
+  values (window widths…) or table capacities. The literals are lifted
+  out of the trace as *parameter columns* (``hetero_agg_body`` appends
+  one broadcast column per skeleton hole, bit-identical to
+  ``Literal.eval``'s ``jnp.full``), each member's state is re-padded to
+  the class-max capacity (``repad_agg_state``; open addressing means
+  the padding changes slot LAYOUT, never per-key values), and one
+  vmapped trace serves the whole bucket: K unequal jobs, one dispatch.
+
+* **The jitted mega-epoch** (``build_mega_epoch``): jobs that share no
+  skeleton at all. Their solo epoch bodies — the very
+  ``agg_epoch_body`` closures ops/fused_epoch.py jits — are
+  concatenated SEQUENTIALLY inside one compiled dispatch over a tuple
+  of heterogeneous states. XLA runs them back-to-back with no host
+  round-trip between: J unequal jobs, one launch, and
+  ``build_mega_agg_probe`` keeps the barrier at one packed [J, 3]
+  fetch.
+
+Both surfaces extend the equal-group packed-stats layout with a third
+slot (``n_live`` — the per-job live-group census) so the profiler can
+attribute cost per job INSIDE a fused dispatch
+(common/profiling.per_job_attribution). Registered in
+``HETERO_EPOCH_BUILDERS`` so rwlint dispatch-discipline,
+common/dispatch_count.py and the profiler cover them exactly like the
+solo/sharded registries.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column
+from ..common.profiling import profile_dispatch
+from ..expr import Expr
+from .fused_epoch import _donate, agg_epoch_body
+from .grouped_agg import AggCore, AggState
+from .hash_table import ht_lookup_or_insert, ht_new
+
+
+# ---------------------------------------------------------------------------
+# skeletonized epoch body — literal holes ride as data
+# ---------------------------------------------------------------------------
+
+
+def hetero_agg_body(chunk_fn: Callable, skel_exprs: Sequence[Expr], core,
+                    rows_per_chunk: int) -> Callable:
+    """``epoch(state, start, key, params, k) -> state``: the q5 agg body
+    with the projection's literal holes supplied as data.
+
+    ``skel_exprs`` reference hole ``h`` as ``InputRef(n_source_cols +
+    h)``; ``params`` is a tuple of scalars (one per hole, already in
+    physical dtype). Each scan iteration appends one broadcast column
+    per hole to the generated chunk — ``jnp.full`` + all-ones mask,
+    exactly ``Literal.eval``'s lowering — so a padded member computes
+    bit-identically to its solo epoch with the literals inlined."""
+    skel_exprs = tuple(skel_exprs)
+
+    def epoch(state, start, key, params, k: int):
+        def body(st, i):
+            ch = chunk_fn(start + i * rows_per_chunk,
+                          jax.random.fold_in(key, i))
+            cap = ch.capacity
+            ones = jnp.ones(cap, jnp.bool_)
+            ch = ch.append_columns(tuple(
+                Column(jnp.full(cap, p), ones) for p in params))
+            projected = ch.with_columns(
+                tuple(e.eval(ch) for e in skel_exprs))
+            return core.apply_chunk(st, projected), None
+
+        state, _ = jax.lax.scan(body, state,
+                                jnp.arange(k, dtype=jnp.int64))
+        return state
+
+    return epoch
+
+
+# ---------------------------------------------------------------------------
+# tier 1: padded shape-class supergroup (one vmapped trace, K unequal jobs)
+# ---------------------------------------------------------------------------
+
+
+def build_padded_group_epoch(chunk_fn: Callable, skel_exprs: Sequence[Expr],
+                             core, rows_per_chunk: int,
+                             donate: bool = True) -> Callable:
+    """The tick-compiler's shape-class epoch: ``epoch(stacked,
+    starts[J], base_keys[J], batch_nos[J], params, k)`` — the
+    skeletonized body vmapped over the job axis, per-job PRNG folding
+    inside the jit (same contract as fused_multi.build_group_epoch).
+    ``params``: tuple of [J] arrays, one per skeleton hole — job j's
+    literal values ride down axis 0. common/dispatch_count.py counts
+    this as ``build_padded_group_epoch.<locals>.padded_epoch``."""
+    body = hetero_agg_body(chunk_fn, skel_exprs, core, rows_per_chunk)
+    vm = jax.vmap(body, in_axes=(0, 0, 0, 0, None))
+
+    def padded_epoch(stacked, starts, base_keys, batch_nos, params,
+                     k: int):
+        keys = jax.vmap(jax.random.fold_in)(base_keys, batch_nos)
+        return vm(stacked, starts, keys, params, k)
+
+    return profile_dispatch(
+        jax.jit(padded_epoch, static_argnums=(5,),
+                donate_argnums=_donate(donate)),
+        padded_epoch.__qualname__)
+
+
+def padded_agg_probe(core) -> Callable:
+    """``probe(stacked) -> (packed [J, 3], rank [J, cap])`` — the
+    supergroup's barrier probe, one dispatch / one fetch. Slot 2 is the
+    per-job live-group census (the [J, *] packed-stats extension): the
+    profiler's per-job cost weight inside the fused dispatch."""
+
+    def probe_one(st):
+        rank = core.flush_rank(st)
+        n_live = jnp.sum(st.table.occupied
+                         & (st.lanes[0] > 0)).astype(jnp.int32)
+        packed = jnp.stack([rank[-1], st.overflow.astype(jnp.int32),
+                            n_live])
+        return packed, rank
+
+    vm = jax.vmap(probe_one)
+
+    def padded_probe(stacked):
+        return vm(stacked)
+
+    return profile_dispatch(jax.jit(padded_probe),
+                            padded_probe.__qualname__)
+
+
+# ---------------------------------------------------------------------------
+# tier 2: the jitted mega-epoch (heterogeneous bodies, one dispatch)
+# ---------------------------------------------------------------------------
+
+
+def build_mega_epoch(specs: Sequence, donate: bool = True) -> Callable:
+    """Concatenate J heterogeneous jobs' epochs into ONE compiled
+    dispatch: ``mega_epoch(states, starts[J], base_keys[J],
+    batch_nos[J], k) -> states`` where ``states`` is a TUPLE of
+    per-job state pytrees (shapes may all differ — no stacking).
+
+    Each ``spec`` is a stream/coschedule.FusedJobSpec; the bodies are
+    built here from the same ``agg_epoch_body`` the solo registry jits,
+    so job j's slice is bit-exact vs its solo fused epoch by
+    construction. XLA sequences the bodies inside the launch — one
+    dispatch, zero host round-trips between jobs. Only ``kind ==
+    "agg"`` concatenates today (the join/session/q3 epochs return
+    per-epoch emission tuples whose host drain is shape-specific);
+    callers route other kinds to their solo/co-scheduled surfaces.
+    common/dispatch_count.py counts this as
+    ``build_mega_epoch.<locals>.mega_epoch``."""
+    bodies = []
+    for spec in specs:
+        if spec.kind != "agg":
+            raise NotImplementedError(
+                f"mega-epoch concatenates agg-shaped jobs only "
+                f"(got kind {spec.kind!r})")
+        bodies.append(agg_epoch_body(spec.chunk_fn, spec.exprs,
+                                     spec.core, spec.rows_per_chunk))
+
+    def mega_epoch(states, starts, base_keys, batch_nos, k: int):
+        out = []
+        for j, body in enumerate(bodies):
+            kj = jax.random.fold_in(base_keys[j], batch_nos[j])
+            out.append(body(states[j], starts[j], kj, k))
+        return tuple(out)
+
+    return profile_dispatch(
+        jax.jit(mega_epoch, static_argnums=(4,),
+                donate_argnums=_donate(donate)),
+        mega_epoch.__qualname__)
+
+
+def build_mega_agg_probe(cores: Sequence) -> Callable:
+    """``probe(states) -> (packed [J, 3], ranks tuple)`` — the whole
+    mega-group's barrier probe in one dispatch and ONE packed fetch,
+    even though every job's rank array keeps its own capacity (the
+    ranks tuple is ragged; only the [J, 3] stats stack)."""
+
+    def mega_probe(states):
+        packed, ranks = [], []
+        for core, st in zip(cores, states):
+            rank = core.flush_rank(st)
+            n_live = jnp.sum(st.table.occupied
+                             & (st.lanes[0] > 0)).astype(jnp.int32)
+            packed.append(jnp.stack([rank[-1],
+                                     st.overflow.astype(jnp.int32),
+                                     n_live]))
+            ranks.append(rank)
+        return jnp.stack(packed), tuple(ranks)
+
+    return profile_dispatch(jax.jit(mega_probe), mega_probe.__qualname__)
+
+
+def build_mega_agg_finish(cores: Sequence) -> Callable:
+    """``finish(states) -> states`` — every job's flush finish in one
+    dispatch (per-core ``finish_flush`` sequenced inside the jit)."""
+
+    def mega_finish(states):
+        return tuple(core.finish_flush(st)
+                     for core, st in zip(cores, states))
+
+    return profile_dispatch(jax.jit(mega_finish),
+                            mega_finish.__qualname__)
+
+
+def mega_agg_gathers(cores: Sequence) -> list:
+    """Per-job jitted flush-window gathers for a mega-group. Gathers
+    are per-job DATA (same as the equal-group path) so they stay
+    per-job dispatches; jobs sharing a core config share the jit cache
+    entry via identical shapes."""
+    out = []
+    for core in cores:
+        def gather(st, rank, lo, core=core):
+            return core.gather_flush_chunk(st, rank, lo)
+        gather.__qualname__ = "mega_agg_gathers.<locals>.gather"
+        out.append(profile_dispatch(jax.jit(gather), gather.__qualname__))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# state re-padding (class-max capacity)
+# ---------------------------------------------------------------------------
+
+
+def repad_agg_state(core: AggCore, state: AggState, new_capacity: int,
+                    out_capacity: int = None) -> tuple:
+    """Grow an AggState to ``new_capacity`` slots: ``(class_core,
+    padded_state)``. Eager/unjitted — this runs at DDL time only (the
+    tick compiler's restack), never per tick. ``out_capacity``
+    overrides the class core's flush-chunk width (state arrays do not
+    depend on it; only gather windowing does).
+
+    Every OCCUPIED slot moves — not just live groups: a group whose
+    row count hit zero but whose delete is still ``ckpt_dirty`` must
+    survive the move or the next checkpoint would miss the durable
+    delete (compare ``AggCore.compact``, which intentionally keeps live
+    rows only because it runs AFTER the checkpoint). Open addressing
+    re-hashes every key into the larger table, so the slot LAYOUT
+    changes but per-key lane values do not — flush chunks may order
+    groups differently than the unpadded state, while each group's
+    emitted values stay bit-exact."""
+    if new_capacity < core.capacity:
+        raise ValueError(
+            f"repad shrinks {core.capacity} -> {new_capacity}")
+    class_core = AggCore(core.key_types, core.group_keys, core.agg_calls,
+                         new_capacity,
+                         core.out_capacity if out_capacity is None
+                         else out_capacity)
+    if new_capacity == core.capacity:
+        return class_core, state
+    occ = state.table.occupied
+    key_cols = [Column(kd, km) for kd, km in
+                zip(state.table.key_data, state.table.key_mask)]
+    ht, slots, _, rebuild_ovf = ht_lookup_or_insert(
+        ht_new(core.key_types, new_capacity), key_cols, occ)
+    dst = jnp.where(occ, slots, new_capacity)
+    init = class_core.init_state()
+
+    def move(arr, init_arr):
+        return init_arr.at[dst].set(arr, mode="drop")
+
+    return class_core, AggState(
+        table=ht,
+        lanes=tuple(move(l, il)
+                    for l, il in zip(state.lanes, init.lanes)),
+        prev_lanes=tuple(move(l, il)
+                         for l, il in zip(state.prev_lanes, init.lanes)),
+        dirty=move(state.dirty, init.dirty),
+        ckpt_dirty=move(state.ckpt_dirty, init.ckpt_dirty),
+        overflow=state.overflow | rebuild_ovf,
+        last_used=move(state.last_used, init.last_used),
+    )
+
+
+#: builder registry — same contract as ops/fused_epoch.EPOCH_BUILDERS:
+#: rwlint dispatch-discipline parses this dict literal statically and
+#: walks each builder's closure; tests/test_registry_coverage.py
+#: cross-checks the parse against this runtime dict and drives every
+#: surface under count_dispatches + the profiler.
+HETERO_EPOCH_BUILDERS = {
+    "padded_agg": build_padded_group_epoch,   # tier 1: shape-class vmap
+    "mega_agg": build_mega_epoch,             # tier 2: concatenated bodies
+}

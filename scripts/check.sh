@@ -49,6 +49,7 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_fused_epoch.py \
     tests/test_fused_q8_q3.py \
     tests/test_coschedule.py \
+    tests/test_tick_compiler.py \
     tests/test_fused_sharded.py \
     tests/test_fused_sharded_ladder.py \
     tests/test_registry_coverage.py \
@@ -59,12 +60,14 @@ python -m pytest -q -p no:cacheprovider \
     "$@"
 
 echo "== sharded-ladder heavy parity (slow-marked out of tier-1) =="
-# the K×S group / q8 / q3 sharded checkpoint + re-shard parity runs and
-# the every-builder dispatch/profiler cross-check compile large
-# shard_map programs — tier-2 per the 870s tier-1 wall budget
+# the K×S group / q8 / q3 sharded checkpoint + re-shard parity runs,
+# the every-builder dispatch/profiler cross-check, and the tick
+# compiler's 200-small-MVs ≤8-dispatch acceptance case compile large
+# programs — tier-2 per the 870s tier-1 wall budget
 python -m pytest -q -p no:cacheprovider -m slow \
     tests/test_fused_sharded_ladder.py \
     tests/test_registry_coverage.py \
+    tests/test_tick_compiler.py \
     "$@"
 
 echo "== pipelined tick (async epoch pipeline, fast tier) =="

@@ -293,3 +293,63 @@ def test_sharded_equi_join_epoch_lowers_for_tpu():
         lowering_platforms=("tpu",)).as_text()
     assert "stablehlo" in text and ("while" in text or "scan" in text)
     assert "all-to-all" in text or "all_to_all" in text
+
+
+@pytest.mark.parametrize("tier", ["padded", "mega"])
+def test_hetero_tick_compiler_epochs_lower_for_tpu(tier):
+    """Both tick-compiler dispatch tiers (ISSUE 19: the skeletonized
+    padded supergroup epoch and the concatenated mega-epoch) lower for
+    platform "tpu" chip-free — same CI contract as every other fused
+    surface."""
+    from risingwave_tpu.common import INT64, TIMESTAMP
+    from risingwave_tpu.connector import BID_SCHEMA, NexmarkConfig
+    from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+    from risingwave_tpu.expr import Literal, call, col
+    from risingwave_tpu.expr.agg import agg as agg_call, count_star
+    from risingwave_tpu.ops.fused_hetero import (
+        build_mega_epoch, build_padded_group_epoch,
+    )
+    from risingwave_tpu.ops.fused_multi import stack_states
+    from risingwave_tpu.ops.grouped_agg import AggCore
+    from risingwave_tpu.stream.coschedule import FusedJobSpec
+    from risingwave_tpu.stream.tick_compiler import skeletonize_exprs
+
+    import numpy as np
+
+    cap = 256
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+    exprs = (call("tumble_start", col(5, TIMESTAMP),
+                  Literal(1_000_000, INT64)), col(0, INT64),
+             col(2, INT64))
+    core = AggCore([INT64, INT64], [0, 1], [count_star()], 1 << 10, cap)
+    if tier == "padded":
+        jobs = 8
+        skel, hole_types, params = skeletonize_exprs(
+            exprs, len(BID_SCHEMA))
+        fused = build_padded_group_epoch(gen.chunk_fn(), skel, core,
+                                         cap, donate=False)
+        stacked = stack_states([core.init_state()
+                                for _ in range(jobs)])
+        param_cols = tuple(
+            jnp.asarray(np.full(jobs, params[h], t.np_dtype))
+            for h, t in enumerate(hole_types))
+        args = (stacked, jnp.zeros(jobs, jnp.int64),
+                jnp.stack([jax.random.PRNGKey(j) for j in range(jobs)]),
+                jnp.zeros(jobs, jnp.int64), param_cols, 4)
+    else:
+        other = AggCore([INT64], [1], [count_star(),
+                                       agg_call("max", 2, INT64)],
+                        1 << 10, cap)
+        specs = [
+            FusedJobSpec("agg", ("agg", ("nexmark_bid", cap)),
+                         gen.chunk_fn(), exprs, core, cap, seed=0),
+            FusedJobSpec("agg", ("agg", ("nexmark_bid", cap)),
+                         gen.chunk_fn(), exprs, other, cap, seed=1),
+        ]
+        fused = build_mega_epoch(specs, donate=False)
+        args = ((core.init_state(), other.init_state()),
+                jnp.zeros(2, jnp.int64),
+                jnp.stack([jax.random.PRNGKey(j) for j in range(2)]),
+                jnp.zeros(2, jnp.int64), 4)
+    text = _lower_tpu_jitted(fused, *args)
+    assert "stablehlo" in text and ("while" in text or "scan" in text)

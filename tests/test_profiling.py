@@ -439,6 +439,51 @@ def test_session_dispatch_per_epoch_invariant_live():
         s.close()
 
 
+def test_session_dispatch_per_epoch_invariant_tick_compiled():
+    """The tick compiler's twin of the invariant above (ISSUE 19
+    satellite): the schedule DISSOLVES on every DDL, so a DROP +
+    re-CREATE retires the dead padded group's epochs-run via
+    TickCompiler.take_retired — otherwise the live per_epoch ratio
+    would read 2.0 after the recompile and falsely flag a dispatch
+    regression."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend.build import BuildConfig
+    from risingwave_tpu.stream.tick_compiler import PADDED_EPOCH_FN
+
+    s = Session(config=BuildConfig(tick_compiler=True,
+                                   agg_table_capacity=1 << 12),
+                source_chunk_capacity=128)
+    try:
+        s.run_sql(
+            "CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price "
+            "BIGINT, channel VARCHAR, url VARCHAR, date_time TIMESTAMP, "
+            "extra VARCHAR) WITH (connector = 'nexmark', "
+            "nexmark_table = 'bid')")
+        mv = ("CREATE MATERIALIZED VIEW {n} AS SELECT auction, "
+              "sum(price + {lit}) AS v FROM bid GROUP BY auction")
+        s.run_sql(mv.format(n="h0", lit=10))
+        s.run_sql(mv.format(n="h1", lit=20))   # same skeleton => padded
+        GLOBAL_PROFILER.reset()
+        for _ in range(4):
+            s.tick()
+        d = s.metrics()["dispatch"]
+        assert d["counts"][PADDED_EPOCH_FN] == 4
+        assert d["per_epoch"][PADDED_EPOCH_FN] == 1.0
+        # DROP dissolves the schedule: its 4 epochs-run must land in the
+        # retirement ledger. Re-CREATE before the next tick so the
+        # surviving singleton never runs a mega interlude.
+        s.run_sql("DROP MATERIALIZED VIEW h1")
+        assert s._dispatch_epochs_retired[PADDED_EPOCH_FN] == 4
+        s.run_sql(mv.format(n="h1", lit=20))
+        for _ in range(4):
+            s.tick()
+        d = s.metrics()["dispatch"]
+        assert d["counts"][PADDED_EPOCH_FN] == 8
+        assert d["per_epoch"][PADDED_EPOCH_FN] == 1.0
+    finally:
+        s.close()
+
+
 @pytest.mark.slow
 def test_hbm_ledger_federates_from_two_workers(tmp_path):
     """Acceptance: the ledger covers jobs hosted on >= 2 worker

@@ -154,6 +154,42 @@ def test_exposition_lint_every_family_has_help_and_type(tmp_path):
         s.close()
 
 
+def test_hetero_families_present_and_linted():
+    """Satellite (ISSUE 19): a tick-compiled session exports the
+    rw_hetero_* family — schedule shape, recompile counter, per-group
+    membership, per-job attribution weights — and every family passes
+    the exposition lint above."""
+    from risingwave_tpu.frontend.build import BuildConfig
+
+    s = Session(config=BuildConfig(tick_compiler=True,
+                                   agg_table_capacity=1 << 12),
+                source_chunk_capacity=64)
+    try:
+        s.run_sql(DDL)
+        s.run_sql("CREATE MATERIALIZED VIEW h0 AS SELECT auction, "
+                  "sum(price + 10) AS v FROM bid GROUP BY auction")
+        s.run_sql("CREATE MATERIALIZED VIEW h1 AS SELECT auction, "
+                  "sum(price + 20) AS v FROM bid GROUP BY auction")
+        for _ in range(2):
+            s.tick()
+        families = _parse_exposition(render_metrics(s))
+        for expected in ("rw_hetero_jobs",
+                         "rw_hetero_dispatches_per_tick",
+                         "rw_hetero_schedule_compiles",
+                         "rw_hetero_group_jobs",
+                         "rw_hetero_flush_weight"):
+            assert expected in families, \
+                f"{expected} missing: {sorted(families)}"
+        jobs = families["rw_hetero_jobs"]["samples"]
+        assert float(jobs[0][2]) == 2
+        groups = families["rw_hetero_group_jobs"]["samples"]
+        assert any(l.get("kind") == "padded" for _, l, _ in groups)
+        weights = families["rw_hetero_flush_weight"]["samples"]
+        assert {l["job"] for _, l, _ in weights} == {"h0", "h1"}
+    finally:
+        s.close()
+
+
 def test_render_slow_epoch_counter():
     s = _session()
     s.run_sql("SET slow_epoch_threshold_ms = 0.0001")

@@ -33,6 +33,7 @@ from risingwave_tpu.connector.tpch import (
 from risingwave_tpu.expr import Literal, call, col
 from risingwave_tpu.expr.agg import count_star
 from risingwave_tpu.ops.fused_epoch import EPOCH_BUILDERS
+from risingwave_tpu.ops.fused_hetero import HETERO_EPOCH_BUILDERS
 from risingwave_tpu.ops.fused_multi import (
     build_group_epoch, fused_multi_agg_epoch, fused_multi_join_epoch,
     stack_states,
@@ -202,6 +203,33 @@ def _build_and_call_all(mesh):
       *_job_args(), K)
     out["COSCHEDULED_BUILDERS:build_group_epoch"] = f
 
+    # the tick compiler's two dispatch tiers (ISSUE 19)
+    from risingwave_tpu.connector import BID_SCHEMA
+    from risingwave_tpu.stream.coschedule import FusedJobSpec
+    from risingwave_tpu.stream.tick_compiler import skeletonize_exprs
+    import numpy as np
+
+    exprs, core, fn = _q5_parts()
+    skel, hole_types, params = skeletonize_exprs(tuple(exprs),
+                                                 len(BID_SCHEMA))
+    f = HETERO_EPOCH_BUILDERS["padded_agg"](fn, skel, core, CAP,
+                                            donate=False)
+    starts, keys, nos = _job_args()
+    param_cols = tuple(jnp.asarray(np.full(JOBS, params[h], t.np_dtype))
+                       for h, t in enumerate(hole_types))
+    f(stack_states([core.init_state() for _ in range(JOBS)]), starts,
+      keys, nos, param_cols, K)
+    out["HETERO_EPOCH_BUILDERS:padded_agg"] = f
+
+    exprs, core, fn = _q5_parts()
+    other = AggCore([INT64], [1], [count_star()], 1 << 10, CAP)
+    specs = [FusedJobSpec("agg", ("agg", ("nexmark_bid", CAP)), fn,
+                          tuple(exprs), c, CAP, seed=j)
+             for j, c in enumerate((core, other))]
+    f = HETERO_EPOCH_BUILDERS["mega_agg"](specs, donate=False)
+    f((core.init_state(), other.init_state()), starts, keys, nos, K)
+    out["HETERO_EPOCH_BUILDERS:mega_agg"] = f
+
     return out
 
 
@@ -218,6 +246,7 @@ def test_rwlint_closure_covers_every_registry_entry():
     assert set(cov["SHARDED_EPOCH_BUILDERS"]) == \
         set(SHARDED_EPOCH_BUILDERS)
     assert set(cov["COSCHEDULED_BUILDERS"]) == set(COSCHEDULED_BUILDERS)
+    assert set(cov["HETERO_EPOCH_BUILDERS"]) == set(HETERO_EPOCH_BUILDERS)
     for reg, entries in cov.items():
         for entry_key, reach in entries.items():
             assert len(reach) >= 5, (reg, entry_key)
@@ -240,7 +269,8 @@ def test_every_builder_counts_and_profiles_under_its_qualname():
     prof = GLOBAL_PROFILER.counts()
     registries = {"EPOCH_BUILDERS": EPOCH_BUILDERS,
                   "SHARDED_EPOCH_BUILDERS": SHARDED_EPOCH_BUILDERS,
-                  "COSCHEDULED_BUILDERS": COSCHEDULED_BUILDERS}
+                  "COSCHEDULED_BUILDERS": COSCHEDULED_BUILDERS,
+                  "HETERO_EPOCH_BUILDERS": HETERO_EPOCH_BUILDERS}
     for reg_key, f in wrapped.items():
         reg_name, builder_name = reg_key.split(":")
         qn = f.__qualname__

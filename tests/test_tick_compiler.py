@@ -1,0 +1,607 @@
+"""The heterogeneous tick compiler (stream/tick_compiler.py +
+ops/fused_hetero.py): UNEQUAL jobs fused into a minimal dispatch
+schedule — shape-class padded supergroups (tier 1) + jitted mega-epochs
+(tier 2). These tests pin the ISSUE 19 contract: bucketing/padding
+rules, one dispatch per compiled group per epoch, bit-exact per-job
+results vs the solo fused path (including U-/U+ retraction churn),
+DDL-driven recompilation with the epochs-retired ledger, recovery onto
+a recompiled schedule, and pipeline_depth=2 bit-exactness at drain
+points. The 200-small-MVs ≤ 8-dispatch acceptance case is @slow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import INT64, TIMESTAMP
+from risingwave_tpu.common.chunk import OP_UPDATE_DELETE, OP_UPDATE_INSERT
+from risingwave_tpu.common.dispatch_count import count_dispatches
+from risingwave_tpu.connector import BID_SCHEMA, NexmarkConfig
+from risingwave_tpu.connector.nexmark import DeviceBidGenerator
+from risingwave_tpu.expr import Literal, call, col
+from risingwave_tpu.expr.agg import agg as agg_call, count_star
+from risingwave_tpu.ops.fused_epoch import fused_source_agg_epoch
+from risingwave_tpu.stream import HashAggExecutor, ProjectExecutor
+from risingwave_tpu.stream.coschedule import FusedJobSpec
+from risingwave_tpu.stream.source import MockSource
+from risingwave_tpu.stream.tick_compiler import (
+    MEGA_EPOCH_FN, PADDED_EPOCH_FN, TickCompiler, shape_class,
+    skeletonize_exprs,
+)
+
+CAP = 128
+N_SOURCE_COLS = len(BID_SCHEMA)
+
+
+def _parts(window_us=1_000_000, calls=None, table_capacity=1 << 10,
+           group_keys=(0, 1), cap=CAP):
+    """One q5-shaped job: tumble-window projection (the window literal
+    is the knob that varies WITHIN a shape class) + a HashAggExecutor
+    whose core/probe/gather are the solo flush reference."""
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(window_us, INT64)),
+        col(0, INT64),
+        col(2, INT64),
+    ]
+    proj = ProjectExecutor(MockSource(BID_SCHEMA, []), exprs,
+                           names=("ws", "auction", "price"))
+    agg = HashAggExecutor(
+        proj, list(group_keys), list(calls or [count_star()]),
+        table_capacity=table_capacity, out_capacity=cap)
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+    return exprs, agg, gen.chunk_fn()
+
+
+def _spec(exprs, agg, chunk_fn, seed=0, cap=CAP):
+    return FusedJobSpec("agg", ("agg", ("nexmark_bid", cap)), chunk_fn,
+                        tuple(exprs), agg.core, cap, seed=seed)
+
+
+def _solo_epoch_and_flush(solo, agg, state, start, key, k):
+    """The solo fused path's full epoch + flush (the parity oracle)."""
+    state = solo(state, jnp.int64(start), key, k)
+    packed, rank = agg._probe(state)
+    n_dirty, overflow, _ = (int(x) for x in jax.device_get(packed))
+    assert not overflow
+    chunks = []
+    lo = 0
+    while lo < n_dirty:
+        chunks.append(agg._gather(state, rank, jnp.int64(lo)))
+        lo += agg.core.groups_per_chunk
+    return agg._finish(state), chunks
+
+
+def _rows(chunks):
+    """Visible (op, *values) multiset of a flush — padding changes slot
+    LAYOUT (hence chunk row order) but never per-key values, so parity
+    is order-insensitive row equality."""
+    out = []
+    for c in chunks:
+        ops, vis = np.asarray(c.ops), np.asarray(c.vis)
+        cols = [(np.asarray(cc.data), np.asarray(cc.mask))
+                for cc in c.columns]
+        for i in np.nonzero(vis)[0]:
+            out.append((int(ops[i]),) + tuple(
+                int(d[i]) if m[i] else None for d, m in cols))
+    return sorted(out, key=repr)
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# skeletonization + shape classes (the bucketing rules)
+# ---------------------------------------------------------------------------
+
+
+def test_skeletonize_lifts_numeric_literals():
+    exprs = [
+        call("tumble_start", col(5, TIMESTAMP), Literal(777, INT64)),
+        col(0, INT64),
+    ]
+    skel, hole_types, params = skeletonize_exprs(tuple(exprs),
+                                                 N_SOURCE_COLS)
+    assert len(hole_types) == len(params) == 1
+    assert params[0] == INT64.to_physical(777)
+    # the hole is an InputRef just past the source columns
+    hole = skel[0].args[1]
+    assert hole.index == N_SOURCE_COLS
+    # two window widths share a skeleton; the plain column is untouched
+    skel2, _, params2 = skeletonize_exprs(
+        (call("tumble_start", col(5, TIMESTAMP), Literal(999, INT64)),
+         col(0, INT64)), N_SOURCE_COLS)
+    assert repr(skel) == repr(skel2) and params2[0] != params[0]
+
+
+def test_skeletonize_keeps_non_numeric_literals_inline():
+    from risingwave_tpu.common.types import BOOL, VARCHAR
+    exprs = (Literal(True, BOOL), Literal("x", VARCHAR),
+             Literal(None, INT64))
+    skel, hole_types, params = skeletonize_exprs(exprs, N_SOURCE_COLS)
+    # nothing lifts: walk returns the very same nodes (Expr __eq__ is
+    # overloaded, so compare by identity)
+    assert all(a is b for a, b in zip(skel, exprs))
+    assert not hole_types and not params
+
+
+def test_shape_class_ignores_capacities_and_literal_values():
+    exprs_a, agg_a, _ = _parts(window_us=1_000_000,
+                               table_capacity=1 << 10)
+    exprs_b, agg_b, _ = _parts(window_us=5_000_000,
+                               table_capacity=1 << 12)
+
+    def sc(exprs, agg):
+        skel, holes, _ = skeletonize_exprs(tuple(exprs), N_SOURCE_COLS)
+        return shape_class(agg.core, skel, holes, CAP,
+                           ("nexmark_bid", CAP))
+
+    assert sc(exprs_a, agg_a) == sc(exprs_b, agg_b)
+    # different agg calls => different class
+    exprs_c, agg_c, _ = _parts(
+        calls=[count_star(), agg_call("max", 2, INT64)])
+    assert sc(exprs_a, agg_a) != sc(exprs_c, agg_c)
+
+
+def test_compiler_buckets_classes_and_chunks_singletons():
+    tc = TickCompiler(mega_max_jobs=2)
+    for j in range(3):                       # one padded class of 3
+        exprs, agg, chunk_fn = _parts(window_us=1_000_000 + j)
+        tc.add(f"p{j}", _spec(exprs, agg, chunk_fn, seed=j),
+               agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+    singles = [
+        _parts(calls=[count_star(), agg_call("max", 2, INT64)]),
+        _parts(calls=[count_star(), agg_call("sum", 2, INT64)]),
+        _parts(calls=[agg_call("min", 2, INT64)]),
+    ]
+    for j, (exprs, agg, chunk_fn) in enumerate(singles):
+        tc.add(f"s{j}", _spec(exprs, agg, chunk_fn, seed=10 + j),
+               agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+    assert tc.dirty
+    tc.ensure_compiled()
+    st = tc.stats()
+    assert not st["dirty"] and st["jobs"] == 6
+    kinds = sorted(g["kind"] for g in st["groups"])
+    # 3 same-skeleton jobs => 1 padded group; 3 unlike singletons chunk
+    # into ceil(3/2) mega groups under mega_max_jobs=2
+    assert kinds == ["mega", "mega", "padded"]
+    assert st["dispatches_per_tick"] == 3
+    assert st["schedule_compiles"] == 1
+    # idempotent until the next DDL
+    tc.ensure_compiled()
+    assert tc.stats()["schedule_compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tier 1: padded supergroups — dispatch count + parity vs solo
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n_jobs", [2, pytest.param(8, marks=pytest.mark.slow)])
+def test_padded_group_one_dispatch_bit_exact_vs_solo(n_jobs):
+    """UNEQUAL jobs (distinct window literals AND table capacities) in
+    one shape class: exactly ONE vmapped dispatch per epoch, and every
+    job's flush stream — inserts and U-/U+ churn — row-equal to its own
+    solo fused run (padding changes slot layout, never values)."""
+    with count_dispatches() as c:
+        tc = TickCompiler()
+        parts = []
+        for j in range(n_jobs):
+            exprs, agg, chunk_fn = _parts(
+                window_us=1_000_000 * (j + 1),
+                table_capacity=1 << (9 + (j % 3)))
+            parts.append((exprs, agg, chunk_fn))
+            tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=100 + j),
+                   agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+        tc.ensure_compiled()
+        assert [g.kind for g in tc.groups] == ["padded"]
+        group = tc.groups[0]
+        # class capacity is the max declared member capacity
+        assert group.core.capacity == max(
+            a.core.capacity for _, a, _ in parts)
+        k = 4
+        group.run_epoch(k)
+        flushes = [group.flush()]
+        c.reset()
+        group.run_epoch(k)
+        assert c.counts[PADDED_EPOCH_FN] == 1
+        assert c.total == 1
+        flushes.append(group.flush())
+    for j, (exprs, agg, chunk_fn) in enumerate(parts):
+        solo = fused_source_agg_epoch(chunk_fn, exprs, agg.core, CAP)
+        st, start = agg.core.init_state(), 0
+        for e in range(2):
+            key = jax.random.fold_in(jax.random.PRNGKey(100 + j), e)
+            st, chunks = _solo_epoch_and_flush(solo, agg, st, start,
+                                               key, k)
+            start += k * CAP
+            assert _rows(flushes[e][f"mv{j}"]) == _rows(chunks)
+
+
+def test_padded_flush_emits_retraction_churn():
+    tc = TickCompiler()
+    for j in range(2):
+        exprs, agg, chunk_fn = _parts(window_us=1_000_000 * (j + 1))
+        tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=j),
+               agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+    tc.ensure_compiled()
+    group = tc.groups[0]
+    group.run_epoch(4)
+    group.flush()
+    group.run_epoch(4)
+    outs = group.flush()
+    ops = np.concatenate([np.asarray(c.ops)[np.asarray(c.vis)]
+                          for c in outs["mv0"]])
+    assert (ops == OP_UPDATE_DELETE).any()
+    assert (ops == OP_UPDATE_INSERT).any()
+
+
+# ---------------------------------------------------------------------------
+# tier 2: mega-epochs — dispatch count + parity vs solo
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n_jobs", [2, pytest.param(8, marks=pytest.mark.slow)])
+def test_mega_group_one_dispatch_bit_exact_vs_solo(n_jobs):
+    """Jobs sharing NO skeleton (different agg-call lists) concatenated
+    into one compiled dispatch: per-job states AND flush chunks are
+    bit-identical to the solo fused path — tier 2 never pads, so even
+    the slot layout coincides."""
+    callsets = [
+        [count_star()],
+        [count_star(), agg_call("max", 2, INT64)],
+        [count_star(), agg_call("sum", 2, INT64)],
+        [agg_call("min", 2, INT64)],
+        [agg_call("sum", 2, INT64)],
+        [agg_call("max", 2, INT64)],
+        [count_star(), agg_call("min", 2, INT64)],
+        [agg_call("sum", 2, INT64), agg_call("max", 2, INT64)],
+    ][:n_jobs]
+    with count_dispatches() as c:
+        tc = TickCompiler()
+        parts = []
+        for j, calls in enumerate(callsets):
+            exprs, agg, chunk_fn = _parts(calls=calls)
+            parts.append((exprs, agg, chunk_fn))
+            tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=200 + j),
+                   agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+        tc.ensure_compiled()
+        assert [g.kind for g in tc.groups] == ["mega"]
+        group = tc.groups[0]
+        k = 4
+        group.run_epoch(k)
+        flushes = [group.flush()]
+        c.reset()
+        group.run_epoch(k)
+        assert c.counts[MEGA_EPOCH_FN] == 1
+        assert c.total == 1
+        flushes.append(group.flush())
+    for j, (exprs, agg, chunk_fn) in enumerate(parts):
+        solo = fused_source_agg_epoch(chunk_fn, exprs, agg.core, CAP)
+        st, start = agg.core.init_state(), 0
+        for e in range(2):
+            key = jax.random.fold_in(jax.random.PRNGKey(200 + j), e)
+            st, chunks = _solo_epoch_and_flush(solo, agg, st, start,
+                                               key, k)
+            start += k * CAP
+            got = flushes[e][f"mv{j}"]
+            assert len(got) == len(chunks)
+            for ca, cb in zip(got, chunks):
+                _assert_tree_equal(ca, cb)
+        _assert_tree_equal(group.state_of(f"mv{j}"), st)
+
+
+# ---------------------------------------------------------------------------
+# DDL: recompilation, drop-one-member, the epochs-retired ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ddl_recompile_drop_one_member_and_retire_ledger():
+    """Dropping ONE member of a padded group dissolves the schedule,
+    retires its epochs under the dispatch qualname, and the survivors
+    recompile + continue from their written-back cursors/states —
+    per-job results stay row-equal to uninterrupted solo runs."""
+    tc = TickCompiler()
+    parts = []
+    for j in range(3):
+        exprs, agg, chunk_fn = _parts(window_us=1_000_000 * (j + 1))
+        parts.append((exprs, agg, chunk_fn))
+        tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=300 + j),
+               agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+    tc.ensure_compiled()
+    k = 4
+    tc.groups[0].run_epoch(k)
+    flush0 = tc.groups[0].flush()
+    dropped_state = tc.remove("mv1")
+    assert dropped_state is not None and tc.dirty
+    assert tc.take_retired() == {PADDED_EPOCH_FN: 1}
+    assert tc.take_retired() == {}               # drained
+    tc.ensure_compiled()
+    assert tc.stats()["schedule_compiles"] == 2
+    group = tc.groups[0]
+    assert group.names == ["mv0", "mv2"]
+    group.run_epoch(k)
+    flush1 = group.flush()
+    for j in (0, 2):
+        exprs, agg, chunk_fn = parts[j]
+        solo = fused_source_agg_epoch(chunk_fn, exprs, agg.core, CAP)
+        st, start = agg.core.init_state(), 0
+        for e in range(2):
+            key = jax.random.fold_in(jax.random.PRNGKey(300 + j), e)
+            st, chunks = _solo_epoch_and_flush(solo, agg, st, start,
+                                               key, k)
+            start += k * CAP
+            got = (flush0 if e == 0 else flush1)[f"mv{j}"]
+            assert _rows(got) == _rows(chunks)
+
+
+def test_recovery_onto_recompiled_schedule_ops_level():
+    """Checkpoint-shaped round trip: export every member's (padded)
+    state + cursors, rebuild a FRESH compiler from the exports,
+    continue both — row-equal flushes. Proves padded states re-enter a
+    recompiled schedule exactly (class capacity is monotone: a member
+    padded by the old schedule never shrinks)."""
+    def build():
+        tc = TickCompiler()
+        for j in range(2):
+            exprs, agg, chunk_fn = _parts(
+                window_us=1_000_000 * (j + 1),
+                table_capacity=1 << (9 + j))
+            tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=400 + j),
+                   agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+        tc.ensure_compiled()
+        return tc
+
+    tc = build()
+    g = tc.groups[0]
+    g.run_epoch(4)
+    g.flush()
+
+    tc2 = TickCompiler()
+    for j in range(2):
+        exprs, agg, chunk_fn = _parts(
+            window_us=1_000_000 * (j + 1), table_capacity=1 << (9 + j))
+        host = jax.device_get(g.state_of(f"mv{j}"))      # checkpoint
+        state = jax.tree_util.tree_map(jnp.asarray, host)  # recovery
+        tc2.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=400 + j),
+                state, n_source_cols=N_SOURCE_COLS,
+                start=g.starts[j], batch_no=g.batch_nos[j])
+    tc2.ensure_compiled()
+    g.run_epoch(4)
+    f1 = g.flush()
+    g2 = tc2.groups[0]
+    g2.run_epoch(4)
+    f2 = g2.flush()
+    for name in f1:
+        assert _rows(f1[name]) == _rows(f2[name])
+
+
+# ---------------------------------------------------------------------------
+# the 200-small-MVs acceptance case (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_200_small_mvs_compile_to_at_most_8_dispatches():
+    """ISSUE 19 acceptance: 200 mixed small dissimilar MVs tick in <= 8
+    dispatches, and sampled members stay row-equal to their solo runs."""
+    cap, k = 64, 2
+    gen = DeviceBidGenerator(NexmarkConfig(chunk_capacity=cap))
+    chunk_fn = gen.chunk_fn()
+
+    def job(j):
+        kind = j % 4
+        if kind == 0:
+            return _parts(window_us=1_000_000 + j, table_capacity=256,
+                          cap=cap)
+        if kind == 1:
+            return _parts(window_us=2_000_000 + j, table_capacity=256,
+                          cap=cap,
+                          calls=[count_star(),
+                                 agg_call("sum", 2, INT64)])
+        if kind == 2:
+            return _parts(table_capacity=256, cap=cap, group_keys=(1,),
+                          calls=[agg_call("max", 2, INT64)])
+        return _parts(table_capacity=256, cap=cap, group_keys=(1, 2),
+                      calls=[count_star()])
+
+    with count_dispatches() as c:
+        tc = TickCompiler()
+        parts = {}
+        for j in range(200):
+            exprs, agg, _ = job(j)
+            parts[j] = (exprs, agg)
+            tc.add(f"mv{j}", _spec(exprs, agg, chunk_fn, seed=j,
+                                   cap=cap),
+                   agg.core.init_state(), n_source_cols=N_SOURCE_COLS)
+        tc.ensure_compiled()
+        n_groups = tc.stats()["dispatches_per_tick"]
+        assert n_groups <= 8, f"200 MVs need {n_groups} dispatches"
+        c.reset()
+        for g in tc.groups:
+            g.run_epoch(k)
+        assert (c.counts.get(PADDED_EPOCH_FN, 0)
+                + c.counts.get(MEGA_EPOCH_FN, 0)) == n_groups
+        flushes = {}
+        for g in tc.groups:
+            flushes.update(g.flush())
+    for j in (0, 1, 2, 3, 101):                  # one per class + extra
+        exprs, agg = parts[j]
+        solo = fused_source_agg_epoch(chunk_fn, exprs, agg.core, cap)
+        key = jax.random.fold_in(jax.random.PRNGKey(j), 0)
+        _, chunks = _solo_epoch_and_flush(solo, agg,
+                                          agg.core.init_state(), 0,
+                                          key, k)
+        assert _rows(flushes[f"mv{j}"]) == _rows(chunks)
+
+
+# ---------------------------------------------------------------------------
+# Session integration: routing, recovery, pipeline depth
+# ---------------------------------------------------------------------------
+
+SRC_SQL = """CREATE SOURCE bid (auction BIGINT, bidder BIGINT,
+    price BIGINT, channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+    extra VARCHAR) WITH (connector = 'nexmark', nexmark_table = 'bid')"""
+
+# three MVs, two shape classes: h0/h1 differ only in a literal (padded
+# supergroup); h2 has different agg calls (mega singleton)
+MV_SQLS = (
+    "CREATE MATERIALIZED VIEW h0 AS SELECT auction, "
+    "sum(price + 100) AS s FROM bid GROUP BY auction",
+    "CREATE MATERIALIZED VIEW h1 AS SELECT auction, "
+    "sum(price + 999) AS s FROM bid GROUP BY auction",
+    "CREATE MATERIALIZED VIEW h2 AS SELECT bidder, count(*) AS c, "
+    "max(price) AS m FROM bid GROUP BY bidder",
+)
+
+
+def _session(tmp_path=None, tick_compiler=True, **kw):
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend.build import BuildConfig
+    return Session(config=BuildConfig(tick_compiler=tick_compiler,
+                                      agg_table_capacity=1 << 12),
+                   source_chunk_capacity=CAP,
+                   data_dir=str(tmp_path) if tmp_path else None, **kw)
+
+
+def test_session_schedule_and_dispatch_counts():
+    with count_dispatches() as c:
+        s = _session()
+        try:
+            s.run_sql(SRC_SQL)
+            for sql in MV_SQLS:
+                s.run_sql(sql)
+            s.tick()                      # compiles the schedule
+            st = s.metrics()["hetero"]
+            assert st["jobs"] == 3
+            assert st["dispatches_per_tick"] == 2
+            assert sorted(g["kind"] for g in st["groups"]) == \
+                ["mega", "padded"]
+            c.reset()
+            s.tick()
+            assert c.counts[PADDED_EPOCH_FN] == 1
+            assert c.counts[MEGA_EPOCH_FN] == 1
+            # attribution weights cover every member
+            attr = st["attribution"]
+            assert set(attr[PADDED_EPOCH_FN]) == {"h0", "h1"}
+            assert set(attr[MEGA_EPOCH_FN]) == {"h2"}
+            # h0 vs h1: same groups, different literal => values differ
+            r0 = dict(s.run_sql("SELECT auction, s FROM h0"))
+            r1 = dict(s.run_sql("SELECT auction, s FROM h1"))
+            assert set(r0) == set(r1)
+            assert any(r0[a] != r1[a] for a in r0)
+        finally:
+            s.close()
+
+
+@pytest.mark.slow
+def test_session_matches_coscheduler_results():
+    """The compiled schedule must agree with the PROVEN engine: the
+    same CREATEs under [streaming] coschedule = true (signature-equal
+    grouping) produce identical MV contents."""
+    def run(**flags):
+        from risingwave_tpu.frontend import Session
+        from risingwave_tpu.frontend.build import BuildConfig
+        s = Session(config=BuildConfig(agg_table_capacity=1 << 12,
+                                       **flags),
+                    source_chunk_capacity=CAP)
+        try:
+            s.run_sql(SRC_SQL)
+            for sql in MV_SQLS:
+                s.run_sql(sql)
+            for _ in range(3):
+                s.tick()
+            return [sorted(map(tuple, s.run_sql(f"SELECT * FROM h{j}")))
+                    for j in range(3)]
+        finally:
+            s.close()
+
+    het = run(tick_compiler=True)
+    cos = run(coschedule=True)
+    assert het == cos
+
+
+def test_session_recovery_onto_recompiled_schedule(tmp_path):
+    """Checkpoint → close → reopen: the -- hetero markers route every
+    MV back through the compiler, recovered MV contents match the
+    committed ones, and ticking continues on the recompiled schedule
+    (including after a DROP between the two sessions)."""
+    s = _session(tmp_path, checkpoint_frequency=2)
+    s.run_sql(SRC_SQL)
+    for sql in MV_SQLS:
+        s.run_sql(sql)
+    for _ in range(5):                 # epochs 2..6; checkpoints at 2,4,6
+        s.tick()
+    committed = [sorted(map(tuple, s.run_sql(f"SELECT * FROM h{j}")))
+                 for j in range(3)]
+    s.close()
+
+    s2 = _session(tmp_path, checkpoint_frequency=2)
+    try:
+        got = [sorted(map(tuple, s2.run_sql(f"SELECT * FROM h{j}")))
+               for j in range(3)]
+        assert got == committed
+        st = s2.metrics()["hetero"]
+        assert st["jobs"] == 3
+        for _ in range(3):
+            s2.tick()
+        st = s2.metrics()["hetero"]
+        assert sorted(g["kind"] for g in st["groups"]) == \
+            ["mega", "padded"]
+        after = [sorted(map(tuple, s2.run_sql(f"SELECT * FROM h{j}")))
+                 for j in range(3)]
+        assert sum(len(r) for r in after) > 0
+        # drop one padded member; the survivor set recompiles cleanly
+        s2.run_sql("DROP MATERIALIZED VIEW h1")
+        for _ in range(2):
+            s2.tick()
+        assert s2.metrics()["hetero"]["jobs"] == 2
+    finally:
+        s2.close()
+
+
+def test_session_recovery_refuses_without_flag(tmp_path):
+    s = _session(tmp_path, checkpoint_frequency=2)
+    s.run_sql(SRC_SQL)
+    s.run_sql(MV_SQLS[0])
+    s.tick()
+    s.close()
+    from risingwave_tpu.frontend.session import SqlError
+    with pytest.raises(SqlError, match="tick-compiled"):
+        _session(tmp_path, tick_compiler=False, checkpoint_frequency=2)
+
+
+@pytest.mark.slow
+def test_session_pipeline_depth2_bit_exact_at_drain():
+    """pipeline_depth=2 defers each group's packed flush one tick; at
+    the drain (flush) the MV contents must be bit-exact vs depth 1, and
+    the per-qualname dispatch counts identical (reordered, never
+    added)."""
+    def run(depth):
+        with count_dispatches() as c:
+            s = _session(chunks_per_tick=2, checkpoint_frequency=4,
+                         pipeline_depth=depth)
+            try:
+                s.run_sql(SRC_SQL)
+                for sql in MV_SQLS:
+                    s.run_sql(sql)
+                for _ in range(7):
+                    s.tick()
+                s.flush()
+                rows = [sorted(map(tuple,
+                                   s.run_sql(f"SELECT * FROM h{j}")))
+                        for j in range(3)]
+                counts = dict(c.counts)
+            finally:
+                s.close()
+        return rows, counts
+
+    rows1, counts1 = run(1)
+    rows2, counts2 = run(2)
+    assert rows1 == rows2
+    for qn in (PADDED_EPOCH_FN, MEGA_EPOCH_FN):
+        assert counts1.get(qn) == counts2.get(qn) and counts1.get(qn)
